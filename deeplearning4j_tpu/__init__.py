@@ -1,0 +1,26 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch framework with the capability surface of Deeplearning4j
+(reference: /root/reference, surveyed in SURVEY.md): builder-style network
+configuration with JSON round-trip, sequential (MultiLayerNetwork) and DAG
+(ComputationGraph) models, a full layer library, training driver with
+updaters/schedules/listeners, evaluation and gradient-check harnesses, Keras
+import, embedding models, and distributed training.
+
+Unlike the reference — eager per-op JNI dispatch into libnd4j with
+reflection-loaded cuDNN helpers (see SURVEY.md §3.1) — every model here traces
+to a single XLA program: forward + backward + updater fuse into one compiled
+step executed on TPU, and gradient synchronization is an in-program collective
+over the ICI mesh (`jax.sharding` + `shard_map`) rather than host-staged
+parameter averaging.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

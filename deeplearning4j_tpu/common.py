@@ -1,0 +1,11 @@
+"""Small shared helpers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def promote_score(x: jax.Array) -> jax.Array:
+    """Promote a loss value to at least float32 (bfloat16 training still
+    accumulates scores in f32; float64 gradient-check mode stays f64)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    DataSet,
+    ListDataSetIterator,
+    ExistingDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.datasets.impl import (  # noqa: F401
+    MnistDataSetIterator,
+    IrisDataSetIterator,
+    DigitsDataSetIterator,
+)

@@ -1,0 +1,203 @@
+"""DataSet container + iterator utilities.
+
+Parity with the reference's data layer (reference: ND4J `DataSet` +
+`DataSetIterator` interface consumed at
+deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:947, and the
+wrappers in deeplearning4j-nn/.../datasets/iterator/: AsyncDataSetIterator
+(background prefetch thread + queue), MultipleEpochsIterator,
+ExistingDataSetIterator).
+
+TPU note: AsyncDataSetIterator overlaps host-side batch preparation with
+device execution — the same role as the reference's prefetch thread; the jit
+dispatch is already asynchronous, so one worker + small queue suffices to
+keep the chip fed.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    """features/labels (+ optional masks), mirroring ND4J DataSet."""
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features).shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        f, l = np.asarray(self.features), np.asarray(self.labels)
+        train = DataSet(f[:n_train], l[:n_train])
+        test = DataSet(f[n_train:], l[n_train:])
+        return train, test
+
+    def shuffle(self, seed: int = 123) -> None:
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(self.num_examples())
+        self.features = np.asarray(self.features)[perm]
+        self.labels = np.asarray(self.labels)[perm]
+        if self.features_mask is not None:
+            self.features_mask = np.asarray(self.features_mask)[perm]
+        if self.labels_mask is not None:
+            self.labels_mask = np.asarray(self.labels_mask)[perm]
+
+
+class BaseDatasetIterator:
+    """Iterate minibatches over in-memory arrays."""
+
+    def __init__(self, features, labels, batch_size: int,
+                 features_mask=None, labels_mask=None,
+                 drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None \
+            else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None \
+            else np.asarray(labels_mask)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._cursor = 0
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        n = self.features.shape[0]
+        if self._cursor >= n:
+            raise StopIteration
+        end = min(self._cursor + self.batch_size, n)
+        if self.drop_last and end - self._cursor < self.batch_size:
+            raise StopIteration
+        sl = slice(self._cursor, end)
+        self._cursor = end
+        return DataSet(
+            self.features[sl], self.labels[sl],
+            None if self.features_mask is None else self.features_mask[sl],
+            None if self.labels_mask is None else self.labels_mask[sl])
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def input_columns(self) -> int:
+        return int(np.prod(self.features.shape[1:]))
+
+    def total_outcomes(self) -> int:
+        return int(self.labels.shape[-1])
+
+
+class ListDataSetIterator(BaseDatasetIterator):
+    """From a list of DataSets (reference: ListDataSetIterator)."""
+
+    def __init__(self, datasets: List[DataSet], batch_size: int):
+        feats = np.concatenate([np.asarray(d.features) for d in datasets])
+        labs = np.concatenate([np.asarray(d.labels) for d in datasets])
+        super().__init__(feats, labs, batch_size)
+
+
+class ExistingDataSetIterator:
+    """Wrap any iterable of DataSets (reference:
+    ExistingDataSetIterator.java)."""
+
+    def __init__(self, iterable: Iterable[DataSet]):
+        self._iterable = list(iterable)
+        self._it: Optional[Iterator] = None
+
+    def __iter__(self):
+        self._it = iter(self._iterable)
+        return self
+
+    def __next__(self):
+        if self._it is None:
+            self._it = iter(self._iterable)
+        return next(self._it)
+
+    def reset(self):
+        self._it = None
+
+
+class AsyncDataSetIterator:
+    """Background-thread prefetch (reference:
+    datasets/iterator/AsyncDataSetIterator.java — used automatically by
+    MultiLayerNetwork.fit at MultiLayerNetwork.java:951)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base, queue_size: int = 2):
+        self.base = base
+        self.queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _worker(self):
+        try:
+            for item in self.base:
+                self._queue.put(item)
+        except BaseException as e:  # propagate to consumer
+            self._error = e
+        finally:
+            self._queue.put(self._SENTINEL)
+
+    def __iter__(self):
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        return self
+
+    def __next__(self):
+        if self._queue is None:
+            iter(self)
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the worker can exit
+            while True:
+                item = self._queue.get()
+                if item is self._SENTINEL:
+                    break
+            self._thread.join(timeout=5)
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+        self._queue = None
+        self._thread = None
+
+
+class MultipleEpochsIterator:
+    """Repeat a base iterator for N epochs (reference:
+    MultipleEpochsIterator.java)."""
+
+    def __init__(self, num_epochs: int, base):
+        self.num_epochs = num_epochs
+        self.base = base
+
+    def __iter__(self):
+        def gen():
+            for _ in range(self.num_epochs):
+                for item in self.base:
+                    yield item
+                if hasattr(self.base, "reset"):
+                    self.base.reset()
+        return gen()
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass  # noqa: F401

@@ -1,0 +1,189 @@
+"""Classification evaluation: accuracy/precision/recall/F1, confusion matrix,
+top-N accuracy.
+
+Parity with the reference's Evaluation (reference:
+deeplearning4j-nn/.../eval/Evaluation.java:46, eval():194, 1,104 LoC, and
+eval/ConfusionMatrix.java). Batch accumulation happens on-device (argmax +
+one bincount-style scatter per batch); only the small [C, C] confusion matrix
+lives on host.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _confusion_update(labels: Array, predictions: Array, num_classes: int,
+                      mask: Optional[Array] = None) -> Array:
+    """Return a [C, C] confusion-count matrix for one batch.
+    rows = actual, cols = predicted."""
+    idx = labels * num_classes + predictions
+    weights = None if mask is None else mask.reshape(-1).astype(jnp.float32)
+    counts = jnp.bincount(idx.reshape(-1), weights=weights,
+                          length=num_classes * num_classes)
+    return counts.reshape(num_classes, num_classes)
+
+
+class ConfusionMatrix:
+    """Accumulating [actual, predicted] count matrix."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, batch_matrix) -> None:
+        self.matrix += np.asarray(batch_matrix, dtype=np.int64)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def actual_total(self, cls: int) -> int:
+        return int(self.matrix[cls].sum())
+
+    def predicted_total(self, cls: int) -> int:
+        return int(self.matrix[:, cls].sum())
+
+
+class Evaluation:
+    """Accumulates classification metrics over batches."""
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.top_n = top_n
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.top_n_correct = 0
+        self.top_n_total = 0
+
+    # ------------------------------------------------------------------ eval
+    def eval(self, labels, predictions, mask=None) -> None:
+        """Accumulate one batch. ``labels`` one-hot (or class indices),
+        ``predictions`` probabilities/scores [B, C] (reference:
+        Evaluation.eval:194). Sequence outputs [B, T, C] are flattened with
+        the mask applied."""
+        labels = jnp.asarray(labels)
+        predictions = jnp.asarray(predictions)
+        if predictions.ndim == 3:  # [B, T, C] sequence output
+            c = predictions.shape[-1]
+            predictions = predictions.reshape(-1, c)
+            labels = labels.reshape(-1, c) if labels.ndim == 3 \
+                else labels.reshape(-1)
+            if mask is not None:
+                mask = jnp.asarray(mask).reshape(-1)
+        c = predictions.shape[-1]
+        if self.num_classes is None:
+            self.num_classes = c
+            self.confusion = ConfusionMatrix(c)
+        elif self.confusion is None:
+            self.confusion = ConfusionMatrix(self.num_classes)
+        lab_idx = labels.argmax(-1) if labels.ndim > 1 \
+            else labels.astype(jnp.int32)
+        pred_idx = predictions.argmax(-1)
+        cm = _confusion_update(lab_idx.astype(jnp.int32),
+                               pred_idx.astype(jnp.int32), self.num_classes,
+                               None if mask is None else jnp.asarray(mask))
+        self.confusion.add(cm)
+        if self.top_n > 1:
+            topk = jnp.argsort(predictions, axis=-1)[:, -self.top_n:]
+            hit = jnp.any(topk == lab_idx[:, None], axis=-1)
+            if mask is not None:
+                m = jnp.asarray(mask).reshape(-1) > 0
+                self.top_n_correct += int(jnp.sum(hit & m))
+                self.top_n_total += int(jnp.sum(m))
+            else:
+                self.top_n_correct += int(jnp.sum(hit))
+                self.top_n_total += int(hit.shape[0])
+
+    # --------------------------------------------------------------- metrics
+    def _m(self) -> np.ndarray:
+        if self.confusion is None:
+            raise ValueError("No batches evaluated yet")
+        return self.confusion.matrix
+
+    def accuracy(self) -> float:
+        m = self._m()
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def top_n_accuracy(self) -> float:
+        if self.top_n_total == 0:
+            return self.accuracy()
+        return self.top_n_correct / self.top_n_total
+
+    def true_positives(self, cls: int) -> int:
+        return int(self._m()[cls, cls])
+
+    def false_positives(self, cls: int) -> int:
+        m = self._m()
+        return int(m[:, cls].sum() - m[cls, cls])
+
+    def false_negatives(self, cls: int) -> int:
+        m = self._m()
+        return int(m[cls].sum() - m[cls, cls])
+
+    def true_negatives(self, cls: int) -> int:
+        m = self._m()
+        return int(m.sum() - m[cls].sum() - m[:, cls].sum() + m[cls, cls])
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self.true_positives(cls) + self.false_positives(cls)
+            return self.true_positives(cls) / denom if denom else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if self._m()[:, i].sum() + self._m()[i].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            denom = self.true_positives(cls) + self.false_negatives(cls)
+            return self.true_positives(cls) / denom if denom else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if self._m()[i].sum() + self._m()[:, i].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self) -> str:
+        """Pretty-printed summary (reference: Evaluation.stats())."""
+        m = self._m()
+        names = self.label_names or [str(i) for i in range(self.num_classes)]
+        lines = ["=" * 60,
+                 f"Examples: {int(m.sum())}",
+                 f"Accuracy:  {self.accuracy():.4f}",
+                 f"Precision: {self.precision():.4f}",
+                 f"Recall:    {self.recall():.4f}",
+                 f"F1 Score:  {self.f1():.4f}"]
+        if self.top_n > 1:
+            lines.append(f"Top-{self.top_n} Accuracy: "
+                         f"{self.top_n_accuracy():.4f}")
+        lines.append("=" * 60)
+        lines.append("Confusion matrix (rows=actual, cols=predicted):")
+        header = "      " + " ".join(f"{n[:5]:>6}" for n in names)
+        lines.append(header)
+        for i, row in enumerate(m):
+            lines.append(f"{names[i][:5]:>5} "
+                         + " ".join(f"{int(v):>6}" for v in row))
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation") -> None:
+        """Merge another Evaluation (the reference's spark-side merge)."""
+        if other.confusion is None:
+            return
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.add(other.confusion.matrix)
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total

@@ -1,0 +1,111 @@
+"""ROC / AUC evaluation (binary and multi-class).
+
+Parity with the reference's thresholded ROC (reference:
+deeplearning4j-nn/.../eval/ROC.java, 299 LoC, and ROCMultiClass.java):
+``threshold_steps`` evenly spaced thresholds accumulate TP/FP/FN/TN counts
+per batch; AUC via trapezoidal integration over the resulting curve. Count
+accumulation is one vectorized [steps] reduction per batch on device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _counts_at_thresholds(labels: Array, probs: Array, thresholds: Array):
+    """labels/probs: [N]; thresholds: [S]. Returns (tp, fp, fn, tn) [S]."""
+    pred = probs[None, :] >= thresholds[:, None]  # [S, N]
+    pos = labels[None, :] > 0.5
+    tp = jnp.sum(pred & pos, axis=1)
+    fp = jnp.sum(pred & ~pos, axis=1)
+    fn = jnp.sum(~pred & pos, axis=1)
+    tn = jnp.sum(~pred & ~pos, axis=1)
+    return tp, fp, fn, tn
+
+
+_counts_jit = jax.jit(_counts_at_thresholds)
+
+
+class ROC:
+    """Binary ROC. ``eval`` takes labels/probabilities for the positive
+    class ([N] or [N, 1] or [N, 2] one-hot/softmax)."""
+
+    def __init__(self, threshold_steps: int = 30):
+        self.threshold_steps = threshold_steps
+        self.thresholds = np.linspace(0.0, 1.0, threshold_steps + 1)
+        s = threshold_steps + 1
+        self.tp = np.zeros(s, np.int64)
+        self.fp = np.zeros(s, np.int64)
+        self.fn = np.zeros(s, np.int64)
+        self.tn = np.zeros(s, np.int64)
+
+    def eval(self, labels, predictions, mask: Optional[np.ndarray] = None
+             ) -> None:
+        labels = jnp.asarray(labels)
+        predictions = jnp.asarray(predictions)
+        if predictions.ndim == 2 and predictions.shape[-1] == 2:
+            predictions = predictions[:, 1]
+            labels = labels[:, 1] if labels.ndim == 2 else labels
+        labels = labels.reshape(-1)
+        predictions = predictions.reshape(-1)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels = labels[jnp.asarray(keep)]
+            predictions = predictions[jnp.asarray(keep)]
+        tp, fp, fn, tn = _counts_jit(labels.astype(jnp.float32),
+                                     predictions.astype(jnp.float32),
+                                     jnp.asarray(self.thresholds,
+                                                 jnp.float32))
+        self.tp += np.asarray(tp, np.int64)
+        self.fp += np.asarray(fp, np.int64)
+        self.fn += np.asarray(fn, np.int64)
+        self.tn += np.asarray(tn, np.int64)
+
+    def get_roc_curve(self):
+        """Returns (fpr, tpr) arrays ordered by increasing threshold."""
+        tpr = self.tp / np.maximum(self.tp + self.fn, 1)
+        fpr = self.fp / np.maximum(self.fp + self.tn, 1)
+        return fpr, tpr
+
+    def get_precision_recall_curve(self):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1)
+        return rec, prec
+
+    def calculate_auc(self) -> float:
+        fpr, tpr = self.get_roc_curve()
+        order = np.argsort(fpr, kind="stable")
+        x = np.concatenate([[0.0], fpr[order], [1.0]])
+        y = np.concatenate([[0.0], tpr[order], [1.0]])
+        return float(np.trapezoid(y, x))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference: eval/ROCMultiClass.java)."""
+
+    def __init__(self, threshold_steps: int = 30):
+        self.threshold_steps = threshold_steps
+        self.per_class: dict = {}
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        c = predictions.shape[-1]
+        for i in range(c):
+            roc = self.per_class.setdefault(i, ROC(self.threshold_steps))
+            lab = labels[:, i] if labels.ndim == 2 else (labels == i)
+            roc.eval(lab.astype(np.float32), predictions[:, i], mask)
+
+    def calculate_auc(self, cls: int) -> float:
+        return self.per_class[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        if not self.per_class:
+            return 0.0
+        return float(np.mean([r.calculate_auc()
+                              for r in self.per_class.values()]))

@@ -1,0 +1,113 @@
+"""Activation functions.
+
+Capability parity with the reference's ND4J activation set consumed by DL4J
+layer configs (reference: deeplearning4j-nn/.../nn/conf/layers/*.java
+`activation` field; the functions themselves live in external ND4J). Here they
+are plain jax functions — XLA fuses them into the surrounding matmul, which is
+the TPU-native replacement for ND4J's per-op transform kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def identity(x: Array) -> Array:
+    return x
+
+
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+def leakyrelu(x: Array, alpha: float = 0.01) -> Array:
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x: Array, alpha: float = 1.0) -> Array:
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x: Array) -> Array:
+    return jax.nn.selu(x)
+
+
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+def softmax(x: Array) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def hardsigmoid(x: Array) -> Array:
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def rationaltanh(x: Array) -> Array:
+    # 1.7159 * tanh(2x/3) approximation via rational function, as in ND4J.
+    a = jnp.abs(2.0 * x / 3.0)
+    rational = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a ** 4)
+    return 1.7159 * jnp.sign(x) * rational
+
+
+def rectifiedtanh(x: Array) -> Array:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def leakyrelu_derivative_free(x: Array) -> Array:  # pragma: no cover - alias
+    return leakyrelu(x)
+
+
+ACTIVATIONS = {
+    "identity": identity,
+    "linear": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "softplus": softplus,
+    "softsign": softsign,
+    "softmax": softmax,
+    "hardtanh": hardtanh,
+    "hardsigmoid": hardsigmoid,
+    "cube": cube,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+}
+
+
+def get_activation(name):
+    """Resolve an activation by name (or pass a callable through)."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

@@ -1,0 +1,9 @@
+from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+    GraphBuilder,
+    TrainingConfig,
+)
+from deeplearning4j_tpu.nn.conf import inputs, preprocessors  # noqa: F401
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401

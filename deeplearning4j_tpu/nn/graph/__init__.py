@@ -1,0 +1,16 @@
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph  # noqa: F401
+from deeplearning4j_tpu.nn.graph.vertices import (  # noqa: F401
+    GraphVertex,
+    MergeVertex,
+    ElementWiseVertex,
+    SubsetVertex,
+    StackVertex,
+    UnstackVertex,
+    ScaleVertex,
+    L2Vertex,
+    L2NormalizeVertex,
+    PreprocessorVertex,
+    LastTimeStepVertex,
+    DuplicateToTimeSeriesVertex,
+    ReshapeVertex,
+)

@@ -1,0 +1,350 @@
+"""ComputationGraph — the DAG model.
+
+Parity with the reference's ComputationGraph (reference:
+deeplearning4j-nn/.../nn/graph/ComputationGraph.java, 2,447 LoC:
+topologicalSortOrder():888, fit(DataSetIterator):701,
+fit(MultiDataSetIterator):783, multi-input/multi-output execution). Executes
+vertices in topological order inside ONE traced function; forward + all
+output losses + backward + update jit into a single XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.common import promote_score
+from deeplearning4j_tpu.nn.conf.configuration import (
+    ComputationGraphConfiguration)
+from deeplearning4j_tpu.nn.conf.preprocessors import infer_preprocessor
+from deeplearning4j_tpu.nn.graph.vertices import GraphVertex
+from deeplearning4j_tpu.nn.layers.base import Layer, apply_dropout
+from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import _dtype_of, _unpack_batch
+from deeplearning4j_tpu.train.updaters import (apply_updater,
+                                               init_updater_state)
+
+Array = jax.Array
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo = conf.topological_order()
+        self.dtype = _dtype_of(conf.training.dtype)
+        self.params: Dict[str, Dict[str, Array]] = {}
+        self.state: Dict[str, Dict[str, Array]] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.listeners: List[Any] = []
+        self.score_value = float("nan")
+        self._jit_cache: Dict[Any, Any] = {}
+        self._preprocessors: Dict[str, Any] = {}
+        self._initialized = False
+        self._resolve_shapes()
+
+    # ---------------------------------------------------------------- shapes
+    def _resolve_shapes(self) -> None:
+        """Propagate InputTypes through the topo order, set layer n_in, and
+        auto-insert preprocessors on family changes (reference:
+        ComputationGraphConfiguration.addPreProcessors)."""
+        types: Dict[str, Any] = dict(self.conf.input_types)
+        if not types:
+            # no declared input types: layers must carry explicit n_in
+            for name in self.topo:
+                spec = self.conf.vertices[name]
+                v = spec.vertex
+                if isinstance(v, Layer) and getattr(v, "n_in", None) is None \
+                        and type(v).__name__ not in ("ActivationLayer",):
+                    pass
+            return
+        for name in self.topo:
+            spec = self.conf.vertices[name]
+            v = spec.vertex
+            in_types = [types[i] for i in spec.inputs if i in types]
+            if not in_types:
+                continue
+            if isinstance(v, Layer):
+                t = in_types[0]
+                pre = infer_preprocessor(t, v.input_family)
+                if pre is not None:
+                    self._preprocessors[name] = pre
+                    t = pre.output_type(t)
+                types[name] = v.update_input_type(t)
+            else:
+                types[name] = v.output_type(in_types)
+        self.resolved_types = types
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        seed = self.conf.training.seed if seed is None else seed
+        root = jax.random.PRNGKey(seed)
+        for i, name in enumerate(self.topo):
+            v = self.conf.vertices[name].vertex
+            if isinstance(v, Layer):
+                key = jax.random.fold_in(root, i)
+                self.params[name] = v.init_params(key, self.dtype)
+                self.state[name] = v.init_state(self.dtype)
+            else:
+                self.params[name] = {}
+                self.state[name] = {}
+        self.updater_state = init_updater_state(self.conf.training,
+                                                self.params)
+        self._initialized = True
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, inputs: Dict[str, Array], *,
+                 train: bool, key, masks: Optional[Dict[str, Array]] = None
+                 ) -> Tuple[Dict[str, Array], Dict[str, Any]]:
+        values: Dict[str, Array] = {}
+        for k, v in inputs.items():
+            values[k] = v.astype(self.dtype) \
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+        new_state: Dict[str, Any] = {}
+        masks = masks or {}
+        for i, name in enumerate(self.topo):
+            spec = self.conf.vertices[name]
+            v = spec.vertex
+            ins = [values[n] for n in spec.inputs]
+            in_masks = [masks.get(n) for n in spec.inputs]
+            if isinstance(v, Layer):
+                h = ins[0]
+                pre = self._preprocessors.get(name)
+                if pre is not None:
+                    h = pre.pre_process(h)
+                lkey = jax.random.fold_in(key, i) if key is not None else None
+                if train and (v.dropout or 0.0) > 0 and lkey is not None:
+                    h = apply_dropout(h, v.dropout, lkey)
+                h, st = v.apply(params[name], state.get(name, {}), h,
+                                train=train, key=lkey, mask=in_masks[0])
+                values[name] = h
+                new_state[name] = st
+                if in_masks[0] is not None and v.family == "rnn":
+                    masks[name] = in_masks[0]
+            else:
+                values[name] = v.apply(ins, masks=in_masks)
+                new_state[name] = state.get(name, {})
+        return values, new_state
+
+    def _loss_fn(self, params, state, inputs, labels: Dict[str, Array], key,
+                 masks=None, train=True):
+        values, new_state = self._forward_preout(params, state, inputs,
+                                                 key=key, masks=masks,
+                                                 train=train)
+        total = jnp.asarray(0.0)
+        for out_name in self.conf.network_outputs:
+            layer = self.conf.vertices[out_name].vertex
+            h_in, mask = values[out_name]
+            total = total + promote_score(layer.loss(params[out_name], h_in,
+                                                labels[out_name], mask))
+        total = total + self._regularization_score(params)
+        return total, new_state
+
+    def _forward_preout(self, params, state, inputs, *, key, masks=None,
+                        train=True):
+        """Forward in train mode, but for output layers record their INPUT
+        (pre-layer activation) so the loss can use fused pre-output forms."""
+        values: Dict[str, Array] = {}
+        for k, v in inputs.items():
+            values[k] = v.astype(self.dtype) \
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+        new_state: Dict[str, Any] = {}
+        masks = dict(masks or {})
+        out_records: Dict[str, Tuple[Array, Optional[Array]]] = {}
+        outputs = set(self.conf.network_outputs)
+        for i, name in enumerate(self.topo):
+            spec = self.conf.vertices[name]
+            v = spec.vertex
+            ins = [values[n] for n in spec.inputs]
+            in_masks = [masks.get(n) for n in spec.inputs]
+            if isinstance(v, Layer):
+                h = ins[0]
+                pre = self._preprocessors.get(name)
+                if pre is not None:
+                    h = pre.pre_process(h)
+                lkey = jax.random.fold_in(key, i) if key is not None else None
+                if train and (v.dropout or 0.0) > 0 and lkey is not None:
+                    h = apply_dropout(h, v.dropout, lkey)
+                if name in outputs and hasattr(v, "loss"):
+                    out_records[name] = (h, in_masks[0])
+                h, st = v.apply(params[name], state.get(name, {}), h,
+                                train=train, key=lkey, mask=in_masks[0])
+                values[name] = h
+                new_state[name] = st
+                if in_masks[0] is not None and v.family == "rnn":
+                    masks[name] = in_masks[0]
+            else:
+                values[name] = v.apply(ins, masks=in_masks)
+                new_state[name] = state.get(name, {})
+        for name in outputs:
+            if name not in out_records:
+                raise ValueError(f"Output '{name}' is not a loss-bearing "
+                                 f"layer")
+        return out_records, new_state
+
+    def _regularization_score(self, params) -> Array:
+        total = jnp.asarray(0.0)
+        for name in self.topo:
+            v = self.conf.vertices[name].vertex
+            if not isinstance(v, Layer):
+                continue
+            l1 = v.l1 or 0.0
+            l2 = v.l2 or 0.0
+            if (l1 == 0.0 and l2 == 0.0) or not params.get(name):
+                continue
+            for k in v.weight_param_keys():
+                if k not in params[name]:
+                    continue
+                w = promote_score(params[name][k])
+                if l2 > 0:
+                    total = total + 0.5 * l2 * jnp.sum(w * w)
+                if l1 > 0:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
+
+    # ------------------------------------------------------------------- fit
+    def _lr_multipliers(self):
+        base = self.conf.training.learning_rate
+        out = {}
+        for name in self.topo:
+            v = self.conf.vertices[name].vertex
+            lr = getattr(v, "learning_rate", None)
+            # explicit 0.0 is a valid per-layer LR (freezing) — test None
+            out[name] = (lr / base) if (lr is not None and base) else 1.0
+        return out
+
+    def _trainable(self):
+        return {name: not isinstance(self.conf.vertices[name].vertex,
+                                     FrozenLayer)
+                for name in self.topo}
+
+    def _make_train_step(self):
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def step(params, state, opt_state, iteration, inputs, labels, key,
+                 masks):
+            def loss_fn(p):
+                return self._loss_fn(p, state, inputs, labels, key, masks)
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = apply_updater(
+                tc, params, grads, opt_state, iteration,
+                lr_multipliers=lr_mult, trainable=trainable)
+            return new_params, new_state, new_opt, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, labels=None, masks=None) -> None:
+        """Train on a (Multi)DataSetIterator or arrays (reference:
+        ComputationGraph.fit:701/783)."""
+        if not self._initialized:
+            self.init()
+        if labels is not None:
+            self._fit_batch(data, labels, masks)
+            return
+        for batch in data:
+            feats, labs, fmask, lmask = _unpack_batch(batch)
+            self._fit_batch(feats, labs, lmask)
+        self.epoch_count += 1
+        if hasattr(data, "reset"):
+            data.reset()
+
+    def _as_input_dict(self, data, names) -> Dict[str, Array]:
+        if isinstance(data, dict):
+            return {k: jnp.asarray(v) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            return {n: jnp.asarray(d) for n, d in zip(names, data)}
+        return {names[0]: jnp.asarray(data)}
+
+    def _fit_batch(self, feats, labs, masks=None) -> None:
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        labels = self._as_input_dict(labs, self.conf.network_outputs)
+        mask_dict = None
+        if masks is not None:
+            mask_dict = self._as_input_dict(masks, self.conf.network_inputs)
+        shape_key = tuple(sorted((k, v.shape) for k, v in inputs.items()))
+        step = self._jit_cache.get(("train", shape_key))
+        if step is None:
+            step = self._make_train_step()
+            self._jit_cache[("train", shape_key)] = step
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.conf.training.seed),
+            self.iteration_count)
+        self.params, self.state, self.updater_state, score = step(
+            self.params, self.state, self.updater_state,
+            self.iteration_count, inputs, labels, key, mask_dict)
+        self.score_value = score
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration_count, self.score_value)
+        self.iteration_count += 1
+
+    # ------------------------------------------------------------- inference
+    def output(self, *data, train: bool = False) -> List[Array]:
+        """Output activations for each configured output (reference:
+        ComputationGraph.output)."""
+        if len(data) == 1:
+            inputs = self._as_input_dict(data[0], self.conf.network_inputs)
+        else:
+            inputs = self._as_input_dict(list(data),
+                                         self.conf.network_inputs)
+        fn = self._jit_cache.get(("output", train))
+        if fn is None:
+            def _out(params, state, inputs):
+                values, _ = self._forward(params, state, inputs, train=train,
+                                          key=None)
+                return [values[n] for n in self.conf.network_outputs]
+            fn = jax.jit(_out)
+            self._jit_cache[("output", train)] = fn
+        return fn(self.params, self.state, inputs)
+
+    def feed_forward(self, data, train: bool = False) -> Dict[str, Array]:
+        inputs = self._as_input_dict(data, self.conf.network_inputs)
+        values, _ = self._forward(self.params, self.state, inputs,
+                                  train=train, key=None)
+        return values
+
+    def score(self, feats, labs=None, masks=None) -> float:
+        if labs is None:
+            f, l, fm, lm = _unpack_batch(feats)
+            return self.score(f, l, lm)
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        labels = self._as_input_dict(labs, self.conf.network_outputs)
+        s, _ = self._loss_fn(self.params, self.state, inputs, labels, None,
+                             None if masks is None else
+                             self._as_input_dict(masks,
+                                                 self.conf.network_inputs),
+                             train=False)
+        return float(s)
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        for batch in iterator:
+            feats, labs, _, lmask = _unpack_batch(batch)
+            out = self.output(feats)
+            labs_d = self._as_input_dict(labs, self.conf.network_outputs)
+            ev.eval(labs_d[self.conf.network_outputs[0]], out[0], mask=lmask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    # ------------------------------------------------------------ flat views
+    def params_flat(self) -> Array:
+        flat, _ = ravel_pytree(self.params)
+        return flat
+
+    def set_params_flat(self, flat) -> None:
+        _, unravel = ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(flat))
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])

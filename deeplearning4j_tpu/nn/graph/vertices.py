@@ -1,0 +1,239 @@
+"""Graph vertices — the non-layer nodes of a ComputationGraph.
+
+Parity with the reference's vertex set (reference:
+deeplearning4j-nn/.../nn/conf/graph/*.java configs +
+nn/graph/vertex/impl/*.java implementations, incl. impl/rnn/ for
+LastTimeStepVertex and DuplicateToTimeSeriesVertex). The reference pairs each
+config with a hand-written doForward/doBackward; here a vertex is a single
+dataclass with a traced ``apply`` (autodiff supplies the backward).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+
+Array = jax.Array
+
+
+class GraphVertex:
+    """Base vertex: pure function of its input activations."""
+
+    def apply(self, inputs: List[Array], masks=None) -> Array:
+        raise NotImplementedError
+
+    def output_type(self, input_types: List):
+        return input_types[0]
+
+
+@register
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (trailing) axis (reference:
+    nn/conf/graph/MergeVertex.java)."""
+
+    def apply(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_type(self, input_types):
+        first = input_types[0]
+        if isinstance(first, it.InputTypeFeedForward):
+            return it.InputType.feed_forward(
+                sum(t.size for t in input_types))
+        if isinstance(first, it.InputTypeRecurrent):
+            return it.InputType.recurrent(
+                sum(t.size for t in input_types), first.time_series_length)
+        if isinstance(first, it.InputTypeConvolutional):
+            return it.InputType.convolutional(
+                first.height, first.width,
+                sum(t.channels for t in input_types))
+        raise ValueError(f"MergeVertex cannot merge {first}")
+
+
+@register
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Elementwise add/subtract/product/average/max of same-shaped inputs
+    (reference: nn/conf/graph/ElementWiseVertex.java)."""
+    op: str = "add"
+
+    def apply(self, inputs, masks=None):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op in ("product", "multiply"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op in ("average", "avg"):
+            return sum(inputs) / float(len(inputs))
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op '{self.op}'")
+
+
+@register
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-axis slice [from_idx, to_idx] inclusive (reference:
+    nn/conf/graph/SubsetVertex.java)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def apply(self, inputs, masks=None):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def output_type(self, input_types):
+        size = self.to_idx - self.from_idx + 1
+        t = input_types[0]
+        if isinstance(t, it.InputTypeRecurrent):
+            return it.InputType.recurrent(size, t.time_series_length)
+        return it.InputType.feed_forward(size)
+
+
+@register
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack along the batch axis (reference:
+    nn/conf/graph/StackVertex.java)."""
+
+    def apply(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice ``from_idx`` of ``stack_size`` equal batch-axis chunks
+    (reference: nn/conf/graph/UnstackVertex.java)."""
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step:(self.from_idx + 1) * step]
+
+
+@register
+@dataclass
+class ScaleVertex(GraphVertex):
+    """Multiply by a fixed scalar (reference:
+    nn/conf/graph/ScaleVertex.java)."""
+    scale_factor: float = 1.0
+
+    def apply(self, inputs, masks=None):
+        return inputs[0] * self.scale_factor
+
+
+@register
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs -> [B, 1] (reference:
+    nn/conf/graph/L2Vertex.java)."""
+    eps: float = 1e-8
+
+    def apply(self, inputs, masks=None):
+        a, b = inputs
+        d = a.reshape(a.shape[0], -1) - b.reshape(b.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True) + self.eps)
+
+    def output_type(self, input_types):
+        return it.InputType.feed_forward(1)
+
+
+@register
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """Normalize to unit L2 norm over the feature axes (reference:
+    nn/conf/graph/L2NormalizeVertex.java)."""
+    eps: float = 1e-8
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        flat = x.reshape(x.shape[0], -1)
+        norm = jnp.sqrt(jnp.sum(flat * flat, axis=-1) + self.eps)
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        return x / norm.reshape(shape)
+
+
+@register
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wrap an InputPreProcessor as a vertex (reference:
+    nn/conf/graph/PreprocessorVertex.java)."""
+    preprocessor: Optional[object] = None
+
+    def apply(self, inputs, masks=None):
+        return self.preprocessor.pre_process(inputs[0])
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+
+@register
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[B, T, F] -> [B, F] taking the last unmasked step (reference:
+    nn/graph/vertex/impl/rnn/LastTimeStepVertex.java)."""
+    mask_input: Optional[str] = None
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1]
+        idx = jnp.maximum(
+            jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)  # [B]
+        return jax.vmap(lambda seq, i: seq[i])(x, idx)
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        return it.InputType.feed_forward(t.size)
+
+
+@register
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B, F] -> [B, T, F] broadcasting over a reference sequence's length
+    (reference: nn/graph/vertex/impl/rnn/DuplicateToTimeSeriesVertex.java).
+    Second input supplies T."""
+    reference_input: Optional[str] = None
+
+    def apply(self, inputs, masks=None):
+        x, ref = inputs[0], inputs[1]
+        t = ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))
+
+    def output_type(self, input_types):
+        f = input_types[0]
+        r = input_types[1]
+        return it.InputType.recurrent(
+            f.size, getattr(r, "time_series_length", -1))
+
+
+@register
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Static reshape (keeps batch axis)."""
+    shape: Sequence[int] = field(default_factory=tuple)
+
+    def apply(self, inputs, masks=None):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))

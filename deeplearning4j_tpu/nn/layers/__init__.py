@@ -1,0 +1,36 @@
+from deeplearning4j_tpu.nn.layers.base import Layer, BaseLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.feedforward import (  # noqa: F401
+    DenseLayer,
+    EmbeddingLayer,
+    AutoEncoder,
+)
+from deeplearning4j_tpu.nn.layers.output import (  # noqa: F401
+    OutputLayer,
+    RnnOutputLayer,
+    LossLayer,
+    CenterLossOutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (  # noqa: F401
+    ConvolutionLayer,
+    Convolution1DLayer,
+    SubsamplingLayer,
+    Subsampling1DLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import (  # noqa: F401
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.misc import (  # noqa: F401
+    ActivationLayer,
+    DropoutLayer,
+    GlobalPoolingLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    GravesLSTM,
+    LSTM,
+    GravesBidirectionalLSTM,
+)
+from deeplearning4j_tpu.nn.layers.variational import (  # noqa: F401
+    VariationalAutoencoder,
+)

@@ -1,0 +1,104 @@
+"""Layer base classes.
+
+The reference splits every layer into a serializable config
+(deeplearning4j-nn/.../nn/conf/layers/*.java) and a runtime implementation
+with hand-written `activate`/`backpropGradient`
+(deeplearning4j-nn/.../nn/layers/**, nn/api/Layer.java:37-309). In a JAX
+design the split disappears: a layer is one dataclass that (a) serializes to
+JSON, (b) initializes its parameter pytree, and (c) defines a pure, traceable
+forward — autodiff replaces `backpropGradient`, and the param-view protocol
+(Model.setParamsViewArray, nn/api/Model.java) becomes the params pytree +
+`ravel_pytree` for flat views.
+
+Apply contract::
+
+    y, new_state = layer.apply(params, state, x, train=..., key=..., mask=...)
+
+``state`` carries non-trainable buffers (batchnorm running stats); stateless
+layers return it unchanged. ``mask`` is an optional [B] or [B, T] {0,1} array
+(the reference's feedForwardMaskArray, nn/api/Layer.java:309).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@dataclass
+class Layer:
+    """Base config for every layer type.
+
+    Fields with ``None`` defaults inherit the global value from
+    `NeuralNetConfiguration` when the layer is added to a network (the
+    reference's global-vs-layer override semantics,
+    NeuralNetConfiguration.Builder javadoc)."""
+    name: Optional[str] = None
+    dropout: Optional[float] = None  # drop prob applied to layer INPUT
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    learning_rate: Optional[float] = None  # per-layer LR override
+    bias_learning_rate: Optional[float] = None
+
+    # -- family / shape inference ------------------------------------------
+    @property
+    def family(self) -> str:
+        """Output activation family: 'ff' | 'cnn' | 'rnn'."""
+        return "ff"
+
+    @property
+    def input_family(self) -> str:
+        """Expected input family (for auto preprocessor insertion)."""
+        return self.family
+
+    def update_input_type(self, input_type):
+        """Resolve nIn from ``input_type`` (mutating, like the reference's
+        `setNIn`) and return this layer's output InputType."""
+        return input_type
+
+    # -- params / state -----------------------------------------------------
+    def init_params(self, key: jax.Array, dtype=jnp.float32
+                    ) -> Dict[str, Array]:
+        return {}
+
+    def init_state(self, dtype=jnp.float32) -> Dict[str, Array]:
+        return {}
+
+    def weight_param_keys(self) -> Tuple[str, ...]:
+        """Parameter names subject to l1/l2 regularization (weights, not
+        biases — matching the reference's DefaultParamInitializer split)."""
+        return ("W",)
+
+    # -- forward ------------------------------------------------------------
+    def apply(self, params: Dict[str, Array], state: Dict[str, Array],
+              x: Array, *, train: bool = False,
+              key: Optional[jax.Array] = None,
+              mask: Optional[Array] = None
+              ) -> Tuple[Array, Dict[str, Array]]:
+        return x, state
+
+    def is_pretrain_layer(self) -> bool:
+        return False
+
+
+@dataclass
+class BaseLayer(Layer):
+    """Base for layers with weights + activation (the reference's
+    nn/conf/layers/BaseLayer.java fields)."""
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[dict] = None
+    bias_init: float = 0.0
+
+
+def apply_dropout(x: Array, rate: float, key: jax.Array) -> Array:
+    """Inverted dropout on a layer's input (reference: util/Dropout.java
+    applied from BaseLayer.applyDropOutIfNecessary, nn/layers/BaseLayer.java:497).
+    ``rate`` is the drop probability."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

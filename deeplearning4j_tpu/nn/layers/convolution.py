@@ -1,0 +1,318 @@
+"""Convolution and pooling layers.
+
+Reference: deeplearning4j-nn/.../nn/layers/convolution/ConvolutionLayer.java
+(im2col at :177, GEMM at :185, col2im backprop :203, cuDNN helper plug point
+:69-76), subsampling/SubsamplingLayer.java, and conf classes
+nn/conf/layers/{ConvolutionLayer,Convolution1DLayer,SubsamplingLayer,
+Subsampling1DLayer,ZeroPaddingLayer}.java.
+
+TPU-native design: no im2col and no helper indirection — `lax.conv` lowers
+straight to the XLA convolution HLO, which the TPU compiler maps onto the MXU
+(this *is* the cuDNN-helper equivalent; there is nothing to plug in). Layout
+is NHWC / HWIO, XLA:TPU's preferred tiling. Pooling is `lax.reduce_window`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import BaseLayer, Layer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+Array = jax.Array
+
+_DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv_out_size(size: int, k: int, s: int, p: int, mode: str) -> int:
+    """Output spatial size (reference: util/ConvolutionUtils.java +
+    KernelValidationUtil). 'same' keeps ceil(size/stride); 'strict'/'truncate'
+    use the standard (size - k + 2p)/s + 1 (strict additionally requires exact
+    divisibility, validated at config time)."""
+    if mode == "same":
+        return -(-size // s)
+    if mode == "strict" and (size - k + 2 * p) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.Strict: (size={size} - k={k} + 2*p={p}) not "
+            f"divisible by stride {s}")
+    return (size - k + 2 * p) // s + 1
+
+
+def _conv_padding(mode: str, padding: Tuple[int, int]):
+    if mode == "same":
+        return "SAME"
+    return [(padding[0], padding[0]), (padding[1], padding[1])]
+
+
+@register
+@dataclass
+class ConvolutionLayer(BaseLayer):
+    """2-D convolution, NHWC activations, HWIO kernel."""
+    n_in: Optional[int] = None   # input channels
+    n_out: Optional[int] = None  # output channels
+    kernel_size: Sequence[int] = (5, 5)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = "truncate"  # 'strict' | 'truncate' | 'same'
+    dilation: Sequence[int] = (1, 1)
+
+    @property
+    def family(self) -> str:
+        return "cnn"
+
+    def update_input_type(self, input_type):
+        if not isinstance(input_type, it.InputTypeConvolutional):
+            raise ValueError(f"ConvolutionLayer needs convolutional input, "
+                             f"got {input_type}")
+        if self.n_in is None:
+            self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        # effective kernel under dilation: k + (k-1)(d-1), matching the
+        # rhs_dilation passed to lax.conv_general_dilated in apply()
+        ekh = kh + (kh - 1) * (dh - 1)
+        ekw = kw + (kw - 1) * (dw - 1)
+        oh = conv_out_size(input_type.height, ekh, sh, ph,
+                           self.convolution_mode)
+        ow = conv_out_size(input_type.width, ekw, sw, pw,
+                           self.convolution_mode)
+        return it.InputType.convolutional(oh, ow, self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        kh, kw = _pair(self.kernel_size)
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        wkey, _ = jax.random.split(key)
+        w = init_weights(wkey, (kh, kw, self.n_in, self.n_out), fan_in,
+                         fan_out, self.weight_init or "xavier", self.dist,
+                         dtype)
+        return {"W": w, "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        w = params["W"].astype(x.dtype)
+        # bf16 inputs accumulate in f32 on the MXU; wider dtypes keep theirs
+        acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
+        z = lax.conv_general_dilated(
+            x, w,
+            window_strides=_pair(self.stride),
+            padding=_conv_padding(self.convolution_mode,
+                                  _pair(self.padding)),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=_DIMENSION_NUMBERS,
+            preferred_element_type=acc,
+        ).astype(x.dtype) + params["b"].astype(x.dtype)
+        return get_activation(self.activation or "identity")(z), state
+
+
+@register
+@dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D convolution over [B, T, C] sequences (reference:
+    nn/conf/layers/Convolution1DLayer.java — implemented there as a 2-D conv
+    with width 1; here a direct 1-D conv)."""
+
+    @property
+    def family(self) -> str:
+        return "rnn"
+
+    @property
+    def input_family(self) -> str:
+        return "rnn"
+
+    def update_input_type(self, input_type):
+        if not isinstance(input_type, it.InputTypeRecurrent):
+            raise ValueError("Convolution1DLayer needs recurrent input")
+        if self.n_in is None:
+            self.n_in = input_type.size
+        k = self.kernel_size if isinstance(self.kernel_size, int) \
+            else self.kernel_size[0]
+        s = self.stride if isinstance(self.stride, int) else self.stride[0]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+        t = input_type.time_series_length
+        ot = conv_out_size(t, k, s, p, self.convolution_mode) if t > 0 else -1
+        return it.InputType.recurrent(self.n_out, ot)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        k = self.kernel_size if isinstance(self.kernel_size, int) \
+            else self.kernel_size[0]
+        fan_in = self.n_in * k
+        fan_out = self.n_out * k
+        wkey, _ = jax.random.split(key)
+        w = init_weights(wkey, (k, self.n_in, self.n_out), fan_in, fan_out,
+                         self.weight_init or "xavier", self.dist, dtype)
+        return {"W": w, "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        k = self.kernel_size if isinstance(self.kernel_size, int) \
+            else self.kernel_size[0]
+        s = self.stride if isinstance(self.stride, int) else self.stride[0]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+        pad = "SAME" if self.convolution_mode == "same" else [(p, p)]
+        acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
+        z = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype), window_strides=(s,), padding=pad,
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            preferred_element_type=acc,
+        ).astype(x.dtype) + params["b"].astype(x.dtype)
+        return get_activation(self.activation or "identity")(z), state
+
+
+@register
+@dataclass
+class SubsamplingLayer(Layer):
+    """2-D pooling: max | avg | pnorm (reference:
+    nn/layers/convolution/subsampling/SubsamplingLayer.java, cuDNN helper
+    plug point :76 — here reduce_window, fused by XLA)."""
+    pooling_type: str = "max"
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    @property
+    def family(self) -> str:
+        return "cnn"
+
+    def update_input_type(self, input_type):
+        if not isinstance(input_type, it.InputTypeConvolutional):
+            raise ValueError("SubsamplingLayer needs convolutional input")
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        oh = conv_out_size(input_type.height, kh, sh, ph,
+                           self.convolution_mode)
+        ow = conv_out_size(input_type.width, kw, sw, pw,
+                           self.convolution_mode)
+        return it.InputType.convolutional(oh, ow, input_type.channels)
+
+    def weight_param_keys(self):
+        return ()
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        ptype = self.pooling_type.lower()
+        if ptype == "max":
+            init = -jnp.inf
+            y = lax.reduce_window(x, init, lax.max, window, strides, pad)
+        elif ptype in ("avg", "mean"):
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            y = s / (kh * kw)
+        elif ptype == "pnorm":
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window,
+                                  strides, pad)
+            y = s ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return y, state
+
+
+@register
+@dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    """1-D pooling over [B, T, C] (reference:
+    nn/conf/layers/Subsampling1DLayer.java)."""
+
+    @property
+    def family(self) -> str:
+        return "rnn"
+
+    @property
+    def input_family(self) -> str:
+        return "rnn"
+
+    def update_input_type(self, input_type):
+        if not isinstance(input_type, it.InputTypeRecurrent):
+            raise ValueError("Subsampling1DLayer needs recurrent input")
+        k = self.kernel_size if isinstance(self.kernel_size, int) \
+            else self.kernel_size[0]
+        s = self.stride if isinstance(self.stride, int) else self.stride[0]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+        t = input_type.time_series_length
+        ot = conv_out_size(t, k, s, p, self.convolution_mode) if t > 0 else -1
+        return it.InputType.recurrent(input_type.size, ot)
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        k = self.kernel_size if isinstance(self.kernel_size, int) \
+            else self.kernel_size[0]
+        s = self.stride if isinstance(self.stride, int) else self.stride[0]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+        window = (1, k, 1)
+        strides = (1, s, 1)
+        pad = "SAME" if self.convolution_mode == "same" \
+            else ((0, 0), (p, p), (0, 0))
+        ptype = self.pooling_type.lower()
+        if ptype == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        elif ptype in ("avg", "mean"):
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pad) / k
+        elif ptype == "pnorm":
+            pw = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** pw, 0.0, lax.add, window,
+                                  strides, pad) ** (1.0 / pw)
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return y, state
+
+
+@register
+@dataclass
+class ZeroPaddingLayer(Layer):
+    """Spatial zero padding (reference: nn/conf/layers/ZeroPaddingLayer.java,
+    nn/layers/convolution/ZeroPaddingLayer.java)."""
+    padding: Sequence[int] = (1, 1)  # (ph, pw) or (top, bottom, left, right)
+
+    @property
+    def family(self) -> str:
+        return "cnn"
+
+    def weight_param_keys(self):
+        return ()
+
+    def _pads(self):
+        p = self.padding
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        return tuple(p)
+
+    def update_input_type(self, input_type):
+        if not isinstance(input_type, it.InputTypeConvolutional):
+            raise ValueError("ZeroPaddingLayer needs convolutional input")
+        t, b, l, r = self._pads()
+        return it.InputType.convolutional(input_type.height + t + b,
+                                          input_type.width + l + r,
+                                          input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        t, b, l, r = self._pads()
+        y = jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+        return y, state

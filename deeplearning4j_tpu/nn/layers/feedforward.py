@@ -1,0 +1,158 @@
+"""Feed-forward layers: Dense, Embedding, AutoEncoder.
+
+Reference: deeplearning4j-nn/.../nn/layers/feedforward/{dense,embedding,
+autoencoder}/ and conf classes nn/conf/layers/{DenseLayer,EmbeddingLayer,
+AutoEncoder}.java. The reference's dense forward is
+``input.mmul(W).addiRowVector(b)`` through JNI GEMM
+(nn/layers/BaseLayer.java:378); here it is a traced einsum on the trailing
+axis — which also lets dense layers operate timestep-wise on [B, T, F]
+sequences without the reference's FeedForwardToRnn reshaping, and keeps the
+matmul on the MXU in one fused XLA program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+Array = jax.Array
+
+
+@register
+@dataclass
+class DenseLayer(BaseLayer):
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeFeedForward):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.feed_forward(self.n_out)
+        if isinstance(input_type, it.InputTypeRecurrent):
+            # dense applied per-timestep
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.recurrent(self.n_out,
+                                          input_type.time_series_length)
+        raise ValueError(f"{type(self).__name__} cannot take input "
+                         f"{input_type}")
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        wkey, _ = jax.random.split(key)
+        w = init_weights(wkey, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init or "xavier", self.dist, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": w, "b": b}
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        z = jnp.matmul(x, params["W"]) + params["b"]
+        return get_activation(self.activation or "sigmoid")(z), state
+
+    def pre_output(self, params, x):
+        return jnp.matmul(x, params["W"]) + params["b"]
+
+
+@register
+@dataclass
+class EmbeddingLayer(BaseLayer):
+    """Index -> vector lookup (reference: nn/layers/feedforward/embedding/
+    EmbeddingLayer.java — mathematically equivalent to a dense layer on
+    one-hot input, implemented as a gather, which XLA lowers to an efficient
+    dynamic-slice on TPU)."""
+    n_in: Optional[int] = None   # vocabulary size
+    n_out: Optional[int] = None  # embedding dim
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeFeedForward):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.feed_forward(self.n_out)
+        raise ValueError(f"EmbeddingLayer cannot take input {input_type}")
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        wkey, _ = jax.random.split(key)
+        w = init_weights(wkey, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init or "xavier", self.dist, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": w, "b": b}
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        # x: integer indices [B] or [B, 1] (the reference takes a column of
+        # indices), or one-hot [B, n_in].
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim >= 2 \
+                and x.shape[-1] == self.n_in:
+            z = jnp.matmul(x, params["W"]) + params["b"]
+        else:
+            idx = x.astype(jnp.int32)
+            if idx.ndim >= 2 and idx.shape[-1] == 1:
+                idx = idx[..., 0]
+            z = params["W"][idx] + params["b"]
+        return get_activation(self.activation or "identity")(z), state
+
+
+@register
+@dataclass
+class AutoEncoder(BaseLayer):
+    """Denoising autoencoder pretrain layer (reference:
+    nn/layers/feedforward/autoencoder/AutoEncoder.java). Forward (supervised
+    path) is encode(); pretraining reconstructs corrupted input — see
+    MultiLayerNetwork.pretrain."""
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeFeedForward):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.feed_forward(self.n_out)
+        raise ValueError(f"AutoEncoder cannot take input {input_type}")
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        wkey, _ = jax.random.split(key)
+        w = init_weights(wkey, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init or "xavier", self.dist, dtype)
+        return {"W": w,
+                "b": jnp.full((self.n_out,), self.bias_init, dtype),
+                "vb": jnp.zeros((self.n_in,), dtype)}
+
+    def encode(self, params, x):
+        act = get_activation(self.activation or "sigmoid")
+        return act(jnp.matmul(x, params["W"]) + params["b"])
+
+    def decode(self, params, h):
+        act = get_activation(self.activation or "sigmoid")
+        return act(jnp.matmul(h, params["W"].T) + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, key):
+        """Reconstruction cross-entropy on corrupted input."""
+        if self.corruption_level > 0 and key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - self.corruption_level,
+                                        x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon = self.decode(params, self.encode(params, corrupted))
+        eps = 1e-7
+        recon = jnp.clip(recon, eps, 1 - eps)
+        return -jnp.mean(jnp.sum(
+            x * jnp.log(recon) + (1 - x) * jnp.log(1 - recon), axis=-1))

@@ -1,0 +1,193 @@
+"""Utility layers: ActivationLayer, DropoutLayer, GlobalPoolingLayer,
+FrozenLayer wrapper.
+
+Reference: deeplearning4j-nn/.../nn/conf/layers/{ActivationLayer,
+DropoutLayer,GlobalPoolingLayer}.java, nn/layers/pooling/GlobalPoolingLayer
+(incl. masked pooling via util/MaskedReductionUtil.java), and
+nn/layers/FrozenLayer.java (used by transfer learning).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import Layer, apply_dropout
+
+Array = jax.Array
+
+
+@register
+@dataclass
+class ActivationLayer(Layer):
+    activation: str = "relu"
+    _family: str = "ff"
+
+    @property
+    def family(self):
+        return self._family
+
+    @property
+    def input_family(self):
+        return self._family
+
+    def weight_param_keys(self):
+        return ()
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeConvolutional):
+            self._family = "cnn"
+        elif isinstance(input_type, it.InputTypeRecurrent):
+            self._family = "rnn"
+        else:
+            self._family = "ff"
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        return get_activation(self.activation)(x), state
+
+
+@register
+@dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout layer; rate is the drop probability."""
+    rate: float = 0.5
+    _family: str = "ff"
+
+    @property
+    def family(self):
+        return self._family
+
+    @property
+    def input_family(self):
+        return self._family
+
+    def weight_param_keys(self):
+        return ()
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeConvolutional):
+            self._family = "cnn"
+        elif isinstance(input_type, it.InputTypeRecurrent):
+            self._family = "rnn"
+        else:
+            self._family = "ff"
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        if train and self.rate > 0 and key is not None:
+            x = apply_dropout(x, self.rate, key)
+        return x, state
+
+
+@register
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Pool over time ([B, T, F] -> [B, F]) or space ([B, H, W, C] -> [B, C]).
+    Types: max | avg | sum | pnorm. Honors sequence masks (the reference's
+    MaskedReductionUtil semantics: masked steps excluded from the
+    reduction)."""
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+    _in_family: str = "rnn"
+
+    @property
+    def family(self):
+        return "ff"
+
+    @property
+    def input_family(self):
+        return self._in_family
+
+    def weight_param_keys(self):
+        return ()
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeRecurrent):
+            self._in_family = "rnn"
+            return it.InputType.feed_forward(input_type.size)
+        if isinstance(input_type, it.InputTypeConvolutional):
+            self._in_family = "cnn"
+            return it.InputType.feed_forward(input_type.channels)
+        raise ValueError(f"GlobalPoolingLayer cannot take {input_type}")
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        if x.ndim == 3:
+            axes = (1,)
+        elif x.ndim == 4:
+            axes = (1, 2)
+        else:
+            raise ValueError("GlobalPoolingLayer needs 3-D or 4-D input")
+        ptype = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[..., None]  # [B, T, 1]
+            if ptype == "max":
+                neg = jnp.finfo(x.dtype).min
+                y = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+            elif ptype in ("avg", "mean"):
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+            elif ptype == "sum":
+                y = jnp.sum(x * m, axis=1)
+            elif ptype == "pnorm":
+                p = float(self.pnorm)
+                y = jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1.0 / p)
+            else:
+                raise ValueError(self.pooling_type)
+            return y, state
+        if ptype == "max":
+            y = jnp.max(x, axis=axes)
+        elif ptype in ("avg", "mean"):
+            y = jnp.mean(x, axis=axes)
+        elif ptype == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif ptype == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling_type)
+        return y, state
+
+
+@register
+@dataclass
+class FrozenLayer(Layer):
+    """Wrapper marking an inner layer's params as non-trainable (reference:
+    nn/layers/FrozenLayer.java; used by TransferLearning.setFeatureExtractor).
+    Gradients are stopped via a trainability mask in the updater, so the inner
+    layer still traces normally."""
+    inner: Optional[Layer] = None
+
+    @property
+    def family(self):
+        return self.inner.family
+
+    @property
+    def input_family(self):
+        return self.inner.input_family
+
+    def update_input_type(self, input_type):
+        return self.inner.update_input_type(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.inner.init_params(key, dtype)
+
+    def init_state(self, dtype=jnp.float32):
+        return self.inner.init_state(dtype)
+
+    def weight_param_keys(self):
+        return self.inner.weight_param_keys()
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None):
+        # Inference-mode inner apply: frozen layers don't update BN stats.
+        return self.inner.apply(params, state, x, train=False, key=key,
+                                mask=mask)

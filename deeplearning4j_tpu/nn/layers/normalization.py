@@ -1,0 +1,134 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference: deeplearning4j-nn/.../nn/layers/normalization/
+BatchNormalization.java:55 (cuDNN helper plug point) and
+LocalResponseNormalization.java; conf classes in nn/conf/layers/. Running
+statistics are non-trainable state threaded through the jitted step (the
+functional replacement for the reference's mutable mean/var params), and the
+whole normalization fuses into neighboring ops under XLA — no helper
+indirection needed.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import BaseLayer, Layer
+
+Array = jax.Array
+
+
+@register
+@dataclass
+class BatchNormalization(BaseLayer):
+    """Batch norm over the trailing (feature/channel) axis — works for both
+    [B, F] dense and [B, H, W, C] conv activations (NHWC makes the channel
+    axis trailing in both cases, unlike the reference's NCHW special-casing).
+    ``decay`` matches the reference's moving-average decay; ``eps`` its
+    epsilon; ``lock_gamma_beta`` freezes scale/shift at 1/0."""
+    n_out: Optional[int] = None
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+    _family: str = "ff"
+
+    @property
+    def family(self) -> str:
+        return self._family
+
+    @property
+    def input_family(self) -> str:
+        return self._family
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeConvolutional):
+            self.n_out = input_type.channels
+            self._family = "cnn"
+        elif isinstance(input_type, it.InputTypeFeedForward):
+            self.n_out = input_type.size
+            self._family = "ff"
+        elif isinstance(input_type, it.InputTypeRecurrent):
+            self.n_out = input_type.size
+            self._family = "rnn"
+        else:
+            raise ValueError(f"BatchNormalization cannot take {input_type}")
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_out,), self.gamma, dtype),
+                "beta": jnp.full((self.n_out,), self.beta, dtype)}
+
+    def init_state(self, dtype=jnp.float32) -> Dict[str, Array]:
+        return {"mean": jnp.zeros((self.n_out,), dtype),
+                "var": jnp.ones((self.n_out,), dtype)}
+
+    def weight_param_keys(self):
+        return ()
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if self.lock_gamma_beta:
+            y = self.gamma * xhat + self.beta
+        else:
+            y = params["gamma"] * xhat + params["beta"]
+        if self.activation:
+            y = get_activation(self.activation)(y)
+        return y, new_state
+
+
+@register
+@dataclass
+class LocalResponseNormalization(Layer):
+    """Across-channel LRN (reference:
+    nn/layers/normalization/LocalResponseNormalization.java; AlexNet-style
+    k + alpha*sum(x^2) over a window of n channels, raised to beta)."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    @property
+    def family(self) -> str:
+        return "cnn"
+
+    def weight_param_keys(self):
+        return ()
+
+    def update_input_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        # x: [B, H, W, C]; window over channel axis.
+        half = int(self.n) // 2
+        sq = x * x
+        # sum over channel window via padded cumsum-free reduce_window
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, int(self.n)),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, half)))
+        denom = (self.k + self.alpha * summed) ** self.beta
+        return x / denom, state

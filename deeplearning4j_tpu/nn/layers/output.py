@@ -1,0 +1,129 @@
+"""Output and loss layers.
+
+Reference: deeplearning4j-nn/.../nn/layers/BaseOutputLayer.java,
+OutputLayer.java, LossLayer.java, recurrent/RnnOutputLayer.java,
+training/CenterLossOutputLayer.java:49 and conf classes in nn/conf/layers/.
+An output layer is a dense layer + loss function; `computeScore` becomes the
+loss term of the jitted step's scalar objective, and the hand-written error
+signal (`backpropGradient`) is replaced by autodiff.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.lossfunctions import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+
+Array = jax.Array
+
+
+@register
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss. Default activation softmax / loss MCXENT, matching the
+    reference's defaults."""
+    loss_function: str = "mcxent"
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        z = self.pre_output(params, x)
+        return get_activation(self.activation or "softmax")(z), state
+
+    def loss(self, params, x, labels, mask=None):
+        z = self.pre_output(params, x)
+        fn = get_loss(self.loss_function)
+        return fn(labels, z, self.activation or "softmax", mask)
+
+
+@register
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output over [B, T, F] sequences (reference:
+    nn/layers/recurrent/RnnOutputLayer.java — the reference reshapes to 2-D
+    and back; operating on the trailing axis makes that a no-op here)."""
+
+    @property
+    def family(self) -> str:
+        return "rnn"
+
+    @property
+    def input_family(self) -> str:
+        return "rnn"
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeRecurrent):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.recurrent(self.n_out,
+                                          input_type.time_series_length)
+        if isinstance(input_type, it.InputTypeFeedForward):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.recurrent(self.n_out)
+        raise ValueError(f"RnnOutputLayer cannot take input {input_type}")
+
+
+@register
+@dataclass
+class LossLayer(BaseLayer):
+    """Loss without parameters (reference: nn/layers/LossLayer.java)."""
+    loss_function: str = "mcxent"
+
+    def update_input_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        return get_activation(self.activation or "softmax")(x), state
+
+    def weight_param_keys(self):
+        return ()
+
+    def loss(self, params, x, labels, mask=None):
+        fn = get_loss(self.loss_function)
+        return fn(labels, x, self.activation or "softmax", mask)
+
+
+@register
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Output layer with an auxiliary center-loss term pulling features
+    toward per-class centers (reference:
+    nn/layers/training/CenterLossOutputLayer.java:49 and conf
+    nn/conf/layers/CenterLossOutputLayer.java). Centers are non-trainable
+    state updated with rate ``alpha`` toward the batch feature means, and the
+    center distance joins the loss scaled by ``lambda_``."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_state(self, dtype=jnp.float32) -> Dict[str, Array]:
+        return {"centers": jnp.zeros((self.n_out, self.n_in), dtype)}
+
+    def loss(self, params, x, labels, mask=None, state=None):
+        base = super().loss(params, x, labels, mask)
+        if state is None:
+            return base
+        centers = state["centers"]
+        assigned = jnp.matmul(labels, centers)  # [B, n_in]
+        center_l = jnp.mean(jnp.sum((x - assigned) ** 2, axis=-1))
+        return base + 0.5 * self.lambda_ * center_l
+
+    def update_centers(self, state, x, labels):
+        centers = state["centers"]
+        counts = jnp.sum(labels, axis=0)  # [n_out]
+        sums = jnp.matmul(labels.T, x)    # [n_out, n_in]
+        means = sums / jnp.maximum(counts[:, None], 1.0)
+        seen = (counts > 0)[:, None]
+        new_centers = jnp.where(seen,
+                                centers + self.alpha * (means - centers),
+                                centers)
+        return {**state, "centers": new_centers}

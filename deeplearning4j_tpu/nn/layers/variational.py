@@ -1,0 +1,159 @@
+"""Variational autoencoder layer.
+
+Reference: deeplearning4j-nn/.../nn/layers/variational/
+VariationalAutoencoder.java (1,095 LoC) + conf
+nn/conf/layers/variational/{VariationalAutoencoder,Gaussian...}.java.
+A pretrain layer: encoder MLP -> (mean, log-var) -> reparameterized sample ->
+decoder MLP -> pluggable reconstruction distribution; unsupervised loss is
+-ELBO. In the supervised forward pass the layer outputs the latent mean (same
+as the reference's activate()).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.serde import register
+from deeplearning4j_tpu.nn.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.weights import init_weights
+
+Array = jax.Array
+
+
+@register
+@dataclass
+class VariationalAutoencoder(BaseLayer):
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None          # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "gaussian"  # gaussian|bernoulli|exponential
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def update_input_type(self, input_type):
+        if isinstance(input_type, it.InputTypeFeedForward):
+            if self.n_in is None:
+                self.n_in = input_type.size
+            return it.InputType.feed_forward(self.n_out)
+        raise ValueError(f"VAE cannot take input {input_type}")
+
+    def _recon_params_per_feature(self) -> int:
+        return 1 if self.reconstruction_distribution == "bernoulli" else 2
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
+        params: Dict[str, Array] = {}
+        scheme = self.weight_init or "xavier"
+        sizes_enc = [self.n_in, *self.encoder_layer_sizes]
+        n_keys = (len(self.encoder_layer_sizes)
+                  + len(self.decoder_layer_sizes) + 4)
+        keys = jax.random.split(key, n_keys)
+        ki = 0
+        for i in range(len(sizes_enc) - 1):
+            params[f"eW{i}"] = init_weights(
+                keys[ki], (sizes_enc[i], sizes_enc[i + 1]), sizes_enc[i],
+                sizes_enc[i + 1], scheme, self.dist, dtype); ki += 1
+            params[f"eb{i}"] = jnp.zeros((sizes_enc[i + 1],), dtype)
+        last_enc = sizes_enc[-1]
+        params["muW"] = init_weights(keys[ki], (last_enc, self.n_out),
+                                     last_enc, self.n_out, scheme, self.dist,
+                                     dtype); ki += 1
+        params["mub"] = jnp.zeros((self.n_out,), dtype)
+        params["lvW"] = init_weights(keys[ki], (last_enc, self.n_out),
+                                     last_enc, self.n_out, scheme, self.dist,
+                                     dtype); ki += 1
+        params["lvb"] = jnp.zeros((self.n_out,), dtype)
+        sizes_dec = [self.n_out, *self.decoder_layer_sizes]
+        for i in range(len(sizes_dec) - 1):
+            params[f"dW{i}"] = init_weights(
+                keys[ki], (sizes_dec[i], sizes_dec[i + 1]), sizes_dec[i],
+                sizes_dec[i + 1], scheme, self.dist, dtype); ki += 1
+            params[f"db{i}"] = jnp.zeros((sizes_dec[i + 1],), dtype)
+        last_dec = sizes_dec[-1]
+        out_size = self.n_in * self._recon_params_per_feature()
+        params["xW"] = init_weights(keys[ki], (last_dec, out_size), last_dec,
+                                    out_size, scheme, self.dist, dtype)
+        params["xb"] = jnp.zeros((out_size,), dtype)
+        return params
+
+    def weight_param_keys(self):
+        keys = ["muW", "lvW", "xW"]
+        keys += [f"eW{i}" for i in range(len(self.encoder_layer_sizes))]
+        keys += [f"dW{i}" for i in range(len(self.decoder_layer_sizes))]
+        return tuple(keys)
+
+    def _encode(self, params, x):
+        act = get_activation(self.activation or "tanh")
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(jnp.matmul(h, params[f"eW{i}"]) + params[f"eb{i}"])
+        mu = jnp.matmul(h, params["muW"]) + params["mub"]
+        mu = get_activation(self.pzx_activation)(mu)
+        logvar = jnp.matmul(h, params["lvW"]) + params["lvb"]
+        return mu, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation or "tanh")
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(jnp.matmul(h, params[f"dW{i}"]) + params[f"db{i}"])
+        return jnp.matmul(h, params["xW"]) + params["xb"]
+
+    def apply(self, params, state, x, *, train=False, key=None, mask=None
+              ) -> Tuple[Array, Dict]:
+        mu, _ = self._encode(params, x)
+        return mu, state
+
+    def _recon_log_prob(self, recon_raw, x):
+        eps = 1e-7
+        kind = self.reconstruction_distribution
+        if kind == "bernoulli":
+            p = jnp.clip(jax.nn.sigmoid(recon_raw), eps, 1 - eps)
+            return jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+        if kind == "gaussian":
+            mean, logvar = jnp.split(recon_raw, 2, axis=-1)
+            var = jnp.exp(logvar)
+            return jnp.sum(
+                -0.5 * (jnp.log(2 * jnp.pi) + logvar + (x - mean) ** 2 / var),
+                axis=-1)
+        if kind == "exponential":
+            # rate = exp(gamma); log p = gamma - rate*x
+            gamma, _ = jnp.split(recon_raw, 2, axis=-1)
+            return jnp.sum(gamma - jnp.exp(gamma) * x, axis=-1)
+        raise ValueError(f"Unknown reconstruction distribution '{kind}'")
+
+    def pretrain_loss(self, params, x, key):
+        """-ELBO = -E[log p(x|z)] + KL(q(z|x) || N(0,1))."""
+        mu, logvar = self._encode(params, x)
+        total = 0.0
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(key, s), mu.shape,
+                                    mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            recon_raw = self._decode(params, z)
+            total = total + self._recon_log_prob(recon_raw, x)
+        log_px = total / self.num_samples
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu ** 2 - 1.0 - logvar, axis=-1)
+        return jnp.mean(kl - log_px)
+
+    def reconstruction_prob(self, params, x, key, num_samples=None):
+        """Importance-sampled reconstruction probability (reference:
+        VariationalAutoencoder.reconstructionProbability)."""
+        n = num_samples or self.num_samples
+        mu, logvar = self._encode(params, x)
+        logps = []
+        for s in range(n):
+            eps = jax.random.normal(jax.random.fold_in(key, s), mu.shape,
+                                    mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            recon_raw = self._decode(params, z)
+            logps.append(self._recon_log_prob(recon_raw, x))
+        return jax.nn.logsumexp(jnp.stack(logps), axis=0) - jnp.log(float(n))

@@ -1,0 +1,515 @@
+"""MultiLayerNetwork — the sequential model.
+
+Parity with the reference's MultiLayerNetwork (reference:
+deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java, 2,590 LoC:
+init:405 flat buffer:445, fit(DataSetIterator):947, backprop():1019,
+doTruncatedBPTT:1119, rnnTimeStep:2234, pretrain, score, output).
+
+TPU-native inversion of the reference's design (SURVEY.md §3.1): instead of
+eager per-op JNI dispatch through a Solver/Updater object graph, the entire
+minibatch step — forward, loss (+L1/L2), backward (autodiff), gradient
+normalization, updater transform, parameter update — traces into ONE jitted
+XLA program. The reference's flat-parameter-view protocol
+(setParamsViewArray) becomes a params pytree; `params()` returns the
+ravel_pytree flat view for API parity.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.common import promote_score
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer, apply_dropout
+from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.train.updaters import (apply_updater,
+                                               init_updater_state)
+
+Array = jax.Array
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+class MultiLayerNetwork:
+    """Sequential network over a MultiLayerConfiguration."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        conf.resolve_shapes()
+        self.layers: List[Layer] = conf.layers
+        self.layer_names = [conf.layer_name(i)
+                            for i in range(len(conf.layers))]
+        self.dtype = _dtype_of(conf.training.dtype)
+        self.params: Dict[str, Dict[str, Array]] = {}
+        self.state: Dict[str, Dict[str, Array]] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.listeners: List[Any] = []
+        self.score_value: float = float("nan")
+        self._jit_cache: Dict[Any, Any] = {}
+        self._rnn_carries: Optional[Dict[str, Any]] = None
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        """Initialize parameters (reference: MultiLayerNetwork.init():405)."""
+        seed = self.conf.training.seed if seed is None else seed
+        root = jax.random.PRNGKey(seed)
+        for i, layer in enumerate(self.layers):
+            name = self.layer_names[i]
+            key = jax.random.fold_in(root, i)
+            self.params[name] = layer.init_params(key, self.dtype)
+            self.state[name] = layer.init_state(self.dtype)
+        self.updater_state = init_updater_state(self.conf.training,
+                                                self.params)
+        self._initialized = True
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, x, *, train: bool,
+                 key: Optional[jax.Array], mask: Optional[Array],
+                 carries: Optional[Dict[str, Any]] = None,
+                 collect: bool = False):
+        """Pure forward over all layers. Returns (activations list if collect
+        else final activation, preout of output layer, new_state,
+        new_carries)."""
+        acts = []
+        new_state = {}
+        new_carries = {}
+        h = x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+            else x
+        preout = None
+        for i, layer in enumerate(self.layers):
+            name = self.layer_names[i]
+            pp = self.conf.input_preprocessors.get(str(i))
+            if pp is not None:
+                h = pp.pre_process(h)
+            lkey = (jax.random.fold_in(key, i)
+                    if key is not None else None)
+            if train and (layer.dropout or 0.0) > 0 and lkey is not None:
+                h = apply_dropout(h, layer.dropout, lkey)
+            if carries is not None and hasattr(layer, "scan_sequence") \
+                    and name in carries:
+                h, carry = layer.scan_sequence(params[name], h,
+                                               carry=carries[name],
+                                               mask=mask)
+                new_carries[name] = carry
+                new_state[name] = state.get(name, {})
+            else:
+                h, st = layer.apply(params[name], state.get(name, {}), h,
+                                    train=train, key=lkey, mask=mask)
+                new_state[name] = st
+            if collect:
+                acts.append(h)
+        return (acts if collect else h), preout, new_state, new_carries
+
+    def _regularization_score(self, params) -> Array:
+        """0.5·l2·||W||² + l1·||W||₁ summed over layers (reference:
+        BaseLayer.calcL2/calcL1 feeding computeScore)."""
+        total = jnp.asarray(0.0)
+        for i, layer in enumerate(self.layers):
+            name = self.layer_names[i]
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if (l1 == 0.0 and l2 == 0.0) or not params.get(name):
+                continue
+            for k in layer.weight_param_keys():
+                if k not in params[name]:
+                    continue
+                w = promote_score(params[name][k])
+                if l2 > 0:
+                    total = total + 0.5 * l2 * jnp.sum(w * w)
+                if l1 > 0:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
+
+    def _loss_fn(self, params, state, x, y, key, mask, train=True):
+        out_layer = self.layers[-1]
+        out_name = self.layer_names[-1]
+        if not hasattr(out_layer, "loss"):
+            raise ValueError("Last layer must be an output/loss layer to "
+                             "compute a score")
+        h = x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+            else x
+        new_state = {}
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers[:-1]):
+            name = self.layer_names[i]
+            pp = self.conf.input_preprocessors.get(str(i))
+            if pp is not None:
+                h = pp.pre_process(h)
+            lkey = jax.random.fold_in(key, i) if key is not None else None
+            if train and (layer.dropout or 0.0) > 0 and lkey is not None:
+                h = apply_dropout(h, layer.dropout, lkey)
+            h, st = layer.apply(params[name], state.get(name, {}), h,
+                                train=train, key=lkey, mask=mask)
+            new_state[name] = st
+        pp = self.conf.input_preprocessors.get(str(n - 1))
+        if pp is not None:
+            h = pp.pre_process(h)
+        okey = jax.random.fold_in(key, n - 1) if key is not None else None
+        if (out_layer.dropout or 0.0) > 0 and okey is not None:
+            h = apply_dropout(h, out_layer.dropout, okey)
+        if hasattr(out_layer, "update_centers"):  # center loss
+            loss = out_layer.loss(params[out_name], h, y, mask,
+                                  state.get(out_name))
+            new_state[out_name] = out_layer.update_centers(
+                state.get(out_name, {}), h, y)
+        else:
+            loss = out_layer.loss(params[out_name], h, y, mask)
+            new_state[out_name] = state.get(out_name, {})
+        score = promote_score(loss) + self._regularization_score(params)
+        return score, new_state
+
+    # ----------------------------------------------------------- train step
+    def _lr_multipliers(self) -> Dict[str, float]:
+        base = self.conf.training.learning_rate
+        out = {}
+        for i, layer in enumerate(self.layers):
+            lr = layer.learning_rate
+            # explicit 0.0 is a valid per-layer LR (DL4J-style freezing), so
+            # test for None rather than falsiness
+            out[self.layer_names[i]] = (lr / base) \
+                if (lr is not None and base) else 1.0
+        return out
+
+    def _trainable(self) -> Dict[str, bool]:
+        return {self.layer_names[i]: not isinstance(l, FrozenLayer)
+                for i, l in enumerate(self.layers)}
+
+    def _make_train_step(self):
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def step(params, state, opt_state, iteration, x, y, key, mask):
+            def loss_fn(p):
+                return self._loss_fn(p, state, x, y, key, mask)
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = apply_updater(
+                tc, params, grads, opt_state, iteration,
+                lr_multipliers=lr_mult, trainable=trainable)
+            return new_params, new_state, new_opt, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, shape_key):
+        fn = self._jit_cache.get(("train", shape_key))
+        if fn is None:
+            fn = self._make_train_step()
+            self._jit_cache[("train", shape_key)] = fn
+        return fn
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, mask=None) -> None:
+        """Train. ``data`` is a DataSetIterator-like (yielding
+        (features, labels) or DataSet objects) or a raw array with
+        ``labels`` (reference: fit(DataSetIterator):947 /
+        fit(INDArray,INDArray):1399)."""
+        if not self._initialized:
+            self.init()
+        if labels is not None:
+            self._fit_batch(data, labels, mask)
+            return
+        for l in self.listeners:
+            l.on_epoch_start(self)
+        for batch in data:
+            feats, labs, fmask, lmask = _unpack_batch(batch)
+            self._fit_batch(feats, labs, lmask if lmask is not None
+                            else fmask)
+        for l in self.listeners:
+            l.on_epoch_end(self)
+        self.epoch_count += 1
+        if hasattr(data, "reset"):
+            data.reset()
+
+    def _fit_batch(self, x, y, mask=None) -> None:
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        if self.conf.backprop_type == "tbptt" and x.ndim == 3:
+            self._fit_tbptt(x, y, mask)
+            return
+        step = self._get_train_step((x.shape, y.shape,
+                                     mask is not None))
+        for _ in range(max(1, self.conf.training.num_iterations)):
+            key = jax.random.fold_in(jax.random.PRNGKey(
+                self.conf.training.seed), self.iteration_count)
+            self.params, self.state, self.updater_state, score = step(
+                self.params, self.state, self.updater_state,
+                self.iteration_count, x, y, key,
+                None if mask is None else jnp.asarray(mask))
+            self.score_value = score
+            for l in self.listeners:
+                if hasattr(l, "record_batch"):
+                    l.record_batch(int(x.shape[0]))
+                l.iteration_done(self, self.iteration_count,
+                                 self.score_value)
+            self.iteration_count += 1
+
+    def _fit_tbptt(self, x, y, mask=None) -> None:
+        """Truncated BPTT (reference: doTruncatedBPTT,
+        MultiLayerNetwork.java:1119): split the time axis into chunks of
+        tbptt_fwd_length, carry RNN state (stop-gradient) across chunks."""
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_chunks = math.ceil(T / L)
+        carries = self._init_carries(x.shape[0])
+        tc = self.conf.training
+        chunk_step = self._jit_cache.get(("tbptt", x.shape[0], x.shape[2]))
+        if chunk_step is None:
+            chunk_step = self._make_tbptt_step()
+            self._jit_cache[("tbptt", x.shape[0], x.shape[2])] = chunk_step
+
+        for c in range(n_chunks):
+            sl = slice(c * L, min((c + 1) * L, T))
+            xs, ys = x[:, sl], y[:, sl]
+            m = None if mask is None else jnp.asarray(mask)[:, sl]
+            key = jax.random.fold_in(jax.random.PRNGKey(tc.seed),
+                                     self.iteration_count)
+            (self.params, self.state, self.updater_state, carries,
+             score) = chunk_step(self.params, self.state,
+                                 self.updater_state, self.iteration_count,
+                                 xs, ys, carries, key, m)
+            self.score_value = score
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration_count,
+                                 self.score_value)
+            self.iteration_count += 1
+
+    def _make_tbptt_step(self):
+        """Jitted TBPTT chunk step, cached per (batch, features) shape —
+        the compiled program is reused across minibatches and chunks."""
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def chunk_step(params, state, opt_state, iteration, xs, ys, carries,
+                       key, m):
+            def loss_fn(p):
+                h = xs.astype(self.dtype)
+                new_state = {}
+                new_carries = {}
+                for i, layer in enumerate(self.layers[:-1]):
+                    name = self.layer_names[i]
+                    if hasattr(layer, "scan_sequence") and name in carries:
+                        h, carry = layer.scan_sequence(
+                            p[name], h, carry=carries.get(name), mask=m)
+                        new_carries[name] = jax.tree_util.tree_map(
+                            jax.lax.stop_gradient, carry)
+                        new_state[name] = state.get(name, {})
+                    else:
+                        h, st = layer.apply(p[name], state.get(name, {}), h,
+                                            train=True, key=key, mask=m)
+                        new_state[name] = st
+                out_layer = self.layers[-1]
+                out_name = self.layer_names[-1]
+                loss = out_layer.loss(p[out_name], h, ys, m)
+                new_state[out_name] = state.get(out_name, {})
+                score = promote_score(loss) \
+                    + self._regularization_score(p)
+                return score, (new_state, new_carries)
+
+            (score, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = apply_updater(
+                tc, params, grads, opt_state, iteration,
+                lr_multipliers=lr_mult, trainable=trainable)
+            return new_params, new_state, new_opt, new_carries, score
+
+        return jax.jit(chunk_step)
+
+    def _init_carries(self, batch: int) -> Dict[str, Any]:
+        carries = {}
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "initial_carry") \
+                    and getattr(layer, "supports_streaming", True):
+                carries[self.layer_names[i]] = layer.initial_carry(
+                    batch, self.dtype)
+        return carries
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data) -> None:
+        """Greedy layerwise unsupervised pretraining for AE/VAE layers
+        (reference: MultiLayerNetwork.pretrain / pretrainLayer)."""
+        if not self._initialized:
+            self.init()
+        for i, layer in enumerate(self.layers):
+            if not layer.is_pretrain_layer():
+                continue
+            self.pretrain_layer(i, data)
+            if hasattr(data, "reset"):
+                data.reset()
+
+    def pretrain_layer(self, layer_idx: int, data) -> None:
+        layer = self.layers[layer_idx]
+        name = self.layer_names[layer_idx]
+        if not layer.is_pretrain_layer():
+            return
+        tc = self.conf.training
+
+        @jax.jit
+        def pstep(params, opt_state, iteration, x, key):
+            def loss_fn(p):
+                full = dict(self.params)
+                full[name] = p
+                h = x.astype(self.dtype)
+                for j in range(layer_idx):
+                    jn = self.layer_names[j]
+                    pp = self.conf.input_preprocessors.get(str(j))
+                    if pp is not None:
+                        h = pp.pre_process(h)
+                    h, _ = self.layers[j].apply(
+                        jax.lax.stop_gradient(full[jn]),
+                        self.state.get(jn, {}), h, train=False)
+                pp = self.conf.input_preprocessors.get(str(layer_idx))
+                if pp is not None:
+                    h = pp.pre_process(h)
+                return layer.pretrain_loss(p, h, key)
+
+            score, grads = jax.value_and_grad(loss_fn)(params)
+            wrapped_p = {name: params}
+            wrapped_g = {name: grads}
+            wrapped_s = {name: opt_state}
+            new_p, new_s = apply_updater(tc, wrapped_p, wrapped_g, wrapped_s,
+                                         iteration)
+            return new_p[name], new_s[name], score
+
+        it = 0
+        batches = data if not hasattr(data, "__array__") else [(data, None)]
+        for batch in batches:
+            feats, _, _, _ = _unpack_batch(batch)
+            key = jax.random.fold_in(jax.random.PRNGKey(tc.seed), it)
+            (self.params[name], self.updater_state[name],
+             score) = pstep(self.params[name], self.updater_state[name], it,
+                            jnp.asarray(feats), key)
+            self.score_value = score
+            it += 1
+
+    # ------------------------------------------------------------- inference
+    def output(self, x, train: bool = False) -> Array:
+        """Final-layer activations (reference: MultiLayerNetwork.output)."""
+        fn = self._jit_cache.get(("output", train))
+        if fn is None:
+            def _out(params, state, x):
+                h, _, _, _ = self._forward(params, state, x, train=train,
+                                           key=None, mask=None)
+                return h
+            fn = jax.jit(_out)
+            self._jit_cache[("output", train)] = fn
+        return fn(self.params, self.state, jnp.asarray(x))
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """All layer activations (reference: feedForward)."""
+        acts, _, _, _ = self._forward(self.params, self.state,
+                                      jnp.asarray(x), train=train, key=None,
+                                      mask=None, collect=True)
+        return acts
+
+    def score(self, x, y=None, mask=None) -> float:
+        """Mean score on a dataset/batch (reference:
+        MultiLayerNetwork.score(DataSet))."""
+        if y is None:
+            feats, labs, fm, lm = _unpack_batch(x)
+            return self.score(feats, labs, lm)
+        fn = self._jit_cache.get("score")
+        if fn is None:
+            # Inference-mode scoring (reference: MultiLayerNetwork.score
+            # delegates to score(data, training=false) — batchnorm must use
+            # running stats, not the scored batch's statistics).
+            def _score(params, state, x, y, mask):
+                s, _ = self._loss_fn(params, state, x, y, None, mask,
+                                     train=False)
+                return s
+            fn = jax.jit(_score)
+            self._jit_cache["score"] = fn
+        return float(fn(self.params, self.state, jnp.asarray(x),
+                        jnp.asarray(y),
+                        None if mask is None else jnp.asarray(mask)))
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (reference:
+        MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        for batch in iterator:
+            feats, labs, _, lmask = _unpack_batch(batch)
+            out = self.output(feats)
+            ev.eval(labs, out, mask=lmask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    # --------------------------------------------------------- rnn inference
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
+
+    def rnn_time_step(self, x) -> Array:
+        """Stateful single/multi-step inference (reference: rnnTimeStep,
+        MultiLayerNetwork.java:2234)."""
+        for i, layer in enumerate(self.layers):
+            if not getattr(layer, "supports_streaming", True):
+                raise ValueError(
+                    f"rnn_time_step unsupported: layer {i} "
+                    f"({type(layer).__name__}) needs the full sequence "
+                    "(reference: GravesBidirectionalLSTM cannot rnnTimeStep)")
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2  # [B, F] -> single step
+        if squeeze:
+            x = x[:, None, :]
+        if self._rnn_carries is None:
+            self._rnn_carries = self._init_carries(x.shape[0])
+        h, _, _, new_carries = self._forward(
+            self.params, self.state, x, train=False, key=None, mask=None,
+            carries=self._rnn_carries)
+        self._rnn_carries.update(new_carries)
+        return h[:, 0] if squeeze else h
+
+    # ------------------------------------------------------------ flat views
+    def params_flat(self) -> Array:
+        """Flat parameter vector (reference: Model.params() — the flat view
+        buffer, MultiLayerNetwork.java:445)."""
+        flat, _ = ravel_pytree(self.params)
+        return flat
+
+    def set_params_flat(self, flat: Array) -> None:
+        _, unravel = ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(flat))
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+                                                   self.updater_state)
+        net._initialized = self._initialized
+        return net
+
+
+def _unpack_batch(batch):
+    """Accept (x, y), (x, y, fmask, lmask), or DataSet-like objects."""
+    if hasattr(batch, "features"):
+        return (batch.features, getattr(batch, "labels", None),
+                getattr(batch, "features_mask", None),
+                getattr(batch, "labels_mask", None))
+    if isinstance(batch, (tuple, list)):
+        if len(batch) == 2:
+            return batch[0], batch[1], None, None
+        if len(batch) == 4:
+            return tuple(batch)
+    raise ValueError(f"Cannot unpack batch of type {type(batch)}")

@@ -1,0 +1,100 @@
+"""Weight initialization schemes.
+
+Parity with the reference's `WeightInit` enum + `WeightInitUtil`
+(reference: deeplearning4j-nn/.../nn/weights/WeightInit.java,
+WeightInitUtil.java): XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, RELU,
+RELU_UNIFORM, UNIFORM, SIGMOID_UNIFORM, ZERO, ONES, IDENTITY, DISTRIBUTION,
+VAR_SCALING variants. Uses jax PRNG keys instead of ND4J's global RNG.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], fan_in: float,
+                 fan_out: float, scheme: str = "xavier",
+                 distribution: Optional[dict] = None,
+                 dtype=jnp.float32) -> Array:
+    """Initialize a weight tensor.
+
+    ``fan_in``/``fan_out`` are passed explicitly because for conv kernels they
+    include the receptive-field size (kh*kw*c), mirroring the reference's
+    `WeightInitUtil.initWeights(fanIn, fanOut, shape, ...)` signature.
+    """
+    scheme = str(scheme).lower()
+    shape = tuple(int(s) for s in shape)
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier_fan_in":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "relu":
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "relu_uniform":
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "uniform":
+        # reference: U(-a, a) with a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "normal_in":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "normal_out":
+        std = math.sqrt(1.0 / fan_out)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "normal_avg":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "distribution":
+        return _sample_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+def _sample_distribution(key: jax.Array, shape, dist: dict,
+                         dtype) -> Array:
+    """Sample from a serialized distribution spec.
+
+    Mirrors the reference's `nn/conf/distribution/` classes:
+    NormalDistribution(mean, std), UniformDistribution(lower, upper),
+    GaussianDistribution == Normal, BinomialDistribution(n, p).
+    """
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lo = float(dist.get("lower", -1.0))
+        hi = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lo, hi)
+    if kind == "binomial":
+        n = int(dist.get("n", 1))
+        p = float(dist.get("p", 0.5))
+        return jax.random.binomial(key, n, p, shape).astype(dtype)
+    raise ValueError(f"Unknown distribution type '{kind}'")

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.train.updaters import (  # noqa: F401
+    init_updater_state,
+    apply_updater,
+    compute_learning_rate,
+)
+from deeplearning4j_tpu.train.listeners import (  # noqa: F401
+    IterationListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+    ComposableIterationListener,
+)

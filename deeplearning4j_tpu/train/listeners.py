@@ -1,0 +1,108 @@
+"""Training listeners.
+
+Parity with the reference's listener suite (reference:
+deeplearning4j-nn/.../optimize/api/IterationListener.java,
+TrainingListener.java and optimize/listeners/{ScoreIterationListener,
+PerformanceListener,CollectScoresIterationListener,
+ParamAndGradientIterationListener,ComposableIterationListener}.java).
+
+Listeners run host-side between jitted steps; to keep the device pipeline hot
+they receive the step's already-materialized scalar score rather than pulling
+tensors themselves.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+    # TrainingListener extension points (reference TrainingListener.java)
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference:
+    ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class PerformanceListener(IterationListener):
+    """Samples/sec + batches/sec (reference: PerformanceListener.java)."""
+
+    def __init__(self, frequency: int = 1, report: bool = True):
+        self.frequency = max(1, frequency)
+        self.report = report
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self._samples_since = 0
+        self.last_samples_per_sec = 0.0
+        self.last_batches_per_sec = 0.0
+
+    def record_batch(self, batch_size: int):
+        self._samples_since += batch_size
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples_since = 0
+            return
+        if (iteration - self._last_iter) >= self.frequency:
+            dt = now - self._last_time
+            batches = iteration - self._last_iter
+            self.last_batches_per_sec = batches / dt if dt > 0 else 0.0
+            self.last_samples_per_sec = (self._samples_since / dt
+                                         if dt > 0 else 0.0)
+            if self.report:
+                log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, "
+                         "score %s", iteration, self.last_samples_per_sec,
+                         self.last_batches_per_sec, score)
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples_since = 0
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Store (iteration, score) pairs (reference:
+    CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)

@@ -1,0 +1,44 @@
+"""Test configuration: force an 8-device CPU mesh so every test — including
+the multi-chip sharding suite — runs without TPU hardware (the 'fake backend'
+CI strategy, SURVEY.md §4: the reference's test-nd4j-native profile analog).
+"""
+import os
+
+# The environment pre-sets JAX_PLATFORMS=axon (the tunneled TPU backend) and a
+# sitecustomize module imports jax + registers the axon PJRT plugin at
+# interpreter startup — before this conftest runs. Env vars are therefore too
+# late; tests must (a) drop the axon backend factory so jax never dials the
+# TPU tunnel, and (b) override the already-read platform config. Tests must
+# never claim the single TPU tunnel — it hangs the suite waiting on a grant.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Float64 available suite-wide: gradient checks need reference-grade
+# precision (models default to float32 internally regardless; they cast
+# inputs to their configured dtype).
+jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("need 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)

@@ -1,0 +1,93 @@
+"""Config DSL: builder, shape inference, preprocessor insertion, JSON
+round-trip (reference test analog: deeplearning4j-core/src/test/java/org/
+deeplearning4j/nn/conf/ serialization tests)."""
+import numpy as np
+
+from deeplearning4j_tpu import (MultiLayerConfiguration,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnFlatToCnnPreProcessor, CnnToFeedForwardPreProcessor)
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          GravesLSTM, OutputLayer,
+                                          SubsamplingLayer)
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration(seed=7, updater="adam",
+                                   learning_rate=1e-3,
+                                   weight_init="xavier")
+            .list(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                   activation="relu"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                  ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                   activation="relu"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                  DenseLayer(n_out=500, activation="relu"),
+                  OutputLayer(n_out=10, activation="softmax",
+                              loss_function="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1)))
+
+
+def test_shape_inference_lenet():
+    conf = lenet_conf()
+    conf.resolve_shapes()
+    # conv1 gets n_in from input channels
+    assert conf.layers[0].n_in == 1
+    # conv2 n_in = conv1 n_out
+    assert conf.layers[2].n_in == 20
+    # dense n_in = 4*4*50 after two conv(5x5,valid)+pool(2x2) stages
+    assert conf.layers[4].n_in == 4 * 4 * 50
+    assert conf.layers[5].n_in == 500
+    # preprocessors auto-inserted: flat->cnn at 0, cnn->ff at 4
+    assert isinstance(conf.input_preprocessors["0"],
+                      CnnFlatToCnnPreProcessor)
+    assert isinstance(conf.input_preprocessors["4"],
+                      CnnToFeedForwardPreProcessor)
+
+
+def test_global_defaults_applied():
+    conf = (NeuralNetConfiguration(activation="tanh", weight_init="relu",
+                                   l2=1e-4, learning_rate=0.05)
+            .list(DenseLayer(n_in=4, n_out=3),
+                  OutputLayer(n_in=3, n_out=2, activation="softmax")))
+    assert conf.layers[0].activation == "tanh"
+    assert conf.layers[0].weight_init == "relu"
+    assert conf.layers[0].l2 == 1e-4
+    assert conf.layers[0].learning_rate == 0.05
+    # explicit layer setting wins over global
+    assert conf.layers[1].activation == "softmax"
+
+
+def test_json_roundtrip():
+    conf = lenet_conf()
+    conf.resolve_shapes()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert len(conf2.layers) == len(conf.layers)
+    assert conf2.layers[0].n_out == 20
+    assert conf2.layers[0].kernel_size == [5, 5]
+    assert conf2.training.updater == "adam"
+    assert conf2.training.learning_rate == 1e-3
+    # round-trip again: stable
+    assert conf2.to_json() == MultiLayerConfiguration.from_json(js).to_json()
+
+
+def test_json_roundtrip_rnn():
+    conf = (NeuralNetConfiguration(seed=3)
+            .list(GravesLSTM(n_in=10, n_out=8, activation="tanh"),
+                  OutputLayer(n_in=8, n_out=4, activation="softmax")))
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.layers[0].n_out == 8
+    assert conf2.layers[0].peephole is True
+
+
+def test_tbptt_config():
+    conf = (NeuralNetConfiguration()
+            .list(GravesLSTM(n_in=5, n_out=6),
+                  OutputLayer(n_in=6, n_out=2))
+            .backprop_type_tbptt(10, 10))
+    assert conf.backprop_type == "tbptt"
+    js = conf.to_json()
+    assert MultiLayerConfiguration.from_json(js).tbptt_fwd_length == 10

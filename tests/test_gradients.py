@@ -1,0 +1,132 @@
+"""Gradient checks — the correctness backbone (reference test analog:
+deeplearning4j-core/src/test/.../gradientcheck/{GradientCheckTests,
+CNNGradientCheckTest,BNGradientCheckTest,...}.java, SURVEY.md §4). Runs in
+float64 for reference-grade precision (ε=1e-6, max rel error 1e-3)."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          GlobalPoolingLayer, GravesLSTM,
+                                          GravesBidirectionalLSTM,
+                                          OutputLayer, RnnOutputLayer,
+                                          SubsamplingLayer)
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _check(conf, x, y, **kw):
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, print_results=True, **kw)
+
+
+RNG = np.random.RandomState(12345)
+
+
+def test_gradcheck_mlp():
+    x = RNG.randn(6, 4)
+    y = np.eye(3)[RNG.randint(0, 3, 6)]
+    for loss, act in [("mcxent", "softmax"), ("mse", "identity"),
+                      ("xent", "sigmoid")]:
+        yy = y if loss != "xent" else (y > 0).astype(float)
+        conf = (NeuralNetConfiguration(seed=42, activation="tanh",
+                                       dtype="float64")
+                .list(DenseLayer(n_in=4, n_out=5),
+                      OutputLayer(n_in=5, n_out=3, activation=act,
+                                  loss_function=loss)))
+        _check(conf, x, yy)
+
+
+def test_gradcheck_mlp_l1_l2():
+    x = RNG.randn(5, 4)
+    y = np.eye(3)[RNG.randint(0, 3, 5)]
+    conf = (NeuralNetConfiguration(seed=42, activation="sigmoid", l1=0.01,
+                                   l2=0.02, dtype="float64")
+            .list(DenseLayer(n_in=4, n_out=6),
+                  OutputLayer(n_in=6, n_out=3, activation="softmax")))
+    _check(conf, x, y)
+
+
+def test_gradcheck_cnn():
+    x = RNG.randn(3, 6 * 6)
+    y = np.eye(2)[RNG.randint(0, 2, 3)]
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                   activation="tanh"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                   pooling_type="avg"),
+                  OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(6, 6, 1)))
+    _check(conf, x, y)
+
+
+def test_gradcheck_cnn_maxpool():
+    x = RNG.randn(2, 6 * 6)
+    y = np.eye(2)[RNG.randint(0, 2, 2)]
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                   activation="sigmoid"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                   pooling_type="max"),
+                  OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(6, 6, 1)))
+    _check(conf, x, y)
+
+
+def test_gradcheck_batchnorm():
+    x = RNG.randn(8, 5)
+    y = np.eye(3)[RNG.randint(0, 3, 8)]
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(DenseLayer(n_in=5, n_out=6, activation="tanh"),
+                  BatchNormalization(),
+                  OutputLayer(n_in=6, n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(5)))
+    _check(conf, x, y)
+
+
+def test_gradcheck_lstm():
+    x = RNG.randn(3, 6, 4)
+    y = np.zeros((3, 6, 2))
+    y[np.arange(3), :, RNG.randint(0, 2, 3)] = 1.0
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(GravesLSTM(n_in=4, n_out=5, activation="tanh"),
+                  RnnOutputLayer(n_in=5, n_out=2, activation="softmax")))
+    _check(conf, x, y)
+
+
+def test_gradcheck_bidirectional_lstm():
+    x = RNG.randn(2, 5, 3)
+    y = np.zeros((2, 5, 2))
+    y[np.arange(2), :, RNG.randint(0, 2, 2)] = 1.0
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(GravesBidirectionalLSTM(n_in=3, n_out=4,
+                                          activation="tanh"),
+                  RnnOutputLayer(n_in=4, n_out=2, activation="softmax")))
+    _check(conf, x, y)
+
+
+def test_gradcheck_masked_rnn():
+    x = RNG.randn(3, 5, 4)
+    y = np.zeros((3, 5, 2))
+    y[np.arange(3), :, RNG.randint(0, 2, 3)] = 1.0
+    mask = np.array([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0], [1, 0, 0, 0, 0]],
+                    dtype=np.float64)
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(GravesLSTM(n_in=4, n_out=4, activation="tanh"),
+                  RnnOutputLayer(n_in=4, n_out=2, activation="softmax")))
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, mask=mask, print_results=True)
+
+
+def test_gradcheck_global_pooling():
+    x = RNG.randn(3, 6, 4)
+    y = np.eye(2)[RNG.randint(0, 2, 3)]
+    conf = (NeuralNetConfiguration(seed=42, dtype="float64")
+            .list(GravesLSTM(n_in=4, n_out=5, activation="tanh"),
+                  GlobalPoolingLayer(pooling_type="avg"),
+                  OutputLayer(n_in=5, n_out=2, activation="softmax")))
+    _check(conf, x, y)

@@ -1,0 +1,189 @@
+"""Layer forward shapes and values (reference test analog:
+deeplearning4j-core/src/test/java/org/deeplearning4j/nn/layers/**)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (ActivationLayer,
+                                          BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          DropoutLayer, EmbeddingLayer,
+                                          GlobalPoolingLayer,
+                                          GravesBidirectionalLSTM,
+                                          GravesLSTM, LossLayer,
+                                          OutputLayer, SubsamplingLayer,
+                                          ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.normalization import (
+    LocalResponseNormalization)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_dense_forward():
+    layer = DenseLayer(n_in=4, n_out=3, activation="identity",
+                       weight_init="xavier")
+    p = layer.init_params(KEY)
+    x = jnp.ones((2, 4))
+    y, _ = layer.apply(p, {}, x)
+    assert y.shape == (2, 3)
+    np.testing.assert_allclose(y, x @ p["W"] + p["b"], rtol=1e-6)
+
+
+def test_dense_on_sequence():
+    layer = DenseLayer(n_in=4, n_out=3, activation="relu")
+    p = layer.init_params(KEY)
+    y, _ = layer.apply(p, {}, jnp.ones((2, 7, 4)))
+    assert y.shape == (2, 7, 3)
+
+
+def test_conv_shapes():
+    layer = ConvolutionLayer(n_in=1, n_out=8, kernel_size=(5, 5),
+                             activation="relu")
+    out_t = layer.update_input_type(InputType.convolutional(28, 28, 1))
+    assert (out_t.height, out_t.width, out_t.channels) == (24, 24, 8)
+    p = layer.init_params(KEY)
+    assert p["W"].shape == (5, 5, 1, 8)
+    y, _ = layer.apply(p, {}, jnp.ones((2, 28, 28, 1)))
+    assert y.shape == (2, 24, 24, 8)
+
+
+def test_conv_same_mode():
+    layer = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(3, 3),
+                             convolution_mode="same", stride=(1, 1))
+    out_t = layer.update_input_type(InputType.convolutional(8, 8, 3))
+    assert (out_t.height, out_t.width) == (8, 8)
+    p = layer.init_params(KEY)
+    y, _ = layer.apply(p, {}, jnp.ones((1, 8, 8, 3)))
+    assert y.shape == (1, 8, 8, 4)
+
+
+def test_subsampling_max_and_avg():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    mx = SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                          stride=(2, 2))
+    y, _ = mx.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0],
+                               [[5, 7], [13, 15]])
+    av = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                          stride=(2, 2))
+    y, _ = av.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_inference():
+    layer = BatchNormalization()
+    layer.update_input_type(InputType.feed_forward(5))
+    p = layer.init_params(KEY)
+    s = layer.init_state()
+    x = jax.random.normal(KEY, (64, 5)) * 3 + 1
+    y, s2 = layer.apply(p, s, x, train=True)
+    # normalized batch: ~0 mean, ~1 var
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(s2["mean"]), 0.0)
+    # inference path uses running stats
+    y2, s3 = layer.apply(p, s2, x, train=False)
+    assert s3 is s2 or np.allclose(np.asarray(s3["mean"]),
+                                   np.asarray(s2["mean"]))
+
+
+def test_lrn_shape():
+    layer = LocalResponseNormalization()
+    x = jax.random.normal(KEY, (2, 4, 4, 8))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == x.shape
+    assert float(jnp.max(jnp.abs(y))) <= float(jnp.max(jnp.abs(x)))
+
+
+def test_zero_padding():
+    layer = ZeroPaddingLayer(padding=(1, 2))
+    y, _ = layer.apply({}, {}, jnp.ones((1, 4, 4, 2)))
+    assert y.shape == (1, 6, 8, 2)
+
+
+def test_embedding_lookup_matches_onehot():
+    layer = EmbeddingLayer(n_in=7, n_out=3, activation="identity")
+    p = layer.init_params(KEY)
+    idx = jnp.array([0, 3, 6])
+    y_idx, _ = layer.apply(p, {}, idx)
+    onehot = jax.nn.one_hot(idx, 7)
+    y_oh, _ = layer.apply(p, {}, onehot)
+    np.testing.assert_allclose(np.asarray(y_idx), np.asarray(y_oh),
+                               rtol=1e-5)
+
+
+def test_lstm_shapes_and_state():
+    layer = GravesLSTM(n_in=6, n_out=4, activation="tanh")
+    layer.update_input_type(InputType.recurrent(6, 10))
+    p = layer.init_params(KEY)
+    assert p["W"].shape == (6, 16)
+    assert p["RW"].shape == (4, 16)
+    assert "pI" in p  # peepholes present (Graves)
+    x = jax.random.normal(KEY, (3, 10, 6))
+    y, _ = layer.apply(p, {}, x)
+    assert y.shape == (3, 10, 4)
+    # step-by-step equals full scan
+    carry = layer.initial_carry(3, jnp.float32)
+    outs = []
+    for t in range(10):
+        carry, h = layer.step(p, carry, x[:, t])
+        outs.append(h)
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(y), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_masking_freezes_state():
+    layer = GravesLSTM(n_in=3, n_out=2)
+    p = layer.init_params(KEY)
+    x = jax.random.normal(KEY, (2, 5, 3))
+    mask = jnp.array([[1, 1, 1, 1, 1], [1, 1, 0, 0, 0]], jnp.float32)
+    y, _ = layer.apply(p, {}, x, mask=mask)
+    # masked outputs are zero
+    np.testing.assert_allclose(np.asarray(y[1, 2:]), 0.0, atol=1e-7)
+
+
+def test_bidirectional_lstm():
+    layer = GravesBidirectionalLSTM(n_in=3, n_out=4, mode="add")
+    p = layer.init_params(KEY)
+    x = jax.random.normal(KEY, (2, 6, 3))
+    y, _ = layer.apply(p, {}, x)
+    assert y.shape == (2, 6, 4)
+    concat = GravesBidirectionalLSTM(n_in=3, n_out=4, mode="concat")
+    pc = concat.init_params(KEY)
+    y2, _ = concat.apply(pc, {}, x)
+    assert y2.shape == (2, 6, 8)
+
+
+def test_global_pooling_masked():
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = jnp.stack([jnp.ones((4, 3)), 2 * jnp.ones((4, 3))])  # [2, 4, 3]
+    mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply({}, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y), [[1, 1, 1], [2, 2, 2]],
+                               rtol=1e-6)
+
+
+def test_dropout_train_vs_inference():
+    layer = DropoutLayer(rate=0.5)
+    x = jnp.ones((8, 100))
+    y_inf, _ = layer.apply({}, {}, x, train=False, key=KEY)
+    np.testing.assert_allclose(np.asarray(y_inf), 1.0)
+    y_tr, _ = layer.apply({}, {}, x, train=True, key=KEY)
+    arr = np.asarray(y_tr)
+    assert ((arr == 0) | (np.isclose(arr, 2.0))).all()
+    assert 0.3 < (arr == 0).mean() < 0.7
+
+
+def test_output_layer_loss_decreasing_direction():
+    layer = OutputLayer(n_in=4, n_out=3, activation="softmax",
+                        loss_function="mcxent")
+    p = layer.init_params(KEY)
+    x = jax.random.normal(KEY, (5, 4))
+    y = jax.nn.one_hot(jnp.array([0, 1, 2, 0, 1]), 3)
+    loss = layer.loss(p, x, y)
+    assert loss.shape == ()
+    assert float(loss) > 0

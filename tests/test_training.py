@@ -1,0 +1,150 @@
+"""End-to-end training: score decreases, accuracy improves (reference test
+analog: deeplearning4j-core/src/test/.../nn/multilayer/ integration tests on
+Iris/MNIST)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import (DigitsDataSetIterator,
+                                         IrisDataSetIterator)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          GravesLSTM, OutputLayer,
+                                          RnnOutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.train.listeners import CollectScoresIterationListener
+
+
+def test_iris_mlp_learns():
+    conf = (NeuralNetConfiguration(seed=42, updater="adam",
+                                   learning_rate=0.01, activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=16),
+                  OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(batch_size=150)
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    first_score = None
+    for epoch in range(200):
+        net.fit(it)
+        if first_score is None:
+            first_score = collector.scores[0][1]
+    final_score = collector.scores[-1][1]
+    assert final_score < first_score * 0.5
+    ev = net.evaluate(IrisDataSetIterator(batch_size=150))
+    assert ev.accuracy() > 0.95
+
+
+def test_digits_cnn_learns():
+    conf = (NeuralNetConfiguration(seed=7, updater="adam",
+                                   learning_rate=5e-3)
+            .list(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                   activation="relu"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                  DenseLayer(n_out=32, activation="relu"),
+                  OutputLayer(n_out=10, activation="softmax",
+                              loss_function="mcxent"))
+            .set_input_type(InputType.convolutional_flat(8, 8, 1)))
+    net = MultiLayerNetwork(conf).init()
+    it = DigitsDataSetIterator(batch_size=128)
+    for _ in range(8):
+        net.fit(it)
+    ev = net.evaluate(DigitsDataSetIterator(batch_size=128))
+    assert ev.accuracy() > 0.85
+
+
+def test_rnn_sequence_classification():
+    # each timestep's label = class of the sequence; simple separable task
+    rng = np.random.RandomState(0)
+    n, t, f, c = 64, 12, 5, 3
+    labels = rng.randint(0, c, n)
+    x = rng.randn(n, t, f).astype(np.float32) * 0.1
+    for i in range(n):
+        x[i, :, labels[i] % f] += 1.0
+    y = np.zeros((n, t, c), np.float32)
+    y[np.arange(n), :, labels] = 1.0
+
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.02)
+            .list(GravesLSTM(n_in=f, n_out=12, activation="tanh"),
+                  RnnOutputLayer(n_in=12, n_out=c, activation="softmax",
+                                 loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x, y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.3
+
+
+def test_tbptt_runs():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 25, 3).astype(np.float32)
+    y = np.tile(np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)][:, None],
+                (1, 25, 1))
+    conf = (NeuralNetConfiguration(seed=1, learning_rate=0.05)
+            .list(GravesLSTM(n_in=3, n_out=6),
+                  RnnOutputLayer(n_in=6, n_out=2, activation="softmax"))
+            .backprop_type_tbptt(10, 10))
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y)
+    assert np.isfinite(float(net.score_value))
+    # 25 steps with chunks of 10 -> 3 chunk iterations
+    assert net.iteration_count == 3
+
+
+def test_rnn_time_step_streaming():
+    conf = (NeuralNetConfiguration(seed=5)
+            .list(GravesLSTM(n_in=3, n_out=4),
+                  RnnOutputLayer(n_in=4, n_out=2, activation="softmax")))
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    step_outs = []
+    for t in range(6):
+        step_outs.append(np.asarray(net.rnn_time_step(x[:, t])))
+    streamed = np.stack(step_outs, axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+
+def test_l2_regularization_changes_score():
+    it = IrisDataSetIterator(batch_size=150)
+    conf = (NeuralNetConfiguration(seed=42, l2=0.1)
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=3, activation="softmax")))
+    net = MultiLayerNetwork(conf).init()
+    conf2 = (NeuralNetConfiguration(seed=42)
+             .list(DenseLayer(n_in=4, n_out=8),
+                   OutputLayer(n_in=8, n_out=3, activation="softmax")))
+    net2 = MultiLayerNetwork(conf2).init()
+    batch = next(iter(it))
+    s_reg = net.score(batch.features, batch.labels)
+    s_noreg = net2.score(batch.features, batch.labels)
+    assert s_reg > s_noreg  # penalty adds positive mass
+
+
+def test_params_flat_roundtrip():
+    conf = (NeuralNetConfiguration(seed=1)
+            .list(DenseLayer(n_in=4, n_out=5),
+                  OutputLayer(n_in=5, n_out=3, activation="softmax")))
+    net = MultiLayerNetwork(conf).init()
+    flat = net.params_flat()
+    assert flat.shape[0] == net.num_params() == (4 * 5 + 5) + (5 * 3 + 3)
+    net.set_params_flat(np.zeros_like(np.asarray(flat)))
+    assert float(np.abs(np.asarray(net.params_flat())).max()) == 0.0
+
+
+def test_frozen_layer_does_not_update():
+    from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+    conf = (NeuralNetConfiguration(seed=1, learning_rate=0.1)
+            .list(FrozenLayer(inner=DenseLayer(n_in=4, n_out=5,
+                                               activation="tanh")),
+                  OutputLayer(n_in=5, n_out=3, activation="softmax")))
+    net = MultiLayerNetwork(conf).init()
+    w_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it)
+    w_after = np.asarray(net.params["layer_0"]["W"])
+    np.testing.assert_array_equal(w_before, w_after)
+    # but the output layer did move
+    assert net.iteration_count > 0
