@@ -225,7 +225,7 @@ class ComputationGraph:
                                      FrozenLayer)
                 for name in self.topo}
 
-    def _make_train_step(self):
+    def _make_train_step(self, **jit_kwargs):
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
@@ -241,7 +241,7 @@ class ComputationGraph:
                 lr_multipliers=lr_mult, trainable=trainable)
             return new_params, new_state, new_opt, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
 
     def fit(self, data, labels=None, masks=None) -> None:
         """Train on a (Multi)DataSetIterator or arrays (reference:

@@ -188,7 +188,9 @@ class MultiLayerNetwork:
         return {self.layer_names[i]: not isinstance(l, FrozenLayer)
                 for i, l in enumerate(self.layers)}
 
-    def _make_train_step(self):
+    def _make_train_step(self, **jit_kwargs):
+        """Build the jitted minibatch step. ``jit_kwargs`` lets callers (e.g.
+        ParallelWrapper) compile the same step with mesh shardings."""
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
@@ -203,7 +205,7 @@ class MultiLayerNetwork:
                 lr_multipliers=lr_mult, trainable=trainable)
             return new_params, new_state, new_opt, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
 
     def _get_train_step(self, shape_key):
         fn = self._jit_cache.get(("train", shape_key))

@@ -1,0 +1,15 @@
+"""Distributed training over a TPU device mesh.
+
+The reference implements data parallelism three ways — ParallelWrapper
+threads averaging params every N iterations
+(deeplearning4j-scaleout/.../parallelism/ParallelWrapper.java:125,218), an
+Aeron parameter server, and Spark parameter averaging
+(dl4j-spark/.../ParameterAveragingTrainingMaster.java:858) — all host-staged
+(SURVEY.md §2.6, §5.8). On TPU those collapse into ONE idiom: a sharded,
+jitted train step whose gradient synchronization is an XLA `psum` riding ICI.
+This package also provides the strategies the reference lacks — tensor,
+pipeline, sequence/context (ring attention), and expert parallelism — as
+sharding policies over the same traced step.
+"""
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

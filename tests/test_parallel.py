@@ -1,0 +1,75 @@
+"""Distributed-training tests on the 8-device virtual CPU mesh.
+
+Reference analog: the Spark suite's local[N] tests, especially
+TestCompareParameterAveragingSparkVsSingleMachine.java (SURVEY.md §4) —
+"spark-averaged training == single-machine training" becomes "data-parallel
+sharded step == single-device step" numerically.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import MeshSpec, ParallelWrapper, make_mesh
+
+
+def _mlp_conf(seed=42, updater="adam"):
+    return (NeuralNetConfiguration(seed=seed, updater=updater,
+                                   learning_rate=0.05, activation="tanh")
+            .list(DenseLayer(n_in=6, n_out=10),
+                  OutputLayer(n_in=10, n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+
+
+def _data(rng, n=64):
+    x = rng.randn(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def test_mesh_axes(devices8):
+    mesh = make_mesh(MeshSpec(data=2, model=2, pipe=2))
+    assert mesh.axis_names == ("pipe", "data", "seq", "model", "expert")
+    assert mesh.shape["data"] == 2 and mesh.shape["pipe"] == 2
+
+
+def test_data_parallel_matches_single_device(devices8, rng):
+    x, y = _data(rng)
+
+    single = MultiLayerNetwork(_mlp_conf()).init()
+    for _ in range(10):
+        single.fit(x, y)
+
+    par_net = MultiLayerNetwork(_mlp_conf()).init()
+    pw = ParallelWrapper(par_net, workers=8)
+    for _ in range(10):
+        pw.fit(x, y)
+
+    # Same seed, same data, same updater: the sharded step must be the same
+    # program, so params agree to float tolerance.
+    f1 = np.asarray(single.params_flat())
+    f2 = np.asarray(par_net.params_flat())
+    np.testing.assert_allclose(f1, f2, rtol=2e-4, atol=2e-5)
+    assert abs(float(single.score_value) - float(par_net.score_value)) < 1e-4
+
+
+def test_data_parallel_uneven_batch_trimmed(devices8, rng):
+    x, y = _data(rng, n=61)  # not divisible by 8 -> trimmed to 56
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    pw = ParallelWrapper(net, workers=8)
+    pw.fit(x, y)
+    assert net.iteration_count == 1
+
+
+def test_parallel_wrapper_iterator(devices8, rng):
+    from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator)
+    x, y = _data(rng, n=64)
+    it = BaseDatasetIterator(x, y, batch_size=32)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    pw = ParallelWrapper(net, workers=4)
+    for _ in range(5):
+        pw.fit(it)
+    assert net.iteration_count == 10
+    assert float(net.score_value) < 1.2
