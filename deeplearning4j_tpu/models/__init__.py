@@ -1,0 +1,13 @@
+"""Model zoo.
+
+The reference's model-level offerings are Keras-imported CNNs (VGG16,
+deeplearning4j-modelimport/.../trainedmodels/TrainedModels.java:16), NLP
+embedding models (Word2Vec et al.), and user-configured MLN/CG networks.
+This package adds the flagship TPU-native model family — transformer LMs —
+plus LeNet-style reference configs used by the benchmark suite.
+"""
+from deeplearning4j_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+)
+from deeplearning4j_tpu.models.zoo import lenet_mnist  # noqa: F401

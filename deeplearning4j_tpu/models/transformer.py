@@ -1,0 +1,204 @@
+"""TransformerLM — the flagship TPU-native model family.
+
+NET-NEW vs the reference (it has no attention, SURVEY.md §5.7); this is the
+model the long-context and multi-dimensional parallelism requirements hang
+off. Design:
+
+- Pure-functional: `init_params` -> pytree, `forward(params, tokens)` ->
+  logits, `loss(params, tokens, targets)` -> scalar. The MLN/CG class API
+  wraps models like this; the flagship stays functional so the parallel
+  train step (parallel/megatron.py) can shard it axis-by-axis.
+- Block parameters are STACKED over depth (leading [L] axis) and applied
+  with `lax.scan` — one compiled block body regardless of depth, and the
+  natural layout for pipeline parallelism (reshape [L] -> [S, L/S], shard
+  the stage axis over 'pipe').
+- Head axis is explicit; attention runs through the same
+  `dot_product_attention` core as the DSL layer, so ring attention drops in
+  by replacing that one call.
+- Weights stay float32 at rest; activations can run bfloat16 (`dtype`),
+  accumulating in f32 on the MXU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.attention import (dot_product_attention,
+                                                    layer_norm)
+
+Array = jax.Array
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4
+    max_len: int = 256
+    mlp_ratio: int = 4
+    dtype: str = "float32"          # activation dtype ('bfloat16' on TPU)
+    n_experts: int = 0              # >0 switches the MLP to MoE every block
+    capacity_factor: float = 1.25
+    eps: float = 1e-5
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def d_ff(self) -> int:
+        return self.d_model * self.mlp_ratio
+
+    def activation_dtype(self):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float64": jnp.float64}[self.dtype]
+
+
+def _winit(key, shape, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32)
+            / jnp.sqrt(jnp.asarray(fan_in, jnp.float32)))
+
+
+def init_params(cfg: TransformerConfig, key: Array) -> Dict[str, Any]:
+    d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    ks = jax.random.split(key, 12)
+
+    def stack(k, shape, fan_in):
+        keys = jax.random.split(k, L)
+        return jnp.stack([_winit(keys[i], shape, fan_in) for i in range(L)])
+
+    blocks: Dict[str, Array] = {
+        "Wq": stack(ks[0], (d, d), d), "Wk": stack(ks[1], (d, d), d),
+        "Wv": stack(ks[2], (d, d), d), "Wo": stack(ks[3], (d, d), d),
+        "ln1g": jnp.ones((L, d)), "ln1b": jnp.zeros((L, d)),
+        "ln2g": jnp.ones((L, d)), "ln2b": jnp.zeros((L, d)),
+    }
+    if cfg.n_experts > 0:
+        e = cfg.n_experts
+        ek = jax.random.split(ks[4], L)
+        blocks["router"] = stack(ks[5], (d, e), d)
+        blocks["We1"] = jnp.stack([
+            jnp.stack([_winit(jax.random.fold_in(ek[i], j), (d, f), d)
+                       for j in range(e)]) for i in range(L)])  # [L, E, D, F]
+        blocks["We2"] = jnp.stack([
+            jnp.stack([_winit(jax.random.fold_in(ek[i], e + j), (f, d), f)
+                       for j in range(e)]) for i in range(L)])  # [L, E, F, D]
+    else:
+        blocks["W1"] = stack(ks[6], (d, f), d)
+        blocks["b1"] = jnp.zeros((L, f))
+        blocks["W2"] = stack(ks[7], (f, d), f)
+        blocks["b2"] = jnp.zeros((L, d))
+    return {
+        "embed": jax.random.normal(ks[8], (v, d), jnp.float32) * 0.02,
+        "pos": jax.random.normal(ks[9], (cfg.max_len, d), jnp.float32) * 0.02,
+        "blocks": blocks,
+        "lnfg": jnp.ones((d,)), "lnfb": jnp.zeros((d,)),
+        "Wout": _winit(ks[10], (d, v), d),
+    }
+
+
+# ---------------------------------------------------------------------------
+# block body — shared by the single-device forward and the parallel step
+# ---------------------------------------------------------------------------
+
+def dense_mlp(h: Array, p: Dict[str, Array]) -> Array:
+    z = jnp.matmul(h, p["W1"].astype(h.dtype)) + p["b1"].astype(h.dtype)
+    z = jax.nn.gelu(z)
+    return jnp.matmul(z, p["W2"].astype(h.dtype)) + p["b2"].astype(h.dtype)
+
+
+def moe_mlp(h: Array, p: Dict[str, Array], cfg: TransformerConfig) -> Array:
+    """Top-1-routed mixture of experts (GShard-style dispatch/combine
+    einsums; expert-parallel variant lives in parallel/megatron.py)."""
+    b, t, d = h.shape
+    x = h.reshape(b * t, d)
+    n, e = x.shape[0], cfg.n_experts
+    logits = jnp.matmul(x.astype(jnp.float32), p["router"])
+    gates = jax.nn.softmax(logits, axis=-1)            # [N, E]
+    expert = jnp.argmax(gates, axis=-1)                # [N]
+    prob = jnp.take_along_axis(gates, expert[:, None], 1)[:, 0]
+    cap = max(1, int(cfg.capacity_factor * n / e))
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)       # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # [N, E]
+    keep = (pos >= 0) & (pos < cap)
+    posc = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    disp = (jax.nn.one_hot(posc, cap, dtype=jnp.float32)
+            * keep[..., None].astype(jnp.float32)
+            * onehot[..., None])                                 # [N, E, C]
+    xin = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
+    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["We1"]))
+    out = jnp.einsum("ecf,efd->ecd", z, p["We2"])                # [E, C, D]
+    comb = disp * prob[:, None, None]
+    y = jnp.einsum("nec,ecd->nd", comb, out)
+    return y.astype(h.dtype).reshape(b, t, d)
+
+
+def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
+                  mask: Optional[Array] = None) -> Array:
+    """One pre-LN transformer block on [B, T, D] (full, unsharded)."""
+    d = cfg.d_model
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+
+    def heads(y):
+        return y.reshape(y.shape[0], y.shape[1], cfg.n_heads, cfg.d_head)
+
+    q = heads(jnp.matmul(x, p["Wq"].astype(x.dtype)))
+    k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
+    v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
+    a = dot_product_attention(q, k, v, causal=True, mask=mask)
+    h = h + jnp.matmul(a.reshape(a.shape[0], a.shape[1], d),
+                       p["Wo"].astype(h.dtype))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    if cfg.n_experts > 0:
+        h = h + moe_mlp(x, p, cfg)
+    else:
+        h = h + dense_mlp(x, p)
+    return h
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: Array) -> Array:
+    """tokens [B, T] int32 -> logits [B, T, V]."""
+    dt = cfg.activation_dtype()
+    t = tokens.shape[1]
+    h = (params["embed"].astype(dt)[tokens]
+         + params["pos"].astype(dt)[:t][None])
+
+    def body(h, p):
+        return block_forward(h, p, cfg), None
+
+    h, _ = lax.scan(body, h, params["blocks"])
+    h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+    return jnp.matmul(h, params["Wout"].astype(h.dtype))
+
+
+def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: Array,
+            targets: Array) -> Array:
+    logits = forward(cfg, params, tokens).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+class TransformerLM:
+    """Thin stateful wrapper matching the framework's model surface
+    (init/fit-style usage goes through parallel/megatron.py's train step or
+    a user loop; this class covers single-chip use and the graft entry)."""
+
+    def __init__(self, cfg: TransformerConfig, seed: int = 0):
+        self.cfg = cfg
+        self.params = init_params(cfg, jax.random.PRNGKey(seed))
+        self._fwd = jax.jit(lambda p, t: forward(cfg, p, t))
+
+    def logits(self, tokens) -> Array:
+        return self._fwd(self.params, jnp.asarray(tokens))
+
+    def loss(self, tokens, targets) -> float:
+        return float(loss_fn(self.cfg, self.params, jnp.asarray(tokens),
+                             jnp.asarray(targets)))

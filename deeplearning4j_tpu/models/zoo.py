@@ -1,0 +1,34 @@
+"""Reference model configs used by benchmarks and examples.
+
+LeNet-on-MNIST is the reference's canonical example/benchmark config
+(BASELINE.md: MultiLayerNetwork.fit + MnistDataSetIterator,
+deeplearning4j-nn/.../MultiLayerNetwork.java:947).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+
+
+def lenet_mnist(seed: int = 12345, learning_rate: float = 0.01,
+                updater: str = "nesterovs", dtype: str = "float32"):
+    """LeNet: conv5x5x20 -> maxpool -> conv5x5x50 -> maxpool -> dense500 ->
+    softmax10 (the classic DL4J LenetMnistExample topology)."""
+    return (NeuralNetConfiguration(seed=seed, updater=updater,
+                                   learning_rate=learning_rate,
+                                   momentum=0.9, weight_init="xavier",
+                                   dtype=dtype)
+            .list(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                   stride=(1, 1), activation="identity"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                   pooling_type="max"),
+                  ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                   stride=(1, 1), activation="identity"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                   pooling_type="max"),
+                  DenseLayer(n_out=500, activation="relu"),
+                  OutputLayer(n_out=10, activation="softmax",
+                              loss_function="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1)))

@@ -77,6 +77,15 @@ def rectifiedtanh(x: Array) -> Array:
     return jnp.maximum(0.0, jnp.tanh(x))
 
 
+def gelu(x: Array) -> Array:
+    """Net-new vs the reference (needed by transformer layers)."""
+    return jax.nn.gelu(x)
+
+
+def swish(x: Array) -> Array:
+    return jax.nn.silu(x)
+
+
 def leakyrelu_derivative_free(x: Array) -> Array:  # pragma: no cover - alias
     return leakyrelu(x)
 
@@ -98,6 +107,8 @@ ACTIVATIONS = {
     "cube": cube,
     "rationaltanh": rationaltanh,
     "rectifiedtanh": rectifiedtanh,
+    "gelu": gelu,
+    "swish": swish,
 }
 
 

@@ -26,6 +26,11 @@ from deeplearning4j_tpu.nn.layers.misc import (  # noqa: F401
     DropoutLayer,
     GlobalPoolingLayer,
 )
+from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
+    LayerNormalization,
+    MultiHeadAttention,
+    TransformerBlock,
+)
 from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
     GravesLSTM,
     LSTM,

@@ -1,0 +1,375 @@
+"""Composite-parallel transformer training: DP x TP x PP x SP x EP in one
+compiled step.
+
+NET-NEW vs the reference, whose only strategy is data parallelism by
+host-staged parameter averaging (SURVEY.md §2.6); here every strategy is a
+sharding of one traced program over the named mesh (parallel/mesh.py):
+
+- data ('data'): batch sharded; gradient psum.
+- tensor ('model'): megatron-style — attention heads and MLP hidden sharded;
+  forward psum ("g" op) paired with an identity-forward/psum-backward "f" op
+  at each parallel region's entry so residual-stream gradients stay exact.
+- pipeline ('pipe'): blocks stacked [L] -> stages [S, L/S]; GPipe microbatch
+  schedule, activations hop stages via ppermute; loss is computed on the
+  last stage and psum-masked across the axis.
+- sequence ('seq'): tokens sharded over time; ring attention
+  (parallel/ring.py) rotates K/V blocks with ppermute.
+- expert ('ep' rides the 'data' axis, Switch/GShard-style): experts sharded
+  over 'data', tokens routed by all_to_all. n_experts % data-size == 0.
+
+Gradient synchronization rule: a leaf's gradient is psum'd over exactly the
+mesh axes it is replicated across among ('pipe','data','seq') — 'model' is
+excluded because the f/g pairing already delivers full gradients on every
+model rank.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.nn.layers.attention import layer_norm
+from deeplearning4j_tpu.parallel.ring import ring_attention
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# megatron f op: identity forward, psum backward
+# ---------------------------------------------------------------------------
+
+def _f_sync(axis_name: str):
+    """Megatron 'f': identity forward, psum backward — placed at a
+    tensor-parallel region's ENTRY so the residual stream's cotangent is
+    reassembled from the per-rank partial paths."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _g_sync(axis_name: str):
+    """Megatron 'g': psum forward, IDENTITY backward — a raw lax.psum is
+    wrong here because its autodiff transpose is another psum, which
+    double-counts the already-full cotangent on every rank."""
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis_name)
+
+    def fwd(x):
+        return lax.psum(x, axis_name), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# parameter partition specs
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/transformer.init_params."""
+    blocks: Dict[str, P] = {
+        "Wq": P("pipe", None, "model"), "Wk": P("pipe", None, "model"),
+        "Wv": P("pipe", None, "model"), "Wo": P("pipe", "model", None),
+        "ln1g": P("pipe", None), "ln1b": P("pipe", None),
+        "ln2g": P("pipe", None), "ln2b": P("pipe", None),
+    }
+    if cfg.n_experts > 0:
+        blocks["router"] = P("pipe", None, None)
+        blocks["We1"] = P("pipe", "data", None, None)
+        blocks["We2"] = P("pipe", "data", None, None)
+    else:
+        blocks["W1"] = P("pipe", None, "model")
+        blocks["b1"] = P("pipe", "model")
+        blocks["W2"] = P("pipe", "model", None)
+        blocks["b2"] = P("pipe", None)
+    return {"embed": P(), "pos": P(), "blocks": blocks,
+            "lnfg": P(), "lnfb": P(), "Wout": P()}
+
+
+def _grad_psum_axes(spec: P, mesh: Mesh) -> Tuple[str, ...]:
+    used = {a for part in spec if part is not None
+            for a in ((part,) if isinstance(part, str) else part)}
+    return tuple(a for a in ("pipe", "data", "seq")
+                 if a not in used and mesh.shape[a] > 1)
+
+
+# ---------------------------------------------------------------------------
+# sharded block forward (operates on LOCAL shards inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _block_fwd_sharded(h: Array, p: Dict[str, Array],
+                       cfg: TransformerConfig, mesh: Mesh) -> Array:
+    tp = mesh.shape["model"]
+    sp = mesh.shape["seq"]
+    dp = mesh.shape["data"]
+    d = cfg.d_model
+    h_loc = cfg.n_heads // tp
+    f_model = _f_sync("model")
+    g_model = _g_sync("model")
+
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    x = f_model(x)
+
+    def heads(y):
+        return y.reshape(y.shape[0], y.shape[1], h_loc, cfg.d_head)
+
+    q = heads(jnp.matmul(x, p["Wq"].astype(x.dtype)))
+    k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
+    v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
+    if sp > 1:
+        a = ring_attention(q, k, v, "seq", causal=True)
+    else:
+        from deeplearning4j_tpu.nn.layers.attention import \
+            dot_product_attention
+        a = dot_product_attention(q, k, v, causal=True)
+    a = a.reshape(a.shape[0], a.shape[1], h_loc * cfg.d_head)
+    attn_out = jnp.matmul(a, p["Wo"].astype(a.dtype))
+    attn_out = g_model(attn_out)
+    h = h + attn_out
+
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    if cfg.n_experts > 0:
+        h = h + _moe_sharded(x, p, cfg, dp)
+    else:
+        x = f_model(x)
+        z = jax.nn.gelu(jnp.matmul(x, p["W1"].astype(x.dtype))
+                        + p["b1"].astype(x.dtype))
+        m = jnp.matmul(z, p["W2"].astype(z.dtype))
+        m = g_model(m)
+        h = h + m + p["b2"].astype(h.dtype)
+    return h
+
+
+def _moe_sharded(x: Array, p: Dict[str, Array], cfg: TransformerConfig,
+                 dp: int) -> Array:
+    """Expert-parallel top-1 MoE: experts sharded over 'data', tokens
+    exchanged by all_to_all (Switch-style). Local x: [b, t, D]."""
+    b, t, d = x.shape
+    e = cfg.n_experts
+    e_loc = e // dp
+    xt = x.reshape(b * t, d)
+    n = b * t
+    logits = jnp.matmul(xt.astype(jnp.float32), p["router"])
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(gates, axis=-1)
+    prob = jnp.take_along_axis(gates, expert[:, None], 1)[:, 0]
+    cap = max(1, int(cfg.capacity_factor * n / e))
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    keep = (pos >= 0) & (pos < cap)
+    posc = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    disp = (jax.nn.one_hot(posc, cap, dtype=jnp.float32)
+            * keep[..., None].astype(jnp.float32) * onehot[..., None])
+    xin = jnp.einsum("nec,nd->ecd", disp, xt.astype(jnp.float32))  # [E,C,D]
+    if dp > 1:
+        # [E, C, D] -> [E/dp, dp*C, D]: each data rank keeps its experts'
+        # tokens from every peer
+        xin = lax.all_to_all(xin, "data", split_axis=0, concat_axis=1,
+                             tiled=True)
+    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["We1"]))
+    out = jnp.einsum("ecf,efd->ecd", z, p["We2"])
+    if dp > 1:
+        out = lax.all_to_all(out, "data", split_axis=1, concat_axis=0,
+                             tiled=True)                            # [E,C,D]
+    comb = disp * prob[:, None, None]
+    y = jnp.einsum("nec,ecd->nd", comb, out)
+    return y.astype(x.dtype).reshape(b, t, d)
+
+
+# ---------------------------------------------------------------------------
+# GPipe pipeline over stacked local blocks
+# ---------------------------------------------------------------------------
+
+def _stage_fn(x: Array, blocks_local, cfg, mesh) -> Array:
+    def body(h, p):
+        return _block_fwd_sharded(h, p, cfg, mesh), None
+
+    y, _ = lax.scan(body, x, blocks_local)
+    return y
+
+
+def _pipeline_apply(blocks_local, h_mb: Array, cfg, mesh) -> Array:
+    """h_mb: [M, mb, tl, D] local microbatches -> outputs [M, mb, tl, D]
+    (meaningful on the LAST pipe stage; other stages produce their own
+    stage outputs, masked out by the caller)."""
+    s = mesh.shape["pipe"]
+    if s == 1:
+        m_, mb, tl, d = h_mb.shape
+        y = _stage_fn(h_mb.reshape(m_ * mb, tl, d), blocks_local, cfg, mesh)
+        return y.reshape(m_, mb, tl, d)
+    i = lax.axis_index("pipe")
+    m_ = h_mb.shape[0]
+    perm_fwd = [(j, j + 1) for j in range(s - 1)]
+    def vary(x):
+        return lax.pcast(x, ("pipe", "data", "seq"), to="varying")
+    recv0 = vary(jnp.zeros_like(h_mb[0]))
+    out0 = vary(jnp.zeros_like(h_mb))
+
+    def tick_full(carry, t):
+        recv, out_buf = carry
+        x0 = lax.dynamic_index_in_dim(h_mb, jnp.clip(t, 0, m_ - 1), 0,
+                                      keepdims=False)
+        x = jnp.where(i == 0, x0, recv)
+        y = _stage_fn(x, blocks_local, cfg, mesh)
+        recv_new = lax.ppermute(y, "pipe", perm_fwd)
+        store = jnp.clip(t - (s - 1), 0, m_ - 1)
+        cur = lax.dynamic_index_in_dim(out_buf, store, 0, keepdims=False)
+        upd = jnp.where(t >= s - 1, y, cur)
+        out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, store, 0)
+        return (recv_new, out_buf), None
+
+    (recv, out_buf), _ = lax.scan(tick_full, (recv0, out0),
+                                  jnp.arange(m_ + s - 1))
+    return out_buf
+
+
+# ---------------------------------------------------------------------------
+# the train step factory
+# ---------------------------------------------------------------------------
+
+class AdamState(NamedTuple):
+    m: Any
+    v: Any
+    count: Array
+
+
+def init_adam_state(params) -> AdamState:
+    z = lambda: jax.tree_util.tree_map(  # noqa: E731
+        lambda p: jnp.zeros(p.shape, p.dtype), params)
+    return AdamState(m=z(), v=z(), count=jnp.zeros((), jnp.int32))
+
+
+def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
+                             learning_rate: float = 1e-3,
+                             n_microbatches: Optional[int] = None,
+                             b1: float = 0.9, b2: float = 0.999,
+                             eps: float = 1e-8):
+    """Build the jitted composite-parallel train step.
+
+    Returns ``step(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)``. ``tokens``/``targets`` are GLOBAL [B, T]
+    int32 arrays (sharded on entry by the step's in_shardings).
+    """
+    s = mesh.shape["pipe"]
+    dp = mesh.shape["data"]
+    sp = mesh.shape["seq"]
+    tp = mesh.shape["model"]
+    if mesh.shape.get("expert", 1) != 1:
+        raise ValueError("expert parallelism rides the 'data' axis; use "
+                         "expert=1 in the mesh (Switch-style EP)")
+    if cfg.n_layers % s:
+        raise ValueError("n_layers must divide by pipe size")
+    if cfg.n_heads % tp or cfg.d_ff % tp:
+        raise ValueError("n_heads and d_ff must divide by model size")
+    if cfg.n_experts and cfg.n_experts % dp:
+        raise ValueError("n_experts must divide by data size")
+    m_ = n_microbatches or s
+    specs = param_specs(cfg)
+
+    def local_forward_loss(params, tokens_loc, targets_loc):
+        """Everything after sharding: local token block -> global mean
+        loss (identical scalar on every device)."""
+        dt = cfg.activation_dtype()
+        b_loc, tl = tokens_loc.shape
+        seq_idx = lax.axis_index("seq").astype(jnp.int32)
+        pos = lax.dynamic_slice(params["pos"],
+                                (seq_idx * tl, jnp.int32(0)),
+                                (tl, cfg.d_model))
+        h = params["embed"].astype(dt)[tokens_loc] + pos.astype(dt)[None]
+        # microbatch split for the pipeline
+        if b_loc % m_:
+            raise ValueError(f"local batch {b_loc} not divisible by "
+                             f"{m_} microbatches")
+        mb = b_loc // m_
+        h_mb = h.reshape(m_, mb, tl, cfg.d_model)
+        out = _pipeline_apply(params["blocks"], h_mb, cfg, mesh)
+        hf = out.reshape(b_loc, tl, cfg.d_model)
+        hf = layer_norm(hf, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(hf, params["Wout"].astype(hf.dtype))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets_loc[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        local_sum = jnp.sum(nll)
+        if s > 1:
+            is_last = (lax.axis_index("pipe") == s - 1)
+            local_sum = jnp.where(is_last, local_sum, 0.0)
+        total = lax.psum(local_sum, ("pipe", "data", "seq"))
+        count = b_loc * tl * dp * sp
+        return total / count
+
+    def sharded_step(params, opt_m, opt_v, count, tokens_loc, targets_loc):
+        loss, grads = jax.value_and_grad(
+            lambda p: local_forward_loss(p, tokens_loc, targets_loc))(params)
+        # sync gradients over the axes each leaf is replicated across
+        grads = jax.tree_util.tree_map(
+            lambda g, sp_: lax.psum(g, _grad_psum_axes(sp_, mesh))
+            if _grad_psum_axes(sp_, mesh) else g,
+            grads, specs)
+        # adam on local shards (identical math on every replica)
+        cnt = count + 1
+        t = cnt.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * g * g
+            mhat = m2 / (1 - jnp.power(b1, t))
+            vhat = v2 / (1 - jnp.power(b2, t))
+            return (p - learning_rate * mhat / (jnp.sqrt(vhat) + eps),
+                    m2, v2)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(opt_m)
+        flat_v = treedef.flatten_up_to(opt_v)
+        new_p, new_m, new_v = [], [], []
+        for pp, gg, mm, vv in zip(flat_p, flat_g, flat_m, flat_v):
+            a, b, c = upd(pp, gg, mm, vv)
+            new_p.append(a)
+            new_m.append(b)
+            new_v.append(c)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_m),
+                jax.tree_util.tree_unflatten(treedef, new_v),
+                cnt, loss)
+
+    data_spec = P(("data",), ("seq",))
+    smapped = shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(specs, specs, specs, P(), data_spec, data_spec),
+        out_specs=(specs, specs, specs, P(), P()),
+        check_vma=False)
+
+    def step(params, opt_state: AdamState, tokens, targets):
+        p2, m2, v2, cnt, loss = smapped(params, opt_state.m, opt_state.v,
+                                        opt_state.count, tokens, targets)
+        return p2, AdamState(m2, v2, cnt), loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
+    """Place a host/replicated param pytree onto the mesh per param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda p, sp_: jax.device_put(p, NamedSharding(mesh, sp_)),
+        params, specs)
