@@ -1,0 +1,67 @@
+"""Benchmark: LeNet-MNIST training throughput (examples/sec/chip).
+
+The reference's canonical config (BASELINE.md: MultiLayerNetwork LeNet on
+MNIST via fit(DataSetIterator), MultiLayerNetwork.java:947). The reference
+publishes no in-tree numbers (BASELINE.json "published": {}), so
+vs_baseline is reported against a fixed reference-CPU-backend estimate of
+~2,500 examples/sec for this config (DL4J 0.8 nd4j-native class hardware);
+the real comparison artifact is the absolute examples/sec/chip trend
+across rounds.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+REFERENCE_CPU_EXAMPLES_PER_SEC = 2500.0
+BATCH = 512
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    # bfloat16 activations: MXU-native on TPU
+    conf = lenet_mnist(dtype="bfloat16")
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((BATCH, 784), dtype=np.float32))
+    y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 10, BATCH)), 10)
+
+    step = net._get_train_step((x.shape, y.shape, False))
+    params, state, opt = net.params, net.state, net.updater_state
+    key = jax.random.PRNGKey(0)
+    for i in range(WARMUP_STEPS):
+        params, state, opt, score = step(params, state, opt, i, x, y, key,
+                                         None)
+    jax.block_until_ready(score)
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP_STEPS, WARMUP_STEPS + MEASURE_STEPS):
+        params, state, opt, score = step(params, state, opt, i, x, y, key,
+                                         None)
+    jax.block_until_ready(score)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = BATCH * MEASURE_STEPS / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(examples_per_sec
+                             / REFERENCE_CPU_EXAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -106,8 +106,9 @@ class ConvolutionLayer(BaseLayer):
     def apply(self, params, state, x, *, train=False, key=None, mask=None
               ) -> Tuple[Array, Dict]:
         w = params["W"].astype(x.dtype)
-        # bf16 inputs accumulate in f32 on the MXU; wider dtypes keep theirs
-        acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
+        # bf16 convs still accumulate in f32 on the MXU (hardware property;
+        # preferred_element_type would only widen the *output*, and its
+        # transpose rule rejects the f32-cotangent/bf16-operand mix)
         z = lax.conv_general_dilated(
             x, w,
             window_strides=_pair(self.stride),
@@ -115,7 +116,6 @@ class ConvolutionLayer(BaseLayer):
                                   _pair(self.padding)),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=_DIMENSION_NUMBERS,
-            preferred_element_type=acc,
         ).astype(x.dtype) + params["b"].astype(x.dtype)
         return get_activation(self.activation or "identity")(z), state
 
@@ -165,11 +165,9 @@ class Convolution1DLayer(ConvolutionLayer):
         s = self.stride if isinstance(self.stride, int) else self.stride[0]
         p = self.padding if isinstance(self.padding, int) else self.padding[0]
         pad = "SAME" if self.convolution_mode == "same" else [(p, p)]
-        acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
         z = lax.conv_general_dilated(
             x, params["W"].astype(x.dtype), window_strides=(s,), padding=pad,
             dimension_numbers=("NWC", "WIO", "NWC"),
-            preferred_element_type=acc,
         ).astype(x.dtype) + params["b"].astype(x.dtype)
         return get_activation(self.activation or "identity")(z), state
 
