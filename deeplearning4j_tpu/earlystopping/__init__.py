@@ -1,0 +1,23 @@
+from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.saver import (  # noqa: F401
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import (  # noqa: F401
+    DataSetLossCalculator,
+)
+from deeplearning4j_tpu.earlystopping.termination import (  # noqa: F401
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import (  # noqa: F401
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingTrainer,
+)

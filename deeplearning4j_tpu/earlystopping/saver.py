@@ -1,0 +1,77 @@
+"""Model savers for early stopping.
+
+Parity with the reference (reference:
+deeplearning4j-nn/.../earlystopping/saver/{InMemoryModelSaver,
+LocalFileModelSaver,LocalFileGraphSaver}.java).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from deeplearning4j_tpu.util.model_serializer import (
+    model_type_of, restore_computation_graph, restore_multi_layer_network,
+    write_model)
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    def __init__(self):
+        self._best: Optional[Any] = None
+        self._latest: Optional[Any] = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Persist best/latest model zips under a directory (reference:
+    LocalFileModelSaver: bestModel.bin / latestModel.bin)."""
+
+    BEST = "bestModel.zip"
+    LATEST = "latestModel.zip"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _restore(self, path: str):
+        if not os.path.exists(path):
+            return None
+        if model_type_of(path) == "ComputationGraph":
+            return restore_computation_graph(path)
+        return restore_multi_layer_network(path)
+
+    def save_best_model(self, net, score: float) -> None:
+        write_model(net, os.path.join(self.directory, self.BEST))
+
+    def save_latest_model(self, net, score: float) -> None:
+        write_model(net, os.path.join(self.directory, self.LATEST))
+
+    def get_best_model(self):
+        return self._restore(os.path.join(self.directory, self.BEST))
+
+    def get_latest_model(self):
+        return self._restore(os.path.join(self.directory, self.LATEST))

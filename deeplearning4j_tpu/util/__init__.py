@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.util.model_serializer import (  # noqa: F401
+    ModelSerializer,
+    restore_computation_graph,
+    restore_multi_layer_network,
+    write_model,
+)
+from deeplearning4j_tpu.util.model_guesser import ModelGuesser  # noqa: F401

@@ -1,0 +1,95 @@
+"""Early stopping tests (reference test analog:
+deeplearning4j-core/src/test/java/org/deeplearning4j/earlystopping/
+TestEarlyStopping.java)."""
+import numpy as np
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator,
+                                                   DataSet)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration(seed=1, updater="adam", learning_rate=lr)
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+    return MultiLayerNetwork(conf).init()
+
+
+def _iter(rng, n=60, batch=20):
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return BaseDatasetIterator(x, y, batch_size=batch)
+
+
+def test_max_epochs_termination(rng):
+    it = _iter(rng)
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+        score_calculator=DataSetLossCalculator(_iter(rng)),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(es, _net(), it).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 5
+
+
+def test_score_improvement_patience(rng):
+    it = _iter(rng)
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(3),
+            MaxEpochsTerminationCondition(200)],
+        score_calculator=DataSetLossCalculator(_iter(rng)))
+    # lr=0 -> no improvement ever -> stops after patience epochs
+    result = EarlyStoppingTrainer(es, _net(lr=0.0), it).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 5
+
+
+def test_max_time_termination(rng):
+    it = _iter(rng)
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(10000)],
+        iteration_termination_conditions=[
+            MaxTimeIterationTerminationCondition(0.0)])
+    result = EarlyStoppingTrainer(es, _net(), it).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_invalid_score_termination(rng):
+    it = _iter(rng)
+    # absurd lr drives the score to nan quickly
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(500)],
+        iteration_termination_conditions=[
+            InvalidScoreIterationTerminationCondition()])
+    net = _net(lr=1e12)
+    result = EarlyStoppingTrainer(es, net, it).fit()
+    assert result.termination_reason in ("IterationTerminationCondition",
+                                         "EpochTerminationCondition")
+
+
+def test_local_file_saver_restores_best(tmp_path, rng):
+    it = _iter(rng)
+    saver = LocalFileModelSaver(str(tmp_path))
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        score_calculator=DataSetLossCalculator(_iter(rng)),
+        model_saver=saver, save_last_model=True)
+    result = EarlyStoppingTrainer(es, _net(), it).fit()
+    best = saver.get_best_model()
+    assert best is not None
+    assert saver.get_latest_model() is not None
+    x = np.asarray(rng.rand(4, 4), np.float32)
+    assert np.asarray(best.output(x)).shape == (4, 3)
+    assert result.best_model_score < float("inf")

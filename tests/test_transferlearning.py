@@ -1,0 +1,107 @@
+"""Transfer learning tests (reference test analog:
+deeplearning4j-core/src/test/java/org/deeplearning4j/nn/transferlearning/
+TransferLearningMLNTest.java, TransferLearningHelperTest.java)."""
+import numpy as np
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.transferlearning import (FineTuneConfiguration,
+                                                    TransferLearning,
+                                                    TransferLearningHelper)
+
+
+def _net():
+    conf = (NeuralNetConfiguration(seed=5, updater="sgd", learning_rate=0.1)
+            .list(DenseLayer(n_in=4, n_out=10, activation="tanh"),
+                  DenseLayer(n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=16):
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def test_feature_extractor_freezes_params(rng):
+    src = _net()
+    x, y = _data(rng)
+    tl = (TransferLearning.Builder(src)
+          .set_feature_extractor(1)
+          .build())
+    assert isinstance(tl.layers[0], FrozenLayer)
+    assert isinstance(tl.layers[1], FrozenLayer)
+    w0_before = np.asarray(tl.params["layer_0"]["W"]).copy()
+    w2_before = np.asarray(tl.params["layer_2"]["W"]).copy()
+    tl.fit(x, y)
+    np.testing.assert_array_equal(np.asarray(tl.params["layer_0"]["W"]),
+                                  w0_before)
+    assert np.abs(np.asarray(tl.params["layer_2"]["W"])
+                  - w2_before).max() > 0
+
+
+def test_frozen_params_copied_from_source(rng):
+    src = _net()
+    tl = TransferLearning.Builder(src).set_feature_extractor(0).build()
+    np.testing.assert_array_equal(np.asarray(tl.params["layer_0"]["W"]),
+                                  np.asarray(src.params["layer_0"]["W"]))
+
+
+def test_nout_replace_reinitializes_both_sides(rng):
+    src = _net()
+    tl = (TransferLearning.Builder(src)
+          .n_out_replace(1, 20, weight_init="xavier")
+          .build())
+    assert np.asarray(tl.params["layer_1"]["W"]).shape == (10, 20)
+    assert np.asarray(tl.params["layer_2"]["W"]).shape == (20, 3)
+    # layer 0 retained from source
+    np.testing.assert_array_equal(np.asarray(tl.params["layer_0"]["W"]),
+                                  np.asarray(src.params["layer_0"]["W"]))
+    x, _ = _data(rng)
+    assert np.asarray(tl.output(x)).shape == (16, 3)
+
+
+def test_remove_and_add_output_layer(rng):
+    src = _net()
+    tl = (TransferLearning.Builder(src)
+          .remove_output_layer()
+          .add_layer(OutputLayer(n_out=7, activation="softmax",
+                                 loss_function="mcxent"))
+          .build())
+    x, _ = _data(rng)
+    assert np.asarray(tl.output(x)).shape == (16, 7)
+
+
+def test_fine_tune_configuration_overrides(rng):
+    src = _net()
+    tl = (TransferLearning.Builder(src)
+          .fine_tune_configuration(FineTuneConfiguration(
+              learning_rate=0.01, updater="adam"))
+          .build())
+    assert tl.conf.training.updater == "adam"
+    assert tl.conf.training.learning_rate == 0.01
+
+
+def test_helper_featurize_matches_full_forward(rng):
+    src = _net()
+    helper = TransferLearningHelper(src, frozen_until=1)
+    x, y = _data(rng)
+    feats = helper.featurize(x)
+    assert np.asarray(feats).shape == (16, 8)
+    out_full = np.asarray(helper.net.output(x))
+    out_tail = np.asarray(helper.output_from_featurized(feats))
+    np.testing.assert_allclose(out_full, out_tail, rtol=1e-5, atol=1e-6)
+
+
+def test_helper_fit_featurized_updates_composite(rng):
+    src = _net()
+    helper = TransferLearningHelper(src, frozen_until=1)
+    x, y = _data(rng)
+    feats = helper.featurize(x)
+    w_before = np.asarray(helper.net.params["layer_2"]["W"]).copy()
+    helper.fit_featurized(feats, y)
+    assert np.abs(np.asarray(helper.net.params["layer_2"]["W"])
+                  - w_before).max() > 0
