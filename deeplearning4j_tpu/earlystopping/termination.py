@@ -16,6 +16,11 @@ import time
 
 
 class EpochTerminationCondition:
+    # conditions that only consult the epoch counter / wall clock set this
+    # False so the trainer runs them even on epochs where no score was
+    # computed (evaluate_every_n_epochs > 1)
+    requires_score: bool = True
+
     def initialize(self) -> None:
         pass
 
@@ -32,6 +37,8 @@ class IterationTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    requires_score = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = max_epochs
 

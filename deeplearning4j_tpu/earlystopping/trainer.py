@@ -84,9 +84,8 @@ class BaseEarlyStoppingTrainer:
             stop_epoch = None
             for c in cfg.epoch_termination_conditions:
                 # score-based conditions only see real (evaluated) scores;
-                # MaxEpochs is score-free and must fire on any epoch
-                if not evaluated \
-                        and not isinstance(c, MaxEpochsTerminationCondition):
+                # score-free ones (requires_score=False) fire on any epoch
+                if not evaluated and getattr(c, "requires_score", True):
                     continue
                 if c.terminate(epoch, score if evaluated else math.inf):
                     stop_epoch = c

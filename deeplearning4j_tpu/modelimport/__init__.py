@@ -1,0 +1,25 @@
+"""Keras model import (reference: deeplearning4j-modelimport module)."""
+from deeplearning4j_tpu.modelimport.keras import (
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+    import_keras_model_configuration,
+    import_keras_model_and_weights_separate,
+    KerasModel, KerasSequentialModel,
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+)
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.trained_models import (vgg16,
+                                                           vgg16_preprocess,
+                                                           load_vgg16)
+
+__all__ = [
+    "import_keras_model_and_weights",
+    "import_keras_sequential_model_and_weights",
+    "import_keras_model_configuration",
+    "import_keras_model_and_weights_separate",
+    "KerasModel", "KerasSequentialModel", "Hdf5Archive",
+    "InvalidKerasConfigurationException",
+    "UnsupportedKerasConfigurationException",
+    "vgg16", "vgg16_preprocess", "load_vgg16",
+]

@@ -1,0 +1,123 @@
+"""HDF5 archive reader for Keras model files.
+
+Parity with the reference's `Hdf5Archive`
+(reference: deeplearning4j-modelimport/.../Hdf5Archive.java:22-35), which
+binds libhdf5 through JavaCPP JNI. Here the native half is h5py's C
+extension over libhdf5 — same library, same role, without a bespoke JNI
+shim. The API mirrors the reference's: read JSON attributes
+(`model_config`, `training_config`), walk groups, read datasets.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+try:
+    import h5py
+    HAVE_H5PY = True
+except ImportError:  # pragma: no cover - baked into the image
+    HAVE_H5PY = False
+
+
+def _to_str(v) -> str:
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    if isinstance(v, np.ndarray) and v.dtype.kind == "S":
+        return v.tobytes().decode("utf-8")
+    return str(v)
+
+
+class Hdf5Archive:
+    """Read-only view of a Keras .h5 file (reference: Hdf5Archive.java)."""
+
+    def __init__(self, path: str):
+        if not HAVE_H5PY:
+            raise ImportError("h5py is required for Keras HDF5 import")
+        self._f = h5py.File(path, "r")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "Hdf5Archive":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- attributes --------------------------------------------------------
+    def read_attribute_as_json(self, name: str,
+                               *group_path: str) -> Optional[Dict]:
+        """Reference: Hdf5Archive.readAttributeAsJson."""
+        g = self._group(*group_path)
+        if g is None or name not in g.attrs:
+            return None
+        return json.loads(_to_str(g.attrs[name]))
+
+    def read_attribute_as_string(self, name: str,
+                                 *group_path: str) -> Optional[str]:
+        g = self._group(*group_path)
+        if g is None or name not in g.attrs:
+            return None
+        return _to_str(g.attrs[name])
+
+    def read_attribute_as_string_list(self, name: str,
+                                      *group_path: str) -> List[str]:
+        g = self._group(*group_path)
+        if g is None or name not in g.attrs:
+            return []
+        return [_to_str(v) for v in g.attrs[name]]
+
+    # -- groups / datasets -------------------------------------------------
+    def _group(self, *path: str):
+        g: Any = self._f
+        for p in path:
+            if p not in g:
+                return None
+            g = g[p]
+        return g
+
+    def has_group(self, *path: str) -> bool:
+        return self._group(*path) is not None
+
+    def groups(self, *path: str) -> List[str]:
+        g = self._group(*path)
+        if g is None:
+            return []
+        return [k for k in g.keys() if isinstance(g[k], h5py.Group)]
+
+    def datasets(self, *path: str) -> List[str]:
+        g = self._group(*path)
+        if g is None:
+            return []
+        return [k for k in g.keys() if isinstance(g[k], h5py.Dataset)]
+
+    def read_dataset(self, *path: str) -> np.ndarray:
+        """Read a dataset by path; the last component may itself contain
+        '/' separators (Keras weight names like 'dense_1/kernel:0')."""
+        g: Any = self._f
+        for p in path:
+            g = g[p]
+        return np.asarray(g)
+
+    def layer_weights(self, layer_group) -> Dict[str, np.ndarray]:
+        """All datasets under a layer group keyed by their Keras weight
+        name (attr `weight_names`), e.g. {'dense_1/kernel:0': array}."""
+        out: Dict[str, np.ndarray] = {}
+        names = [_to_str(n) for n in layer_group.attrs.get("weight_names",
+                                                           [])]
+        if names:
+            for n in names:
+                out[n] = np.asarray(layer_group[n])
+            return out
+        # Keras 1 files have no weight_names on some groups: walk datasets
+        def visit(name, obj):
+            if isinstance(obj, h5py.Dataset):
+                out[name] = np.asarray(obj)
+        layer_group.visititems(visit)
+        return out
+
+    @property
+    def root(self):
+        return self._f

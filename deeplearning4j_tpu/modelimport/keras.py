@@ -1,0 +1,711 @@
+"""Keras model import: HDF5 / JSON → MultiLayerNetwork or ComputationGraph.
+
+Parity with the reference's deeplearning4j-modelimport module:
+`KerasModelImport` entry points (reference: KerasModelImport.java:48-231),
+`KerasModel`/`KerasSequentialModel` (KerasModel.java,
+KerasSequentialModel.java) and the 14 per-layer mappers
+(layers/Keras*.java): Dense, Convolution, Pooling, GlobalPooling,
+BatchNormalization, Activation, Dropout, Embedding, Flatten, Input, Loss,
+Lstm, Merge, ZeroPadding. Both Keras 1 (`nb_filter`, `border_mode`,
+`dim_ordering`, per-gate LSTM weights) and Keras 2 (`filters`,
+`padding`, fused gate blocks) config/weight formats are handled, matching
+the reference's dual support (KerasLayer.java keras_version dispatch).
+
+TPU-first divergence: the reference converts everything to NCHW
+(KerasLayer dim-ordering conversion, TensorFlowCnnToFeedForwardPreProcessor)
+because libnd4j convs are channels-first. This framework's activations are
+NHWC — the layout XLA:TPU tiles best — so TensorFlow-Keras kernels (HWIO)
+copy through with **no transpose** and Theano-ordering kernels (OIHW) are
+permuted once at import. Inference inputs are NHWC.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.nn.conf.configuration import (
+    NeuralNetConfiguration, MultiLayerConfiguration,
+    ComputationGraphConfiguration)
+from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                      EmbeddingLayer)
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
+    Subsampling1DLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.layers.misc import (ActivationLayer, DropoutLayer,
+                                               GlobalPoolingLayer)
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.graph.vertices import (MergeVertex,
+                                                  ElementWiseVertex)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """Reference: exceptions/InvalidKerasConfigurationException.java."""
+
+
+class UnsupportedKerasConfigurationException(ValueError):
+    """Reference: exceptions/UnsupportedKerasConfigurationException.java."""
+
+
+# ---------------------------------------------------------------------------
+# activation / loss name mapping (reference: KerasLayer.mapActivation,
+# KerasLossLayer loss mapping)
+# ---------------------------------------------------------------------------
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "softmax": "softmax", "softplus": "softplus",
+    "softsign": "softsign", "elu": "elu", "selu": "selu",
+    "hard_sigmoid": "hardsigmoid", "leakyrelu": "leakyrelu",
+    "leaky_relu": "leakyrelu", "gelu": "gelu", "swish": "swish",
+}
+
+_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "mean_absolute_percentage_error": "mape", "mape": "mape",
+    "mean_squared_logarithmic_error": "msle", "msle": "msle",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "kullback_leibler_divergence": "kl_divergence",
+    "kld": "kl_divergence",
+    "poisson": "poisson", "cosine_proximity": "cosine_proximity",
+}
+
+
+def map_activation(name: str) -> str:
+    if name not in _ACTIVATIONS:
+        raise UnsupportedKerasConfigurationException(
+            f"Unknown Keras activation '{name}'")
+    return _ACTIVATIONS[name]
+
+
+def map_loss(name: str) -> str:
+    if name not in _LOSSES:
+        raise UnsupportedKerasConfigurationException(
+            f"Unknown Keras loss '{name}'")
+    return _LOSSES[name]
+
+
+# ---------------------------------------------------------------------------
+# per-layer config mapping (reference: layers/Keras*.java)
+# ---------------------------------------------------------------------------
+
+def _cfg(layer: Dict) -> Dict:
+    return layer.get("config", {})
+
+
+def _k1(cfg: Dict, k2_name: str, k1_name: str, default=None):
+    """Fetch a config field under its Keras-2 name, falling back to the
+    Keras-1 name (reference: KerasLayer version dispatch)."""
+    if k2_name in cfg:
+        return cfg[k2_name]
+    return cfg.get(k1_name, default)
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1] if len(v) > 1 else v[0])
+    return int(v), int(v)
+
+
+def _padding_mode(cfg: Dict) -> str:
+    mode = _k1(cfg, "padding", "border_mode", "valid")
+    if mode == "same":
+        return "same"
+    if mode == "valid":
+        return "truncate"
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported Keras padding mode '{mode}'")
+
+
+def _dim_ordering(cfg: Dict) -> str:
+    """'tf' (channels_last / HWIO kernels) or 'th' (channels_first / OIHW)."""
+    v = _k1(cfg, "data_format", "dim_ordering", "channels_last")
+    if v in ("channels_last", "tf", "default"):
+        return "tf"
+    if v in ("channels_first", "th"):
+        return "th"
+    raise UnsupportedKerasConfigurationException(f"dim ordering '{v}'")
+
+
+def map_keras_layer(class_name: str, layer: Dict) -> Optional[Layer]:
+    """Map one Keras layer dict to a framework Layer config; returns None
+    for structural layers absorbed elsewhere (Input, Flatten, Reshape —
+    the reference turns Flatten into a preprocessor, KerasFlatten.java;
+    here family-change shape inference inserts it automatically)."""
+    cfg = _cfg(layer)
+    name = cfg.get("name") or layer.get("name")
+
+    if class_name in ("InputLayer", "Flatten", "Reshape", "Masking"):
+        return None
+
+    if class_name == "Dense":
+        act = map_activation(cfg.get("activation", "linear"))
+        n_out = _k1(cfg, "units", "output_dim")
+        return DenseLayer(name=name, n_out=int(n_out), activation=act)
+
+    if class_name == "Activation":
+        return ActivationLayer(name=name,
+                               activation=map_activation(cfg["activation"]))
+
+    if class_name in ("Dropout", "SpatialDropout2D", "SpatialDropout1D"):
+        # reference maps dropout rate p -> dropOut retain semantics
+        return DropoutLayer(name=name, rate=float(_k1(cfg, "rate", "p")))
+
+    if class_name in ("Conv2D", "Convolution2D"):
+        filters = int(_k1(cfg, "filters", "nb_filter"))
+        if "kernel_size" in cfg:
+            kh, kw = _pair(cfg["kernel_size"])
+        else:  # Keras 1
+            kh, kw = int(cfg["nb_row"]), int(cfg["nb_col"])
+        sh, sw = _pair(_k1(cfg, "strides", "subsample", (1, 1)))
+        act = map_activation(cfg.get("activation", "linear"))
+        return ConvolutionLayer(name=name, n_out=filters,
+                                kernel_size=(kh, kw), stride=(sh, sw),
+                                convolution_mode=_padding_mode(cfg),
+                                activation=act)
+
+    if class_name in ("Conv1D", "Convolution1D"):
+        filters = int(_k1(cfg, "filters", "nb_filter"))
+        k = _k1(cfg, "kernel_size", "filter_length")
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = _k1(cfg, "strides", "subsample_length", 1)
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        act = map_activation(cfg.get("activation", "linear"))
+        return Convolution1DLayer(name=name, n_out=filters,
+                                  kernel_size=(k,), stride=(s,),
+                                  convolution_mode=_padding_mode(cfg),
+                                  activation=act)
+
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        ptype = "max" if class_name.startswith("Max") else "avg"
+        kh, kw = _pair(_k1(cfg, "pool_size", "pool_size", (2, 2)))
+        strides = _k1(cfg, "strides", "strides")
+        sh, sw = _pair(strides) if strides is not None else (kh, kw)
+        return SubsamplingLayer(name=name, pooling_type=ptype,
+                                kernel_size=(kh, kw), stride=(sh, sw),
+                                convolution_mode=_padding_mode(cfg))
+
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        ptype = "max" if class_name.startswith("Max") else "avg"
+        k = _k1(cfg, "pool_size", "pool_length", 2)
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = _k1(cfg, "strides", "stride")
+        if s is None:
+            s = k
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        return Subsampling1DLayer(name=name, pooling_type=ptype,
+                                  kernel_size=(k,), stride=(s,),
+                                  convolution_mode=_padding_mode(cfg))
+
+    if class_name in ("GlobalMaxPooling1D", "GlobalAveragePooling1D",
+                      "GlobalMaxPooling2D", "GlobalAveragePooling2D"):
+        ptype = "max" if "Max" in class_name else "avg"
+        return GlobalPoolingLayer(name=name, pooling_type=ptype)
+
+    if class_name == "BatchNormalization":
+        return BatchNormalization(
+            name=name,
+            decay=float(_k1(cfg, "momentum", "momentum", 0.99)),
+            eps=float(cfg.get("epsilon", 1e-3)))
+
+    if class_name == "Embedding":
+        n_in = int(_k1(cfg, "input_dim", "input_dim"))
+        n_out = int(_k1(cfg, "output_dim", "output_dim"))
+        return EmbeddingLayer(name=name, n_in=n_in, n_out=n_out,
+                              activation="identity")
+
+    if class_name == "LSTM":
+        n_out = int(_k1(cfg, "units", "output_dim"))
+        act = map_activation(cfg.get("activation", "tanh"))
+        gate = map_activation(_k1(cfg, "recurrent_activation",
+                                  "inner_activation", "hard_sigmoid"))
+        fb = 1.0 if _k1(cfg, "unit_forget_bias", "forget_bias_init",
+                        True) else 0.0
+        return LSTM(name=name, n_out=n_out, activation=act,
+                    gate_activation=gate, forget_gate_bias_init=fb)
+
+    if class_name == "ZeroPadding2D":
+        pad = cfg.get("padding", (1, 1))
+        if isinstance(pad, (list, tuple)) and pad and \
+                isinstance(pad[0], (list, tuple)):
+            (t, b), (l, r) = pad
+            return ZeroPaddingLayer(name=name, padding=(t, b, l, r))
+        ph, pw = _pair(pad)
+        return ZeroPaddingLayer(name=name, padding=(ph, pw))
+
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported Keras layer type '{class_name}'")
+
+
+def map_merge_vertex(class_name: str, layer: Dict):
+    cfg = _cfg(layer)
+    if class_name in ("Concatenate", "Merge") and \
+            cfg.get("mode", "concat") in ("concat", "concatenate", None):
+        return MergeVertex()
+    if class_name == "Add" or (class_name == "Merge"
+                               and cfg.get("mode") == "sum"):
+        return ElementWiseVertex(op="add")
+    if class_name == "Subtract":
+        return ElementWiseVertex(op="subtract")
+    if class_name == "Multiply" or (class_name == "Merge"
+                                    and cfg.get("mode") == "mul"):
+        return ElementWiseVertex(op="product")
+    if class_name == "Average" or (class_name == "Merge"
+                                   and cfg.get("mode") == "ave"):
+        return ElementWiseVertex(op="average")
+    if class_name == "Maximum":
+        return ElementWiseVertex(op="max")
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported Keras merge '{class_name}'")
+
+
+_MERGE_CLASSES = ("Merge", "Add", "Subtract", "Multiply", "Average",
+                  "Maximum", "Concatenate")
+
+
+def _input_type_from_shape(shape, dim_ordering: str = "tf"):
+    """batch_input_shape (None, ...) → InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return it.InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return it.InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        if dim_ordering == "th":
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return it.InputType.convolutional(h, w, c)
+    raise UnsupportedKerasConfigurationException(
+        f"Cannot infer input type from shape {shape}")
+
+
+# ---------------------------------------------------------------------------
+# weight conversion (reference: KerasLayer.getWeightsFromHdf5 + per-layer
+# setWeights; gate order & transposes)
+# ---------------------------------------------------------------------------
+
+def _short(weight_name: str) -> str:
+    """'model/dense_1/kernel:0' → 'kernel'."""
+    base = weight_name.split("/")[-1]
+    return base.split(":")[0]
+
+
+def convert_weights(framework_layer: Layer, kweights: Dict[str, np.ndarray],
+                    dim_ordering: str = "tf"
+                    ) -> Tuple[Dict[str, np.ndarray],
+                               Dict[str, np.ndarray]]:
+    """Map a Keras layer's weight dict onto (params, state) for the
+    corresponding framework layer. Handles Keras-1 per-gate LSTM weights,
+    Theano OIHW kernels, and BN running stats."""
+    short = {_short(k): v for k, v in kweights.items()}
+    params: Dict[str, np.ndarray] = {}
+    state: Dict[str, np.ndarray] = {}
+
+    if isinstance(framework_layer, BatchNormalization):
+        params["gamma"] = short.get("gamma")
+        params["beta"] = short.get("beta")
+        state["mean"] = short.get("moving_mean", short.get("running_mean"))
+        var = short.get("moving_variance")
+        if var is None and "running_std" in short:
+            # Keras 1 stored std for some backends; DL4J treats it as var
+            var = short["running_std"]
+        state["var"] = var
+        return ({k: v for k, v in params.items() if v is not None},
+                {k: v for k, v in state.items() if v is not None})
+
+    if isinstance(framework_layer, LSTM):
+        if "kernel" in short:  # Keras 2 fused blocks, gate order i,f,c,o
+            params["W"] = short["kernel"]
+            params["RW"] = short["recurrent_kernel"]
+            if "bias" in short:
+                params["b"] = short["bias"]
+        else:  # Keras 1 per-gate: W_i U_i b_i W_c U_c b_c W_f U_f b_f W_o...
+            def gate(prefix):
+                for k, v in short.items():
+                    if k.endswith(prefix) or k == prefix:
+                        return v
+                raise InvalidKerasConfigurationException(
+                    f"LSTM weight '{prefix}' missing; have {list(short)}")
+            # our gate order: i, f, g(c), o (recurrent.py _gates)
+            params["W"] = np.concatenate(
+                [gate("W_i"), gate("W_f"), gate("W_c"), gate("W_o")], axis=1)
+            params["RW"] = np.concatenate(
+                [gate("U_i"), gate("U_f"), gate("U_c"), gate("U_o")], axis=1)
+            params["b"] = np.concatenate(
+                [gate("b_i"), gate("b_f"), gate("b_c"), gate("b_o")], axis=0)
+        return params, state
+
+    if isinstance(framework_layer, (ConvolutionLayer,)):
+        w = short.get("kernel", short.get("W"))
+        if w is None:
+            raise InvalidKerasConfigurationException(
+                f"Conv weights missing; have {list(short)}")
+        if w.ndim == 4 and dim_ordering == "th":
+            w = np.transpose(w, (2, 3, 1, 0))  # OIHW → HWIO
+        if isinstance(framework_layer, Convolution1DLayer) and w.ndim == 3:
+            # Keras Conv1D kernel [k, in, out] → our [1, k, in, out]
+            w = w[None, :, :, :]
+        params["W"] = w
+        b = short.get("bias", short.get("b"))
+        if b is not None:
+            params["b"] = b
+        return params, state
+
+    if isinstance(framework_layer, EmbeddingLayer):
+        emb = short.get("embeddings", short.get("W"))
+        params["W"] = emb
+        params["b"] = np.zeros(emb.shape[1], emb.dtype)
+        return params, state
+
+    if isinstance(framework_layer, DenseLayer):  # includes OutputLayer
+        params["W"] = short.get("kernel", short.get("W"))
+        b = short.get("bias", short.get("b"))
+        if b is not None:
+            params["b"] = b
+        return params, state
+
+    return params, state
+
+
+# ---------------------------------------------------------------------------
+# model-level import (reference: KerasSequentialModel.java, KerasModel.java)
+# ---------------------------------------------------------------------------
+
+def _model_config_from_archive(archive: Hdf5Archive) -> Dict:
+    cfg = archive.read_attribute_as_json("model_config")
+    if cfg is None:
+        raise InvalidKerasConfigurationException(
+            "HDF5 file has no 'model_config' attribute (weights-only file? "
+            "pass the architecture JSON separately)")
+    return cfg
+
+
+def _sequential_layers(model_config: Dict) -> List[Dict]:
+    cfg = model_config.get("config")
+    if isinstance(cfg, list):  # Keras 1 / early 2
+        return cfg
+    return cfg["layers"]
+
+
+class KerasSequentialModel:
+    """Sequential Keras JSON → MultiLayerConfiguration
+    (reference: KerasSequentialModel.java)."""
+
+    def __init__(self, model_config: Dict,
+                 training_config: Optional[Dict] = None,
+                 enforce_training_config: bool = False):
+        if model_config.get("class_name") not in ("Sequential",):
+            raise InvalidKerasConfigurationException(
+                f"Not a Sequential model: {model_config.get('class_name')}")
+        self.layer_configs = _sequential_layers(model_config)
+        self.training_config = training_config
+        if enforce_training_config and training_config is None:
+            # reference: KerasModel.java enforceTrainingConfig — fail fast
+            # when the file was saved without compile() information
+            raise InvalidKerasConfigurationException(
+                "enforce_training_config=True but the file has no "
+                "'training_config' attribute (model was not compiled "
+                "before saving)")
+        self.layers: List[Layer] = []
+        self.keras_names: List[str] = []
+        self.dim_ordering = "tf"
+        self.input_type = None
+        self._build()
+
+    def _loss(self) -> Optional[str]:
+        if not self.training_config:
+            return None
+        loss = self.training_config.get("loss")
+        if isinstance(loss, dict):
+            loss = next(iter(loss.values()))
+        if isinstance(loss, dict):  # keras serialized loss object
+            loss = loss.get("config", {}).get("name", loss.get("class_name"))
+            loss = str(loss).lower()
+        return map_loss(loss) if loss else None
+
+    def _build(self) -> None:
+        for lc in self.layer_configs:
+            cname = lc["class_name"]
+            cfg = _cfg(lc)
+            shape = cfg.get("batch_input_shape")
+            if "dim_ordering" in cfg or "data_format" in cfg:
+                self.dim_ordering = _dim_ordering(cfg)
+            if shape is not None and self.input_type is None:
+                self.input_type = _input_type_from_shape(
+                    shape, self.dim_ordering)
+            mapped = map_keras_layer(cname, lc)
+            if mapped is None:
+                continue
+            self.layers.append(mapped)
+            self.keras_names.append(cfg.get("name") or lc.get("name")
+                                    or f"layer_{len(self.layers)}")
+        loss = self._loss()
+        if loss and self.layers and \
+                type(self.layers[-1]) in (DenseLayer,):
+            last = self.layers[-1]
+            # reference: KerasLoss appends an OutputLayer when a training
+            # config is present (KerasModel.java getTrainingConfig path)
+            self.layers[-1] = OutputLayer(
+                name=last.name, n_in=last.n_in, n_out=last.n_out,
+                activation=last.activation, loss_function=loss)
+
+    def multi_layer_configuration(self) -> MultiLayerConfiguration:
+        conf = NeuralNetConfiguration(seed=12345).list(*self.layers)
+        if self.input_type is not None:
+            conf.set_input_type(self.input_type)
+        return conf
+
+
+class KerasModel:
+    """Functional Keras JSON → ComputationGraphConfiguration
+    (reference: KerasModel.java)."""
+
+    def __init__(self, model_config: Dict,
+                 training_config: Optional[Dict] = None,
+                 enforce_training_config: bool = False):
+        if model_config.get("class_name") not in ("Model", "Functional"):
+            raise InvalidKerasConfigurationException(
+                f"Not a functional model: {model_config.get('class_name')}")
+        cfg = model_config["config"]
+        self.layer_configs = cfg["layers"]
+        self.input_names = [n[0] for n in cfg["input_layers"]]
+        self.output_names = [n[0] for n in cfg["output_layers"]]
+        self.training_config = training_config
+        if enforce_training_config and training_config is None:
+            raise InvalidKerasConfigurationException(
+                "enforce_training_config=True but the file has no "
+                "'training_config' attribute (model was not compiled "
+                "before saving)")
+        self.dim_ordering = "tf"
+        self.builder = NeuralNetConfiguration(seed=12345).graph_builder()
+        self.keras_layer_names: List[str] = []
+        self._skipped: Dict[str, str] = {}  # skipped layer → its input
+        self._build()
+
+    @staticmethod
+    def _inbound(lc: Dict) -> List[str]:
+        nodes = lc.get("inbound_nodes", [])
+        if not nodes:
+            return []
+        node = nodes[0]
+        if isinstance(node, dict):  # keras 3 style {"args": ...}
+            raise UnsupportedKerasConfigurationException(
+                "Keras 3 saved-model JSON not supported; re-save in "
+                "Keras 2 / TF-Keras HDF5 format")
+        return [inb[0] for inb in node]
+
+    def _resolve(self, name: str) -> str:
+        while name in self._skipped:
+            name = self._skipped[name]
+        return name
+
+    def _build(self) -> None:
+        input_types = {}
+        for lc in self.layer_configs:
+            cname = lc["class_name"]
+            cfg = _cfg(lc)
+            name = lc.get("name") or cfg.get("name")
+            if "dim_ordering" in cfg or "data_format" in cfg:
+                self.dim_ordering = _dim_ordering(cfg)
+            inbound = [self._resolve(n) for n in self._inbound(lc)]
+            if cname == "InputLayer":
+                shape = cfg.get("batch_input_shape")
+                if shape is not None:
+                    input_types[name] = _input_type_from_shape(
+                        shape, self.dim_ordering)
+                continue
+            if cname in _MERGE_CLASSES:
+                self.builder.add_vertex(name, map_merge_vertex(cname, lc),
+                                        *inbound)
+                continue
+            mapped = map_keras_layer(cname, lc)
+            if mapped is None:
+                # structural layer: route around it
+                self._skipped[name] = inbound[0]
+                continue
+            self.builder.add_layer(name, mapped, *inbound)
+            self.keras_layer_names.append(name)
+        self.builder.add_inputs(*self.input_names)
+        self.builder.set_input_types(**input_types)
+        outputs = [self._resolve(n) for n in self.output_names]
+        self.builder.set_outputs(*outputs)
+        self._apply_training_config(outputs)
+
+    def _loss_for(self, output_name: str) -> Optional[str]:
+        """Loss for one output from training_config; Keras stores either a
+        single loss or a dict keyed by output layer name (reference:
+        KerasModel.java getTrainingConfig loss handling)."""
+        if not self.training_config:
+            return None
+        loss = self.training_config.get("loss")
+        if isinstance(loss, dict) and not {"class_name", "config"} <= \
+                set(loss):
+            loss = loss.get(output_name) or next(iter(loss.values()), None)
+        if isinstance(loss, dict):  # serialized loss object
+            loss = loss.get("config", {}).get("name", loss.get("class_name"))
+            loss = str(loss).lower()
+        return map_loss(loss) if loss else None
+
+    def _apply_training_config(self, outputs: List[str]) -> None:
+        """Turn each output Dense vertex into a loss-bearing OutputLayer so
+        the imported graph can fit()/score() (the sequential path does the
+        same; reference: KerasLoss appended output layers)."""
+        for oname in outputs:
+            loss = self._loss_for(oname)
+            if loss is None:
+                continue
+            spec = self.builder._conf.vertices.get(oname)
+            if spec is None:
+                continue
+            v = spec.vertex
+            if type(v) is DenseLayer:
+                spec.vertex = OutputLayer(
+                    name=v.name, n_in=v.n_in, n_out=v.n_out,
+                    activation=v.activation, loss_function=loss,
+                    dropout=v.dropout, l1=v.l1, l2=v.l2,
+                    learning_rate=v.learning_rate,
+                    bias_learning_rate=v.bias_learning_rate)
+
+    def computation_graph_configuration(self) -> ComputationGraphConfiguration:
+        return self.builder.build()
+
+
+# ---------------------------------------------------------------------------
+# weight copying
+# ---------------------------------------------------------------------------
+
+def _weight_root(archive: Hdf5Archive):
+    if archive.has_group("model_weights"):
+        return archive.root["model_weights"]
+    return archive.root
+
+
+def _find_layer_group(root, keras_name: str):
+    if keras_name in root:
+        g = root[keras_name]
+        # TF-Keras nests again: model_weights/dense_1/dense_1/{kernel,bias}
+        return g
+    return None
+
+
+def copy_weights_to_network(archive: Hdf5Archive, net,
+                            layers: List[Layer], keras_names: List[str],
+                            dim_ordering: str = "tf") -> None:
+    """Copy HDF5 weights into an initialized network by Keras layer name
+    (reference: KerasModel.copyWeightsToModel / helpers.KerasModelUtils)."""
+    root = _weight_root(archive)
+    for layer, kname in zip(layers, keras_names):
+        group = _find_layer_group(root, kname)
+        if group is None:
+            if layer.init_params.__func__ is Layer.init_params:
+                continue  # parameterless layer
+            raise InvalidKerasConfigurationException(
+                f"No weights for layer '{kname}' in HDF5 file")
+        kweights = archive.layer_weights(group)
+        if not kweights:
+            continue
+        params, state = convert_weights(layer, kweights, dim_ordering)
+        pname = layer.name or kname
+        tgt = net.params.get(pname)
+        if tgt is None:
+            raise InvalidKerasConfigurationException(
+                f"Network has no params entry '{pname}'")
+        for k, v in params.items():
+            if k in tgt and tuple(tgt[k].shape) != tuple(v.shape):
+                raise InvalidKerasConfigurationException(
+                    f"Shape mismatch for {pname}.{k}: model "
+                    f"{tuple(tgt[k].shape)} vs file {tuple(v.shape)}")
+            tgt[k] = jnp.asarray(v, dtype=net.dtype)
+        if state:
+            st = net.state.setdefault(pname, {})
+            for k, v in state.items():
+                st[k] = jnp.asarray(v, dtype=net.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry points (reference: KerasModelImport.java:48-231)
+# ---------------------------------------------------------------------------
+
+def import_keras_sequential_model_and_weights(
+        path: str, enforce_training_config: bool = False
+        ) -> MultiLayerNetwork:
+    """HDF5 with architecture + weights → MultiLayerNetwork
+    (reference: KerasModelImport.importKerasSequentialModelAndWeights)."""
+    with Hdf5Archive(path) as archive:
+        mc = _model_config_from_archive(archive)
+        tc = archive.read_attribute_as_json("training_config")
+        km = KerasSequentialModel(mc, tc, enforce_training_config)
+        conf = km.multi_layer_configuration()
+        net = MultiLayerNetwork(conf).init()
+        copy_weights_to_network(archive, net, net.layers, km.keras_names,
+                                km.dim_ordering)
+        return net
+
+
+def import_keras_model_and_weights(path: str,
+                                   enforce_training_config: bool = False
+                                   ) -> ComputationGraph:
+    """HDF5 functional model + weights → ComputationGraph
+    (reference: KerasModelImport.importKerasModelAndWeights:101)."""
+    with Hdf5Archive(path) as archive:
+        mc = _model_config_from_archive(archive)
+        if mc.get("class_name") == "Sequential":
+            raise InvalidKerasConfigurationException(
+                "File holds a Sequential model; use "
+                "import_keras_sequential_model_and_weights")
+        tc = archive.read_attribute_as_json("training_config")
+        km = KerasModel(mc, tc, enforce_training_config)
+        conf = km.computation_graph_configuration()
+        net = ComputationGraph(conf).init()
+        layers = [conf.vertices[n].vertex for n in km.keras_layer_names]
+        copy_weights_to_network(archive, net, layers, km.keras_layer_names,
+                                km.dim_ordering)
+        return net
+
+
+def import_keras_model_configuration(json_path_or_str: str):
+    """Architecture-only JSON → configuration (reference:
+    KerasModelImport.importKerasModelConfiguration / Sequential variant)."""
+    s = json_path_or_str
+    if not s.lstrip().startswith("{"):
+        with open(s) as f:
+            s = f.read()
+    mc = json.loads(s)
+    if mc.get("class_name") == "Sequential":
+        return KerasSequentialModel(mc).multi_layer_configuration()
+    return KerasModel(mc).computation_graph_configuration()
+
+
+def import_keras_model_and_weights_separate(json_path: str, h5_path: str):
+    """JSON architecture + weights-only HDF5 (reference:
+    KerasModelImport.importKerasModelAndWeights(json, h5) variants)."""
+    with open(json_path) as f:
+        mc = json.loads(f.read())
+    with Hdf5Archive(h5_path) as archive:
+        if mc.get("class_name") == "Sequential":
+            km = KerasSequentialModel(mc)
+            net = MultiLayerNetwork(km.multi_layer_configuration()).init()
+            copy_weights_to_network(archive, net, net.layers,
+                                    km.keras_names, km.dim_ordering)
+            return net
+        kg = KerasModel(mc)
+        conf = kg.computation_graph_configuration()
+        netg = ComputationGraph(conf).init()
+        layers = [conf.vertices[n].vertex for n in kg.keras_layer_names]
+        copy_weights_to_network(archive, netg, layers, kg.keras_layer_names,
+                                kg.dim_ordering)
+        return netg

@@ -1,0 +1,88 @@
+"""Pretrained-model helpers: VGG16 architecture + ImageNet preprocessing.
+
+Parity with the reference's trained-models utilities (reference:
+deeplearning4j-modelimport/.../trainedmodels/TrainedModels.java:16-18,
+TrainedModelHelper.java, Utils/ImageNetLabels.java). The reference
+downloads DL4J-converted Keras VGG16 weights from hard-coded URLs
+(TrainedModels.java:38-41); here the architecture builders are always
+available and `load_vgg16_weights(path)` imports a locally provided Keras
+HDF5 file (zero-egress environments cannot download).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import (
+    NeuralNetConfiguration, MultiLayerConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                      SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+# ImageNet channel means used by VGG preprocessing (BGR order in the
+# original caffe weights; reference: TrainedModels.VGG16 getPreProcessor)
+VGG_MEAN_RGB = np.array([123.68, 116.779, 103.939], dtype=np.float32)
+
+
+def _conv(n_out: int, name: str) -> ConvolutionLayer:
+    return ConvolutionLayer(name=name, n_out=n_out, kernel_size=(3, 3),
+                            stride=(1, 1), convolution_mode="same",
+                            activation="relu")
+
+
+def _pool(name: str) -> SubsamplingLayer:
+    return SubsamplingLayer(name=name, pooling_type="max",
+                            kernel_size=(2, 2), stride=(2, 2))
+
+
+def vgg16(num_classes: int = 1000, include_top: bool = True,
+          height: int = 224, width: int = 224, channels: int = 3,
+          learning_rate: float = 0.01, seed: int = 12345,
+          dtype: str = "bfloat16") -> MultiLayerConfiguration:
+    """VGG16 (Simonyan & Zisserman 2014) as a sequential configuration —
+    the reference's canonical Keras-import benchmark model
+    (BASELINE.md: "ComputationGraph VGG16 via Keras import"). NHWC
+    activations; convs are 3x3 'same', bf16 by default for the MXU."""
+    blocks = [
+        (2, 64), (2, 128), (3, 256), (3, 512), (3, 512),
+    ]
+    layers = []
+    for bi, (reps, ch) in enumerate(blocks, start=1):
+        for ri in range(1, reps + 1):
+            layers.append(_conv(ch, f"block{bi}_conv{ri}"))
+        layers.append(_pool(f"block{bi}_pool"))
+    if include_top:
+        layers.append(DenseLayer(name="fc1", n_out=4096, activation="relu"))
+        layers.append(DenseLayer(name="fc2", n_out=4096, activation="relu"))
+        layers.append(OutputLayer(name="predictions", n_out=num_classes,
+                                  activation="softmax",
+                                  loss_function="mcxent"))
+    conf = NeuralNetConfiguration(
+        seed=seed, learning_rate=learning_rate, updater="nesterovs",
+        weight_init="relu", dtype=dtype,
+    ).list(*layers)
+    conf.set_input_type(InputType.convolutional(height, width, channels))
+    return conf
+
+
+def vgg16_preprocess(images: np.ndarray) -> np.ndarray:
+    """Subtract ImageNet channel means from NHWC uint8/float images
+    (reference: TrainedModels.VGG16 VGG16ImagePreProcessor)."""
+    return np.asarray(images, np.float32) - VGG_MEAN_RGB
+
+
+def load_vgg16(h5_path: str):
+    """Import VGG16 weights from a local Keras HDF5 file
+    (reference flow: TrainedModelHelper → KerasModelImport)."""
+    from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+    from deeplearning4j_tpu.modelimport.keras import (
+        import_keras_sequential_model_and_weights,
+        import_keras_model_and_weights)
+    with Hdf5Archive(h5_path) as archive:
+        cfg = archive.read_attribute_as_json("model_config") or {}
+    if cfg.get("class_name") == "Sequential":
+        return import_keras_sequential_model_and_weights(h5_path)
+    return import_keras_model_and_weights(h5_path)

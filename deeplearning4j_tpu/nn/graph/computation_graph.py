@@ -348,3 +348,13 @@ class ComputationGraph:
 
     def num_params(self) -> int:
         return int(self.params_flat().shape[0])
+
+    def clone(self) -> "ComputationGraph":
+        import copy
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+                                                   self.updater_state)
+        net._initialized = self._initialized
+        return net

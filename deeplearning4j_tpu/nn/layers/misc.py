@@ -97,7 +97,7 @@ class GlobalPoolingLayer(Layer):
     pooling_type: str = "max"
     pnorm: int = 2
     collapse_dimensions: bool = True
-    _in_family: str = "rnn"
+    _in_family: str = "any"
 
     @property
     def family(self):
@@ -105,6 +105,8 @@ class GlobalPoolingLayer(Layer):
 
     @property
     def input_family(self):
+        # 'any': pools whatever family arrives (rnn time axis or cnn
+        # spatial axes) — no preprocessor should be auto-inserted.
         return self._in_family
 
     def weight_param_keys(self):
