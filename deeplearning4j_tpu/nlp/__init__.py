@@ -1,0 +1,23 @@
+"""NLP / embeddings (reference: deeplearning4j-nlp-parent)."""
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, NGramTokenizerFactory, CommonPreprocessor,
+    LowCasePreProcessor, EndingPreProcessor)
+from deeplearning4j_tpu.nlp.sentenceiterator import (
+    CollectionSentenceIterator, BasicLineIterator, FileSentenceIterator,
+    LabelAwareIterator, LabelledDocument, LabelsSource)
+from deeplearning4j_tpu.nlp.vocab import (VocabConstructor, AbstractCache,
+                                          VocabWord, build_huffman_tree)
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, ParagraphVectors, Glove
+from deeplearning4j_tpu.nlp.serialization import WordVectorSerializer
+
+__all__ = [
+    "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "CommonPreprocessor", "LowCasePreProcessor", "EndingPreProcessor",
+    "CollectionSentenceIterator", "BasicLineIterator",
+    "FileSentenceIterator", "LabelAwareIterator", "LabelledDocument",
+    "LabelsSource", "VocabConstructor", "AbstractCache", "VocabWord",
+    "build_huffman_tree", "InMemoryLookupTable", "SequenceVectors",
+    "Word2Vec", "ParagraphVectors", "Glove", "WordVectorSerializer",
+]

@@ -1,0 +1,73 @@
+"""Weight lookup table: syn0 / syn1 / syn1neg matrices.
+
+Parity with the reference's InMemoryLookupTable (reference:
+deeplearning4j-nlp/.../models/embeddings/inmemory/InMemoryLookupTable.java,
+731 LoC: syn0/syn1/syn1neg INDArrays, expTable, negative table). The
+expTable (precomputed sigmoid) is dropped — XLA fuses the real sigmoid.
+Weights are jax arrays living in HBM; updates come from the batched
+learning steps (learning.py) as whole-matrix functional updates.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import (AbstractCache, make_unigram_table,
+                                          padded_huffman_arrays)
+
+
+class InMemoryLookupTable:
+    """syn0 (input embeddings), syn1 (HS inner nodes), syn1neg (negative
+    sampling output embeddings)."""
+
+    def __init__(self, cache: AbstractCache, vector_length: int = 100,
+                 seed: int = 12345, use_hs: bool = False,
+                 use_neg: bool = True, negative_table_size: int = 100_000):
+        self.cache = cache
+        self.vector_length = int(vector_length)
+        self.seed = seed
+        self.use_hs = use_hs
+        self.use_neg = use_neg
+        self.negative_table_size = negative_table_size
+        self.syn0: Optional[jax.Array] = None
+        self.syn1: Optional[jax.Array] = None
+        self.syn1neg: Optional[jax.Array] = None
+        self.neg_table: Optional[np.ndarray] = None
+        self.codes = self.points = self.code_mask = None
+
+    def reset_weights(self) -> None:
+        """Reference: InMemoryLookupTable.resetWeights — syn0 ~ U(-0.5,0.5)/d,
+        syn1/syn1neg zeros."""
+        v = self.cache.num_words()
+        d = self.vector_length
+        key = jax.random.PRNGKey(self.seed)
+        self.syn0 = (jax.random.uniform(key, (v, d)) - 0.5) / d
+        if self.use_hs:
+            self.syn1 = jnp.zeros((max(v - 1, 1), d))
+            codes, points, mask = padded_huffman_arrays(self.cache)
+            self.codes = jnp.asarray(codes)
+            self.points = jnp.asarray(points)
+            self.code_mask = jnp.asarray(mask)
+        if self.use_neg:
+            self.syn1neg = jnp.zeros((v, d))
+            self.neg_table = make_unigram_table(self.cache,
+                                                self.negative_table_size)
+
+    # -- vector queries (reference: WeightLookupTable interface) ----------
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.cache.index_of(word)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def put_vector(self, word: str, vec) -> None:
+        idx = self.cache.index_of(word)
+        if idx < 0:
+            raise KeyError(word)
+        self.syn0 = self.syn0.at[idx].set(jnp.asarray(vec))

@@ -1,0 +1,179 @@
+"""Sentence / document iterators feeding the embedding trainers.
+
+Parity with the reference's text sources (reference:
+deeplearning4j-nlp/.../text/sentenceiterator/: BasicLineIterator,
+CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
+SentencePreProcessor; documentiterator/: LabelAwareIterator, LabelsSource).
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional
+
+
+class SentencePreProcessor:
+    def pre_process(self, sentence: str) -> str:
+        raise NotImplementedError
+
+
+class SentenceIterator:
+    """Reference: sentenceiterator/SentenceIterator.java."""
+
+    def __init__(self):
+        self._pre: Optional[SentencePreProcessor] = None
+
+    def set_pre_processor(self, pre: SentencePreProcessor) -> None:
+        self._pre = pre
+
+    def _apply(self, s: str) -> str:
+        return self._pre.pre_process(s) if self._pre else s
+
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[str]:
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """Reference: sentenceiterator/CollectionSentenceIterator.java."""
+
+    def __init__(self, sentences: Iterable[str]):
+        super().__init__()
+        self._sentences = list(sentences)
+        self._idx = 0
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._idx]
+        self._idx += 1
+        return self._apply(s)
+
+    def has_next(self) -> bool:
+        return self._idx < len(self._sentences)
+
+    def reset(self) -> None:
+        self._idx = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file (reference:
+    sentenceiterator/BasicLineIterator.java)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._fh = None
+        self._peek: Optional[str] = None
+        self.reset()
+
+    def reset(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(self.path, "r", encoding="utf-8")
+        self._advance()
+
+    def _advance(self) -> None:
+        line = self._fh.readline()
+        self._peek = line.rstrip("\n") if line else None
+
+    def has_next(self) -> bool:
+        return self._peek is not None
+
+    def next_sentence(self) -> str:
+        s = self._peek
+        self._advance()
+        return self._apply(s)
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All lines of all files under a directory (reference:
+    sentenceiterator/FileSentenceIterator.java)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = root
+        self.reset()
+
+    def reset(self) -> None:
+        self._lines: List[str] = []
+        if os.path.isfile(self.root):
+            paths = [self.root]
+        else:
+            paths = sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(self.root) for f in fs)
+        for p in paths:
+            with open(p, "r", encoding="utf-8", errors="replace") as f:
+                self._lines.extend(l.rstrip("\n") for l in f)
+        self._idx = 0
+
+    def has_next(self) -> bool:
+        return self._idx < len(self._lines)
+
+    def next_sentence(self) -> str:
+        s = self._lines[self._idx]
+        self._idx += 1
+        return self._apply(s)
+
+
+class LabelsSource:
+    """Generates / stores document labels (reference:
+    documentiterator/LabelsSource.java)."""
+
+    def __init__(self, template: str = "DOC_%d"):
+        self.template = template
+        self.labels: List[str] = []
+
+    def next_label(self) -> str:
+        label = self.template % len(self.labels)
+        self.labels.append(label)
+        return label
+
+    def store_label(self, label: str) -> None:
+        if label not in self.labels:
+            self.labels.append(label)
+
+
+class LabelledDocument:
+    """Reference: documentiterator/LabelledDocument.java."""
+
+    def __init__(self, content: str, labels: Optional[List[str]] = None):
+        self.content = content
+        self.labels = labels or []
+
+
+class LabelAwareIterator:
+    """Documents with labels, for ParagraphVectors (reference:
+    documentiterator/LabelAwareIterator.java)."""
+
+    def __init__(self, documents: Iterable[LabelledDocument]):
+        self._docs = list(documents)
+        self._idx = 0
+        self.labels_source = LabelsSource()
+        for d in self._docs:
+            for l in d.labels:
+                self.labels_source.store_label(l)
+
+    def has_next_document(self) -> bool:
+        return self._idx < len(self._docs)
+
+    def next_document(self) -> LabelledDocument:
+        d = self._docs[self._idx]
+        self._idx += 1
+        return d
+
+    def reset(self) -> None:
+        self._idx = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next_document():
+            yield self.next_document()

@@ -1,0 +1,182 @@
+"""Word-vector serialization: word2vec C text/binary formats + native npz.
+
+Parity with the reference's WordVectorSerializer (reference:
+deeplearning4j-nlp/.../models/embeddings/loader/WordVectorSerializer.java,
+2,824 LoC: writeWordVectors, loadTxtVectors, readBinaryModel,
+writeFullModel/loadFullModel). The classic Google word2vec formats are
+byte-compatible; the full-model format here is a single .npz (arrays +
+vocab JSON) instead of the reference's multi-section text file.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+
+
+class WordVectorSerializer:
+    """Static-style API mirroring the reference class."""
+
+    # -- word2vec C text format -------------------------------------------
+    @staticmethod
+    def write_word_vectors(model, path: str) -> None:
+        """`word v1 v2 ...` one word per line (reference:
+        WordVectorSerializer.writeWordVectors)."""
+        cache: AbstractCache = model.vocab
+        with open(path, "w", encoding="utf-8") as f:
+            for w in cache.vocab_words():
+                vec = model.word_vector(w.word)
+                f.write(w.word + " " +
+                        " ".join(f"{x:.6f}" for x in vec) + "\n")
+
+    @staticmethod
+    def load_txt_vectors(path: str):
+        """Reference: WordVectorSerializer.loadTxtVectors — returns a
+        query-only model (vocab + lookup table)."""
+        words = []
+        vecs = []
+        with open(path, "r", encoding="utf-8") as f:
+            first = f.readline()
+            parts = first.rstrip("\n").split(" ")
+            # google format may start with a "V D" header line
+            if len(parts) == 2 and parts[0].isdigit() and parts[1].isdigit():
+                pass  # header — skip
+            else:
+                words.append(parts[0])
+                vecs.append([float(x) for x in parts[1:] if x])
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 2:
+                    continue
+                words.append(parts[0])
+                vecs.append([float(x) for x in parts[1:] if x])
+        return _static_model(words, np.asarray(vecs, np.float32))
+
+    # -- word2vec C binary format -----------------------------------------
+    @staticmethod
+    def write_binary(model, path: str) -> None:
+        """Google News .bin layout: "V D\\n" then per word `word 0x20`
+        + D float32 LE (reference: readBinaryModel's inverse)."""
+        cache: AbstractCache = model.vocab
+        mat = np.asarray(model.lookup_table.vectors(), np.float32)
+        v, d = mat.shape
+        with open(path, "wb") as f:
+            f.write(f"{v} {d}\n".encode())
+            for w in cache.vocab_words():
+                f.write(w.word.encode("utf-8") + b" ")
+                f.write(mat[w.index].astype("<f4").tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def read_binary_model(path: str):
+        """Reference: WordVectorSerializer.readBinaryModel."""
+        words = []
+        with open(path, "rb") as f:
+            header = b""
+            while not header.endswith(b"\n"):
+                header += f.read(1)
+            v, d = (int(x) for x in header.split())
+            mat = np.zeros((v, d), np.float32)
+            for i in range(v):
+                word = b""
+                while True:
+                    ch = f.read(1)
+                    if ch in (b" ", b""):
+                        break
+                    if ch != b"\n":
+                        word += ch
+                mat[i] = np.frombuffer(f.read(4 * d), "<f4")
+                nl = f.read(1)
+                if nl not in (b"\n", b""):
+                    f.seek(-1, 1)
+                words.append(word.decode("utf-8"))
+        return _static_model(words, mat)
+
+    # -- full model (config + weights + vocab) ----------------------------
+    @staticmethod
+    def write_full_model(model, path: str) -> None:
+        """Reference: WordVectorSerializer.writeFullModel — everything
+        needed to RESUME training, not just query."""
+        cache: AbstractCache = model.vocab
+        lt: InMemoryLookupTable = model.lookup_table
+        vocab_meta = [{"word": w.word, "freq": w.element_frequency,
+                       "code": w.code, "points": w.points}
+                      for w in cache.vocab_words()]
+        arrays = {"syn0": np.asarray(lt.syn0)}
+        if lt.syn1 is not None:
+            arrays["syn1"] = np.asarray(lt.syn1)
+        if lt.syn1neg is not None:
+            arrays["syn1neg"] = np.asarray(lt.syn1neg)
+        config = {
+            "layer_size": model.layer_size, "window": model.window,
+            "learning_rate": model.learning_rate,
+            "negative": model.negative, "use_hs": model.use_hs,
+            "min_word_frequency": model.min_word_frequency,
+            "seed": model.seed,
+            "vocab": vocab_meta,
+        }
+        np.savez(path, _config=np.frombuffer(
+            json.dumps(config).encode(), np.uint8), **arrays)
+
+    @staticmethod
+    def load_full_model(path: str):
+        """Inverse of write_full_model; returns a trainable Word2Vec."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        import jax.numpy as jnp
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        config = json.loads(bytes(data["_config"]).decode())
+        model = Word2Vec(
+            layer_size=config["layer_size"], window=config["window"],
+            learning_rate=config["learning_rate"],
+            negative=config["negative"],
+            use_hierarchic_softmax=config["use_hs"],
+            min_word_frequency=config["min_word_frequency"],
+            seed=config["seed"])
+        cache = AbstractCache()
+        for meta in config["vocab"]:
+            w = VocabWord(meta["word"], meta["freq"])
+            cache.add_token(w)
+        cache.finalize_vocab()
+        for meta in config["vocab"]:
+            w = cache.word_for(meta["word"])
+            w.code = meta["code"]
+            w.points = meta["points"]
+        model.vocab = cache
+        lt = InMemoryLookupTable(cache, config["layer_size"],
+                                 seed=config["seed"],
+                                 use_hs=config["use_hs"],
+                                 use_neg=config["negative"] > 0)
+        lt.reset_weights()
+        lt.syn0 = jnp.asarray(data["syn0"])
+        if "syn1" in data:
+            lt.syn1 = jnp.asarray(data["syn1"])
+        if "syn1neg" in data:
+            lt.syn1neg = jnp.asarray(data["syn1neg"])
+        model.lookup_table = lt
+        return model
+
+
+def _static_model(words, mat: np.ndarray):
+    """Build a query-only WordVectors object from (words, matrix)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    import jax.numpy as jnp
+    model = Word2Vec(layer_size=mat.shape[1])
+    cache = AbstractCache()
+    for i, w in enumerate(words):
+        cache.add_token(VocabWord(w, float(len(words) - i)))
+    cache.finalize_vocab()
+    # preserve file order as index order
+    model.vocab = cache
+    lt = InMemoryLookupTable(cache, mat.shape[1], use_hs=False,
+                             use_neg=False)
+    reordered = np.zeros_like(mat)
+    for i, w in enumerate(words):
+        reordered[cache.index_of(w)] = mat[i]
+    lt.syn0 = jnp.asarray(reordered)
+    model.lookup_table = lt
+    return model

@@ -1,0 +1,124 @@
+"""Tokenizers and token preprocessors.
+
+Parity with the reference's text pipeline (reference:
+deeplearning4j-nlp-parent/deeplearning4j-nlp/.../text/tokenization/
+tokenizer/ and tokenizerfactory/): DefaultTokenizer splits on
+whitespace/punct, preprocessors normalize tokens, NGramTokenizer emits
+n-grams, factories stamp out tokenizers per sentence.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+
+class TokenPreProcess:
+    """Reference: tokenization/tokenizer/TokenPreProcess.java."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference:
+    preprocessor/CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude stemmer for plurals/verb endings (reference:
+    preprocessor/EndingPreProcessor.java)."""
+
+    def pre_process(self, token: str) -> str:
+        if token.endswith("s") and not token.endswith("ss"):
+            token = token[:-1]
+        if token.endswith("ly"):
+            token = token[:-2]
+        if token.endswith("ing"):
+            token = token[:-3]
+        return token
+
+
+class Tokenizer:
+    """Reference: tokenization/tokenizer/Tokenizer.java."""
+
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+        self._idx = 0
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+    def has_more_tokens(self) -> bool:
+        return self._idx < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._idx]
+        self._idx += 1
+        return self._pre.pre_process(t) if self._pre else t
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> List[str]:
+        out = []
+        while self.has_more_tokens():
+            t = self.next_token()
+            if t:
+                out.append(t)
+        return out
+
+
+class TokenizerFactory:
+    """Reference: tokenizerfactory/TokenizerFactory.java."""
+
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None):
+        self._pre = preprocessor
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace/punct stream tokenizer (reference:
+    tokenizerfactory/DefaultTokenizerFactory.java wrapping
+    DefaultTokenizer's StringTokenizer delimiters)."""
+
+    _SPLIT = re.compile(r"[\s\t\n\r\f]+")
+
+    def create(self, text: str) -> Tokenizer:
+        toks = [t for t in self._SPLIT.split(text.strip()) if t]
+        return Tokenizer(toks, self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Emit all n-grams between min_n..max_n joined by spaces (reference:
+    tokenizerfactory/NGramTokenizerFactory.java / NGramTokenizer)."""
+
+    def __init__(self, min_n: int, max_n: int,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        super().__init__(preprocessor)
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def create(self, text: str) -> Tokenizer:
+        base = DefaultTokenizerFactory(self._pre).create(text).get_tokens()
+        grams: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                grams.append(" ".join(base[i:i + n]))
+        return Tokenizer(grams, None)

@@ -1,0 +1,191 @@
+"""Vocabulary construction: word counts, Huffman coding, caches.
+
+Parity with the reference's vocab subsystem (reference:
+deeplearning4j-nlp/.../models/word2vec/wordstore/VocabConstructor.java:168
+buildJointVocabulary — parallel corpus scan + word counts + Huffman codes;
+models/word2vec/Huffman.java; wordstore/inmemory/AbstractCache.java;
+word2vec/VocabWord.java). The reference scans with worker threads; corpus
+scanning stays host-side here (it is IO-bound string work, not tensor
+work), single-pass with a Counter.
+"""
+from __future__ import annotations
+
+import heapq
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class VocabWord:
+    """One vocabulary element: frequency + Huffman code/points
+    (reference: models/word2vec/VocabWord.java, SequenceElement.java)."""
+
+    def __init__(self, word: str, frequency: float = 1.0):
+        self.word = word
+        self.element_frequency = float(frequency)
+        self.index = -1
+        # Huffman data (hierarchical softmax): binary code + inner-node ids
+        self.code: List[int] = []
+        self.points: List[int] = []
+        self.is_label = False  # ParagraphVectors doc labels
+
+    def increment(self, by: float = 1.0) -> None:
+        self.element_frequency += by
+
+    def __repr__(self):
+        return f"VocabWord({self.word!r}, f={self.element_frequency})"
+
+
+class AbstractCache:
+    """In-memory vocab cache (reference:
+    wordstore/inmemory/AbstractCache.java; InMemoryLookupCache)."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_count = 0.0
+
+    # -- building ----------------------------------------------------------
+    def add_token(self, element: VocabWord) -> None:
+        existing = self._words.get(element.word)
+        if existing is None:
+            self._words[element.word] = element
+        else:
+            existing.increment(element.element_frequency)
+
+    def update_words_occurrences(self) -> None:
+        self.total_word_count = sum(w.element_frequency
+                                    for w in self._words.values())
+
+    def finalize_vocab(self) -> None:
+        """Assign indices by descending frequency (reference behavior:
+        words sorted by frequency for the unigram table & Huffman tree)."""
+        self._by_index = sorted(self._words.values(),
+                                key=lambda w: (-w.element_frequency, w.word))
+        for i, w in enumerate(self._by_index):
+            w.index = i
+        self.update_words_occurrences()
+
+    # -- queries (reference: VocabCache interface) -------------------------
+    def contains_word(self, word: str) -> bool:
+        return word in self._words
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def word_at_index(self, idx: int) -> Optional[VocabWord]:
+        if 0 <= idx < len(self._by_index):
+            return self._by_index[idx]
+        return None
+
+    def index_of(self, word: str) -> int:
+        w = self._words.get(word)
+        return w.index if w else -1
+
+    def word_frequency(self, word: str) -> float:
+        w = self._words.get(word)
+        return w.element_frequency if w else 0.0
+
+    def num_words(self) -> int:
+        return len(self._words)
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._by_index]
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._by_index)
+
+
+def build_huffman_tree(cache: AbstractCache, max_code_length: int = 40
+                       ) -> None:
+    """Assign Huffman codes/points to every vocab word (reference:
+    models/word2vec/Huffman.java — same two-heap construction; codes feed
+    hierarchical softmax)."""
+    words = cache.vocab_words()
+    n = len(words)
+    if n == 0:
+        return
+    # heap of (freq, tiebreak, node_id); leaves 0..n-1, inner n..2n-2
+    heap = [(w.element_frequency, i, i) for i, w in enumerate(words)]
+    heapq.heapify(heap)
+    parent = {}
+    binary = {}
+    next_id = n
+    while len(heap) > 1:
+        f1, _, a = heapq.heappop(heap)
+        f2, _, b = heapq.heappop(heap)
+        parent[a] = next_id
+        parent[b] = next_id
+        binary[a] = 0
+        binary[b] = 1
+        heapq.heappush(heap, (f1 + f2, next_id, next_id))
+        next_id += 1
+    root = heap[0][2] if heap else None
+    for i, w in enumerate(words):
+        code: List[int] = []
+        points: List[int] = []
+        node = i
+        while node != root and node in parent:
+            code.append(binary[node])
+            node = parent[node]
+            points.append(node - n)  # inner-node row in syn1
+        w.code = list(reversed(code))[:max_code_length]
+        w.points = list(reversed(points))[:max_code_length]
+
+
+class VocabConstructor:
+    """Scan sequences and build a joint vocabulary (reference:
+    VocabConstructor.buildJointVocabulary, VocabConstructor.java:168)."""
+
+    def __init__(self, min_word_frequency: int = 1,
+                 build_huffman: bool = True):
+        self.min_word_frequency = min_word_frequency
+        self.build_huffman = build_huffman
+
+    def build_vocab(self, sequences: Iterable[Sequence[str]]
+                    ) -> AbstractCache:
+        counts: Counter = Counter()
+        for seq in sequences:
+            counts.update(seq)
+        cache = AbstractCache()
+        for word, c in counts.items():
+            if c >= self.min_word_frequency:
+                cache.add_token(VocabWord(word, float(c)))
+        cache.finalize_vocab()
+        if self.build_huffman:
+            build_huffman_tree(cache)
+        return cache
+
+
+def make_unigram_table(cache: AbstractCache, table_size: int = 100_000,
+                       power: float = 0.75) -> np.ndarray:
+    """Negative-sampling table: word index drawn ∝ freq^0.75 (reference:
+    InMemoryLookupTable.resetWeights negative table construction)."""
+    freqs = np.array([w.element_frequency for w in cache.vocab_words()],
+                     dtype=np.float64)
+    if freqs.size == 0:
+        return np.zeros(0, np.int32)
+    p = freqs ** power
+    p /= p.sum()
+    counts = np.maximum(1, np.round(p * table_size)).astype(np.int64)
+    table = np.repeat(np.arange(len(freqs), dtype=np.int32), counts)
+    return table
+
+
+def padded_huffman_arrays(cache: AbstractCache):
+    """Dense [V, L] code/point/mask arrays for batched hierarchical softmax
+    (TPU-first: the reference walks per-word java lists inside
+    AggregateSkipGram; XLA wants rectangular tensors)."""
+    words = cache.vocab_words()
+    L = max((len(w.code) for w in words), default=1)
+    V = len(words)
+    codes = np.zeros((V, L), np.float32)
+    points = np.zeros((V, L), np.int32)
+    mask = np.zeros((V, L), np.float32)
+    for i, w in enumerate(words):
+        l = len(w.code)
+        codes[i, :l] = w.code
+        points[i, :l] = w.points
+        mask[i, :l] = 1.0
+    return codes, points, mask

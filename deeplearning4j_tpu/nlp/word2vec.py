@@ -1,0 +1,360 @@
+"""Word2Vec, ParagraphVectors, GloVe — user-facing embedding models.
+
+Parity with the reference builders (reference:
+deeplearning4j-nlp/.../models/word2vec/Word2Vec.java (builder wrapping
+SequenceVectors with a tokenizer + sentence iterator),
+models/paragraphvectors/ParagraphVectors.java (PV-DM / PV-DBOW, label
+vectors, inferVector), models/glove/Glove.java + AbstractCoOccurrences
+(co-occurrence counting + AdaGrad fit)).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp import learning
+from deeplearning4j_tpu.nlp.sentenceiterator import (SentenceIterator,
+                                                     CollectionSentenceIterator,
+                                                     LabelAwareIterator)
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabWord
+from deeplearning4j_tpu.nlp.word_vectors import WordVectorsMixin
+
+
+class Word2Vec(SequenceVectors):
+    """Reference: models/word2vec/Word2Vec.java — SkipGram/CBOW over a
+    tokenized sentence stream. Use `Word2Vec.builder()` or kwargs."""
+
+    def __init__(self, *, sentence_iterator: Optional[SentenceIterator]
+                 = None, sentences: Optional[Iterable[str]] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if sentence_iterator is None and sentences is not None:
+            sentence_iterator = CollectionSentenceIterator(sentences)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory()
+
+    def _sequences(self) -> Iterable[List[str]]:
+        if self.sentence_iterator is None:
+            return []
+        self.sentence_iterator.reset()
+        for sentence in self.sentence_iterator:
+            toks = self.tokenizer_factory.create(sentence).get_tokens()
+            if toks:
+                yield toks
+
+    class Builder:
+        """Fluent builder mirroring Word2Vec.Builder."""
+
+        def __init__(self):
+            self._kw: Dict = {}
+
+        def iterate(self, it: SentenceIterator) -> "Word2Vec.Builder":
+            self._kw["sentence_iterator"] = it
+            return self
+
+        def tokenizer_factory(self, tf) -> "Word2Vec.Builder":
+            self._kw["tokenizer_factory"] = tf
+            return self
+
+        def layer_size(self, n: int) -> "Word2Vec.Builder":
+            self._kw["layer_size"] = n
+            return self
+
+        def window_size(self, n: int) -> "Word2Vec.Builder":
+            self._kw["window"] = n
+            return self
+
+        def min_word_frequency(self, n: int) -> "Word2Vec.Builder":
+            self._kw["min_word_frequency"] = n
+            return self
+
+        def learning_rate(self, lr: float) -> "Word2Vec.Builder":
+            self._kw["learning_rate"] = lr
+            return self
+
+        def negative_sample(self, n: int) -> "Word2Vec.Builder":
+            self._kw["negative"] = n
+            return self
+
+        def use_hierarchic_softmax(self, b: bool) -> "Word2Vec.Builder":
+            self._kw["use_hierarchic_softmax"] = b
+            return self
+
+        def epochs(self, n: int) -> "Word2Vec.Builder":
+            self._kw["epochs"] = n
+            return self
+
+        def iterations(self, n: int) -> "Word2Vec.Builder":
+            self._kw["iterations"] = n
+            return self
+
+        def seed(self, n: int) -> "Word2Vec.Builder":
+            self._kw["seed"] = n
+            return self
+
+        def batch_size(self, n: int) -> "Word2Vec.Builder":
+            self._kw["batch_size"] = n
+            return self
+
+        def elements_learning_algorithm(self, name: str
+                                        ) -> "Word2Vec.Builder":
+            self._kw["elements_learning_algorithm"] = name
+            return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(**self._kw)
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+
+class ParagraphVectors(Word2Vec):
+    """Doc embeddings via PV-DM / PV-DBOW (reference:
+    models/paragraphvectors/ParagraphVectors.java). Labels live in their
+    own vector table; `infer_vector` fits a fresh doc vector with frozen
+    word weights (reference: inferVector)."""
+
+    def __init__(self, *, iterator: Optional[LabelAwareIterator] = None,
+                 sequence_learning_algorithm: str = "dm", **kwargs):
+        super().__init__(**kwargs)
+        self.document_iterator = iterator
+        self.sequence_algorithm = sequence_learning_algorithm.lower()
+        self.doc_vecs: Optional[jax.Array] = None
+        self.label_index: Dict[str, int] = {}
+
+    def _documents(self):
+        self.document_iterator.reset()
+        for doc in self.document_iterator:
+            toks = self.tokenizer_factory.create(doc.content).get_tokens()
+            labels = doc.labels or [f"DOC_{len(self.label_index)}"]
+            yield labels, toks
+
+    def _sequences(self) -> Iterable[List[str]]:
+        for _, toks in self._documents():
+            if toks:
+                yield toks
+
+    def fit(self) -> "ParagraphVectors":
+        if self.vocab is None:
+            self.build_vocab()
+        # label table
+        for labels, _ in self._documents():
+            for l in labels:
+                if l not in self.label_index:
+                    self.label_index[l] = len(self.label_index)
+        n_docs = max(len(self.label_index), 1)
+        key = jax.random.PRNGKey(self.seed + 1)
+        self.doc_vecs = (jax.random.uniform(
+            key, (n_docs, self.layer_size)) - 0.5) / self.layer_size
+
+        lt = self.lookup_table
+        for epoch in range(self.epochs * self.iterations):
+            doc_ids: List[int] = []
+            targets: List[int] = []
+            windows: List[List[int]] = []
+            for labels, toks in self._documents():
+                ids = self._encode(toks)
+                lids = [self.label_index[l] for l in labels]
+                n = len(ids)
+                for i in range(n):
+                    lo, hi = max(0, i - self.window), min(n, i + self.window
+                                                          + 1)
+                    ctx = [int(ids[j]) for j in range(lo, hi) if j != i]
+                    for lid in lids:
+                        doc_ids.append(lid)
+                        targets.append(int(ids[i]))
+                        windows.append(ctx)
+            if not targets:
+                continue
+            W = 2 * self.window
+            n_ex = len(targets)
+            win_arr = np.zeros((n_ex, W), np.int32)
+            win_mask = np.zeros((n_ex, W), np.float32)
+            for r, ctx in enumerate(windows):
+                l = min(len(ctx), W)
+                win_arr[r, :l] = ctx[:l]
+                win_mask[r, :l] = 1.0
+            order = self._rng.permutation(n_ex)
+            doc_a = np.asarray(doc_ids, np.int32)[order]
+            tgt_a = np.asarray(targets, np.int32)[order]
+            win_arr, win_mask = win_arr[order], win_mask[order]
+            lr = self.learning_rate * (1.0 - epoch /
+                                       max(self.epochs * self.iterations, 1))
+            lr = max(lr, self.min_learning_rate)
+            for s in range(0, n_ex, self.batch_size):
+                nb = len(tgt_a[s:s + self.batch_size])
+                lr_vec = np.zeros(self.batch_size, np.float32)
+                lr_vec[:nb] = lr
+                negs = self._sample_negatives(nb)
+                if self.sequence_algorithm == "dbow":
+                    self.doc_vecs, lt.syn1neg, _ = learning.dbow_neg_step(
+                        self.doc_vecs, lt.syn1neg,
+                        jnp.asarray(self._pad(doc_a[s:s + self.batch_size])),
+                        jnp.asarray(self._pad(tgt_a[s:s + self.batch_size])),
+                        jnp.asarray(negs), jnp.asarray(lr_vec))
+                else:
+                    lt.syn0, self.doc_vecs, lt.syn1neg, _ = \
+                        learning.dm_neg_step(
+                            lt.syn0, self.doc_vecs, lt.syn1neg,
+                            jnp.asarray(self._pad(
+                                doc_a[s:s + self.batch_size])),
+                            jnp.asarray(self._pad_2d(
+                                win_arr[s:s + self.batch_size])),
+                            jnp.asarray(self._pad_2d(
+                                win_mask[s:s + self.batch_size])),
+                            jnp.asarray(self._pad(
+                                tgt_a[s:s + self.batch_size])),
+                            jnp.asarray(negs), jnp.asarray(lr_vec))
+        return self
+
+    def _pad_2d(self, arr: np.ndarray) -> np.ndarray:
+        b = self.batch_size
+        if len(arr) == b:
+            return arr
+        pad = np.zeros((b - len(arr),) + arr.shape[1:], arr.dtype)
+        return np.concatenate([arr, pad])
+
+    # -- queries -----------------------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        idx = self.label_index.get(label)
+        if idx is None:
+            return None
+        return np.asarray(self.doc_vecs[idx])
+
+    def doc_similarity(self, a: str, b: str) -> float:
+        va, vb = self.doc_vector(a), self.doc_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        na, nb = np.linalg.norm(va), np.linalg.norm(vb)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(np.dot(va, vb) / (na * nb))
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     lr: float = 0.05) -> np.ndarray:
+        """Fit one fresh doc vector, word weights frozen (reference:
+        ParagraphVectors.inferVector)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        ids = self._encode(toks)
+        lt = self.lookup_table
+        rng = np.random.default_rng(self.seed)
+        dv = jnp.asarray((rng.random(self.layer_size) - 0.5)
+                         / self.layer_size, jnp.float32)[None, :]
+        if len(ids) == 0:
+            return np.asarray(dv[0])
+        n = len(ids)
+        dv = dv[0]
+        for _ in range(steps):
+            negs = self._sample_negatives_for(n)
+            lr_vec = np.full(n, lr / max(n, 1), np.float32)
+            dv, _ = learning.dbow_infer_step(
+                dv, lt.syn1neg, jnp.asarray(ids), jnp.asarray(negs),
+                jnp.asarray(lr_vec))
+        return np.asarray(dv)
+
+    def _sample_negatives_for(self, n: int) -> np.ndarray:
+        table = self.lookup_table.neg_table
+        picks = self._rng.integers(0, len(table), (n, self.negative))
+        return table[picks].astype(np.int32)
+
+
+class Glove(WordVectorsMixin):
+    """GloVe embeddings (reference: models/glove/Glove.java:
+    AbstractCoOccurrences counting + per-pair AdaGrad; here co-occurrence
+    counting host-side + batched jitted glove_step)."""
+
+    def __init__(self, *, sentences: Optional[Iterable[str]] = None,
+                 sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 layer_size: int = 50, window: int = 5, epochs: int = 5,
+                 learning_rate: float = 0.05, min_word_frequency: int = 1,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 batch_size: int = 1024, seed: int = 12345):
+        if sentence_iterator is None and sentences is not None:
+            sentence_iterator = CollectionSentenceIterator(sentences)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory()
+        self.layer_size = layer_size
+        self.window = window
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_word_frequency = min_word_frequency
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = None
+        self.lookup_table = None
+        self._rng = np.random.default_rng(seed)
+
+    def _sequences(self):
+        self.sentence_iterator.reset()
+        for s in self.sentence_iterator:
+            toks = self.tokenizer_factory.create(s).get_tokens()
+            if toks:
+                yield toks
+
+    def fit(self) -> "Glove":
+        from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+        from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+        self.vocab = VocabConstructor(
+            min_word_frequency=self.min_word_frequency,
+            build_huffman=False).build_vocab(self._sequences())
+        # co-occurrence counts (reference: AbstractCoOccurrences — weighted
+        # by 1/distance)
+        cooc: Dict = defaultdict(float)
+        for toks in self._sequences():
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = [i for i in ids if i >= 0]
+            for i, wi in enumerate(ids):
+                for j in range(max(0, i - self.window), i):
+                    # symmetric window, weighted by 1/distance (GloVe paper;
+                    # reference: AbstractCoOccurrences weighting)
+                    cooc[(wi, ids[j])] += 1.0 / (i - j)
+                    cooc[(ids[j], wi)] += 1.0 / (i - j)
+        if not cooc:
+            raise ValueError("empty co-occurrence matrix")
+        rows = np.array([k[0] for k in cooc], np.int32)
+        cols = np.array([k[1] for k in cooc], np.int32)
+        vals = np.array(list(cooc.values()), np.float32)
+
+        V, D = self.vocab.num_words(), self.layer_size
+        key = jax.random.PRNGKey(self.seed)
+        k1, k2 = jax.random.split(key)
+        w_main = (jax.random.uniform(k1, (V, D)) - 0.5) / D
+        w_ctx = (jax.random.uniform(k2, (V, D)) - 0.5) / D
+        b_main = jnp.zeros(V)
+        b_ctx = jnp.zeros(V)
+        n = len(rows)
+        for _ in range(self.epochs):
+            order = self._rng.permutation(n)
+            for s in range(0, n, self.batch_size):
+                sl = order[s:s + self.batch_size]
+                nb = len(sl)
+                pad = self.batch_size - nb
+                r = np.concatenate([rows[sl], np.zeros(pad, np.int32)])
+                c = np.concatenate([cols[sl], np.zeros(pad, np.int32)])
+                x = np.concatenate([vals[sl], np.ones(pad, np.float32)])
+                lr_vec = np.zeros(self.batch_size, np.float32)
+                lr_vec[:nb] = self.learning_rate
+                w_main, w_ctx, b_main, b_ctx, _ = learning.glove_step(
+                    w_main, w_ctx, b_main, b_ctx, jnp.asarray(r),
+                    jnp.asarray(c), jnp.asarray(x), jnp.asarray(lr_vec),
+                    self.x_max, self.alpha)
+        # final embedding = w_main + w_ctx (GloVe paper convention)
+        lt = InMemoryLookupTable(self.vocab, D, seed=self.seed,
+                                 use_hs=False, use_neg=False)
+        lt.syn0 = w_main + w_ctx
+        self.lookup_table = lt
+        return self

@@ -1,0 +1,83 @@
+"""WordVectors query API: similarity, nearest neighbours, arithmetic.
+
+Parity with the reference's WordVectors interface and WordVectorsImpl
+(reference: deeplearning4j-nlp/.../models/embeddings/wordvectors/
+WordVectors.java, WordVectorsImpl.java: getWordVector, similarity,
+wordsNearest, accuracy). Queries run as one matmul against the whole
+syn0 — MXU-shaped, not a host loop.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class WordVectorsMixin:
+    """Mixed into SequenceVectors subclasses; expects `vocab` and
+    `lookup_table` attributes."""
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        if not self.has_word(word):
+            return None
+        return self.lookup_table.vector(word)
+
+    getWordVector = word_vector  # reference-style alias
+
+    def similarity(self, a: str, b: str) -> float:
+        """Cosine similarity (reference: WordVectorsImpl.similarity)."""
+        va, vb = self.word_vector(a), self.word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        na, nb = np.linalg.norm(va), np.linalg.norm(vb)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(np.dot(va, vb) / (na * nb))
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        """Top-N cosine neighbours (reference:
+        WordVectorsImpl.wordsNearest) — one [V,D]x[D] matmul."""
+        exclude = set()
+        if isinstance(word_or_vec, str):
+            vec = self.word_vector(word_or_vec)
+            if vec is None:
+                return []
+            exclude.add(word_or_vec)
+        else:
+            vec = np.asarray(word_or_vec)
+        mat = np.asarray(self.lookup_table.vectors())
+        norms = np.linalg.norm(mat, axis=1)
+        norms[norms == 0] = 1.0
+        sims = (mat @ vec) / (norms * (np.linalg.norm(vec) or 1.0))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i)).word
+            if w in exclude:
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive: Sequence[str],
+                          negative: Sequence[str] = (),
+                          top_n: int = 10) -> List[str]:
+        """king - man + woman style arithmetic (reference:
+        WordVectorsImpl.wordsNearest(Collection, Collection, int))."""
+        vec = np.zeros(self.lookup_table.vector_length, np.float32)
+        for w in positive:
+            v = self.word_vector(w)
+            if v is not None:
+                vec += v
+        for w in negative:
+            v = self.word_vector(w)
+            if v is not None:
+                vec -= v
+        nearest = self.words_nearest(vec, top_n + len(positive)
+                                     + len(negative))
+        skip = set(positive) | set(negative)
+        return [w for w in nearest if w not in skip][:top_n]

@@ -1,0 +1,218 @@
+"""NLP stack tests.
+
+Models the reference's NLP test strategy (SURVEY.md §4: tokenizer/iterator
+unit tests + small-corpus Word2Vec similarity-sanity tests —
+Word2VecTestsSmall.java, VocabConstructorTest.java).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    AbstractCache, BasicLineIterator, CollectionSentenceIterator,
+    CommonPreprocessor, DefaultTokenizerFactory, Glove, LabelAwareIterator,
+    LabelledDocument, NGramTokenizerFactory, ParagraphVectors,
+    SequenceVectors, VocabConstructor, VocabWord, Word2Vec,
+    WordVectorSerializer, build_huffman_tree)
+
+
+def _toy_corpus(n_rep=40):
+    """Structured corpus: 'day'/'night' share contexts, 'cat'/'dog' share
+    contexts, the two clusters never mix."""
+    a = ["the day was bright and the night was dark",
+         "every day follows a night and every night follows a day",
+         "day and night alternate like light and dark"]
+    b = ["the cat chased the dog around the yard",
+         "a dog barked while the cat slept on the mat",
+         "cat and dog play together in the yard"]
+    return (a + b) * n_rep
+
+
+# -- tokenization -----------------------------------------------------------
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo-bar").get_tokens()
+    assert "hello" in toks and "world" in toks
+    assert all("," not in t and "!" not in t for t in toks)
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.create("a b c").get_tokens()
+    assert "a" in toks and "a b" in toks and "b c" in toks
+
+
+# -- vocab ------------------------------------------------------------------
+
+def test_vocab_constructor_counts_and_min_frequency():
+    seqs = [["a", "b", "a"], ["a", "c"]]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert cache.contains_word("a")
+    assert not cache.contains_word("b")  # freq 1 < 2
+    assert cache.word_frequency("a") == 3
+    assert cache.index_of("a") == 0  # most frequent first
+
+
+def test_huffman_codes_prefix_free():
+    cache = AbstractCache()
+    for w, f in [("a", 10), ("b", 5), ("c", 3), ("d", 1)]:
+        cache.add_token(VocabWord(w, f))
+    cache.finalize_vocab()
+    build_huffman_tree(cache)
+    codes = {w.word: "".join(map(str, w.code))
+             for w in cache.vocab_words()}
+    # prefix-free property
+    vals = list(codes.values())
+    for i, c1 in enumerate(vals):
+        for j, c2 in enumerate(vals):
+            if i != j:
+                assert not c2.startswith(c1)
+    # more frequent words get shorter codes
+    assert len(codes["a"]) <= len(codes["d"])
+
+
+# -- iterators --------------------------------------------------------------
+
+def test_basic_line_iterator(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("line one\nline two\nline three\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["line one", "line two", "line three"]
+    it.reset()
+    assert it.next_sentence() == "line one"
+
+
+# -- word2vec ---------------------------------------------------------------
+
+def test_word2vec_similarity_sanity():
+    """Reference analog: Word2VecTestsSmall — related words end up closer
+    than unrelated ones."""
+    w2v = Word2Vec(sentences=_toy_corpus(), layer_size=32, window=3,
+                   negative=5, epochs=3, seed=42, learning_rate=0.05,
+                   min_word_frequency=3, batch_size=256)
+    w2v.fit()
+    assert w2v.has_word("day") and w2v.has_word("cat")
+    related = w2v.similarity("day", "night")
+    cross = w2v.similarity("day", "dog")
+    assert related > cross, (related, cross)
+    nearest = w2v.words_nearest("day", top_n=5)
+    assert "night" in nearest
+
+
+def test_word2vec_builder_api():
+    it = CollectionSentenceIterator(_toy_corpus(5))
+    w2v = (Word2Vec.builder().iterate(it).layer_size(16).window_size(2)
+           .min_word_frequency(1).learning_rate(0.05).negative_sample(3)
+           .epochs(1).seed(7).batch_size(128).build())
+    w2v.fit()
+    assert w2v.word_vector("day").shape == (16,)
+
+
+def test_word2vec_hierarchical_softmax():
+    w2v = Word2Vec(sentences=_toy_corpus(10), layer_size=16, window=3,
+                   negative=0, use_hierarchic_softmax=True, epochs=2,
+                   seed=3, min_word_frequency=2, batch_size=128)
+    w2v.fit()
+    v = w2v.word_vector("day")
+    assert v is not None and np.isfinite(v).all()
+    assert not np.allclose(v, 0)
+
+
+def test_word2vec_cbow():
+    w2v = Word2Vec(sentences=_toy_corpus(10), layer_size=16, window=3,
+                   negative=3, epochs=2, seed=3, min_word_frequency=2,
+                   batch_size=128, elements_learning_algorithm="cbow")
+    w2v.fit()
+    assert np.isfinite(w2v.word_vector("night")).all()
+
+
+# -- serialization ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_model():
+    w2v = Word2Vec(sentences=_toy_corpus(10), layer_size=12, window=2,
+                   negative=3, epochs=1, seed=11, min_word_frequency=2,
+                   batch_size=128)
+    w2v.fit()
+    return w2v
+
+
+def test_txt_roundtrip(small_model, tmp_path):
+    p = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.write_word_vectors(small_model, p)
+    loaded = WordVectorSerializer.load_txt_vectors(p)
+    for w in ("day", "night", "cat"):
+        np.testing.assert_allclose(loaded.word_vector(w),
+                                   small_model.word_vector(w), atol=1e-5)
+
+
+def test_binary_roundtrip(small_model, tmp_path):
+    p = str(tmp_path / "vectors.bin")
+    WordVectorSerializer.write_binary(small_model, p)
+    loaded = WordVectorSerializer.read_binary_model(p)
+    for w in ("day", "dog"):
+        np.testing.assert_allclose(loaded.word_vector(w),
+                                   small_model.word_vector(w), atol=1e-6)
+
+
+def test_full_model_roundtrip_resumes_training(small_model, tmp_path):
+    p = str(tmp_path / "full.npz")
+    WordVectorSerializer.write_full_model(small_model, p)
+    loaded = WordVectorSerializer.load_full_model(p)
+    np.testing.assert_allclose(loaded.word_vector("day"),
+                               small_model.word_vector("day"), atol=1e-6)
+    # resume training: attach a corpus and run another epoch
+    loaded.sentence_iterator = CollectionSentenceIterator(_toy_corpus(2))
+    before = loaded.word_vector("day").copy()
+    loaded.fit()
+    after = loaded.word_vector("day")
+    assert not np.allclose(before, after)  # weights moved
+
+
+# -- paragraph vectors ------------------------------------------------------
+
+def test_paragraph_vectors_doc_similarity():
+    docs = []
+    for i in range(6):
+        docs.append(LabelledDocument(
+            "the day was bright and the night was dark and day follows "
+            "night", [f"SKY_{i}"]))
+        docs.append(LabelledDocument(
+            "the cat chased the dog and the dog chased the cat in the "
+            "yard", [f"PET_{i}"]))
+    pv = ParagraphVectors(iterator=LabelAwareIterator(docs), layer_size=24,
+                          window=3, negative=4, epochs=12, seed=5,
+                          min_word_frequency=1, batch_size=128,
+                          learning_rate=0.05,
+                          sequence_learning_algorithm="dm")
+    pv.fit()
+    same = pv.doc_similarity("SKY_0", "SKY_1")
+    diff = pv.doc_similarity("SKY_0", "PET_0")
+    assert same > diff, (same, diff)
+    vec = pv.infer_vector("day and night and day")
+    assert vec.shape == (24,) and np.isfinite(vec).all()
+
+
+def test_paragraph_vectors_dbow():
+    docs = [LabelledDocument("day night day night bright dark", ["A"]),
+            LabelledDocument("cat dog cat dog yard mat", ["B"])]
+    pv = ParagraphVectors(iterator=LabelAwareIterator(docs), layer_size=8,
+                          window=2, negative=3, epochs=5, seed=5,
+                          min_word_frequency=1, batch_size=64,
+                          sequence_learning_algorithm="dbow")
+    pv.fit()
+    assert pv.doc_vector("A").shape == (8,)
+    assert np.isfinite(pv.doc_vector("A")).all()
+
+
+# -- glove ------------------------------------------------------------------
+
+def test_glove_trains_and_queries():
+    g = Glove(sentences=_toy_corpus(20), layer_size=16, window=4, epochs=8,
+              learning_rate=0.05, min_word_frequency=2, seed=1,
+              batch_size=256)
+    g.fit()
+    related = g.similarity("day", "night")
+    cross = g.similarity("day", "dog")
+    assert np.isfinite(related) and np.isfinite(cross)
+    assert related > cross, (related, cross)
