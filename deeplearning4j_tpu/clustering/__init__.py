@@ -1,0 +1,8 @@
+"""Clustering + trees + t-SNE (reference: deeplearning4j-core
+clustering/ and plot/)."""
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, ClusterSet
+from deeplearning4j_tpu.clustering.trees import KDTree, VPTree, knn
+from deeplearning4j_tpu.clustering.tsne import Tsne, BarnesHutTsne
+
+__all__ = ["KMeansClustering", "ClusterSet", "KDTree", "VPTree", "knn",
+           "Tsne", "BarnesHutTsne"]

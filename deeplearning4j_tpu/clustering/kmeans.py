@@ -1,0 +1,92 @@
+"""K-means clustering.
+
+Parity with the reference's cluster framework (reference:
+deeplearning4j-core/.../clustering/kmeans/KMeansClustering.java,
+clustering/algorithm/BaseClusteringAlgorithm.java, cluster/Cluster.java,
+ClusterSet.java). TPU-first: Lloyd iterations are one jitted program —
+the [N,K] pairwise-distance matrix is a matmul (MXU), assignment an
+argmin, centroid update a segment mean — instead of the reference's
+per-point java loops over Cluster objects.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class ClusterSet(NamedTuple):
+    """Result container (reference: clustering/cluster/ClusterSet.java)."""
+    centers: np.ndarray        # [K, D]
+    assignments: np.ndarray    # [N]
+    distances: np.ndarray      # [N] distance to own center
+    iterations: int
+
+    def get_centers(self) -> np.ndarray:
+        return self.centers
+
+    def get_cluster_for_point(self, i: int) -> int:
+        return int(self.assignments[i])
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(points: Array, centers: Array, k: int):
+    # pairwise sq-distances via the expansion trick: one [N,D]x[D,K] matmul
+    p2 = jnp.sum(points * points, axis=1, keepdims=True)       # [N,1]
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]           # [1,K]
+    d2 = p2 + c2 - 2.0 * points @ centers.T                    # [N,K]
+    assign = jnp.argmin(d2, axis=1)                            # [N]
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)    # [N,K]
+    counts = one_hot.sum(0)                                    # [K]
+    sums = one_hot.T @ points                                  # [K,D]
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts[:, None], 1.0),
+                            centers)
+    mind = jnp.take_along_axis(d2, assign[:, None], axis=1)[:, 0]
+    return new_centers, assign, jnp.sqrt(jnp.maximum(mind, 0.0))
+
+
+class KMeansClustering:
+    """Reference: KMeansClustering.setup(k, maxIterations, distanceFn).
+    Only euclidean is implemented (the reference default)."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 tolerance: float = 1e-4, seed: int = 12345):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.seed = seed
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance_function: str = "euclidean", seed: int = 12345
+              ) -> "KMeansClustering":
+        if distance_function not in ("euclidean", "l2"):
+            raise ValueError("only euclidean distance is supported")
+        return KMeansClustering(k, max_iterations, seed=seed)
+
+    def apply_to(self, points) -> ClusterSet:
+        """Run Lloyd's algorithm (reference:
+        BaseClusteringAlgorithm.applyTo)."""
+        pts = jnp.asarray(np.asarray(points, np.float32))
+        n = pts.shape[0]
+        if n < self.k:
+            raise ValueError(f"need >= k={self.k} points, got {n}")
+        rng = np.random.default_rng(self.seed)
+        centers = pts[jnp.asarray(rng.choice(n, self.k, replace=False))]
+        assign = dists = None
+        it = 0
+        for it in range(1, self.max_iterations + 1):
+            new_centers, assign, dists = _lloyd_step(pts, centers, self.k)
+            shift = float(jnp.max(jnp.sum((new_centers - centers) ** 2,
+                                          axis=1)))
+            centers = new_centers
+            if shift < self.tolerance ** 2:
+                break
+        return ClusterSet(np.asarray(centers), np.asarray(assign),
+                          np.asarray(dists), it)

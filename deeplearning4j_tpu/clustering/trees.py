@@ -1,0 +1,189 @@
+"""Spatial trees: KD-tree, VP-tree + brute-force device KNN.
+
+Parity with the reference's tree structures (reference:
+deeplearning4j-core/.../clustering/kdtree/KDTree.java,
+clustering/vptree/VPTree.java, clustering/sptree/SpTree.java — the last
+supports Barnes-Hut t-SNE). Host-side trees are kept for API parity and
+CPU-bound callers; `knn()` is the TPU-first path — the full [N,M]
+distance matrix is one matmul, which beats pointer-chasing trees on an
+MXU for any N that fits HBM (tsne.py uses it).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _knn_device(queries, points, k: int):
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+    p2 = jnp.sum(points * points, axis=1)[None, :]
+    d2 = q2 + p2 - 2.0 * queries @ points.T
+    d2 = jnp.maximum(d2, 0.0)
+    neg_d, idx = jax.lax.top_k(-d2, k)
+    return jnp.sqrt(-neg_d), idx
+
+
+def knn(queries, points, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact k-nearest-neighbours on device. Returns (distances, indices),
+    each [Q, k]."""
+    d, i = _knn_device(jnp.asarray(np.asarray(queries, np.float32)),
+                       jnp.asarray(np.asarray(points, np.float32)), k)
+    return np.asarray(d), np.asarray(i)
+
+
+class KDTree:
+    """Classic k-d tree (reference: clustering/kdtree/KDTree.java:
+    insert, nn (nearest), knn(point, distance))."""
+
+    class _Node:
+        __slots__ = ("point", "idx", "left", "right", "axis")
+
+        def __init__(self, point, idx, axis):
+            self.point = point
+            self.idx = idx
+            self.axis = axis
+            self.left = None
+            self.right = None
+
+    def __init__(self, dims: int):
+        self.dims = dims
+        self.root: Optional[KDTree._Node] = None
+        self.size = 0
+
+    def insert(self, point) -> None:
+        point = np.asarray(point, np.float64)
+        idx = self.size
+        self.size += 1
+        if self.root is None:
+            self.root = KDTree._Node(point, idx, 0)
+            return
+        node = self.root
+        while True:
+            axis = node.axis
+            branch = "left" if point[axis] < node.point[axis] else "right"
+            child = getattr(node, branch)
+            if child is None:
+                setattr(node, branch, KDTree._Node(
+                    point, idx, (axis + 1) % self.dims))
+                return
+            node = child
+
+    def nn(self, point) -> Tuple[np.ndarray, float, int]:
+        """Nearest neighbour: (point, distance, insert-index)."""
+        point = np.asarray(point, np.float64)
+        best = [None, np.inf, -1]
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - point))
+            if d < best[1]:
+                best[0], best[1], best[2] = node.point, d, node.idx
+            axis = node.axis
+            diff = point[axis] - node.point[axis]
+            near, far = (node.left, node.right) if diff < 0 else \
+                (node.right, node.left)
+            visit(near)
+            if abs(diff) < best[1]:
+                visit(far)
+
+        visit(self.root)
+        return best[0], best[1], best[2]
+
+    def knn_within(self, point, distance: float) -> List[Tuple[float, int]]:
+        """All points within `distance` (reference: KDTree.knn(point,
+        distance)), sorted by distance."""
+        point = np.asarray(point, np.float64)
+        out: List[Tuple[float, int]] = []
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - point))
+            if d <= distance:
+                out.append((d, node.idx))
+            diff = point[node.axis] - node.point[node.axis]
+            near, far = (node.left, node.right) if diff < 0 else \
+                (node.right, node.left)
+            visit(near)
+            if abs(diff) <= distance:
+                visit(far)
+
+        visit(self.root)
+        return sorted(out)
+
+
+class VPTree:
+    """Vantage-point tree (reference: clustering/vptree/VPTree.java:
+    built from an items matrix, search(target, k))."""
+
+    class _Node:
+        __slots__ = ("idx", "threshold", "inside", "outside")
+
+        def __init__(self, idx):
+            self.idx = idx
+            self.threshold = 0.0
+            self.inside = None
+            self.outside = None
+
+    def __init__(self, items, seed: int = 12345):
+        self.items = np.asarray(items, np.float64)
+        self._rng = np.random.default_rng(seed)
+        self.root = self._build(list(range(len(self.items))))
+
+    def _dist(self, a: int, b: int) -> float:
+        return float(np.linalg.norm(self.items[a] - self.items[b]))
+
+    def _build(self, idxs: List[int]):
+        if not idxs:
+            return None
+        vp = idxs[self._rng.integers(0, len(idxs))]
+        rest = [i for i in idxs if i != vp]
+        node = VPTree._Node(vp)
+        if not rest:
+            return node
+        dists = np.array([self._dist(vp, i) for i in rest])
+        node.threshold = float(np.median(dists))
+        inside = [i for i, d in zip(rest, dists) if d < node.threshold]
+        outside = [i for i, d in zip(rest, dists) if d >= node.threshold]
+        node.inside = self._build(inside)
+        node.outside = self._build(outside)
+        return node
+
+    def search(self, target, k: int) -> Tuple[List[int], List[float]]:
+        """k nearest items to `target` (reference: VPTree.search)."""
+        target = np.asarray(target, np.float64)
+        import heapq
+        heap: List[Tuple[float, int]] = []  # max-heap via negation
+        tau = [np.inf]
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(self.items[node.idx] - target))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, node.idx))
+                tau[0] = -heap[0][0]
+            if node.inside is None and node.outside is None:
+                return
+            if d < node.threshold:
+                visit(node.inside)
+                if d + tau[0] >= node.threshold:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau[0] <= node.threshold:
+                    visit(node.inside)
+
+        visit(self.root)
+        pairs = sorted((-negd, i) for negd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

@@ -1,0 +1,9 @@
+"""Graph embeddings (reference: deeplearning4j-graph)."""
+from deeplearning4j_tpu.graph.graph import (Graph, Vertex, Edge,
+                                            RandomWalkIterator,
+                                            WeightedRandomWalkIterator,
+                                            load_edge_list)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+
+__all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "load_edge_list", "DeepWalk"]

@@ -1,0 +1,80 @@
+"""DeepWalk graph embeddings.
+
+Parity with the reference (reference:
+deeplearning4j-graph/.../models/deepwalk/DeepWalk.java — skip-gram with
+hierarchical softmax (GraphHuffman binary tree) over random walks;
+models/embeddings/GraphVectors.java query API). Here DeepWalk subclasses
+SequenceVectors: walks become token sequences ("vertex ids as words") and
+training uses the batched XLA hierarchical-softmax skip-gram step — the
+same re-design that replaced the hogwild word2vec (learning.py).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph, RandomWalkIterator
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+
+class DeepWalk(SequenceVectors):
+    """Reference: models/deepwalk/DeepWalk.java (Builder: vectorSize,
+    windowSize, learningRate; fit(GraphWalkIterator))."""
+
+    def __init__(self, *, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.01, walk_length: int = 40,
+                 walks_per_vertex: int = 1, seed: int = 12345, **kwargs):
+        kwargs.setdefault("negative", 0)
+        kwargs.setdefault("use_hierarchic_softmax", True)
+        kwargs.setdefault("min_word_frequency", 1)
+        super().__init__(layer_size=vector_size, window=window_size,
+                         learning_rate=learning_rate, seed=seed, **kwargs)
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.graph: Optional[Graph] = None
+        self._walks: List[List[str]] = []
+
+    # SequenceVectors corpus = the collected walks
+    def _sequences(self) -> Iterable[List[str]]:
+        return self._walks
+
+    def initialize(self, graph: Graph) -> None:
+        """Reference: DeepWalk.initialize(graph) — sets up vocab over all
+        vertices (every vertex appears, freq from walk occurrences)."""
+        self.graph = graph
+
+    def fit_graph(self, graph: Optional[Graph] = None,
+                  walk_iterator: Optional[RandomWalkIterator] = None
+                  ) -> "DeepWalk":
+        """Reference: DeepWalk.fit(IGraph) / fit(GraphWalkIterator)."""
+        if graph is not None:
+            self.graph = graph
+        if self.graph is None and walk_iterator is None:
+            raise ValueError("need a graph or a walk iterator")
+        self._walks = []
+        if walk_iterator is None:
+            for rep in range(self.walks_per_vertex):
+                it = RandomWalkIterator(self.graph, self.walk_length,
+                                        seed=self.seed + rep)
+                for walk in it:
+                    self._walks.append([str(v) for v in walk])
+        else:
+            for walk in walk_iterator:
+                self._walks.append([str(v) for v in walk])
+        self.build_vocab()
+        return self.fit()
+
+    # -- GraphVectors query API (reference: embeddings/GraphVectors.java) --
+    def get_vertex_vector(self, idx: int) -> Optional[np.ndarray]:
+        return self.word_vector(str(idx))
+
+    def similarity_vertices(self, a: int, b: int) -> float:
+        return self.similarity(str(a), str(b))
+
+    def verticesNearest(self, idx: int, top_n: int = 10) -> List[int]:
+        return [int(w) for w in self.words_nearest(str(idx), top_n)]
+
+    @property
+    def vector_size(self) -> int:
+        return self.layer_size

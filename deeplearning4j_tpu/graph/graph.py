@@ -1,0 +1,164 @@
+"""In-memory graph: vertices, edges, loaders.
+
+Parity with the reference's graph core (reference:
+deeplearning4j-graph/.../graph/Graph.java, api/Vertex.java, api/Edge.java,
+graph/iterator/RandomWalkIterator.java, WeightedRandomWalkIterator.java,
+data/GraphLoader.java).
+"""
+from __future__ import annotations
+
+from typing import Generic, Iterable, Iterator, List, Optional, Sequence, \
+    Tuple, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+
+class Vertex(Generic[T]):
+    """Reference: api/Vertex.java — index + value."""
+
+    def __init__(self, idx: int, value: T = None):
+        self.idx = idx
+        self.value = value
+
+    def __repr__(self):
+        return f"Vertex({self.idx}, {self.value!r})"
+
+
+class Edge:
+    """Reference: api/Edge.java — (from, to, weight, directed)."""
+
+    def __init__(self, frm: int, to: int, weight: float = 1.0,
+                 directed: bool = False):
+        self.frm = frm
+        self.to = to
+        self.weight = weight
+        self.directed = directed
+
+
+class Graph(Generic[T]):
+    """Adjacency-list graph (reference: graph/Graph.java)."""
+
+    def __init__(self, num_vertices: int, allow_multiple_edges: bool = False):
+        self._vertices: List[Vertex] = [Vertex(i) for i in
+                                        range(num_vertices)]
+        self._adj: List[List[Tuple[int, float]]] = \
+            [[] for _ in range(num_vertices)]
+        self.allow_multiple_edges = allow_multiple_edges
+
+    def num_vertices(self) -> int:
+        return len(self._vertices)
+
+    def get_vertex(self, idx: int) -> Vertex:
+        return self._vertices[idx]
+
+    def set_vertex_value(self, idx: int, value) -> None:
+        self._vertices[idx].value = value
+
+    def add_edge(self, frm: int, to: int, weight: float = 1.0,
+                 directed: bool = False) -> None:
+        if not self.allow_multiple_edges and \
+                any(t == to for t, _ in self._adj[frm]):
+            return
+        self._adj[frm].append((to, weight))
+        if not directed:
+            self._adj[to].append((frm, weight))
+
+    def get_connected_vertex_indices(self, idx: int) -> List[int]:
+        return [t for t, _ in self._adj[idx]]
+
+    def degree(self, idx: int) -> int:
+        return len(self._adj[idx])
+
+    def weights_of(self, idx: int) -> np.ndarray:
+        return np.array([w for _, w in self._adj[idx]], np.float64)
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from every vertex (reference:
+    graph/iterator/RandomWalkIterator.java; NoEdgeHandling modes
+    SELF_LOOP_ON_DISCONNECTED / EXCEPTION_ON_DISCONNECTED)."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 12345,
+                 no_edge_handling: str = "self_loop"):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.rng = np.random.default_rng(seed)
+        self.no_edge_handling = no_edge_handling
+        self._order = self.rng.permutation(graph.num_vertices())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length - 1):
+            neigh = self.graph.get_connected_vertex_indices(cur)
+            if not neigh:
+                if self.no_edge_handling == "exception":
+                    raise ValueError(
+                        f"Vertex {cur} has no edges (NoEdgeHandling."
+                        "EXCEPTION_ON_DISCONNECTED)")
+                walk.append(cur)  # self loop
+                continue
+            cur = int(neigh[self.rng.integers(0, len(neigh))])
+            walk.append(cur)
+        return walk
+
+    def reset(self) -> None:
+        self._order = self.rng.permutation(self.graph.num_vertices())
+        self._pos = 0
+
+    def __iter__(self) -> Iterator[List[int]]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional walks (reference:
+    WeightedRandomWalkIterator.java)."""
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length - 1):
+            neigh = self.graph.get_connected_vertex_indices(cur)
+            if not neigh:
+                walk.append(cur)
+                continue
+            w = self.graph.weights_of(cur)
+            p = w / w.sum()
+            cur = int(neigh[self.rng.choice(len(neigh), p=p)])
+            walk.append(cur)
+        return walk
+
+
+def load_edge_list(path: str, num_vertices: Optional[int] = None,
+                   directed: bool = False, delimiter: Optional[str] = None
+                   ) -> Graph:
+    """Edge-list file loader (reference: data/GraphLoader.java
+    loadUndirectedGraphEdgeListFile)."""
+    edges = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(delimiter)
+            frm, to = int(parts[0]), int(parts[1])
+            w = float(parts[2]) if len(parts) > 2 else 1.0
+            edges.append((frm, to, w))
+            max_idx = max(max_idx, frm, to)
+    g = Graph(num_vertices or max_idx + 1)
+    for frm, to, w in edges:
+        g.add_edge(frm, to, w, directed)
+    return g

@@ -1,0 +1,174 @@
+"""Graph / DeepWalk / clustering / t-SNE tests.
+
+Models the reference's test style (deeplearning4j-graph test suite:
+TestGraph, TestDeepWalk similarity sanity; clustering tests; t-SNE smoke).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (DeepWalk, Graph, RandomWalkIterator,
+                                      WeightedRandomWalkIterator,
+                                      load_edge_list)
+from deeplearning4j_tpu.clustering import (BarnesHutTsne, KDTree,
+                                           KMeansClustering, Tsne, VPTree,
+                                           knn)
+
+
+# -- graph ------------------------------------------------------------------
+
+def test_graph_edges_and_degree():
+    g = Graph(4)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    g.add_edge(2, 3, directed=True)
+    assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+    assert g.degree(1) == 2
+    assert g.get_connected_vertex_indices(3) == []  # directed
+    # duplicate suppressed
+    g.add_edge(0, 1)
+    assert g.degree(0) == 1
+
+
+def test_random_walks_cover_all_vertices():
+    g = Graph(10)
+    for i in range(10):
+        g.add_edge(i, (i + 1) % 10)
+    it = RandomWalkIterator(g, walk_length=5, seed=1)
+    walks = list(it)
+    assert len(walks) == 10
+    assert all(len(w) == 5 for w in walks)
+    starts = {w[0] for w in walks}
+    assert starts == set(range(10))
+    # consecutive entries are neighbours on the ring
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert abs(a - b) in (1, 9)
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3, allow_multiple_edges=True)
+    # vertex 0 overwhelmingly prefers 1
+    g.add_edge(0, 1, weight=1000.0)
+    g.add_edge(0, 2, weight=0.001)
+    it = WeightedRandomWalkIterator(g, walk_length=2, seed=0)
+    hits = [w[1] for w in it if w[0] == 0]
+    assert hits and all(h == 1 for h in hits)
+
+
+def test_edge_list_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0 1\n1 2 2.5\n")
+    g = load_edge_list(str(p))
+    assert g.num_vertices() == 3
+    assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+
+
+# -- deepwalk ---------------------------------------------------------------
+
+def test_deepwalk_two_cliques():
+    """Two 6-cliques joined by one bridge edge: within-clique similarity
+    must beat cross-clique (reference analog: TestDeepWalk)."""
+    g = Graph(12)
+    for a in range(6):
+        for b in range(a + 1, 6):
+            g.add_edge(a, b)
+            g.add_edge(6 + a, 6 + b)
+    g.add_edge(0, 6)  # bridge
+    dw = DeepWalk(vector_size=16, window_size=3, walk_length=10,
+                  walks_per_vertex=8, learning_rate=0.05, epochs=3, seed=2,
+                  batch_size=256)
+    dw.fit_graph(g)
+    assert dw.get_vertex_vector(3).shape == (16,)
+    within = dw.similarity_vertices(2, 3)
+    cross = dw.similarity_vertices(2, 9)
+    assert within > cross, (within, cross)
+
+
+# -- kmeans -----------------------------------------------------------------
+
+def test_kmeans_separates_blobs():
+    rng = np.random.default_rng(0)
+    blob1 = rng.normal(0, 0.3, (50, 4))
+    blob2 = rng.normal(5, 0.3, (50, 4))
+    pts = np.concatenate([blob1, blob2])
+    km = KMeansClustering.setup(2, max_iterations=50)
+    cs = km.apply_to(pts)
+    a = set(cs.assignments[:50].tolist())
+    b = set(cs.assignments[50:].tolist())
+    assert len(a) == 1 and len(b) == 1 and a != b
+    # centers near blob means
+    centers = sorted(cs.centers.mean(axis=1).tolist())
+    assert abs(centers[0] - 0) < 0.5 and abs(centers[1] - 5) < 0.5
+
+
+def test_kmeans_rejects_unknown_distance():
+    with pytest.raises(ValueError):
+        KMeansClustering.setup(2, distance_function="manhattan")
+
+
+# -- trees ------------------------------------------------------------------
+
+def test_kdtree_nn_matches_bruteforce():
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(100, 3))
+    tree = KDTree(3)
+    for p in pts:
+        tree.insert(p)
+    q = rng.normal(size=3)
+    _, d, idx = tree.nn(q)
+    brute = np.linalg.norm(pts - q, axis=1)
+    assert idx == int(np.argmin(brute))
+    assert d == pytest.approx(float(brute.min()))
+
+
+def test_vptree_knn_matches_bruteforce():
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(80, 5))
+    tree = VPTree(pts)
+    q = rng.normal(size=5)
+    idxs, dists = tree.search(q, 5)
+    brute = np.linalg.norm(pts - q, axis=1)
+    expect = np.argsort(brute)[:5]
+    assert set(idxs) == set(expect.tolist())
+
+
+def test_device_knn_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(64, 8)).astype(np.float32)
+    qs = rng.normal(size=(4, 8)).astype(np.float32)
+    d, i = knn(qs, pts, 3)
+    for r in range(4):
+        brute = np.linalg.norm(pts - qs[r], axis=1)
+        assert set(i[r].tolist()) == set(np.argsort(brute)[:3].tolist())
+
+
+# -- t-SNE ------------------------------------------------------------------
+
+def test_tsne_separates_clusters():
+    rng = np.random.default_rng(4)
+    a = rng.normal(0, 0.1, (30, 10))
+    b = rng.normal(3, 0.1, (30, 10))
+    X = np.concatenate([a, b])
+    ts = Tsne(perplexity=10, max_iter=300, learning_rate=100, seed=0)
+    Y = ts.fit(X)
+    assert Y.shape == (60, 2)
+    # clusters stay separated in the embedding
+    da = Y[:30].mean(0)
+    db = Y[30:].mean(0)
+    spread_a = np.linalg.norm(Y[:30] - da, axis=1).mean()
+    between = np.linalg.norm(da - db)
+    assert between > 2 * spread_a
+    assert np.isfinite(ts.kl_divergence)
+
+
+def test_barnes_hut_alias_runs():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(40, 6))
+    ts = BarnesHutTsne(theta=0.5, perplexity=8, max_iter=50, seed=0)
+    Y = ts.fit(X)
+    assert Y.shape == (40, 2) and np.isfinite(Y).all()
+
+
+def test_tsne_perplexity_validation():
+    with pytest.raises(ValueError):
+        Tsne(perplexity=30).fit(np.zeros((10, 3)))
