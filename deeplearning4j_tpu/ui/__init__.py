@@ -1,0 +1,12 @@
+"""Training UI / stats pipeline (reference: deeplearning4j-ui-parent)."""
+from deeplearning4j_tpu.ui.storage import (
+    Persistable, StatsStorage, StatsStorageRouter, InMemoryStatsStorage,
+    FileStatsStorage, SqliteStatsStorage, RemoteUIStatsStorageRouter)
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "Persistable", "StatsStorage", "StatsStorageRouter",
+    "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+    "RemoteUIStatsStorageRouter", "StatsListener", "UIServer",
+]

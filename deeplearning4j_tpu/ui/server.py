@@ -1,0 +1,206 @@
+"""Training UI server: browser dashboard over a StatsStorage.
+
+Parity with the reference's Play-framework UI (reference:
+deeplearning4j-ui-parent/deeplearning4j-play/.../PlayUIServer.java,
+module/train/TrainModule.java — score chart, layer parameter/update
+stats, system tab; remote-stats receiver endpoint). Play + SBE are
+replaced by a stdlib ThreadingHTTPServer serving one self-contained
+HTML page (inline JS polling JSON endpoints) — no web framework, no
+codegen, same dashboard capabilities.
+
+Endpoints:
+  GET  /                      dashboard HTML
+  GET  /train/sessions        list of session ids
+  GET  /train/overview?sid=   score series + iteration timings
+  GET  /train/model?sid=      per-parameter norms/histograms (latest)
+  GET  /train/system?sid=     static hardware/model info
+  POST /remote/receive        remote StatsStorageRouter records
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.storage import (InMemoryStatsStorage,
+                                           Persistable, StatsStorage)
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; }
+ .chart { border: 1px solid #ccc; margin-bottom: 1em; }
+ h2 { margin: 0.3em 0; }
+ pre { background: #f6f6f6; padding: 0.6em; }
+</style></head>
+<body>
+<h1>Training dashboard</h1>
+<div>Session: <select id="session"></select></div>
+<h2>Score vs iteration</h2>
+<svg id="score" class="chart" width="800" height="240"></svg>
+<h2>Parameter L2 norms</h2>
+<pre id="params"></pre>
+<h2>System</h2>
+<pre id="system"></pre>
+<script>
+async function j(u) { const r = await fetch(u); return r.json(); }
+function drawScore(svg, xs, ys) {
+  svg.innerHTML = '';
+  if (!xs.length) return;
+  const W = 800, H = 240, P = 30;
+  const xmax = Math.max(...xs), ymin = Math.min(...ys),
+        ymax = Math.max(...ys) || 1;
+  const px = x => P + (W - 2*P) * (xmax ? x / xmax : 0);
+  const py = y => H - P - (H - 2*P) * ((y - ymin) / ((ymax - ymin) || 1));
+  let d = '';
+  xs.forEach((x, i) => { d += (i ? 'L' : 'M') + px(x) + ',' + py(ys[i]); });
+  svg.innerHTML = '<path d="' + d +
+    '" fill="none" stroke="#36c" stroke-width="1.5"/>' +
+    '<text x="4" y="14">' + ymax.toPrecision(4) + '</text>' +
+    '<text x="4" y="' + (H-8) + '">' + ymin.toPrecision(4) + '</text>';
+}
+async function refresh() {
+  const sel = document.getElementById('session');
+  const sessions = await j('/train/sessions');
+  if (sel.options.length !== sessions.length) {
+    sel.innerHTML = sessions.map(s =>
+      '<option value="' + s + '">' + s + '</option>').join('');
+  }
+  const sid = sel.value || sessions[0];
+  if (!sid) return;
+  const ov = await j('/train/overview?sid=' + sid);
+  drawScore(document.getElementById('score'), ov.iterations, ov.scores);
+  const model = await j('/train/model?sid=' + sid);
+  document.getElementById('params').textContent =
+    JSON.stringify(model, null, 1);
+  const sys = await j('/train/system?sid=' + sid);
+  document.getElementById('system').textContent =
+    JSON.stringify(sys, null, 1);
+}
+setInterval(refresh, 2000); refresh();
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/1.0"
+    storage: StatsStorage = None  # injected
+
+    def log_message(self, *args) -> None:  # silence request logging
+        pass
+
+    def _json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _first_worker(self, sid: str) -> Optional[str]:
+        workers = self.storage.list_worker_ids_for_session(sid)
+        return workers[0] if workers else None
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        if url.path in ("/", "/train", "/train/overview.html"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/train/sessions":
+            self._json(self.storage.list_session_ids())
+            return
+        sid = q.get("sid", "")
+        if url.path == "/train/overview":
+            out = {"iterations": [], "scores": [], "durations": []}
+            for wid in self.storage.list_worker_ids_for_session(sid):
+                for u in self.storage.get_all_updates_after(
+                        sid, "Update", wid, -1.0):
+                    out["iterations"].append(u.get("iteration", 0))
+                    out["scores"].append(u.get("score", 0.0))
+                    out["durations"].append(
+                        u.get("iteration_duration_s", 0.0))
+            self._json(out)
+            return
+        if url.path == "/train/model":
+            wid = self._first_worker(sid)
+            latest = self.storage.get_latest_update(sid, "Update", wid) \
+                if wid else None
+            self._json((latest or {}).get("parameters", {}))
+            return
+        if url.path == "/train/system":
+            wid = self._first_worker(sid)
+            static = self.storage.get_static_info(sid, "StaticInfo", wid) \
+                if wid else None
+            self._json(static or {})
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self) -> None:
+        if urlparse(self.path).path != "/remote/receive":
+            self._json({"error": "not found"}, 404)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        obj = json.loads(self.rfile.read(length) or b"{}")
+        kind = obj.pop("_kind", "update")
+        record = Persistable(obj)
+        if kind == "static":
+            self.storage.put_static_info(record)
+        elif kind == "meta":
+            self.storage.put_storage_metadata(record)
+        else:
+            self.storage.put_update(record)
+        self._json({"ok": True})
+
+
+class UIServer:
+    """Reference: UIServer.getInstance() / PlayUIServer — singleton HTTP
+    server; attach(statsStorage) to make its sessions visible."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storage: StatsStorage = InMemoryStatsStorage()
+        handler = type("BoundHandler", (_Handler,),
+                       {"storage": self.storage})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        """Mirror records from `storage` into the server's own store
+        (reference: UIServer.attach)."""
+        def mirror(kind: str, record: Persistable) -> None:
+            if kind == "static":
+                self.storage.put_static_info(record)
+            elif kind == "meta":
+                self.storage.put_storage_metadata(record)
+            else:
+                self.storage.put_update(record)
+        storage.register_stats_storage_listener(mirror)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

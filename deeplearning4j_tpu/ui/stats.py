@@ -1,0 +1,126 @@
+"""StatsListener: collect per-iteration training statistics.
+
+Parity with the reference (reference:
+deeplearning4j-ui-parent/deeplearning4j-ui-model/.../stats/
+BaseStatsListener.java:287 iterationDone — score, param/gradient/update
+histograms and norms, memory, GC, hardware info, every N iterations;
+encoded with SBE codecs stats/sbe/UpdateEncoder.java). Here records are
+plain dicts routed to any StatsStorageRouter; norms/histograms are
+computed on device in one jitted call per collection step (the reference
+pulls each param array to host and loops).
+"""
+from __future__ import annotations
+
+import os
+import time
+import resource
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+from deeplearning4j_tpu.ui.storage import (Persistable, StatsStorageRouter)
+
+
+@partial(jax.jit, static_argnames=("nbins",))
+def _tensor_stats(flat: jax.Array, nbins: int = 20):
+    """mean / std / min / max / L2 norm / histogram for one flat vector."""
+    norm = jnp.linalg.norm(flat)
+    mn, mx = jnp.min(flat), jnp.max(flat)
+    hist = jnp.histogram(flat, bins=nbins)[0]
+    return (jnp.mean(flat), jnp.std(flat), mn, mx, norm, hist)
+
+
+def _summarize(tree, nbins: int = 20) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    flat_items = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat_items:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        arr = jnp.ravel(jnp.asarray(leaf)).astype(jnp.float32)
+        if arr.size == 0:
+            continue
+        mean, std, mn, mx, norm, hist = _tensor_stats(arr, nbins)
+        out[name] = {
+            "mean": float(mean), "std": float(std), "min": float(mn),
+            "max": float(mx), "norm": float(norm),
+            "histogram": np.asarray(hist).tolist(),
+        }
+    return out
+
+
+class StatsListener(IterationListener):
+    """Collects stats every `frequency` iterations and routes them
+    (reference: BaseStatsListener(statsStorageRouter, frequency))."""
+
+    def __init__(self, router: StatsStorageRouter, frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "worker_0", collect_histograms: bool = True,
+                 histogram_bins: int = 20):
+        self.router = router
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._static_sent = False
+        self._start_time: Optional[float] = None
+        self._last_iter_time: Optional[float] = None
+
+    # -- static info (reference: BaseStatsListener initial report) ---------
+    def _send_static(self, model) -> None:
+        import platform
+        record = Persistable({
+            "session_id": self.session_id, "type_id": "StaticInfo",
+            "worker_id": self.worker_id, "timestamp": time.time(),
+            "hardware": {
+                "jax_backend": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "devices": [str(d) for d in jax.devices()],
+                "host": platform.node(),
+                "python": platform.python_version(),
+            },
+            "model": {
+                "class": type(model).__name__,
+                "num_params": int(getattr(model, "num_params",
+                                          lambda: 0)()),
+            },
+        })
+        self.router.put_static_info(record)
+        self._static_sent = True
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if not self._static_sent:
+            self._send_static(model)
+            self._start_time = time.time()
+        if iteration % self.frequency != 0:
+            return
+        now = time.time()
+        duration = (now - self._last_iter_time) if self._last_iter_time \
+            else 0.0
+        self._last_iter_time = now
+        record = Persistable({
+            "session_id": self.session_id, "type_id": "Update",
+            "worker_id": self.worker_id, "timestamp": now,
+            "iteration": iteration,
+            "score": float(score),
+            "iteration_duration_s": duration,
+            "memory": {
+                "rss_mb": resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            },
+        })
+        params = getattr(model, "params", None)
+        if params and self.collect_histograms:
+            record["parameters"] = _summarize(params, self.histogram_bins)
+        state = getattr(model, "updater_state", None)
+        if state and self.collect_histograms:
+            try:
+                record["updater_state"] = _summarize(state,
+                                                     self.histogram_bins)
+            except Exception:
+                pass  # opt states can hold non-array leaves
+        self.router.put_update(record)

@@ -1,0 +1,132 @@
+"""UI / stats pipeline tests.
+
+Models the reference's UI test strategy (SURVEY.md §4: SBE encode/decode
+round-trip TestStatsClasses; storage backends TestStatsStorage; Play
+server smoke TestPlayUI). JSON records replace SBE, so the round-trip
+test becomes storage round-trip; the server smoke test runs against the
+real HTTP server on an ephemeral port.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   Persistable, RemoteUIStatsStorageRouter,
+                                   SqliteStatsStorage, StatsListener,
+                                   UIServer)
+
+
+def _record(sid="s1", tid="Update", wid="w0", ts=1.0, **extra):
+    return Persistable({"session_id": sid, "type_id": tid,
+                        "worker_id": wid, "timestamp": ts, **extra})
+
+
+@pytest.mark.parametrize("make_storage", [
+    lambda tmp: InMemoryStatsStorage(),
+    lambda tmp: FileStatsStorage(str(tmp / "stats.jsonl")),
+    lambda tmp: SqliteStatsStorage(str(tmp / "stats.db")),
+], ids=["memory", "file", "sqlite"])
+def test_storage_backends_roundtrip(make_storage, tmp_path):
+    st = make_storage(tmp_path)
+    st.put_static_info(_record(tid="StaticInfo", info={"a": 1}))
+    st.put_update(_record(ts=1.0, iteration=0, score=2.0))
+    st.put_update(_record(ts=2.0, iteration=1, score=1.5))
+    assert st.list_session_ids() == ["s1"]
+    assert "Update" in st.list_type_ids_for_session("s1")
+    assert st.list_worker_ids_for_session("s1") == ["w0"]
+    ups = st.get_all_updates_after("s1", "Update", "w0", -1)
+    assert [u["score"] for u in ups] == [2.0, 1.5]
+    assert st.get_all_updates_after("s1", "Update", "w0", 1.5)[0][
+        "iteration"] == 1
+    static = st.get_static_info("s1", "StaticInfo", "w0")
+    assert static["info"] == {"a": 1}
+    latest = st.get_latest_update("s1", "Update", "w0")
+    assert latest["score"] == 1.5
+    st.close()
+
+
+def test_file_storage_persists_across_reopen(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    st = FileStatsStorage(p)
+    st.put_update(_record(score=3.0))
+    st.close()
+    st2 = FileStatsStorage(p)
+    assert st2.get_latest_update("s1", "Update", "w0")["score"] == 3.0
+    st2.close()
+
+
+def test_stats_listener_collects_norms_and_histograms():
+    """StatsListener on a real training run (reference:
+    BaseStatsListener.iterationDone:287)."""
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    storage = InMemoryStatsStorage()
+    listener = StatsListener(storage, frequency=1, session_id="test_sess")
+    conf = NeuralNetConfiguration(seed=1, learning_rate=0.1).list(
+        DenseLayer(n_in=4, n_out=8, activation="relu"),
+        OutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(listener)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    for _ in range(3):
+        net.fit(x, y)
+
+    assert storage.list_session_ids() == ["test_sess"]
+    static = storage.get_static_info("test_sess", "StaticInfo", "worker_0")
+    assert static["model"]["num_params"] > 0
+    ups = storage.get_all_updates_after("test_sess", "Update", "worker_0",
+                                        -1)
+    assert len(ups) == 3
+    u = ups[-1]
+    assert np.isfinite(u["score"])
+    # per-parameter stats present with histograms
+    pkeys = list(u["parameters"])
+    assert any("W" in k for k in pkeys)
+    first = u["parameters"][pkeys[0]]
+    assert {"mean", "std", "min", "max", "norm", "histogram"} <= set(first)
+    assert len(first["histogram"]) == 20
+
+
+def test_ui_server_endpoints_and_remote_router():
+    server = UIServer(port=0)  # ephemeral
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        storage.put_static_info(_record(tid="StaticInfo",
+                                        hardware={"x": 1}))
+        storage.put_update(_record(ts=1.0, iteration=0, score=2.5,
+                                   parameters={"l/W": {"norm": 1.0}}))
+
+        def get(path):
+            with urllib.request.urlopen(server.url + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        assert get("/train/sessions") == ["s1"]
+        ov = get("/train/overview?sid=s1")
+        assert ov["scores"] == [2.5]
+        model = get("/train/model?sid=s1")
+        assert model["l/W"]["norm"] == 1.0
+        sysinfo = get("/train/system?sid=s1")
+        assert sysinfo["hardware"] == {"x": 1}
+        # dashboard HTML served
+        with urllib.request.urlopen(server.url + "/", timeout=5) as r:
+            assert b"Training dashboard" in r.read()
+
+        # remote router → server (reference: RemoteUIStatsStorageRouter →
+        # remote receiver endpoint)
+        router = RemoteUIStatsStorageRouter(server.url)
+        router.put_update(_record(sid="remote_sess", ts=1.0, iteration=0,
+                                  score=9.9))
+        assert "remote_sess" in get("/train/sessions")
+        ov2 = get("/train/overview?sid=remote_sess")
+        assert ov2["scores"] == [9.9]
+    finally:
+        server.stop()
