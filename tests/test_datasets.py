@@ -1,0 +1,131 @@
+"""Data pipeline tests: record readers, fetchers, iterator wrappers.
+
+Models the reference's iterator/datavec tests
+(deeplearning4j-core/src/test/.../datasets/).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.impl import (CifarDataSetIterator,
+                                              LFWDataSetIterator,
+                                              MnistDataSetIterator)
+from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                   DataSet,
+                                                   IteratorDataSetIterator,
+                                                   MultipleEpochsIterator,
+                                                   SamplingDataSetIterator,
+                                                   ViewIterator)
+from deeplearning4j_tpu.datasets.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, MultiDataSet, RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator, SequenceRecordReaderDataSetIterator)
+
+
+def test_csv_record_reader(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("h1,h2,label\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n")
+    reader = CSVRecordReader(str(p), skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch_size=2, label_index=-1,
+                                     num_classes=3)
+    batch = next(iter(it))
+    assert batch.features.shape == (2, 2)
+    np.testing.assert_allclose(batch.features[0], [1.0, 2.0])
+    np.testing.assert_allclose(batch.labels[0], [1, 0, 0])
+    assert it.total_outcomes() == 3
+
+
+def test_csv_record_reader_regression(tmp_path):
+    p = tmp_path / "reg.csv"
+    p.write_text("1,2,0.5\n3,4,0.7\n")
+    it = RecordReaderDataSetIterator(CSVRecordReader(str(p)), 2,
+                                     regression=True)
+    b = next(iter(it))
+    assert b.labels.shape == (2, 1)
+    np.testing.assert_allclose(b.labels[:, 0], [0.5, 0.7])
+
+
+def test_sequence_record_reader_masks(tmp_path):
+    p1 = tmp_path / "seq1.csv"
+    p1.write_text("1,2,0\n3,4,1\n5,6,0\n")   # T=3
+    p2 = tmp_path / "seq2.csv"
+    p2.write_text("7,8,1\n")                  # T=1 → padded+masked
+    reader = CSVSequenceRecordReader([str(p1), str(p2)])
+    it = SequenceRecordReaderDataSetIterator(reader, batch_size=2,
+                                             num_classes=2)
+    b = next(iter(it))
+    assert b.features.shape == (2, 3, 2)
+    assert b.features_mask.tolist() == [[1, 1, 1], [1, 0, 0]]
+    np.testing.assert_allclose(b.labels[1, 0], [0, 1])
+    assert b.labels[1, 1].sum() == 0  # padded step
+
+
+def test_image_record_reader_npy(tmp_path):
+    for cls in ("cats", "dogs"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.full((4, 4, 1), 0.5, np.float32))
+    reader = ImageRecordReader(4, 4, 1)
+    reader.initialize(str(tmp_path))
+    assert reader.labels == ["cats", "dogs"]
+    it = RecordReaderDataSetIterator(reader, batch_size=6, num_classes=2)
+    b = next(iter(it))
+    assert b.features.shape == (6, 4, 4, 1)
+    assert b.labels.sum(0).tolist() == [3, 3]
+
+
+def test_multi_dataset_iterator():
+    recs_a = [[1.0, 2.0, 0], [3.0, 4.0, 1]]
+    builder = (RecordReaderMultiDataSetIterator.Builder(batch_size=2)
+               .add_reader("r", CollectionRecordReader(recs_a))
+               .add_input("r", 0, 1)
+               .add_output_one_hot("r", 2, 2))
+    mds = next(iter(builder.build()))
+    assert isinstance(mds, MultiDataSet)
+    assert mds.features[0].shape == (2, 2)
+    assert mds.labels[0].shape == (2, 2)
+
+
+def test_cifar_lfw_shapes():
+    cifar = CifarDataSetIterator(batch_size=8, num_examples=32)
+    b = next(iter(cifar))
+    assert b.features.shape == (8, 32, 32, 3)
+    assert b.labels.shape == (8, 10)
+    lfw = LFWDataSetIterator(batch_size=4, num_examples=16, height=32,
+                             width=32)
+    b = next(iter(lfw))
+    assert b.features.shape == (4, 32, 32, 3)
+
+
+def test_sampling_and_view_iterators():
+    ds = DataSet(np.arange(20, dtype=np.float32).reshape(10, 2),
+                 np.eye(2, dtype=np.float32)[np.arange(10) % 2])
+    samp = SamplingDataSetIterator(ds, batch_size=4, total_batches=3,
+                                   seed=0)
+    batches = list(samp)
+    assert len(batches) == 3 and batches[0].features.shape == (4, 2)
+    view = ViewIterator(ds, batch_size=4)
+    sizes = [b.features.shape[0] for b in view]
+    assert sizes == [4, 4, 2]
+
+
+def test_iterator_dataset_iterator_and_async():
+    def gen():
+        for i in range(5):
+            yield DataSet(np.full((2, 3), i, np.float32),
+                          np.zeros((2, 1), np.float32))
+    it = IteratorDataSetIterator(gen)
+    vals = [b.features[0, 0] for b in it]
+    assert vals == [0, 1, 2, 3, 4]
+    it.reset()
+    async_it = AsyncDataSetIterator(it, queue_size=2)
+    vals2 = [b.features[0, 0] for b in async_it]
+    assert vals2 == [0, 1, 2, 3, 4]
+
+
+def test_mnist_iterator_shapes():
+    it = MnistDataSetIterator(batch_size=16, num_examples=64)
+    b = next(iter(it))
+    assert b.features.shape == (16, 784)
+    assert b.labels.shape == (16, 10)
