@@ -1,0 +1,16 @@
+"""Distributed-training facades (reference: deeplearning4j-scaleout)."""
+from deeplearning4j_tpu.scaleout.training_master import (
+    TrainingMaster, ParameterAveragingTrainingMaster,
+    DistributedDl4jMultiLayer, DistributedComputationGraph,
+    SparkDl4jMultiLayer, SparkComputationGraph)
+from deeplearning4j_tpu.scaleout.stats import (SparkTrainingStats,
+                                               timed_phase)
+from deeplearning4j_tpu.scaleout.parallel_trainer import \
+    EarlyStoppingParallelTrainer
+
+__all__ = [
+    "TrainingMaster", "ParameterAveragingTrainingMaster",
+    "DistributedDl4jMultiLayer", "DistributedComputationGraph",
+    "SparkDl4jMultiLayer", "SparkComputationGraph", "SparkTrainingStats",
+    "timed_phase", "EarlyStoppingParallelTrainer",
+]

@@ -1,0 +1,31 @@
+"""Early stopping over data-parallel training.
+
+Parity with the reference's EarlyStoppingParallelTrainer (reference:
+deeplearning4j-scaleout-parallelwrapper/.../EarlyStoppingParallelTrainer.java
+(372 LoC): early-stopping loop where each epoch's fitting runs through
+ParallelWrapper). Here the wrapper's sharded jitted step does the
+multi-device work; the early-stopping control loop is unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration, EarlyStoppingResult)
+from deeplearning4j_tpu.earlystopping.trainer import BaseEarlyStoppingTrainer
+from deeplearning4j_tpu.nn.multilayer import _unpack_batch
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+class EarlyStoppingParallelTrainer(BaseEarlyStoppingTrainer):
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iter,
+                 workers: Optional[int] = None,
+                 wrapper: Optional[ParallelWrapper] = None):
+        super().__init__(config, net, train_iter)
+        self.wrapper = wrapper or ParallelWrapper(net, workers=workers)
+
+    def _fit_batch(self, batch) -> None:
+        feats, labs, fmask, lmask = _unpack_batch(batch)
+        self.wrapper.fit(feats, labs,
+                         lmask if lmask is not None else fmask)

@@ -1,0 +1,174 @@
+"""Cluster-scale training facade: TrainingMaster SPI + distributed fit.
+
+Parity with the reference's Spark layer (reference:
+deeplearning4j-scaleout/spark/dl4j-spark/.../api/TrainingMaster.java:29-139
+SPI; impl/paramavg/ParameterAveragingTrainingMaster.java — split RDD,
+broadcast params, run workers, aggregate averages;
+impl/multilayer/SparkDl4jMultiLayer.java:218 fit(JavaRDD);
+impl/graph/SparkComputationGraph.java). The reference moves parameters
+driver↔executor as byte arrays every averaging round (SURVEY.md §3.5);
+TPU-native both the intra-step gradient sync and the parameter residency
+collapse into the sharded jitted step (psum over ICI inside the program,
+multi-host via the same program launched by each host's process over
+DCN) — so the TrainingMaster here CONFIGURES sharding and batching, and
+`fit` drives the ParallelWrapper path. Averaging-frequency/RDD-export
+knobs are accepted for API parity and documented as no-ops.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator,
+                                                   DataSet)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.scaleout.stats import (SparkTrainingStats,
+                                               timed_phase)
+
+
+class TrainingMaster:
+    """SPI (reference: api/TrainingMaster.java). Implementations decide
+    how a dataset is partitioned into worker batches and how results
+    combine."""
+
+    def configure(self, model) -> ParallelWrapper:
+        raise NotImplementedError
+
+    def batches(self, data: Iterable[DataSet]) -> Iterable[DataSet]:
+        raise NotImplementedError
+
+
+@dataclass
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Reference: ParameterAveragingTrainingMaster.Builder —
+    batchSizePerWorker, averagingFrequency, workerPrefetchNumBatches,
+    rddTrainingApproach/exportDirectory (no-op here: there is no RDD),
+    repartition strategy (no-op: batches are already dense arrays)."""
+
+    workers: Optional[int] = None
+    batch_size_per_worker: int = 16
+    averaging_frequency: int = 1          # parity; sync is per-step
+    worker_prefetch_num_batches: int = 2  # parity
+    collect_training_stats: bool = False
+    stats: Optional[SparkTrainingStats] = None
+
+    class Builder:
+        def __init__(self, batch_size_per_worker: int = 16):
+            self._kw = {"batch_size_per_worker": batch_size_per_worker}
+
+        def workers(self, n: int):
+            self._kw["workers"] = n
+            return self
+
+        def averaging_frequency(self, n: int):
+            self._kw["averaging_frequency"] = n
+            return self
+
+        def worker_prefetch_num_batches(self, n: int):
+            self._kw["worker_prefetch_num_batches"] = n
+            return self
+
+        def collect_training_stats(self, b: bool):
+            self._kw["collect_training_stats"] = b
+            return self
+
+        def build(self) -> "ParameterAveragingTrainingMaster":
+            return ParameterAveragingTrainingMaster(**self._kw)
+
+    def configure(self, model) -> ParallelWrapper:
+        pw = ParallelWrapper(model, workers=self.workers)
+        if self.collect_training_stats:
+            self.stats = SparkTrainingStats()
+        return pw
+
+    def global_batch(self, workers: int) -> int:
+        return self.batch_size_per_worker * workers
+
+    def batches(self, data):
+        return data
+
+
+class _DistributedModelBase:
+    """Shared driver for the Spark-wrapper analogs."""
+
+    def __init__(self, model, training_master: TrainingMaster):
+        self.model = model
+        self.tm = training_master
+        self.pw = training_master.configure(model)
+
+    @property
+    def stats(self) -> Optional[SparkTrainingStats]:
+        return getattr(self.tm, "stats", None)
+
+    def _fit_arrays(self, feats: np.ndarray, labels: np.ndarray) -> None:
+        workers = self.pw.workers
+        gb = self.tm.global_batch(workers) if isinstance(
+            self.tm, ParameterAveragingTrainingMaster) else 32 * workers
+        stats = self.stats
+        n = feats.shape[0]
+        for s in range(0, n, gb):
+            xb, yb = feats[s:s + gb], labels[s:s + gb]
+            if stats is not None:
+                with timed_phase(stats, "fit"):
+                    self.pw.fit(xb, yb)
+            else:
+                self.pw.fit(xb, yb)
+
+    def fit(self, data, labels=None):
+        """fit(iterator) or fit(features, labels) (reference:
+        SparkDl4jMultiLayer.fit(JavaRDD<DataSet>):218 / fit(String path)).
+        """
+        if labels is not None:
+            self._fit_arrays(np.asarray(data), np.asarray(labels))
+            return self.model
+        stats = self.stats
+        if stats is not None:
+            with timed_phase(stats, "split"):
+                batches = list(self.tm.batches(data))
+        else:
+            batches = self.tm.batches(data)
+        for ds in batches:
+            f = np.asarray(ds.features)
+            l = np.asarray(ds.labels)
+            self._fit_arrays(f, l)
+        if hasattr(data, "reset"):
+            data.reset()
+        return self.model
+
+    def evaluate(self, iterator):
+        """Reference: SparkDl4jMultiLayer evaluation on RDDs
+        (impl/multilayer/evaluation) — here the model's own evaluator."""
+        return self.model.evaluate(iterator)
+
+    def score(self, feats, labels) -> float:
+        return self.model.score(feats, labels)
+
+    def get_network(self):
+        return self.model
+
+
+class DistributedDl4jMultiLayer(_DistributedModelBase):
+    """Reference: SparkDl4jMultiLayer (spark/impl/multilayer/
+    SparkDl4jMultiLayer.java). The SparkContext argument has no analog —
+    the device mesh plays the cluster's role."""
+
+
+class DistributedComputationGraph(_DistributedModelBase):
+    """Reference: SparkComputationGraph (spark/impl/graph/)."""
+
+    def _fit_arrays(self, feats, labels) -> None:
+        # ComputationGraph fit takes lists of inputs/labels
+        workers = self.pw.workers
+        gb = self.tm.global_batch(workers) if isinstance(
+            self.tm, ParameterAveragingTrainingMaster) else 32 * workers
+        n = feats.shape[0]
+        for s in range(0, n, gb):
+            self.model.fit([feats[s:s + gb]], [labels[s:s + gb]])
+
+
+# Reference-name aliases, for users arriving from the Spark API
+SparkDl4jMultiLayer = DistributedDl4jMultiLayer
+SparkComputationGraph = DistributedComputationGraph
