@@ -46,6 +46,17 @@ def _parse_idx(data: bytes) -> np.ndarray:
 
 def _try_download(name: str) -> Optional[np.ndarray]:
     MNIST_CACHE.mkdir(parents=True, exist_ok=True)
+    raw = MNIST_CACHE / f"{name}.idx"
+    if raw.exists():
+        # native C++ IDX parse fast path (native/dataloader.cpp idx_read)
+        from deeplearning4j_tpu import native_bridge
+        arr = native_bridge.idx_read(str(raw))
+        if arr is not None:
+            return arr
+        try:
+            return _parse_idx(raw.read_bytes())
+        except Exception:
+            return None
     path = MNIST_CACHE / f"{name}.gz"
     if not path.exists():
         try:
@@ -54,7 +65,9 @@ def _try_download(name: str) -> Optional[np.ndarray]:
             return None
     try:
         with gzip.open(path, "rb") as f:
-            return _parse_idx(f.read())
+            data = f.read()
+        raw.write_bytes(data)  # decompressed cache for the native parser
+        return _parse_idx(data)
     except Exception:
         return None
 
@@ -186,15 +199,23 @@ class CifarDataSetIterator(BaseDatasetIterator):
                  else ["test_batch.bin"])
         feats, labels = None, None
         if all((cache / f).exists() for f in files):
+            from deeplearning4j_tpu import native_bridge
             raw_all, lab_all = [], []
             for f in files:
+                native = native_bridge.cifar_read(str(cache / f))
+                if native is not None:  # C++ parse (dataloader.cpp)
+                    imgs, labs = native
+                    raw_all.append(imgs)
+                    lab_all.append(labs)
+                    continue
                 buf = np.fromfile(cache / f, np.uint8)
                 rows = buf.reshape(-1, 3073)
                 lab_all.append(rows[:, 0])
                 imgs = rows[:, 1:].reshape(-1, 3, 32, 32)
-                raw_all.append(np.transpose(imgs, (0, 2, 3, 1)))  # NHWC
-            feats = np.concatenate(raw_all).astype(np.float32) / 255.0
-            labels = np.concatenate(lab_all)
+                raw_all.append(np.transpose(imgs, (0, 2, 3, 1))
+                               .astype(np.float32) / 255.0)  # NHWC
+            feats = np.concatenate(raw_all).astype(np.float32)
+            labels = np.concatenate(lab_all).astype(np.int64)
             self.synthetic = False
         else:
             if not allow_synthetic:

@@ -141,6 +141,22 @@ class RecordReaderDataSetIterator(BaseDatasetIterator):
     def __init__(self, reader: RecordReader, batch_size: int,
                  label_index: int = -1, num_classes: Optional[int] = None,
                  regression: bool = False):
+        if isinstance(reader, CSVRecordReader):
+            # native C++ CSV parse fast path (native/dataloader.cpp)
+            from deeplearning4j_tpu import native_bridge
+            mat = native_bridge.csv_read_floats(
+                reader.path, reader.delimiter, reader.skip_lines)
+            if mat is not None and not np.isnan(mat).any():
+                li = mat.shape[1] - 1 if label_index == -1 else label_index
+                f = np.delete(mat, li, axis=1)
+                lab_col = mat[:, li]
+                if regression or num_classes is None:
+                    l = lab_col[:, None].astype(np.float32)
+                else:
+                    l = np.eye(num_classes, dtype=np.float32)[
+                        lab_col.astype(int)]
+                super().__init__(f, l, batch_size)
+                return
         feats, labels = [], []
         for rec in reader.records():
             vals = list(rec)

@@ -1,0 +1,232 @@
+// Native data loader for deeplearning4j_tpu.
+//
+// Role parity: the reference's data path is native end-to-end — DataVec's
+// image/record loaders ride JavaCPP bindings (libnd4j-side buffers), MNIST
+// IDX parsing feeds INDArrays directly (reference:
+// deeplearning4j-core/.../datasets/mnist/MnistDbFile.java + fetchers), and
+// the async prefetch thread hands device-bound buffers to the trainer
+// (AsyncDataSetIterator.java). This library is the TPU-native equivalent:
+// parse IDX / CSV / CIFAR binaries into dense row-major buffers the Python
+// layer wraps zero-copy as numpy arrays (then jax device_put), plus a
+// background-thread file prefetcher that overlaps disk IO with device
+// execution. Exposed via a plain C ABI for ctypes (no pybind11 in image).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC dataloader.cpp -o libdl4jtpu_io.so
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST) parsing
+// ---------------------------------------------------------------------------
+
+// Reads an (uncompressed) IDX file. Returns 0 on success.
+// dims_out must hold >= 4 entries; ndim_out receives the dimension count.
+// If out == nullptr only the header is parsed (size query).
+int idx_read(const char* path, uint8_t* out, int64_t out_cap,
+             int64_t* dims_out, int32_t* ndim_out) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) return -1;
+    uint8_t hdr[4];
+    f.read(reinterpret_cast<char*>(hdr), 4);
+    if (!f || hdr[0] != 0 || hdr[1] != 0) return -2;
+    if (hdr[2] != 0x08) return -3;  // only unsigned-byte payloads
+    int ndim = hdr[3];
+    if (ndim < 1 || ndim > 4) return -4;
+    int64_t total = 1;
+    for (int i = 0; i < ndim; ++i) {
+        uint8_t b[4];
+        f.read(reinterpret_cast<char*>(b), 4);
+        int64_t d = (int64_t(b[0]) << 24) | (int64_t(b[1]) << 16) |
+                    (int64_t(b[2]) << 8) | int64_t(b[3]);
+        dims_out[i] = d;
+        total *= d;
+    }
+    *ndim_out = ndim;
+    if (out == nullptr) return 0;
+    if (out_cap < total) return -5;
+    f.read(reinterpret_cast<char*>(out), total);
+    return f ? 0 : -6;
+}
+
+// ---------------------------------------------------------------------------
+// CSV parsing → float32 matrix
+// ---------------------------------------------------------------------------
+
+// Counts rows/cols first (pass out == nullptr), then fills row-major floats.
+// Non-numeric fields parse as NaN. Returns 0 on success.
+int csv_read_floats(const char* path, float* out, int64_t out_cap,
+                    int64_t* rows_out, int64_t* cols_out, char delim,
+                    int32_t skip_lines) {
+    std::ifstream f(path);
+    if (!f) return -1;
+    std::string line;
+    int64_t rows = 0, cols = 0, filled = 0;
+    int32_t lineno = 0;
+    while (std::getline(f, line)) {
+        if (lineno++ < skip_lines) continue;
+        if (line.empty()) continue;
+        // split
+        int64_t c = 0;
+        size_t start = 0;
+        while (start <= line.size()) {
+            size_t end = line.find(delim, start);
+            if (end == std::string::npos) end = line.size();
+            if (out != nullptr) {
+                if (filled >= out_cap) return -5;
+                const std::string field = line.substr(start, end - start);
+                try {
+                    out[filled++] = std::stof(field);
+                } catch (...) {
+                    out[filled++] = nanf("");
+                }
+            }
+            ++c;
+            start = end + 1;
+        }
+        if (cols == 0) cols = c;
+        else if (c != cols) return -4;  // ragged
+        ++rows;
+    }
+    *rows_out = rows;
+    *cols_out = cols;
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// CIFAR-10 binary batch parsing
+// ---------------------------------------------------------------------------
+
+// Each record: 1 label byte + 3072 pixel bytes (CHW). Outputs NHWC float32
+// in [0,1] and uint8 labels. Pass images == nullptr for a count query.
+int cifar_read(const char* path, float* images, uint8_t* labels,
+               int64_t max_records, int64_t* n_records_out) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) return -1;
+    f.seekg(0, std::ios::end);
+    int64_t size = f.tellg();
+    f.seekg(0);
+    const int64_t rec = 3073;
+    int64_t n = size / rec;
+    *n_records_out = n;
+    if (images == nullptr) return 0;
+    if (n > max_records) n = max_records;
+    std::vector<uint8_t> buf(rec);
+    for (int64_t i = 0; i < n; ++i) {
+        f.read(reinterpret_cast<char*>(buf.data()), rec);
+        if (!f) return -2;
+        labels[i] = buf[0];
+        // CHW uint8 → HWC float32/255
+        float* img = images + i * 32 * 32 * 3;
+        for (int c = 0; c < 3; ++c)
+            for (int p = 0; p < 1024; ++p)
+                img[p * 3 + c] = buf[1 + c * 1024 + p] / 255.0f;
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Background-thread file prefetcher (AsyncDataSetIterator's disk half)
+// ---------------------------------------------------------------------------
+
+struct Prefetcher {
+    std::vector<std::string> paths;
+    std::queue<std::vector<char>*> ready;
+    std::mutex mu;
+    std::condition_variable cv_ready, cv_space;
+    size_t queue_cap;
+    std::thread worker;
+    std::atomic<bool> done{false};
+    std::atomic<bool> stop{false};
+
+    void run() {
+        for (const auto& p : paths) {
+            if (stop.load()) break;
+            std::ifstream f(p, std::ios::binary);
+            auto* buf = new std::vector<char>();
+            if (f) {
+                f.seekg(0, std::ios::end);
+                buf->resize(f.tellg());
+                f.seekg(0);
+                f.read(buf->data(), buf->size());
+            }
+            std::unique_lock<std::mutex> lk(mu);
+            cv_space.wait(lk, [&] {
+                return ready.size() < queue_cap || stop.load(); });
+            if (stop.load()) { delete buf; break; }
+            ready.push(buf);
+            cv_ready.notify_one();
+        }
+        done.store(true);
+        cv_ready.notify_all();
+    }
+};
+
+void* prefetch_create(const char** paths, int64_t n_paths,
+                      int64_t queue_cap) {
+    auto* p = new Prefetcher();
+    for (int64_t i = 0; i < n_paths; ++i) p->paths.emplace_back(paths[i]);
+    p->queue_cap = static_cast<size_t>(queue_cap);
+    p->worker = std::thread([p] { p->run(); });
+    return p;
+}
+
+// Blocks until the next file is buffered; returns its size without
+// consuming it, or -1 when the stream is exhausted.
+int64_t prefetch_peek_size(void* handle) {
+    auto* p = static_cast<Prefetcher*>(handle);
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [&] {
+        return !p->ready.empty() || p->done.load(); });
+    if (p->ready.empty()) return -1;
+    return static_cast<int64_t>(p->ready.front()->size());
+}
+
+// Copies the buffered front file into out (cap must be >= its size, see
+// prefetch_peek_size) and consumes it. Returns bytes copied, -1 if
+// exhausted, -2 if cap is too small (file stays buffered).
+int64_t prefetch_next(void* handle, char* out, int64_t cap) {
+    auto* p = static_cast<Prefetcher*>(handle);
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [&] {
+        return !p->ready.empty() || p->done.load(); });
+    if (p->ready.empty()) return -1;
+    std::vector<char>* buf = p->ready.front();
+    int64_t n = static_cast<int64_t>(buf->size());
+    if (n > cap) return -2;
+    p->ready.pop();
+    p->cv_space.notify_one();
+    lk.unlock();
+    std::memcpy(out, buf->data(), n);
+    delete buf;
+    return n;
+}
+
+void prefetch_destroy(void* handle) {
+    auto* p = static_cast<Prefetcher*>(handle);
+    p->stop.store(true);
+    p->cv_space.notify_all();
+    if (p->worker.joinable()) p->worker.join();
+    while (!p->ready.empty()) {
+        delete p->ready.front();
+        p->ready.pop();
+    }
+    delete p;
+}
+
+// ---------------------------------------------------------------------------
+int dl4jtpu_io_abi_version() { return 1; }
+
+}  // extern "C"

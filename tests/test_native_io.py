@@ -1,0 +1,101 @@
+"""Native C++ IO library tests (native/dataloader.cpp via ctypes).
+
+The library parallels the reference's native data path (DataVec loaders,
+MnistDbFile IDX parsing, AsyncDataSetIterator prefetch — SURVEY.md §2.9);
+tests verify parity between the native parsers and the pure-Python
+fallbacks, and the threaded prefetcher's ordering.
+"""
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native_bridge as nb
+
+pytestmark = pytest.mark.skipif(not nb.native_available(),
+                                reason="native IO library unavailable")
+
+
+def _write_idx(path, arr: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 8, arr.ndim))
+        for s in arr.shape:
+            f.write(struct.pack(">I", s))
+        f.write(arr.tobytes())
+
+
+def test_idx_native_matches_python(tmp_path):
+    arr = np.arange(3 * 5 * 7, dtype=np.uint8).reshape(3, 5, 7)
+    p = str(tmp_path / "t.idx")
+    _write_idx(p, arr)
+    got = nb.idx_read(p)
+    np.testing.assert_array_equal(got, arr)
+    from deeplearning4j_tpu.datasets.impl import _parse_idx
+    np.testing.assert_array_equal(_parse_idx(open(p, "rb").read()), arr)
+
+
+def test_idx_rejects_bad_magic(tmp_path):
+    p = str(tmp_path / "bad.idx")
+    open(p, "wb").write(b"\x01\x02\x03\x04garbage")
+    assert nb.idx_read(p) is None
+
+
+def test_csv_native_matches_python(tmp_path):
+    p = str(tmp_path / "t.csv")
+    open(p, "w").write("a,b,c\n1.5,2,3\n-4,5.25,6\n")
+    mat = nb.csv_read_floats(p, skip_lines=1)
+    np.testing.assert_allclose(mat, [[1.5, 2, 3], [-4, 5.25, 6]])
+
+
+def test_cifar_native_parse(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 4
+    recs = b""
+    labels = []
+    pixels = []
+    for i in range(n):
+        lab = int(rng.integers(0, 10))
+        px = rng.integers(0, 256, 3072).astype(np.uint8)  # CHW
+        labels.append(lab)
+        pixels.append(px)
+        recs += bytes([lab]) + px.tobytes()
+    p = str(tmp_path / "batch.bin")
+    open(p, "wb").write(recs)
+    imgs, labs = nb.cifar_read(p)
+    assert imgs.shape == (n, 32, 32, 3)
+    assert labs.tolist() == labels
+    # pixel mapping: CHW/255 → HWC
+    chw = pixels[0].reshape(3, 32, 32).astype(np.float32) / 255.0
+    np.testing.assert_allclose(imgs[0], np.transpose(chw, (1, 2, 0)),
+                               atol=1e-6)
+
+
+def test_prefetcher_order_and_content(tmp_path):
+    paths = []
+    for i in range(5):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(bytes([i]) * (100 + i))
+        paths.append(str(p))
+    with nb.FilePrefetcher(paths, queue_cap=2) as pf:
+        outs = list(pf)
+    assert len(outs) == 5
+    for i, o in enumerate(outs):
+        assert len(o) == 100 + i and o[0] == i
+
+
+def test_record_reader_native_path_matches_fallback(tmp_path):
+    """The CSV fast path and the pure-Python path must produce identical
+    DataSets."""
+    from deeplearning4j_tpu.datasets.records import (
+        CollectionRecordReader, CSVRecordReader, RecordReaderDataSetIterator)
+    p = str(tmp_path / "d.csv")
+    rows = [[1.0, 2.0, 0], [3.0, 4.0, 1], [5.0, 6.0, 2]]
+    open(p, "w").write("\n".join(",".join(str(v) for v in r)
+                                for r in rows) + "\n")
+    fast = RecordReaderDataSetIterator(CSVRecordReader(p), 3, num_classes=3)
+    slow = RecordReaderDataSetIterator(CollectionRecordReader(rows), 3,
+                                       num_classes=3)
+    bf, bs = next(iter(fast)), next(iter(slow))
+    np.testing.assert_allclose(bf.features, bs.features)
+    np.testing.assert_allclose(bf.labels, bs.labels)
