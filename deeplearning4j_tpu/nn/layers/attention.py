@@ -48,6 +48,17 @@ def dot_product_attention(q: Array, k: Array, v: Array, *,
     """
     dh = q.shape[-1]
     scale = (1.0 / jnp.sqrt(dh)) if scale is None else scale
+    # Pallas fast path (ops/flash_attention.py) — the cuDNN-helper
+    # pattern: kernel when eligible, this jnp path as the fallback.
+    # Offsets must be concrete (custom_vjp statics); traced offsets
+    # (shard_map ring callers) take the fallback.
+    if isinstance(q_offset, int) and isinstance(kv_offset, int):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention, flash_attention_available)
+        if flash_attention_available(q, k, mask):
+            return flash_attention(q, k, v, causal=causal,
+                                   q_offset=q_offset, kv_offset=kv_offset,
+                                   scale=float(scale))
     # [B, H, T, S]
     scores = jnp.einsum("bthd,bshd->bhts", q, k,
                         preferred_element_type=jnp.float32) * scale

@@ -1,0 +1,6 @@
+"""Custom TPU kernels (pallas) — the cuDNN-helper role (reference:
+deeplearning4j-cuda/ helper pattern, SURVEY.md §2.3)."""
+from deeplearning4j_tpu.ops.flash_attention import (flash_attention,
+                                                    flash_attention_available)
+
+__all__ = ["flash_attention", "flash_attention_available"]

@@ -1,0 +1,173 @@
+"""Pallas flash-attention kernel for TPU.
+
+Role parity: the reference accelerates its hot layers with hand-written
+cuDNN kernels loaded as optional fast paths
+(reference: deeplearning4j-cuda/.../CudnnConvolutionHelper.java, loaded
+reflectively at ConvolutionLayer.java:69-76 with a pure-Java fallback).
+Attention is this framework's hottest net-new op (the reference has
+none, SURVEY.md §5.7), so it gets the same treatment: a Pallas kernel
+(VMEM-tiled, online-softmax over query blocks, f32 accumulation) used
+when available, with the jnp reference path as fallback — selection at
+call time, zero API change (`dot_product_attention` dispatches).
+
+Kernel shape strategy: grid over (batch*heads, q-blocks); each program
+holds one q block plus the full K/V rows for its batch-head in VMEM
+(T*Dh*4B each — fits VMEM for T ≲ 8k per chip). Longer sequences ride
+sequence parallelism instead: parallel/ring.py shards T across the mesh
+and calls this kernel on local blocks.
+
+Backward pass: recompute (flash-attention's own trick, and the
+`jax.checkpoint` idiom): the VJP re-runs the jnp reference attention
+under vjp, trading FLOPs for never materializing [T,S] probabilities in
+HBM during the forward.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NEG_INF = -1e30
+BLOCK_Q = 128
+
+
+def _reference_attention(q, k, v, scale: float, causal: bool,
+                         q_offset, kv_offset):
+    """jnp reference path ([B*H, T, D] layout), f32 softmax."""
+    s = jnp.einsum("btd,bsd->bts", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq = q.shape[1]
+        sk = k.shape[1]
+        qi = jnp.arange(tq)[:, None] + q_offset
+        ki = jnp.arange(sk)[None, :] + kv_offset
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p.astype(q.dtype), v)
+
+
+def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *,
+                  scale: float, causal: bool):
+    """One (batch-head, q-block) program: full-K online attention.
+
+    qo_ref/ko_ref: [1,1] SMEM global position offsets (sequence-parallel
+    callers pass non-zero offsets, attention.py q_offset/kv_offset).
+    """
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0]                      # [BQ, D]
+    k = k_ref[0]                      # [S, D]
+    v = v_ref[0]                      # [S, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [BQ, S]
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + pl.program_id(1) * q.shape[0] + qo_ref[0, 0]
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ko_ref[0, 0]
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _flash_forward(q3, k3, v3, scale: float, causal: bool,
+                   q_offset, kv_offset, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(BLOCK_Q, tq)
+    grid = (bh, tq // bq)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qo, ko, q3, k3, v3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention3(q3, k3, v3, scale, causal, q_offset, kv_offset,
+                      interpret):
+    return _flash_forward(q3, k3, v3, scale, causal, q_offset, kv_offset,
+                          interpret)
+
+
+def _fwd(q3, k3, v3, scale, causal, q_offset, kv_offset, interpret):
+    out = _flash_forward(q3, k3, v3, scale, causal, q_offset, kv_offset,
+                         interpret)
+    return out, (q3, k3, v3)
+
+
+def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
+    q3, k3, v3 = res
+    # recompute-based backward (see module docstring)
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference_attention(q, k, v, scale, causal,
+                                             q_offset, kv_offset),
+        q3, k3, v3)
+    return vjp(g)
+
+
+_flash_attention3.defvjp(_fwd, _bwd)
+
+
+def flash_attention_available(q: Array, k: Array,
+                              mask: Optional[Array]) -> bool:
+    """Kernel eligibility: TPU backend (or forced interpret), no arbitrary
+    mask (padding masks take the jnp path), q length divisible by the
+    block."""
+    env = os.environ.get("DL4JTPU_FLASH", "auto")
+    if env == "0":
+        return False
+    if mask is not None:
+        return False
+    if q.ndim != 4:
+        return False
+    tq = q.shape[1]
+    if tq % min(BLOCK_Q, tq) != 0 or tq < 8:
+        return False
+    if env == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
+                    q_offset=0, kv_offset=0,
+                    scale: Optional[float] = None) -> Array:
+    """[B, T, H, D] attention via the Pallas kernel. Same contract as
+    attention.dot_product_attention (which dispatches here)."""
+    b, tq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    interpret = os.environ.get("DL4JTPU_FLASH") == "interpret"
+    # [B, T, H, D] → [B*H, T, D]
+    def to3(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+    out3 = _flash_attention3(to3(q), to3(k), to3(v), float(scale),
+                             bool(causal), q_offset, kv_offset, interpret)
+    return jnp.transpose(out3.reshape(b, h, tq, d), (0, 2, 1, 3))

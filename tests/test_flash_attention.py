@@ -1,0 +1,99 @@
+"""Pallas flash-attention kernel vs jnp reference.
+
+Models the reference's CuDNNGradientChecks strategy (SURVEY.md §2.3:
+numeric check of the accelerated path against the baseline path) — here
+the Pallas kernel (interpret mode on CPU) against the jnp attention.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+from deeplearning4j_tpu.ops.flash_attention import (flash_attention,
+                                                    flash_attention_available)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("DL4JTPU_FLASH", "interpret")
+    yield
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape).astype(np.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    b, t, h, d = 2, 128, 4, 32
+    q, k, v = (_rand((b, t, h, d), s) for s in (0, 1, 2))
+    got = flash_attention(q, k, v, causal=causal)
+    os.environ["DL4JTPU_FLASH"] = "0"
+    want = dot_product_attention(q, k, v, causal=causal)
+    os.environ["DL4JTPU_FLASH"] = "interpret"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_offsets_match_reference():
+    """Blockwise callers pass global position offsets; causal masking must
+    line up with the monolithic computation."""
+    b, t, h, d = 1, 128, 2, 16
+    q, k, v = (_rand((b, 2 * t, h, d), s) for s in (3, 4, 5))
+    os.environ["DL4JTPU_FLASH"] = "0"
+    full = dot_product_attention(q, k, v, causal=True)
+    os.environ["DL4JTPU_FLASH"] = "interpret"
+    # second query block attending over the full 2t keys
+    blk = flash_attention(q[:, t:], k, v, causal=True, q_offset=t,
+                          kv_offset=0)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(full[:, t:]),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_gradients_match_reference():
+    b, t, h, d = 1, 64, 2, 16
+    q, k, v = (_rand((b, t, h, d), s) for s in (6, 7, 8))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        os.environ["DL4JTPU_FLASH"] = "0"
+        out = dot_product_attention(q, k, v, causal=True)
+        os.environ["DL4JTPU_FLASH"] = "interpret"
+        return jnp.sum(out ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_dispatcher_uses_fallback_for_masks():
+    """Padding masks must take the jnp path (kernel ineligible) and still
+    be correct."""
+    b, t, h, d = 2, 16, 2, 8
+    q, k, v = (_rand((b, t, h, d), s) for s in (9, 10, 11))
+    mask = jnp.asarray(np.array([[1] * 10 + [0] * 6, [1] * 16],
+                                np.float32))
+    assert not flash_attention_available(q, k, mask)
+    out = dot_product_attention(q, k, v, mask=mask)
+    # masked keys contribute nothing: perturbing them changes nothing
+    v2 = v.at[0, 12].set(99.0)
+    out2 = dot_product_attention(q, k, v2, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_eligibility_rules():
+    q = _rand((1, 128, 2, 16), 0)
+    assert flash_attention_available(q, q, None)  # interpret env set
+    os.environ["DL4JTPU_FLASH"] = "0"
+    assert not flash_attention_available(q, q, None)
+    os.environ["DL4JTPU_FLASH"] = "interpret"
+    q_small = _rand((1, 5, 2, 16), 0)
+    assert not flash_attention_available(q_small, q_small, None)
