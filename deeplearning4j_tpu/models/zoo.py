@@ -32,3 +32,39 @@ def lenet_mnist(seed: int = 12345, learning_rate: float = 0.01,
                   OutputLayer(n_out=10, activation="softmax",
                               loss_function="mcxent"))
             .set_input_type(InputType.convolutional_flat(28, 28, 1)))
+
+
+def char_rnn_lstm(vocab_size: int, hidden: int = 200, layers: int = 2,
+                  tbptt_length: int = 50, seed: int = 12345,
+                  learning_rate: float = 0.1, dtype: str = "float32"):
+    """Character-level LSTM language model — the reference's GravesLSTM
+    char-RNN benchmark config (BASELINE.md: GravesLSTM char-RNN,
+    deeplearning4j-nn/.../recurrent/GravesLSTM.java:94,142; classic DL4J
+    GravesLSTMCharModellingExample topology: stacked LSTMs + RnnOutput
+    with truncated BPTT)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+    stack = [GravesLSTM(n_out=hidden, activation="tanh")
+             for _ in range(layers)]
+    conf = (NeuralNetConfiguration(seed=seed, updater="rmsprop",
+                                   learning_rate=learning_rate,
+                                   weight_init="xavier", dtype=dtype)
+            .list(*stack,
+                  RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                                 loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(vocab_size)))
+    conf.backprop_type_tbptt(tbptt_length, tbptt_length)
+    return conf
+
+
+def mlp_mnist(seed: int = 12345, learning_rate: float = 0.006,
+              hidden: int = 1000, dtype: str = "float32"):
+    """Single-hidden-layer MLP (the reference's MLPMnistSingleLayerExample
+    topology) — the smallest end-to-end sanity config."""
+    return (NeuralNetConfiguration(seed=seed, updater="nesterovs",
+                                   learning_rate=learning_rate,
+                                   momentum=0.9, weight_init="xavier",
+                                   dtype=dtype)
+            .list(DenseLayer(n_in=784, n_out=hidden, activation="relu"),
+                  OutputLayer(n_out=10, activation="softmax",
+                              loss_function="negativeloglikelihood")))

@@ -1,0 +1,138 @@
+"""Streaming inference / online training routes.
+
+Parity with the reference's dl4j-streaming module (reference:
+deeplearning4j-scaleout/dl4j-streaming/.../kafka/NDArrayPublisher.java,
+NDArrayConsumer.java and routes/DL4jServeRouteBuilder.java — Camel
+routes wiring Kafka topics through a model for online inference or
+incremental fit). Kafka/Camel are cluster middleware, not part of the
+training system; the equivalent here is a broker-agnostic in-process
+pub/sub with the same topology (topics, publishers, consumers, a serve
+route pumping input-topic arrays through the model onto an output
+topic). A real deployment would back `Topic` with its broker of choice;
+the route logic is unchanged.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Topic:
+    """A named stream of ndarrays (the Kafka-topic role)."""
+
+    def __init__(self, name: str, maxsize: int = 1024):
+        self.name = name
+        self._q: "queue.Queue" = queue.Queue(maxsize)
+
+    def put(self, arr: np.ndarray, timeout: Optional[float] = None) -> None:
+        self._q.put(np.asarray(arr), timeout=timeout)
+
+    def get(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self._q.get(timeout=timeout)
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+
+class TopicRegistry:
+    _topics: Dict[str, Topic] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def topic(cls, name: str) -> Topic:
+        with cls._lock:
+            if name not in cls._topics:
+                cls._topics[name] = Topic(name)
+            return cls._topics[name]
+
+
+class NDArrayPublisher:
+    """Reference: kafka/NDArrayPublisher.java."""
+
+    def __init__(self, topic: str):
+        self._topic = TopicRegistry.topic(topic)
+
+    def publish(self, arr: np.ndarray) -> None:
+        self._topic.put(arr)
+
+
+class NDArrayConsumer:
+    """Reference: kafka/NDArrayConsumer.java."""
+
+    def __init__(self, topic: str):
+        self._topic = TopicRegistry.topic(topic)
+
+    def consume(self, timeout: Optional[float] = 5.0) -> np.ndarray:
+        return self._topic.get(timeout=timeout)
+
+
+class DL4jServeRoute:
+    """Online-inference route (reference: routes/
+    DL4jServeRouteBuilder.java): consume arrays from `input_topic`, run
+    `model.output`, publish predictions to `output_topic`. `start()`
+    spawns the pump thread; `stop()` drains and joins."""
+
+    def __init__(self, model, input_topic: str, output_topic: str,
+                 transform: Optional[Callable] = None):
+        self.model = model
+        self.consumer = NDArrayConsumer(input_topic)
+        self.publisher = NDArrayPublisher(output_topic)
+        self.transform = transform
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                arr = self.consumer.consume(timeout=0.1)
+            except queue.Empty:
+                continue
+            if self.transform is not None:
+                arr = self.transform(arr)
+            out = self.model.output(arr)
+            if isinstance(out, list):
+                out = out[0]
+            self.publisher.publish(np.asarray(out))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class DL4jTrainingRoute:
+    """Online-training route: consume (features, labels) pairs and fit
+    incrementally (the reference's training-route variant of
+    DL4jServeRouteBuilder)."""
+
+    def __init__(self, model, features_topic: str, labels_topic: str):
+        self.model = model
+        self.features = NDArrayConsumer(features_topic)
+        self.labels = NDArrayConsumer(labels_topic)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                x = self.features.consume(timeout=0.1)
+                y = self.labels.consume(timeout=5.0)
+            except queue.Empty:
+                continue
+            self.model.fit(x, y)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

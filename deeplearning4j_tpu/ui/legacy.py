@@ -1,0 +1,78 @@
+"""Legacy visualization listeners.
+
+Parity with the reference's deeplearning4j-ui module (reference:
+deeplearning4j-ui-parent/deeplearning4j-ui/.../ConvolutionalIterationListener
+(activation image grids) and FlowIterationListener (layer-flow view)).
+The Play-rendering half lives in ui/server.py; these listeners capture
+the underlying artifacts — per-layer activation snapshots and the layer
+flow graph — to disk as .npy / .json for any front end to render.
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+
+class ConvolutionalIterationListener(IterationListener):
+    """Every `frequency` iterations, run the model's feed-forward on the
+    last batch's first example and save each 4-D (conv) activation as an
+    .npy grid (reference: ConvolutionalIterationListener activation
+    image grids)."""
+
+    def __init__(self, out_dir: str, frequency: int = 10):
+        self.out_dir = Path(out_dir)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        self.frequency = max(1, frequency)
+        self.last_input: Optional[np.ndarray] = None
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.frequency != 0 or self.last_input is None:
+            return
+        acts = model.feed_forward(self.last_input[:1])
+        if isinstance(acts, dict):
+            items = acts.items()
+        else:
+            items = ((f"layer_{i}", a) for i, a in enumerate(acts))
+        for name, a in items:
+            a = np.asarray(a)
+            if a.ndim == 4:  # [1, H, W, C] → [C, H, W] grid source
+                np.save(self.out_dir / f"iter{iteration}_{name}.npy",
+                        np.transpose(a[0], (2, 0, 1)))
+
+    def record_input(self, x) -> None:
+        self.last_input = np.asarray(x)
+
+
+class FlowIterationListener(IterationListener):
+    """Write the layer-flow graph + per-layer score info as JSON
+    (reference: FlowIterationListener layer-flow viz)."""
+
+    def __init__(self, out_path: str, frequency: int = 10):
+        self.out_path = out_path
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.frequency != 0:
+            return
+        layers = []
+        conf = getattr(model, "conf", None)
+        if hasattr(model, "layer_names"):  # MultiLayerNetwork
+            for i, name in enumerate(model.layer_names):
+                layers.append({"name": name,
+                               "type": type(model.layers[i]).__name__,
+                               "inputs": [model.layer_names[i - 1]]
+                               if i else []})
+        elif conf is not None and hasattr(conf, "vertices"):
+            for name, spec in conf.vertices.items():
+                layers.append({"name": name,
+                               "type": type(spec.vertex).__name__,
+                               "inputs": list(spec.inputs)})
+        with open(self.out_path, "w") as f:
+            json.dump({"iteration": iteration, "score": float(score),
+                       "layers": layers}, f, indent=1)

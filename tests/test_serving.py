@@ -1,0 +1,163 @@
+"""Keras-backend server, streaming routes, zoo configs, legacy listeners.
+
+Models the reference's small-module surfaces (deeplearning4j-keras py4j
+entry point, dl4j-streaming Camel routes, deeplearning4j-ui legacy
+listeners).
+"""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras_server import (DeepLearning4jEntryPoint,
+                                             KerasServer)
+from deeplearning4j_tpu.streaming import (DL4jServeRoute, NDArrayConsumer,
+                                          NDArrayPublisher)
+
+
+def _write_keras_fixture(path):
+    import h5py
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    w2 = rng.normal(size=(8, 2)).astype(np.float32)
+    mc = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {"name": "d1", "units": 8,
+         "activation": "relu", "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {"name": "d2", "units": 2,
+         "activation": "softmax"}}]}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(mc).encode()
+        f.attrs["training_config"] = json.dumps(
+            {"loss": "categorical_crossentropy"}).encode()
+        g = f.create_group("model_weights")
+        g.attrs["layer_names"] = np.array([b"d1", b"d2"], dtype="S8")
+        for n, w in (("d1", w1), ("d2", w2)):
+            lg = g.create_group(n)
+            lg.attrs["weight_names"] = np.array(
+                [f"{n}/kernel:0".encode(), f"{n}/bias:0".encode()],
+                dtype="S32")
+            lg.create_dataset(f"{n}/kernel:0", data=w)
+            lg.create_dataset(f"{n}/bias:0",
+                              data=np.zeros(w.shape[1], np.float32))
+
+
+def test_entry_point_fit_and_predict(tmp_path):
+    model_path = str(tmp_path / "m.h5")
+    _write_keras_fixture(model_path)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    data_path = str(tmp_path / "d.npz")
+    np.savez(data_path, features=x, labels=y)
+
+    ep = DeepLearning4jEntryPoint()
+    res = ep.fit(model_path, data_path, epochs=2, batch_size=16)
+    assert len(res["scores"]) == 2
+    assert all(np.isfinite(s) for s in res["scores"])
+    pred = ep.predict(model_path, data_path)
+    out = np.load(pred["output_path"])
+    assert out.shape == (32, 2)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_keras_server_http_roundtrip(tmp_path):
+    model_path = str(tmp_path / "m.h5")
+    _write_keras_fixture(model_path)
+    x = np.random.default_rng(2).normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+    data_path = str(tmp_path / "d.npz")
+    np.savez(data_path, features=x, labels=y)
+
+    server = KerasServer(port=0)
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                server.url + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        with urllib.request.urlopen(server.url + "/health",
+                                    timeout=5) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        res = post("/fit", {"model_path": model_path,
+                            "data_path": data_path, "epochs": 1})
+        assert "scores" in res and len(res["scores"]) == 1
+        res = post("/predict", {"model_path": model_path,
+                                "data_path": data_path})
+        assert np.load(res["output_path"]).shape == (8, 2)
+    finally:
+        server.stop()
+
+
+def test_streaming_serve_route():
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = NeuralNetConfiguration(seed=1).list(
+        DenseLayer(n_in=3, n_out=4, activation="tanh"),
+        OutputLayer(n_out=2, activation="softmax",
+                    loss_function="mcxent"))
+    net = MultiLayerNetwork(conf).init()
+
+    route = DL4jServeRoute(net, "in_topic", "out_topic")
+    route.start()
+    try:
+        pub = NDArrayPublisher("in_topic")
+        sub = NDArrayConsumer("out_topic")
+        x = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+        pub.publish(x)
+        out = sub.consume(timeout=30)
+        assert out.shape == (5, 2)
+        np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-5)
+    finally:
+        route.stop()
+
+
+def test_zoo_char_rnn_and_mlp_train():
+    from deeplearning4j_tpu.models.zoo import char_rnn_lstm, mlp_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = char_rnn_lstm(vocab_size=12, hidden=16, layers=2,
+                         tbptt_length=8)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (4, 16))]
+    y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (4, 16))]
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    out = net.output(x)
+    assert out.shape == (4, 16, 12)
+
+    mlp = MultiLayerNetwork(mlp_mnist(hidden=32)).init()
+    xb = rng.normal(size=(8, 784)).astype(np.float32)
+    yb = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    mlp.fit(xb, yb)
+    assert np.isfinite(mlp.score_value)
+
+
+def test_legacy_listeners(tmp_path):
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui.legacy import (ConvolutionalIterationListener,
+                                              FlowIterationListener)
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    conv_l = ConvolutionalIterationListener(str(tmp_path / "acts"),
+                                            frequency=1)
+    flow_l = FlowIterationListener(str(tmp_path / "flow.json"), frequency=1)
+    net.set_listeners(conv_l, flow_l)
+    rng = np.random.default_rng(0)
+    x = rng.random((4, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    conv_l.record_input(x)
+    net.fit(x, y)
+    acts = list((tmp_path / "acts").glob("*.npy"))
+    assert acts, "no activation grids saved"
+    grid = np.load(acts[0])
+    assert grid.ndim == 3  # [C, H, W]
+    flow = json.load(open(tmp_path / "flow.json"))
+    assert len(flow["layers"]) == 6
+    assert flow["layers"][1]["inputs"] == [flow["layers"][0]["name"]]
