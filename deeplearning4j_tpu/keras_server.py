@@ -30,35 +30,47 @@ class DeepLearning4jEntryPoint:
 
     def __init__(self):
         self._model_cache: Dict[str, Any] = {}
+        self._model_locks: Dict[str, threading.Lock] = {}
+        self._cache_lock = threading.Lock()
 
     def _load(self, model_path: str):
-        if model_path not in self._model_cache:
-            from deeplearning4j_tpu.modelimport.trained_models import \
-                load_vgg16  # dispatches sequential vs functional
-            self._model_cache[model_path] = load_vgg16(model_path)
-        return self._model_cache[model_path]
+        """Import (once) and return (model, per-model lock). Networks are
+        stateful (params/updater/iteration), so concurrent RPCs on the
+        same model serialize on its lock."""
+        with self._cache_lock:
+            lock = self._model_locks.setdefault(model_path,
+                                                threading.Lock())
+        with lock:
+            if model_path not in self._model_cache:
+                from deeplearning4j_tpu.modelimport.keras import \
+                    import_keras_model_auto
+                self._model_cache[model_path] = \
+                    import_keras_model_auto(model_path)
+        return self._model_cache[model_path], lock
 
     def fit(self, model_path: str, data_path: str, epochs: int = 1,
             batch_size: int = 32) -> Dict[str, Any]:
         """Reference: DeepLearning4jEntryPoint.sequentialFit — import the
         Keras model, train on the pushed minibatch file(s)."""
-        net = self._load(model_path)
+        net, lock = self._load(model_path)
         data = np.load(data_path)
         x, y = data["features"], data["labels"]
         scores = []
         from deeplearning4j_tpu.datasets.iterators import \
             BaseDatasetIterator
-        for _ in range(int(epochs)):
-            net.fit(BaseDatasetIterator(x, y, int(batch_size)))
-            scores.append(float(net.score_value))
+        with lock:
+            for _ in range(int(epochs)):
+                net.fit(BaseDatasetIterator(x, y, int(batch_size)))
+                scores.append(float(net.score_value))
         return {"scores": scores}
 
     def predict(self, model_path: str, data_path: str,
                 output_path: Optional[str] = None) -> Dict[str, Any]:
-        net = self._load(model_path)
+        net, lock = self._load(model_path)
         data = np.load(data_path)
         x = data["features"]
-        out = net.output(x)
+        with lock:
+            out = net.output(x)
         if isinstance(out, list):
             out = out[0]
         output_path = output_path or data_path + ".out.npy"

@@ -4,6 +4,7 @@ from deeplearning4j_tpu.modelimport.keras import (
     import_keras_sequential_model_and_weights,
     import_keras_model_configuration,
     import_keras_model_and_weights_separate,
+    import_keras_model_auto,
     KerasModel, KerasSequentialModel,
     InvalidKerasConfigurationException,
     UnsupportedKerasConfigurationException,
@@ -18,6 +19,7 @@ __all__ = [
     "import_keras_sequential_model_and_weights",
     "import_keras_model_configuration",
     "import_keras_model_and_weights_separate",
+    "import_keras_model_auto",
     "KerasModel", "KerasSequentialModel", "Hdf5Archive",
     "InvalidKerasConfigurationException",
     "UnsupportedKerasConfigurationException",

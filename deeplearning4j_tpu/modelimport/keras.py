@@ -677,6 +677,19 @@ def import_keras_model_and_weights(path: str,
         return net
 
 
+def import_keras_model_auto(path: str,
+                            enforce_training_config: bool = False):
+    """Dispatch on the file's model_config class: Sequential →
+    MultiLayerNetwork, functional → ComputationGraph (the reference's
+    ModelGuesser-style convenience on top of KerasModelImport)."""
+    with Hdf5Archive(path) as archive:
+        mc = _model_config_from_archive(archive)
+    if mc.get("class_name") == "Sequential":
+        return import_keras_sequential_model_and_weights(
+            path, enforce_training_config)
+    return import_keras_model_and_weights(path, enforce_training_config)
+
+
 def import_keras_model_configuration(json_path_or_str: str):
     """Architecture-only JSON → configuration (reference:
     KerasModelImport.importKerasModelConfiguration / Sequential variant)."""

@@ -77,12 +77,6 @@ def vgg16_preprocess(images: np.ndarray) -> np.ndarray:
 def load_vgg16(h5_path: str):
     """Import VGG16 weights from a local Keras HDF5 file
     (reference flow: TrainedModelHelper → KerasModelImport)."""
-    from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
-    from deeplearning4j_tpu.modelimport.keras import (
-        import_keras_sequential_model_and_weights,
-        import_keras_model_and_weights)
-    with Hdf5Archive(h5_path) as archive:
-        cfg = archive.read_attribute_as_json("model_config") or {}
-    if cfg.get("class_name") == "Sequential":
-        return import_keras_sequential_model_and_weights(h5_path)
-    return import_keras_model_and_weights(h5_path)
+    from deeplearning4j_tpu.modelimport.keras import \
+        import_keras_model_auto
+    return import_keras_model_auto(h5_path)

@@ -47,12 +47,15 @@ def dot_product_attention(q: Array, k: Array, v: Array, *,
     Scores and softmax are computed in float32 regardless of input dtype.
     """
     dh = q.shape[-1]
-    scale = (1.0 / jnp.sqrt(dh)) if scale is None else scale
+    # dh is static — python math keeps scale concrete under jit (the
+    # pallas dispatch below needs a weak-typed float)
+    scale = (dh ** -0.5) if scale is None else scale
     # Pallas fast path (ops/flash_attention.py) — the cuDNN-helper
     # pattern: kernel when eligible, this jnp path as the fallback.
     # Offsets must be concrete (custom_vjp statics); traced offsets
     # (shard_map ring callers) take the fallback.
-    if isinstance(q_offset, int) and isinstance(kv_offset, int):
+    if isinstance(q_offset, int) and isinstance(kv_offset, int) \
+            and isinstance(scale, (int, float)):
         from deeplearning4j_tpu.ops.flash_attention import (
             flash_attention, flash_attention_available)
         if flash_attention_available(q, k, mask):

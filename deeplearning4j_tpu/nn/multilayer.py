@@ -256,6 +256,8 @@ class MultiLayerNetwork:
             for l in self.listeners:
                 if hasattr(l, "record_batch"):
                     l.record_batch(int(x.shape[0]))
+                if hasattr(l, "record_input"):
+                    l.record_input(x)
                 l.iteration_done(self, self.iteration_count,
                                  self.score_value)
             self.iteration_count += 1

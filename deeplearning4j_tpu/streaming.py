@@ -13,11 +13,14 @@ the route logic is unchanged.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class Topic:
@@ -84,18 +87,34 @@ class DL4jServeRoute:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _publish_interruptible(self, out: np.ndarray) -> None:
+        """Bounded put that keeps observing the stop flag — a stalled
+        output consumer must not wedge the pump past stop()."""
+        while not self._stop.is_set():
+            try:
+                self.publisher._topic.put(out, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
     def _pump(self) -> None:
         while not self._stop.is_set():
             try:
                 arr = self.consumer.consume(timeout=0.1)
             except queue.Empty:
                 continue
-            if self.transform is not None:
-                arr = self.transform(arr)
-            out = self.model.output(arr)
-            if isinstance(out, list):
-                out = out[0]
-            self.publisher.publish(np.asarray(out))
+            try:
+                if self.transform is not None:
+                    arr = self.transform(arr)
+                out = self.model.output(arr)
+                if isinstance(out, list):
+                    out = out[0]
+            except Exception:
+                # per-exchange error handling (the Camel route's
+                # equivalent): log and keep serving
+                log.exception("serve route: dropping bad input batch")
+                continue
+            self._publish_interruptible(np.asarray(out))
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._pump, daemon=True)
@@ -120,13 +139,21 @@ class DL4jTrainingRoute:
         self._thread: Optional[threading.Thread] = None
 
     def _pump(self) -> None:
+        pending_x: Optional[np.ndarray] = None
         while not self._stop.is_set():
             try:
-                x = self.features.consume(timeout=0.1)
-                y = self.labels.consume(timeout=5.0)
+                if pending_x is None:
+                    pending_x = self.features.consume(timeout=0.1)
+                # keep the feature batch until its labels arrive —
+                # dropping it would misalign every later (x, y) pair
+                y = self.labels.consume(timeout=0.1)
             except queue.Empty:
                 continue
-            self.model.fit(x, y)
+            x, pending_x = pending_x, None
+            try:
+                self.model.fit(x, y)
+            except Exception:
+                log.exception("training route: dropping bad batch")
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._pump, daemon=True)
