@@ -11,6 +11,9 @@ from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec, ParagraphVectors, Glove
 from deeplearning4j_tpu.nlp.serialization import WordVectorSerializer
+from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
+                                                TfidfVectorizer,
+                                                InvertedIndex)
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory",
@@ -20,4 +23,5 @@ __all__ = [
     "LabelsSource", "VocabConstructor", "AbstractCache", "VocabWord",
     "build_huffman_tree", "InMemoryLookupTable", "SequenceVectors",
     "Word2Vec", "ParagraphVectors", "Glove", "WordVectorSerializer",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex",
 ]

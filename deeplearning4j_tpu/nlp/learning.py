@@ -49,8 +49,7 @@ def _sg_neg_loss_and_grads(syn0_c, syn1_ctx, syn1_neg):
     return loss, g_center, g_ctx, g_negv
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def skipgram_neg_step(syn0: Array, syn1neg: Array, centers: Array,
+def skipgram_neg_impl(syn0: Array, syn1neg: Array, centers: Array,
                       contexts: Array, negatives: Array, lr: Array
                       ) -> Tuple[Array, Array, Array]:
     """One batched skip-gram negative-sampling update.
@@ -72,6 +71,27 @@ def skipgram_neg_step(syn0: Array, syn1neg: Array, centers: Array,
     syn1neg = syn1neg.at[negatives.reshape(-1)].add(
         (-lr[:, None, None] * g_neg).reshape(-1, g_neg.shape[-1]))
     return syn0, syn1neg, loss
+
+
+# single-device jitted form (donated buffers update in place in HBM)
+skipgram_neg_step = jax.jit(skipgram_neg_impl, donate_argnums=(0, 1))
+
+
+def make_sharded_skipgram_step(mesh):
+    """Data-parallel skip-gram (the reference's distributed Word2Vec role,
+    spark/dl4j-spark-nlp/.../Word2Vec.java map-partitions + weight-delta
+    accumulation, SURVEY.md §2.6): pair batches shard over the mesh's
+    'data' axis, syn0/syn1neg stay replicated, and GSPMD turns the
+    scatter-adds into an allreduce of per-shard deltas over ICI —
+    equivalent math, collective-speed sync every batch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    mat = NamedSharding(mesh, P("data", None))
+    return jax.jit(skipgram_neg_impl,
+                   in_shardings=(rep, rep, row, row, mat, row),
+                   out_shardings=(rep, rep, rep),
+                   donate_argnums=(0, 1))
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

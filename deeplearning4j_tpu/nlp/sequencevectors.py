@@ -38,7 +38,8 @@ class SequenceVectors(WordVectorsMixin):
                  epochs: int = 1, iterations: int = 1,
                  min_word_frequency: int = 1, batch_size: int = 512,
                  subsampling: float = 0.0, seed: int = 12345,
-                 elements_learning_algorithm: str = "skipgram"):
+                 elements_learning_algorithm: str = "skipgram",
+                 mesh=None):
         self.layer_size = layer_size
         self.window = window
         self.learning_rate = learning_rate
@@ -52,6 +53,13 @@ class SequenceVectors(WordVectorsMixin):
         self.subsampling = subsampling
         self.seed = seed
         self.algorithm = elements_learning_algorithm.lower()
+        # device mesh with a 'data' axis → mesh-sharded pair batches (the
+        # distributed Word2Vec mode; see make_sharded_skipgram_step)
+        self.mesh = mesh
+        self._sharded_step = None
+        if mesh is not None and self.algorithm != "skipgram":
+            raise ValueError("mesh-distributed training currently covers "
+                             "the skipgram algorithm")
         self.vocab: Optional[AbstractCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.default_rng(seed)
@@ -187,7 +195,14 @@ class SequenceVectors(WordVectorsMixin):
                 jnp.asarray(pts), jnp.asarray(codes), jnp.asarray(cmask),
                 jnp.asarray(lr_vec))
             return
-        lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_step(
+        if self.mesh is not None:
+            if self._sharded_step is None:
+                self._sharded_step = learning.make_sharded_skipgram_step(
+                    self.mesh)
+            step = self._sharded_step
+        else:
+            step = learning.skipgram_neg_step
+        lt.syn0, lt.syn1neg, _ = step(
             lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
             jnp.asarray(contexts_p),
             jnp.asarray(self._sample_negatives(n)), jnp.asarray(lr_vec))

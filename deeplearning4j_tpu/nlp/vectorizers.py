@@ -1,0 +1,116 @@
+"""Bag-of-words / TF-IDF vectorizers + inverted index.
+
+Parity with the reference's document-vectorization pipeline (reference:
+deeplearning4j-nlp/.../bagofwords/vectorizer/{BagOfWordsVectorizer,
+TfidfVectorizer,BaseTextVectorizer}.java and text/invertedindex/
+InvertedIndex.java). `fit_transform` produces the dense [N_docs, V]
+matrix as a jax array (one device put; downstream models consume it
+directly), matching the reference's INDArray output.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+class InvertedIndex:
+    """word → [doc ids] (reference: text/invertedindex/InvertedIndex.java,
+    Lucene-backed there; in-memory postings here)."""
+
+    def __init__(self):
+        self._postings: Dict[str, List[int]] = defaultdict(list)
+        self._docs: List[List[str]] = []
+
+    def add_doc(self, tokens: Sequence[str]) -> int:
+        doc_id = len(self._docs)
+        self._docs.append(list(tokens))
+        for w in set(tokens):
+            self._postings[w].append(doc_id)
+        return doc_id
+
+    def documents(self, word: str) -> List[int]:
+        return list(self._postings.get(word, []))
+
+    def doc(self, doc_id: int) -> List[str]:
+        return self._docs[doc_id]
+
+    def num_documents(self) -> int:
+        return len(self._docs)
+
+
+class BaseTextVectorizer:
+    """Shared fit/transform plumbing (reference:
+    BaseTextVectorizer.java)."""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 1):
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.vocab: Optional[AbstractCache] = None
+        self.index = InvertedIndex()
+
+    def _tokenize(self, text: str) -> List[str]:
+        return self.tokenizer_factory.create(text).get_tokens()
+
+    def fit(self, documents: Iterable[str]) -> "BaseTextVectorizer":
+        token_docs = [self._tokenize(d) for d in documents]
+        for toks in token_docs:
+            self.index.add_doc(toks)
+        self.vocab = VocabConstructor(
+            min_word_frequency=self.min_word_frequency,
+            build_huffman=False).build_vocab(token_docs)
+        return self
+
+    def _counts_row(self, tokens: Sequence[str]) -> np.ndarray:
+        row = np.zeros(self.vocab.num_words(), np.float32)
+        for w, c in Counter(tokens).items():
+            i = self.vocab.index_of(w)
+            if i >= 0:
+                row[i] = c
+        return row
+
+    def transform(self, documents: Iterable[str]):
+        import jax.numpy as jnp
+        rows = [self._weight(self._counts_row(self._tokenize(d)))
+                for d in documents]
+        return jnp.asarray(np.stack(rows))
+
+    def fit_transform(self, documents: Iterable[str]):
+        docs = list(documents)
+        self.fit(docs)
+        return self.transform(docs)
+
+    def _weight(self, counts: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class BagOfWordsVectorizer(BaseTextVectorizer):
+    """Raw term counts (reference: BagOfWordsVectorizer.java)."""
+
+    def _weight(self, counts: np.ndarray) -> np.ndarray:
+        return counts
+
+
+class TfidfVectorizer(BaseTextVectorizer):
+    """tf-idf weighting (reference: TfidfVectorizer.java — same smooth
+    idf = log(N / df))."""
+
+    def _idf(self) -> np.ndarray:
+        n_docs = max(self.index.num_documents(), 1)
+        idf = np.zeros(self.vocab.num_words(), np.float32)
+        for w in self.vocab.vocab_words():
+            df = len(self.index.documents(w.word))
+            idf[w.index] = math.log((n_docs + 1.0) / (df + 1.0)) + 1.0
+        return idf
+
+    def _weight(self, counts: np.ndarray) -> np.ndarray:
+        tf = counts / max(counts.sum(), 1.0)
+        return tf * self._idf()

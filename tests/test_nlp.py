@@ -216,3 +216,41 @@ def test_glove_trains_and_queries():
     cross = g.similarity("day", "dog")
     assert np.isfinite(related) and np.isfinite(cross)
     assert related > cross, (related, cross)
+
+
+def test_vectorizers_and_inverted_index():
+    from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
+                                                    TfidfVectorizer)
+    docs = ["the cat sat on the mat", "the dog sat on the log",
+            "cats and dogs"]
+    bow = BagOfWordsVectorizer()
+    m = np.asarray(bow.fit_transform(docs))
+    assert m.shape[0] == 3
+    the_idx = bow.vocab.index_of("the")
+    assert m[0, the_idx] == 2  # 'the' twice in doc 0
+    assert bow.index.documents("sat") == [0, 1]
+    assert bow.index.num_documents() == 3
+
+    tf = TfidfVectorizer()
+    t = np.asarray(tf.fit_transform(docs))
+    cat_idx = tf.vocab.index_of("cat")
+    # 'cat' (1 doc) outweighs 'sat' (2 docs) per-occurrence in doc 0
+    sat_idx = tf.vocab.index_of("sat")
+    assert t[0, cat_idx] > t[0, sat_idx]
+
+
+def test_distributed_word2vec_matches_single(devices8):
+    """Mesh-sharded skip-gram must track the single-device trainer
+    (the reference's spark-vs-single equivalence pattern, SURVEY §4)."""
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    kw = dict(sentences=_toy_corpus(10), layer_size=16, window=3,
+              negative=3, epochs=2, seed=13, min_word_frequency=2,
+              batch_size=64, learning_rate=0.05)
+    single = Word2Vec(**kw)
+    single.fit()
+    dist = Word2Vec(mesh=data_parallel_mesh(8), **kw)
+    dist.fit()
+    v1 = single.word_vector("day")
+    v2 = dist.word_vector("day")
+    np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
+    assert dist.similarity("day", "night") > dist.similarity("day", "dog")
