@@ -273,3 +273,56 @@ class IteratorDataSetIterator:
     def reset(self):
         it = self._factory
         self._it = iter(it() if callable(it) else it)
+
+
+class ReconstructionDataSetIterator:
+    """Wrap an iterator so labels == features, for unsupervised training
+    (reference: datasets/iterator/ReconstructionDataSetIterator.java)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __iter__(self):
+        self._inner.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        ds = next(self._inner)
+        return DataSet(ds.features, np.asarray(ds.features).copy())
+
+    def reset(self):
+        self._inner.reset()
+
+
+class MovingWindowDataSetIterator:
+    """Slide a (height, width) window over each image, emitting window
+    batches (reference: iterator/MovingWindowBaseDataSetIterator.java +
+    util/MovingWindowMatrix.java)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, window_h: int,
+                 window_w: int, stride_h: Optional[int] = None,
+                 stride_w: Optional[int] = None):
+        feats = np.asarray(dataset.features)
+        labels = np.asarray(dataset.labels)
+        if feats.ndim != 4:
+            raise ValueError("MovingWindow needs [B, H, W, C] features")
+        sh = stride_h or window_h
+        sw = stride_w or window_w
+        wins, labs = [], []
+        _, H, W, _ = feats.shape
+        for top in range(0, H - window_h + 1, sh):
+            for left in range(0, W - window_w + 1, sw):
+                wins.append(feats[:, top:top + window_h,
+                                  left:left + window_w, :])
+                labs.append(labels)
+        self._inner = BaseDatasetIterator(np.concatenate(wins),
+                                          np.concatenate(labs), batch_size)
+
+    def __iter__(self):
+        return iter(self._inner)
+
+    def __next__(self):
+        return next(self._inner)
+
+    def reset(self):
+        self._inner.reset()

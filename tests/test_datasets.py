@@ -129,3 +129,20 @@ def test_mnist_iterator_shapes():
     b = next(iter(it))
     assert b.features.shape == (16, 784)
     assert b.labels.shape == (16, 10)
+
+
+def test_reconstruction_and_moving_window():
+    from deeplearning4j_tpu.datasets.iterators import (
+        BaseDatasetIterator, MovingWindowDataSetIterator,
+        ReconstructionDataSetIterator)
+    rng = np.random.default_rng(0)
+    f = rng.random((6, 8, 8, 1)).astype(np.float32)
+    l = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+    rec = ReconstructionDataSetIterator(BaseDatasetIterator(
+        f.reshape(6, -1), l, 3))
+    b = next(iter(rec))
+    np.testing.assert_allclose(b.features, b.labels)
+    mw = MovingWindowDataSetIterator(DataSet(f, l), batch_size=8,
+                                     window_h=4, window_w=4)
+    b = next(iter(mw))
+    assert b.features.shape == (8, 4, 4, 1)

@@ -148,3 +148,47 @@ def test_frozen_layer_does_not_update():
     np.testing.assert_array_equal(w_before, w_after)
     # but the output layer did move
     assert net.iteration_count > 0
+
+
+def test_rbm_pretrain_reduces_free_energy_gap():
+    """RBM CD-1 pretraining learns the data distribution (reference:
+    RBM contrastive divergence; analog of the reference's RBM pretrain
+    tests)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import RBM
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(0)
+    # binary patterns: two prototypes + flip noise
+    protos = np.array([[1, 1, 1, 1, 0, 0, 0, 0],
+                       [0, 0, 0, 0, 1, 1, 1, 1]], np.float32)
+    idx = rng.integers(0, 2, 128)
+    x = protos[idx]
+    flip = rng.random(x.shape) < 0.05
+    x = np.abs(x - flip.astype(np.float32))
+
+    conf = NeuralNetConfiguration(seed=1, updater="sgd",
+                                  learning_rate=0.1).list(
+        RBM(n_in=8, n_out=6),
+        OutputLayer(n_out=2, activation="softmax",
+                    loss_function="mcxent"))
+    conf.set_pretrain(True)
+    net = MultiLayerNetwork(conf).init()
+    rbm = net.layers[0]
+
+    def fe(v):
+        return float(np.mean(np.asarray(
+            rbm._free_energy(net.params["layer_0"], jnp.asarray(v)))))
+
+    rand_v = rng.integers(0, 2, x.shape).astype(np.float32)
+    gap_before = fe(rand_v) - fe(x)
+    for _ in range(30):
+        net.pretrain_layer(0, x)
+    gap_after = fe(rand_v) - fe(x)
+    # after training, data should have much lower free energy than noise
+    assert gap_after > gap_before + 1.0, (gap_before, gap_after)
+    # supervised forward still works on top
+    h, _ = rbm.apply(net.params["layer_0"], {}, jnp.asarray(x[:4]))
+    assert h.shape == (4, 6)
