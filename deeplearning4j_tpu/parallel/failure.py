@@ -1,0 +1,101 @@
+"""Failure detection + checkpoint-based recovery for training loops.
+
+The reference has none of this in-tree (SURVEY.md §5.3: Spark mode
+inherits RDD retry; a lost executor just loses one split). The
+TPU-idiomatic equivalent named there — "checkpoint-based restart +
+multi-host health via the coordination service" — is what this module
+provides: a `FaultTolerantTrainer` that wraps any fit loop with
+periodic checkpoints, detects step failures (device OOM, preempted
+TPU grant, injected faults), restores the last good checkpoint, and
+resumes; plus a `FaultInjector` for deterministic failure testing
+(the fault-injection harness the reference also lacks).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Iterable, Optional
+
+from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingFailure(RuntimeError):
+    """Raised by fault injection; real device errors (XlaRuntimeError
+    etc.) are caught by their base RuntimeError."""
+
+
+class FaultInjector:
+    """Deterministically fail chosen iterations (test harness)."""
+
+    def __init__(self, fail_at: Iterable[int] = ()):
+        self.fail_at = set(int(i) for i in fail_at)
+        self.injected = 0
+
+    def check(self, iteration: int) -> None:
+        if iteration in self.fail_at:
+            self.fail_at.discard(iteration)
+            self.injected += 1
+            raise TrainingFailure(f"injected fault at iteration "
+                                  f"{iteration}")
+
+
+class FaultTolerantTrainer:
+    """Run fit over an iterator with checkpoint/restore-based recovery.
+
+    Each minibatch step is guarded; on failure the model is restored
+    from the latest checkpoint and the epoch continues from the current
+    batch (at-least-once batch semantics — same guarantee as the
+    reference's Spark retry, which may also re-process a split).
+    """
+
+    def __init__(self, net, checkpoint_dir: str,
+                 checkpoint_frequency: int = 50, max_restarts: int = 3,
+                 fault_injector: Optional[FaultInjector] = None,
+                 use_orbax: Optional[bool] = None):
+        self.net = net
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         use_orbax=use_orbax)
+        self.checkpoint_frequency = max(1, checkpoint_frequency)
+        self.max_restarts = max_restarts
+        self.fault_injector = fault_injector
+        self.restarts = 0
+
+    def _maybe_checkpoint(self) -> None:
+        if self.net.iteration_count % self.checkpoint_frequency == 0:
+            self.manager.save(self.net)
+
+    def fit(self, iterator, epochs: int = 1) -> None:
+        if not self.net._initialized:
+            self.net.init()
+        restored = self.manager.restore(self.net)
+        if restored is not None:
+            log.info("resumed from checkpoint step %d", restored)
+        from deeplearning4j_tpu.nn.multilayer import _unpack_batch
+        for _ in range(epochs):
+            for batch in iterator:
+                feats, labs, fmask, lmask = _unpack_batch(batch)
+                while True:
+                    try:
+                        if self.fault_injector is not None:
+                            self.fault_injector.check(
+                                self.net.iteration_count)
+                        self.net.fit(feats, labs,
+                                     lmask if lmask is not None else fmask)
+                        break
+                    except RuntimeError as e:
+                        self.restarts += 1
+                        if self.restarts > self.max_restarts:
+                            raise
+                        log.warning(
+                            "step failed (%s); restoring last checkpoint "
+                            "(restart %d/%d)", e, self.restarts,
+                            self.max_restarts)
+                        if self.manager.restore(self.net) is None:
+                            log.warning("no checkpoint yet; retrying from "
+                                        "current params")
+                self._maybe_checkpoint()
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        self.manager.save(self.net)

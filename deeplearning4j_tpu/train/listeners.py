@@ -106,3 +106,28 @@ class ComposableIterationListener(IterationListener):
     def iteration_done(self, model, iteration, score):
         for l in self.listeners:
             l.iteration_done(model, iteration, score)
+
+
+class ProfilerListener(IterationListener):
+    """Capture an XLA/jax profiler trace for a window of iterations
+    (SURVEY.md §5.1: the reference has PerformanceListener + Spark phase
+    stats but no tracer; the TPU equivalent is the jax profiler —
+    traces open in TensorBoard / xprof)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.stop_iteration = start_iteration + num_iterations
+        self._active = False
+
+    def iteration_done(self, model, iteration, score):
+        import jax
+        if iteration == self.start_iteration and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            log.info("profiler trace started → %s", self.log_dir)
+        elif iteration >= self.stop_iteration and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace stopped")

@@ -1,0 +1,155 @@
+"""Checkpoint / resume for long-running (and distributed) training.
+
+Parity with the reference's checkpoint story (reference: SURVEY.md §5.4 —
+ModelSerializer zip of configuration.json + coefficients.bin +
+updaterState.bin restores training exactly; earlystopping/saver/
+LocalFileModelSaver persists best/latest). That covers single-process
+saves; the TPU-idiomatic extension (SURVEY §5.3: "checkpoint-based
+restart + multi-host health") is orbax: async array checkpointing that
+coordinates across hosts, versioned step directories, retention.
+
+`CheckpointManager` wraps orbax when available and falls back to the
+npz serializer otherwise; `CheckpointListener` snapshots every N
+iterations from inside the normal listener stream.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+try:
+    import orbax.checkpoint as ocp
+    HAVE_ORBAX = True
+except ImportError:  # pragma: no cover
+    HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Save/restore (params, state, updater_state, iteration) for a
+    network. Orbax path: async multi-host-safe array checkpointing.
+    Fallback: npz files. Either way, directory layout is
+    `<root>/step_<N>/` with `latest` resolution and retention."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 use_orbax: Optional[bool] = None):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.use_orbax = HAVE_ORBAX if use_orbax is None else use_orbax
+        self._ocp_mgr = None
+        if self.use_orbax:
+            self._ocp_mgr = ocp.CheckpointManager(
+                self.directory.resolve(),
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True))
+
+    # -- save --------------------------------------------------------------
+    def save(self, net, step: Optional[int] = None) -> int:
+        step = int(net.iteration_count if step is None else step)
+        payload = {"params": net.params, "state": net.state,
+                   "updater_state": net.updater_state}
+        if self.use_orbax:
+            self._ocp_mgr.save(step, args=ocp.args.StandardSave(payload))
+            self._ocp_mgr.wait_until_finished()
+        else:
+            d = self.directory / f"step_{step}"
+            d.mkdir(parents=True, exist_ok=True)
+            flat = {}
+            for k, tree in payload.items():
+                leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+                for path, leaf in leaves:
+                    name = k + "|" + "/".join(
+                        str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+                    flat[name] = np.asarray(leaf)
+            np.savez(d / "arrays.npz", **flat)
+            self._retain()
+        meta = {"step": step,
+                "iteration_count": int(net.iteration_count),
+                "epoch_count": int(net.epoch_count)}
+        (self.directory / f"meta_{step}.json").write_text(json.dumps(meta))
+        return step
+
+    def _retain(self) -> None:
+        steps = self.all_steps()
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(self.directory / f"step_{s}", ignore_errors=True)
+            try:
+                (self.directory / f"meta_{s}.json").unlink()
+            except FileNotFoundError:
+                pass
+
+    # -- restore -----------------------------------------------------------
+    def all_steps(self) -> List[int]:
+        if self.use_orbax:
+            return sorted(self._ocp_mgr.all_steps())
+        out = []
+        for p in self.directory.glob("step_*"):
+            m = re.fullmatch(r"step_(\d+)", p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, net, step: Optional[int] = None):
+        """Restore in place; returns the step restored from (None if no
+        checkpoint exists)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        template = {"params": net.params, "state": net.state,
+                    "updater_state": net.updater_state}
+        if self.use_orbax:
+            restored = self._ocp_mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        else:
+            data = np.load(self.directory / f"step_{step}" / "arrays.npz")
+            restored = {}
+            for k, tree in template.items():
+                leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+                vals = []
+                for path, leaf in leaves:
+                    name = k + "|" + "/".join(
+                        str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+                    vals.append(jax.numpy.asarray(data[name]))
+                restored[k] = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(tree), vals)
+        net.params = restored["params"]
+        net.state = restored["state"]
+        net.updater_state = restored["updater_state"]
+        meta_path = self.directory / f"meta_{step}.json"
+        if meta_path.exists():
+            meta = json.loads(meta_path.read_text())
+            net.iteration_count = meta.get("iteration_count", step)
+            net.epoch_count = meta.get("epoch_count", 0)
+        return step
+
+
+class CheckpointListener(IterationListener):
+    """Snapshot every `frequency` iterations (the reference's
+    CheckpointListener role; rides the standard listener stream)."""
+
+    def __init__(self, manager: CheckpointManager, frequency: int = 100):
+        self.manager = manager
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration > 0 and iteration % self.frequency == 0:
+            self.manager.save(model, step=iteration)
+            log.info("checkpointed at iteration %d", iteration)
