@@ -27,15 +27,21 @@ class TrainingFailure(RuntimeError):
 
 
 class FaultInjector:
-    """Deterministically fail chosen iterations (test harness)."""
+    """Deterministically fail chosen iterations (test harness).
+    `persistent=True` keeps failing the same iteration on retry —
+    models a hard fault (bad host, poisoned input) rather than a
+    transient one."""
 
-    def __init__(self, fail_at: Iterable[int] = ()):
+    def __init__(self, fail_at: Iterable[int] = (),
+                 persistent: bool = False):
         self.fail_at = set(int(i) for i in fail_at)
+        self.persistent = persistent
         self.injected = 0
 
     def check(self, iteration: int) -> None:
         if iteration in self.fail_at:
-            self.fail_at.discard(iteration)
+            if not self.persistent:
+                self.fail_at.discard(iteration)
             self.injected += 1
             raise TrainingFailure(f"injected fault at iteration "
                                   f"{iteration}")

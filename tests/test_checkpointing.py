@@ -99,7 +99,7 @@ def test_fault_tolerant_trainer_gives_up(tmp_path):
     x, y = _data(32)
     it = BaseDatasetIterator(x, y, 16)
     net = _net()
-    injector = FaultInjector(fail_at=[0, 1, 2, 3, 4, 5, 6, 7])
+    injector = FaultInjector(fail_at=[1], persistent=True)  # hard fault
     trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
                                    max_restarts=2,
                                    fault_injector=injector,
