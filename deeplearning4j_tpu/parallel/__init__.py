@@ -13,3 +13,9 @@ sharding policies over the same traced step.
 """
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+
+from deeplearning4j_tpu.parallel.multihost import (initialize_multihost,
+                                                   process_info,
+                                                   MultiHostLauncher)
+from deeplearning4j_tpu.parallel.failure import (FaultTolerantTrainer,
+                                                 FaultInjector)

@@ -42,3 +42,8 @@ def devices8():
 @pytest.fixture
 def rng():
     return np.random.RandomState(12345)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests")
