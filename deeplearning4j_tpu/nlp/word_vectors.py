@@ -81,3 +81,20 @@ class WordVectorsMixin:
                                      + len(negative))
         skip = set(positive) | set(negative)
         return [w for w in nearest if w not in skip][:top_n]
+
+
+    def accuracy(self, questions) -> float:
+        """Analogy-question accuracy: each question is (a, b, c, expected)
+        — 'a is to b as c is to expected' (reference:
+        WordVectorsImpl.accuracy over questions-words.txt sections).
+        Returns the fraction answered correctly by vector arithmetic."""
+        correct = 0
+        total = 0
+        for a, b, c, expected in questions:
+            if not all(self.has_word(w) for w in (a, b, c, expected)):
+                continue
+            total += 1
+            answer = self.words_nearest_sum([b, c], [a], top_n=1)
+            if answer and answer[0] == expected:
+                correct += 1
+        return correct / total if total else float("nan")

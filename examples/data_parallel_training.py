@@ -1,0 +1,42 @@
+"""Data-parallel training over a device mesh (the reference's
+ParallelWrapper / SparkDl4jMultiLayer examples).
+
+On a TPU slice this uses all chips; elsewhere set
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+for a virtual mesh. Multi-host: launch one copy per host with
+JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID set and
+call initialize_multihost() first (parallel/multihost.py).
+"""
+import jax
+
+from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo import mlp_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.scaleout import (ParameterAveragingTrainingMaster,
+                                         SparkDl4jMultiLayer)
+
+
+def main() -> None:
+    n = len(jax.devices())
+    print(f"{n} device(s): {jax.devices()}")
+
+    net = MultiLayerNetwork(mlp_mnist()).init()
+    # direct wrapper (reference: ParallelWrapper)
+    pw = ParallelWrapper(net, workers=n)
+    pw.fit(MnistDataSetIterator(batch_size=64 * n, num_examples=6400))
+    print("wrapper-trained score:", float(net.score_value))
+
+    # TrainingMaster facade (reference: SparkDl4jMultiLayer)
+    net2 = MultiLayerNetwork(mlp_mnist(seed=9)).init()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=64)
+          .workers(n).collect_training_stats(True).build())
+    sp = SparkDl4jMultiLayer(net2, tm)
+    sp.fit(MnistDataSetIterator(batch_size=64 * n, num_examples=6400))
+    print("facade-trained score:", float(net2.score_value))
+    sp.stats.export_stats_html("/tmp/training_stats.html")
+    print("phase stats:", sp.stats.as_dict())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""LeNet on MNIST — the canonical training example (the reference's
+LenetMnistExample flow: MnistDataSetIterator → MultiLayerNetwork.fit →
+Evaluation).
+
+Run: python examples/lenet_mnist.py [--epochs N] [--batch 128]
+"""
+import argparse
+
+from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo import lenet_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.listeners import (PerformanceListener,
+                                                ScoreIterationListener)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--examples", type=int, default=10000)
+    args = ap.parse_args()
+
+    net = MultiLayerNetwork(lenet_mnist(dtype="bfloat16")).init()
+    net.set_listeners(ScoreIterationListener(10), PerformanceListener(10))
+    train = MnistDataSetIterator(args.batch, train=True,
+                                 num_examples=args.examples)
+    for epoch in range(args.epochs):
+        net.fit(train)
+        print(f"epoch {epoch}: score {net.score_value:.4f}")
+    test = MnistDataSetIterator(args.batch, train=False,
+                                num_examples=args.examples // 5)
+    ev = net.evaluate(test)
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

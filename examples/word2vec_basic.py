@@ -1,0 +1,51 @@
+"""Word2Vec training + similarity queries (the reference's
+Word2VecRawTextExample flow).
+
+Run: python examples/word2vec_basic.py [--corpus path]
+(no --corpus → small built-in corpus)
+"""
+import argparse
+
+from deeplearning4j_tpu.nlp import (BasicLineIterator,
+                                    CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, Word2Vec,
+                                    WordVectorSerializer)
+
+BUILTIN = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps while the quick fox runs",
+    "a king rules the kingdom and a queen rules beside the king",
+    "the queen and the king host a feast in the kingdom",
+    "day turns to night and night turns to day",
+] * 50
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", default=None)
+    ap.add_argument("--out", default="/tmp/word_vectors.txt")
+    args = ap.parse_args()
+
+    iterator = (BasicLineIterator(args.corpus) if args.corpus
+                else CollectionSentenceIterator(BUILTIN))
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    w2v = (Word2Vec.builder()
+           .iterate(iterator)
+           .tokenizer_factory(tf)
+           .layer_size(64).window_size(5)
+           .min_word_frequency(2).negative_sample(5)
+           .epochs(3).seed(42).build())
+    w2v.fit()
+
+    for a, b in [("king", "queen"), ("day", "night"), ("king", "dog")]:
+        if w2v.has_word(a) and w2v.has_word(b):
+            print(f"similarity({a}, {b}) = {w2v.similarity(a, b):.3f}")
+    if w2v.has_word("king"):
+        print("nearest to 'king':", w2v.words_nearest("king", 5))
+    WordVectorSerializer.write_word_vectors(w2v, args.out)
+    print("vectors written to", args.out)
+
+
+if __name__ == "__main__":
+    main()
