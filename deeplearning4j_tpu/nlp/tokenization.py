@@ -122,3 +122,58 @@ class NGramTokenizerFactory(TokenizerFactory):
             for i in range(len(base) - n + 1):
                 grams.append(" ".join(base[i:i + n]))
         return Tokenizer(grams, None)
+
+
+class RegexTokenizerFactory(TokenizerFactory):
+    """Tokenize on a custom regex pattern match (covers the reference's
+    assorted specialty tokenizers — e.g. PosUimaTokenizer-style filters
+    — without the UIMA dependency)."""
+
+    def __init__(self, pattern: str,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        super().__init__(preprocessor)
+        self._pattern = re.compile(pattern)
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._pattern.findall(text), self._pre)
+
+
+class CJKTokenizerFactory(TokenizerFactory):
+    """CJK-aware tokenizer: splits CJK runs into character n-grams and
+    keeps latin words whole. Role of the reference's vendored analyzers
+    (deeplearning4j-nlp-japanese Kuromoji morphological analyzer,
+    deeplearning4j-nlp-korean wrapper — both vendored third-party
+    dictionaries, deliberately not reimplemented); character n-grams
+    are the standard dictionary-free fallback and the TokenizerFactory
+    interface is the plug point for a real analyzer."""
+
+    _CJK = re.compile(r"[぀-ヿ㐀-鿿가-힯]+")
+    _LATIN = re.compile(r"[A-Za-z0-9]+")
+
+    def __init__(self, ngram: int = 2,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        super().__init__(preprocessor)
+        self.ngram = max(1, ngram)
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        i = 0
+        while i < len(text):
+            m = self._CJK.match(text, i)
+            if m:
+                run = m.group(0)
+                n = self.ngram
+                if len(run) <= n:
+                    tokens.append(run)
+                else:
+                    tokens.extend(run[j:j + n]
+                                  for j in range(len(run) - n + 1))
+                i = m.end()
+                continue
+            m = self._LATIN.match(text, i)
+            if m:
+                tokens.append(m.group(0))
+                i = m.end()
+                continue
+            i += 1
+        return Tokenizer(tokens, self._pre)

@@ -131,3 +131,24 @@ class ProfilerListener(IterationListener):
             jax.profiler.stop_trace()
             self._active = False
             log.info("profiler trace stopped")
+
+
+class NanScoreGuardListener(IterationListener):
+    """Raise (or warn) on NaN/Inf scores — the divergence tripwire
+    (SURVEY.md §5.2: the reference's numerics safety net is offline
+    gradient checks plus InvalidScoreIterationTerminationCondition; this
+    is the always-on in-loop variant)."""
+
+    def __init__(self, raise_on_invalid: bool = True):
+        self.raise_on_invalid = raise_on_invalid
+        self.tripped_at: Optional[int] = None
+
+    def iteration_done(self, model, iteration, score):
+        import math
+        if math.isnan(score) or math.isinf(score):
+            self.tripped_at = iteration
+            msg = (f"invalid score {score} at iteration {iteration} — "
+                   "training diverged")
+            if self.raise_on_invalid:
+                raise FloatingPointError(msg)
+            log.warning(msg)

@@ -254,3 +254,25 @@ def test_distributed_word2vec_matches_single(devices8):
     v2 = dist.word_vector("day")
     np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
     assert dist.similarity("day", "night") > dist.similarity("day", "dog")
+
+
+def test_cjk_and_regex_tokenizers():
+    from deeplearning4j_tpu.nlp.tokenization import (CJKTokenizerFactory,
+                                                     RegexTokenizerFactory)
+    toks = CJKTokenizerFactory(2).create("私は猫が好き hello").get_tokens()
+    assert "私は" in toks and "hello" in toks
+    assert all(len(t) == 2 or t.isascii() for t in toks)
+    r = RegexTokenizerFactory(r"[a-z]+").create("foo BAR baz").get_tokens()
+    assert r == ["foo", "baz"]
+
+
+def test_nan_guard_listener():
+    from deeplearning4j_tpu.train.listeners import NanScoreGuardListener
+    import pytest as _pytest
+    g = NanScoreGuardListener()
+    g.iteration_done(None, 1, 0.5)  # fine
+    with _pytest.raises(FloatingPointError):
+        g.iteration_done(None, 2, float("nan"))
+    soft = NanScoreGuardListener(raise_on_invalid=False)
+    soft.iteration_done(None, 3, float("inf"))
+    assert soft.tripped_at == 3
