@@ -9,4 +9,7 @@ from deeplearning4j_tpu.datasets.impl import (  # noqa: F401
     MnistDataSetIterator,
     IrisDataSetIterator,
     DigitsDataSetIterator,
+    CifarDataSetIterator,
+    LFWDataSetIterator,
+    CurvesDataSetIterator,
 )

@@ -272,3 +272,30 @@ class LFWDataSetIterator(BaseDatasetIterator):
             feats, labels = feats[:num_examples], labels[:num_examples]
         onehot = np.eye(num_classes, dtype=np.float32)[labels]
         super().__init__(feats, onehot, batch_size)
+
+
+class CurvesDataSetIterator(BaseDatasetIterator):
+    """Curves dataset (reference: datasets/fetchers/CurvesDataFetcher +
+    iterator/CurvesDataSetIterator — 784-dim curve images used for deep
+    autoencoder pretraining; labels == features, i.e. reconstruction
+    targets). The reference downloads curves.ser; zero-egress here, so
+    curves are synthesized deterministically: random cubic Bézier
+    strokes rasterized to 28x28, matching the original data's shape and
+    use."""
+
+    def __init__(self, batch_size: int = 128, num_examples: int = 1000,
+                 seed: int = 12):
+        rng = np.random.RandomState(seed)
+        h = w = 28
+        feats = np.zeros((num_examples, h, w), dtype=np.float32)
+        t = np.linspace(0.0, 1.0, 64)[:, None]
+        bez = np.concatenate([(1 - t) ** 3, 3 * (1 - t) ** 2 * t,
+                              3 * (1 - t) * t ** 2, t ** 3], axis=1)
+        for i in range(num_examples):
+            ctrl = rng.uniform(3, w - 4, size=(4, 2))
+            pts = bez @ ctrl  # [64, 2] points along the curve
+            xi = np.clip(np.round(pts[:, 0]).astype(int), 0, w - 1)
+            yi = np.clip(np.round(pts[:, 1]).astype(int), 0, h - 1)
+            feats[i, yi, xi] = 1.0
+        flat = feats.reshape(num_examples, h * w)
+        super().__init__(flat, flat.copy(), batch_size)

@@ -1,3 +1,4 @@
 from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix  # noqa: F401
+from deeplearning4j_tpu.eval.meta import Prediction, RecordMetaData  # noqa: F401
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
 from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass  # noqa: F401

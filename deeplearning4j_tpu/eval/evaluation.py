@@ -64,16 +64,19 @@ class Evaluation:
         self.confusion: Optional[ConfusionMatrix] = None
         self.top_n_correct = 0
         self.top_n_total = 0
+        self.predictions: List = []  # Prediction records (eval/meta)
 
     # ------------------------------------------------------------------ eval
-    def eval(self, labels, predictions, mask=None) -> None:
+    def eval(self, labels, predictions, mask=None, metadata=None) -> None:
         """Accumulate one batch. ``labels`` one-hot (or class indices),
         ``predictions`` probabilities/scores [B, C] (reference:
         Evaluation.eval:194). Sequence outputs [B, T, C] are flattened with
         the mask applied."""
         labels = jnp.asarray(labels)
         predictions = jnp.asarray(predictions)
+        seq_T = None
         if predictions.ndim == 3:  # [B, T, C] sequence output
+            seq_T = predictions.shape[1]
             c = predictions.shape[-1]
             predictions = predictions.reshape(-1, c)
             labels = labels.reshape(-1, c) if labels.ndim == 3 \
@@ -93,6 +96,25 @@ class Evaluation:
                                pred_idx.astype(jnp.int32), self.num_classes,
                                None if mask is None else jnp.asarray(mask))
         self.confusion.add(cm)
+        if metadata is not None:
+            # per-record provenance tracking (reference: eval(...,
+            # List<RecordMetaData>) overload, Evaluation.java:218).
+            # Sequence outputs: metadata is per-record, rows are per
+            # timestep — map row i back to record i // T; masked
+            # timesteps are excluded (matching the confusion matrix).
+            from deeplearning4j_tpu.eval.meta import Prediction
+            la = np.asarray(lab_idx)
+            pa = np.asarray(pred_idx)
+            ma = None if mask is None \
+                else np.asarray(mask).reshape(-1)
+            for i in range(la.shape[0]):
+                rec = i // seq_T if seq_T is not None else i
+                if rec >= len(metadata):
+                    break
+                if ma is not None and ma[i] <= 0:
+                    continue
+                self.predictions.append(
+                    Prediction(int(la[i]), int(pa[i]), metadata[rec]))
         if self.top_n > 1:
             topk = jnp.argsort(predictions, axis=-1)[:, -self.top_n:]
             hit = jnp.any(topk == lab_idx[:, None], axis=-1)
@@ -103,6 +125,26 @@ class Evaluation:
             else:
                 self.top_n_correct += int(jnp.sum(hit))
                 self.top_n_total += int(hit.shape[0])
+
+    # ------------------------------------------------- eval/meta queries
+    def get_prediction_errors(self) -> List:
+        """Misclassified records with provenance (reference:
+        Evaluation.getPredictionErrors())."""
+        return [p for p in self.predictions
+                if p.actual_class != p.predicted_class]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List:
+        return [p for p in self.predictions if p.actual_class == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> List:
+        return [p for p in self.predictions if p.predicted_class == cls]
+
+    def get_predictions(self, actual: int, predicted: int) -> List:
+        """Records with a specific (actual, predicted) pair (reference:
+        Evaluation.getPredictions(actual, predicted))."""
+        return [p for p in self.predictions
+                if p.actual_class == actual
+                and p.predicted_class == predicted]
 
     # --------------------------------------------------------------- metrics
     def _m(self) -> np.ndarray:

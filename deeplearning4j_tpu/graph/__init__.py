@@ -2,8 +2,11 @@
 from deeplearning4j_tpu.graph.graph import (Graph, Vertex, Edge,
                                             RandomWalkIterator,
                                             WeightedRandomWalkIterator,
+                                            Node2VecWalkIterator,
                                             load_edge_list)
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.node2vec import Node2Vec
 
 __all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
-           "WeightedRandomWalkIterator", "load_edge_list", "DeepWalk"]
+           "WeightedRandomWalkIterator", "Node2VecWalkIterator",
+           "load_edge_list", "DeepWalk", "Node2Vec"]

@@ -44,6 +44,12 @@ class DeepWalk(SequenceVectors):
         vertices (every vertex appears, freq from walk occurrences)."""
         self.graph = graph
 
+    def _make_walk_iterator(self, rep: int) -> RandomWalkIterator:
+        """Walk-sampling strategy hook — subclasses (Node2Vec) override
+        this single factory instead of re-implementing fit_graph."""
+        return RandomWalkIterator(self.graph, self.walk_length,
+                                  seed=self.seed + rep)
+
     def fit_graph(self, graph: Optional[Graph] = None,
                   walk_iterator: Optional[RandomWalkIterator] = None
                   ) -> "DeepWalk":
@@ -55,15 +61,14 @@ class DeepWalk(SequenceVectors):
         self._walks = []
         if walk_iterator is None:
             for rep in range(self.walks_per_vertex):
-                it = RandomWalkIterator(self.graph, self.walk_length,
-                                        seed=self.seed + rep)
-                for walk in it:
+                for walk in self._make_walk_iterator(rep):
                     self._walks.append([str(v) for v in walk])
         else:
             for walk in walk_iterator:
                 self._walks.append([str(v) for v in walk])
         self.build_vocab()
-        return self.fit()
+        self.fit()
+        return self
 
     # -- GraphVectors query API (reference: embeddings/GraphVectors.java) --
     def get_vertex_vector(self, idx: int) -> Optional[np.ndarray]:

@@ -141,6 +141,55 @@ class WeightedRandomWalkIterator(RandomWalkIterator):
         return walk
 
 
+class Node2VecWalkIterator(RandomWalkIterator):
+    """Second-order p/q-biased walks (node2vec; reference API surface:
+    deeplearning4j-nlp models/node2vec/Node2Vec — builder wrapping
+    SequenceVectors, SURVEY.md §2.5). Transition weight from walk step
+    (prev → cur) to neighbor x: 1/p if x == prev (return), 1 if x is a
+    neighbor of prev (BFS-like), else 1/q (DFS-like)."""
+
+    def __init__(self, graph: Graph, walk_length: int, *, p: float = 1.0,
+                 q: float = 1.0, seed: int = 12345,
+                 no_edge_handling: str = "self_loop"):
+        super().__init__(graph, walk_length, seed=seed,
+                         no_edge_handling=no_edge_handling)
+        self.p = p
+        self.q = q
+        self._neigh_sets = [set(graph.get_connected_vertex_indices(v))
+                            for v in range(graph.num_vertices())]
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        prev: Optional[int] = None
+        cur = start
+        for _ in range(self.walk_length - 1):
+            neigh = self.graph.get_connected_vertex_indices(cur)
+            if not neigh:
+                if self.no_edge_handling == "exception":
+                    raise ValueError(f"Vertex {cur} has no edges")
+                walk.append(cur)
+                continue
+            if prev is None:
+                nxt = int(neigh[self.rng.integers(0, len(neigh))])
+            else:
+                w = np.empty(len(neigh))
+                prev_neigh = self._neigh_sets[prev]
+                for i, x in enumerate(neigh):
+                    if x == prev:
+                        w[i] = 1.0 / self.p
+                    elif x in prev_neigh:
+                        w[i] = 1.0
+                    else:
+                        w[i] = 1.0 / self.q
+                nxt = int(neigh[self.rng.choice(len(neigh),
+                                                p=w / w.sum())])
+            prev, cur = cur, nxt
+            walk.append(cur)
+        return walk
+
+
 def load_edge_list(path: str, num_vertices: Optional[int] = None,
                    directed: bool = False, delimiter: Optional[str] = None
                    ) -> Graph:

@@ -1,0 +1,34 @@
+"""Node2Vec graph embeddings.
+
+Parity with the reference's Node2Vec builder (reference:
+deeplearning4j-nlp-parent inventory, SURVEY.md §2.5 — "Word2Vec /
+ParagraphVectors / Glove / Node2Vec: Builder APIs wrapping
+SequenceVectors"). Same re-design as DeepWalk: p/q-biased second-order
+walks become token sequences, trained with the batched XLA skip-gram
+step (negative sampling by default, matching the node2vec formulation)
+instead of hogwild threads.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.graph import Node2VecWalkIterator
+
+
+class Node2Vec(DeepWalk):
+    """DeepWalk with p/q-biased transition sampling. p penalizes
+    returning to the previous vertex; q trades breadth-first (q>1 keeps
+    walks local) vs depth-first exploration. Only the walk-sampling
+    strategy differs from DeepWalk, so only the iterator factory is
+    overridden."""
+
+    def __init__(self, *, p: float = 1.0, q: float = 1.0,
+                 negative: int = 5, **kwargs):
+        kwargs.setdefault("use_hierarchic_softmax", negative == 0)
+        super().__init__(negative=negative, **kwargs)
+        self.p = p
+        self.q = q
+
+    def _make_walk_iterator(self, rep: int) -> Node2VecWalkIterator:
+        return Node2VecWalkIterator(self.graph, self.walk_length,
+                                    p=self.p, q=self.q,
+                                    seed=self.seed + rep)

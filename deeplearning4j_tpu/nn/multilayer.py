@@ -56,6 +56,7 @@ class MultiLayerNetwork:
         self.score_value: float = float("nan")
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries: Optional[Dict[str, Any]] = None
+        self._solver = None
         self._initialized = False
 
     # ------------------------------------------------------------------ init
@@ -242,6 +243,24 @@ class MultiLayerNetwork:
         y = jnp.asarray(y)
         if self.conf.backprop_type == "tbptt" and x.ndim == 3:
             self._fit_tbptt(x, y, mask)
+            return
+        if self.conf.training.optimization_algo not in (
+                "stochastic_gradient_descent", "sgd"):
+            # Second-order path (reference: Solver.java:48 dispatches on
+            # OptimizationAlgorithm to LBFGS/CG/LineGD)
+            from deeplearning4j_tpu.train.solvers import Solver
+            if self._solver is None:
+                self._solver = Solver(self)
+            score = self._solver.optimize(x, y, mask)
+            self.score_value = score
+            for l in self.listeners:
+                if hasattr(l, "record_batch"):
+                    l.record_batch(int(x.shape[0]))
+                if hasattr(l, "record_input"):
+                    l.record_input(x)
+                l.iteration_done(self, self.iteration_count,
+                                 self.score_value)
+            self.iteration_count += 1
             return
         step = self._get_train_step((x.shape, y.shape,
                                      mask is not None))

@@ -3,10 +3,23 @@ from deeplearning4j_tpu.train.updaters import (  # noqa: F401
     apply_updater,
     compute_learning_rate,
 )
+from deeplearning4j_tpu.train.solvers import (  # noqa: F401
+    Solver,
+    LBFGS,
+    ConjugateGradient,
+    LineGradientDescent,
+    StochasticGradientDescent,
+    BaseSolver,
+    backtrack_line_search,
+    EpsTermination,
+    Norm2Termination,
+    ZeroDirection,
+)
 from deeplearning4j_tpu.train.listeners import (  # noqa: F401
     IterationListener,
     ScoreIterationListener,
     PerformanceListener,
     CollectScoresIterationListener,
     ComposableIterationListener,
+    ParamAndGradientIterationListener,
 )

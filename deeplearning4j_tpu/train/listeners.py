@@ -152,3 +152,61 @@ class NanScoreGuardListener(IterationListener):
             if self.raise_on_invalid:
                 raise FloatingPointError(msg)
             log.warning(msg)
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter and update statistics, tab-delimited to a
+    file and/or the log (reference: optimize/listeners/
+    ParamAndGradientIterationListener.java — writes mean magnitudes of
+    params and gradients every N iterations).
+
+    The jitted train step fuses backward+update on device and does not
+    materialize gradients host-side, so the gradient column reports the
+    per-step parameter delta (update = -lr·transformed-gradient), which
+    is the quantity DL4J's UI actually charts as the update:parameter
+    ratio. Columns: iteration, score, then per-tensor |mean|, |Δmean|.
+    """
+
+    def __init__(self, iterations: int = 1,
+                 file_path: Optional[str] = None,
+                 print_to_log: bool = True,
+                 print_header: bool = True,
+                 print_mean: bool = True):
+        self.iterations = max(1, iterations)
+        self.file_path = file_path
+        self.print_to_log = print_to_log
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self._prev_flat = None
+        self._header_written = False
+
+    def _write(self, line: str) -> None:
+        if self.file_path:
+            with open(self.file_path, "a") as f:
+                f.write(line + "\n")
+        if self.print_to_log:
+            log.info("%s", line)
+
+    def iteration_done(self, model, iteration, score):
+        import jax.numpy as jnp
+        # _prev_flat refreshes EVERY iteration so that with
+        # iterations=N the logged delta is the last single step, not an
+        # N-step cumulative drift
+        flat = model.params_flat()
+        if iteration % self.iterations != 0:
+            self._prev_flat = flat
+            return
+        if not self._header_written and self.print_header:
+            self._write("iteration\tscore\tparamMeanAbs\tupdateMeanAbs"
+                        "\tupdateParamRatio")
+            self._header_written = True
+        p_mean = float(jnp.mean(jnp.abs(flat)))
+        if self._prev_flat is not None \
+                and self._prev_flat.shape == flat.shape:
+            u_mean = float(jnp.mean(jnp.abs(flat - self._prev_flat)))
+        else:
+            u_mean = float("nan")
+        ratio = u_mean / p_mean if p_mean > 0 else float("nan")
+        self._write(f"{iteration}\t{float(score):.6g}\t{p_mean:.6g}"
+                    f"\t{u_mean:.6g}\t{ratio:.6g}")
+        self._prev_flat = flat

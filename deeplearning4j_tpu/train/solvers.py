@@ -1,0 +1,336 @@
+"""Convex optimizers beyond the first-order updater family.
+
+Parity with the reference's Solver dispatch (reference:
+deeplearning4j-nn/.../optimize/Solver.java:41-74 — OptimizationAlgorithm
+→ {StochasticGradientDescent, LineGradientDescent, ConjugateGradient,
+LBFGS}; BaseOptimizer.gradientAndScore:156; BackTrackLineSearch;
+optimize/terminations/{EpsTermination,Norm2Termination,ZeroDirection};
+optimize/stepfunctions/NegativeGradientStepFunction).
+
+TPU-first shape: the reference evaluates score+gradient through the eager
+per-op JNI stack on every line-search probe. Here the score+gradient of
+the WHOLE network w.r.t. the flat parameter vector traces into one jitted
+XLA program (``value_and_grad`` over ``ravel_pytree``); the solver outer
+loop — curvature history, Polak-Ribière beta, Armijo backtracking — is
+host-side control flow driving repeated executions of that compiled
+program. Line search is inherently sequential, so host altitude is
+correct; the per-probe cost is one fused device program, not thousands of
+op dispatches.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------- terminations
+class TerminationCondition:
+    """reference: optimize/api/TerminationCondition.java"""
+
+    def terminate(self, new_score: float, old_score: float,
+                  other: Optional[Array] = None) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    """|new - old| < eps (reference: terminations/EpsTermination.java)."""
+
+    def __init__(self, eps: float = 1e-10, tolerance: float = 1e-5):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, new_score, old_score, other=None):
+        if old_score == 0.0:
+            return abs(new_score - old_score) < self.eps
+        return (abs(new_score - old_score)
+                / abs(old_score)) < self.tolerance
+
+    def __repr__(self):
+        return f"EpsTermination(eps={self.eps}, tol={self.tolerance})"
+
+
+class Norm2Termination(TerminationCondition):
+    """||grad||₂ < threshold (reference: terminations/Norm2Termination)."""
+
+    def __init__(self, gradient_norm_threshold: float = 1e-8):
+        self.threshold = gradient_norm_threshold
+
+    def terminate(self, new_score, old_score, other=None):
+        if other is None:
+            return False
+        return float(jnp.linalg.norm(other)) < self.threshold
+
+
+class ZeroDirection(TerminationCondition):
+    """Search direction vanished (reference: terminations/ZeroDirection)."""
+
+    def terminate(self, new_score, old_score, other=None):
+        if other is None:
+            return False
+        return float(jnp.max(jnp.abs(other))) == 0.0
+
+
+# ---------------------------------------------------------------- line search
+def backtrack_line_search(f: Callable[[Array], float], w: Array,
+                          score0: float, grad: Array, direction: Array,
+                          *, max_iterations: int = 16, c1: float = 1e-4,
+                          initial_step: float = 1.0,
+                          backoff: float = 0.5) -> Tuple[float, Array, float]:
+    """Armijo backtracking along ``direction`` (reference:
+    optimize/solvers/BackTrackLineSearch.java — sufficient-decrease
+    slope c1=1e-4, geometric backoff; the reference defaults to 5
+    probes, here 16 so stiff curvature like Rosenbrock still finds an
+    Armijo point). Returns (step, new_w, new_score); step 0.0 means no
+    improving point was found (caller restarts/steps raw gradient).
+    """
+    slope = float(jnp.vdot(grad, direction))
+    if slope >= 0.0:
+        return 0.0, w, score0
+    step = initial_step
+    for _ in range(max_iterations):
+        cand = w + step * direction
+        score = float(f(cand))
+        if score <= score0 + c1 * step * slope:
+            return step, cand, score
+        step *= backoff
+    return 0.0, w, score0
+
+
+# -------------------------------------------------------------------- solvers
+class BaseSolver:
+    """Shared optimize() driver (reference: BaseOptimizer.java:156 —
+    gradientAndScore → search direction → line search/step → check
+    termination conditions)."""
+
+    def __init__(self, value_and_grad: Callable[[Array],
+                                                Tuple[float, Array]],
+                 *, max_iterations: int = 10,
+                 terminations: Optional[Sequence[TerminationCondition]]
+                 = None,
+                 learning_rate: float = 1.0):
+        self.value_and_grad = value_and_grad
+        self.max_iterations = max_iterations
+        self.terminations = list(terminations) if terminations is not None \
+            else [EpsTermination(), ZeroDirection()]
+        self.learning_rate = learning_rate
+        self.score_history: List[float] = []
+
+    def _value(self, w: Array) -> float:
+        s, _ = self.value_and_grad(w)
+        return float(s)
+
+    def _direction(self, grad: Array, state: dict) -> Array:
+        raise NotImplementedError
+
+    def _post_step(self, state: dict, w_old: Array, w_new: Array,
+                   grad_old: Array, grad_new: Array) -> None:
+        pass
+
+    def optimize(self, w0: Array) -> Tuple[Array, float]:
+        w = jnp.asarray(w0)
+        score, grad = self.value_and_grad(w)
+        score = float(score)
+        self.score_history = [score]
+        state: dict = {}
+        for _ in range(self.max_iterations):
+            direction = self._direction(grad, state)
+            if any(isinstance(t, ZeroDirection)
+                   and t.terminate(score, score, direction)
+                   for t in self.terminations):
+                break
+            step, w_new, new_score = backtrack_line_search(
+                self._value, w, score, grad, direction,
+                initial_step=self.learning_rate)
+            if step == 0.0:
+                # no improvement along direction: fall back to raw
+                # negative gradient (reference: BaseOptimizer restart)
+                step, w_new, new_score = backtrack_line_search(
+                    self._value, w, score, grad, -grad,
+                    initial_step=self.learning_rate)
+                if step == 0.0:
+                    break
+                state.clear()
+            _, grad_new = self.value_and_grad(w_new)
+            self._post_step(state, w, w_new, grad, grad_new)
+            old_score, score = score, new_score
+            w, grad = w_new, grad_new
+            self.score_history.append(score)
+            if any(t.terminate(score, old_score, grad)
+                   for t in self.terminations):
+                break
+        return w, score
+
+
+class LineGradientDescent(BaseSolver):
+    """Steepest descent + line search (reference:
+    optimize/solvers/LineGradientDescent.java)."""
+
+    def _direction(self, grad, state):
+        return -grad
+
+
+class ConjugateGradient(BaseSolver):
+    """Nonlinear CG, Polak-Ribière with automatic restart (reference:
+    optimize/solvers/ConjugateGradient.java — beta = max(0, PR))."""
+
+    def _direction(self, grad, state):
+        prev_grad = state.get("prev_grad")
+        prev_dir = state.get("prev_dir")
+        if prev_grad is None or prev_dir is None:
+            d = -grad
+        else:
+            denom = float(jnp.vdot(prev_grad, prev_grad))
+            beta = 0.0 if denom == 0.0 else max(
+                0.0, float(jnp.vdot(grad, grad - prev_grad)) / denom)
+            d = -grad + beta * prev_dir
+        state["prev_dir"] = d
+        return d
+
+    def _post_step(self, state, w_old, w_new, grad_old, grad_new):
+        state["prev_grad"] = grad_old
+
+
+class LBFGS(BaseSolver):
+    """Limited-memory BFGS, two-loop recursion (reference:
+    optimize/solvers/LBFGS.java — default history m=4)."""
+
+    def __init__(self, value_and_grad, *, m: int = 4, **kw):
+        super().__init__(value_and_grad, **kw)
+        self.m = m
+
+    def _direction(self, grad, state):
+        pairs = state.get("pairs", [])
+        q = grad
+        alphas = []
+        for s, y, rho in reversed(pairs):
+            alpha = rho * float(jnp.vdot(s, q))
+            q = q - alpha * y
+            alphas.append(alpha)
+        if pairs:
+            s, y, _ = pairs[-1]
+            gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)),
+                                                1e-30)
+            r = gamma * q
+        else:
+            r = q
+        for (s, y, rho), alpha in zip(pairs, reversed(alphas)):
+            beta = rho * float(jnp.vdot(y, r))
+            r = r + s * (alpha - beta)
+        return -r
+
+    def _post_step(self, state, w_old, w_new, grad_old, grad_new):
+        s = w_new - w_old
+        y = grad_new - grad_old
+        sy = float(jnp.vdot(s, y))
+        if sy > 1e-10:  # curvature condition; skip degenerate pairs
+            pairs = state.setdefault("pairs", [])
+            pairs.append((s, y, 1.0 / sy))
+            if len(pairs) > self.m:
+                pairs.pop(0)
+
+
+class StochasticGradientDescent(BaseSolver):
+    """Plain SGD step on the flat vector (reference:
+    optimize/solvers/StochasticGradientDescent.java:54-61 — params +=
+    -lr·grad via NegativeGradientStepFunction). The jitted updater path
+    in MultiLayerNetwork subsumes this; kept for Solver-API parity."""
+
+    def optimize(self, w0):
+        w = jnp.asarray(w0)
+        self.score_history = []
+        for _ in range(self.max_iterations):
+            score, grad = self.value_and_grad(w)
+            self.score_history.append(float(score))
+            w = w - self.learning_rate * grad
+        score, _ = self.value_and_grad(w)  # score at the returned point
+        score = float(score)
+        self.score_history.append(score)
+        return w, score
+
+
+_ALGOS = {
+    "stochastic_gradient_descent": StochasticGradientDescent,
+    "sgd": StochasticGradientDescent,
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """Dispatch a model + minibatch onto an optimizer (reference:
+    optimize/Solver.java:41-74). Builds ONE jitted flat
+    ``value_and_grad`` of the network score (cached per input shape) and
+    hands it to the algorithm selected by
+    ``conf.training.optimization_algo``."""
+
+    def __init__(self, net, *, max_iterations: Optional[int] = None,
+                 terminations: Optional[Sequence[TerminationCondition]]
+                 = None):
+        self.net = net
+        tc = net.conf.training
+        self.algo = tc.optimization_algo
+        if self.algo not in _ALGOS:
+            raise ValueError(f"Unknown optimization_algo '{self.algo}'; "
+                             f"one of {sorted(_ALGOS)}")
+        self.max_iterations = (max_iterations if max_iterations is not None
+                               else max(1, tc.num_iterations))
+        self.terminations = terminations
+        self._vg_cache = {}
+
+    def _flat_value_and_grad(self, x, y, mask):
+        """Jitted (score, grad) of the flat params; layer state (BN
+        running stats, center-loss centers) is threaded through as an
+        argument and written back to the net on every evaluation — the
+        eager reference likewise updates running stats on each forward
+        pass (BaseOptimizer.gradientAndScore:156)."""
+        key = (x.shape, y.shape, mask is not None)
+        jitted = self._vg_cache.get(key)
+        if jitted is None:
+            net = self.net
+            _, unravel = ravel_pytree(net.params)
+
+            def loss_flat(w, state, x, y, mask):
+                p = unravel(w)
+                s, new_state = net._loss_fn(p, state, x, y, None, mask,
+                                            train=True)
+                return s, new_state
+
+            jitted = jax.jit(jax.value_and_grad(loss_flat, has_aux=True))
+            self._vg_cache[key] = jitted
+
+        def vg(w):
+            (score, new_state), grad = jitted(w, self.net.state, x, y,
+                                              mask)
+            self.net.state = new_state
+            return score, grad
+
+        return vg
+
+    def optimize(self, x, y, mask=None) -> float:
+        """One Solver.optimize() call: full-batch second-order fit of the
+        net's params on (x, y). Updates net.params in place; returns the
+        final score."""
+        net = self.net
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        mask = None if mask is None else jnp.asarray(mask)
+        vg = self._flat_value_and_grad(x, y, mask)
+        flat, unravel = ravel_pytree(net.params)
+        cls = _ALGOS[self.algo]
+        kw = dict(max_iterations=self.max_iterations,
+                  learning_rate=(net.conf.training.learning_rate
+                                 if cls is StochasticGradientDescent
+                                 else 1.0))
+        if self.terminations is not None:
+            kw["terminations"] = self.terminations
+        solver = cls(vg, **kw)
+        w, score = solver.optimize(flat)
+        net.params = unravel(w)
+        net.score_value = score
+        return score

@@ -1,0 +1,119 @@
+"""Tests for the SURVEY §2 long-tail items: Node2Vec, eval/meta
+prediction tracking, Curves dataset, ParamAndGradientIterationListener."""
+import numpy as np
+
+from deeplearning4j_tpu.graph import (Graph, Node2Vec, Node2VecWalkIterator)
+
+
+def _two_cliques(k: int = 5) -> Graph:
+    """Two k-cliques joined by one bridge edge — communities the
+    embedding must separate."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(k - 1, k)
+    return g
+
+
+def test_node2vec_walks_respect_pq_bias():
+    g = _two_cliques(4)
+    # q >> 1: walks stay local (BFS-like) — from within a clique, the
+    # fraction of steps leaving the start community should be small
+    it = Node2VecWalkIterator(g, walk_length=20, p=1.0, q=4.0, seed=3)
+    cross = total = 0
+    for walk in it:
+        com = 0 if walk[0] < 4 else 1
+        for v in walk[1:]:
+            total += 1
+            if (0 if v < 4 else 1) != com:
+                cross += 1
+    assert total > 0
+    assert cross / total < 0.5
+
+
+def test_node2vec_embeddings_separate_communities():
+    g = _two_cliques(5)
+    n2v = Node2Vec(vector_size=16, window_size=3, walk_length=12,
+                   walks_per_vertex=6, p=0.5, q=2.0, seed=11,
+                   learning_rate=0.05, epochs=12, negative=3)
+    n2v.fit_graph(g)
+    # mean intra-community similarity must exceed inter-community
+    intra, inter = [], []
+    for a in range(10):
+        for b in range(a + 1, 10):
+            s = n2v.similarity_vertices(a, b)
+            (intra if (a < 5) == (b < 5) else inter).append(s)
+    assert np.mean(intra) > np.mean(inter)
+
+
+def test_evaluation_prediction_meta_tracking():
+    from deeplearning4j_tpu.eval import Evaluation, RecordMetaData
+
+    labels = np.eye(3)[[0, 1, 2, 1]]
+    # record 3 (actual 1) is misclassified as 2
+    preds = np.asarray([[0.9, 0.05, 0.05],
+                        [0.1, 0.8, 0.1],
+                        [0.1, 0.1, 0.8],
+                        [0.1, 0.2, 0.7]])
+    meta = [RecordMetaData(uri="file.csv", index=i) for i in range(4)]
+    ev = Evaluation()
+    ev.eval(labels, preds, metadata=meta)
+    errors = ev.get_prediction_errors()
+    assert len(errors) == 1
+    assert errors[0].actual_class == 1
+    assert errors[0].predicted_class == 2
+    assert errors[0].record_meta_data.index == 3
+    assert "file.csv:3" in errors[0].record_meta_data.get_location()
+    assert len(ev.get_predictions_by_actual_class(1)) == 2
+    assert len(ev.get_predictions_by_predicted_class(2)) == 2
+    assert len(ev.get_predictions(1, 2)) == 1
+
+
+def test_curves_iterator_shapes_and_reconstruction_targets():
+    from deeplearning4j_tpu.datasets import CurvesDataSetIterator
+
+    it = CurvesDataSetIterator(batch_size=32, num_examples=96)
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.features.shape == (32, 784)
+    np.testing.assert_array_equal(b.features, b.labels)
+    # curves are sparse binary strokes
+    assert 0 < b.features.mean() < 0.3
+    # deterministic across constructions
+    it2 = CurvesDataSetIterator(batch_size=32, num_examples=96)
+    np.testing.assert_array_equal(batches[0].features,
+                                  next(iter(it2)).features)
+
+
+def test_param_and_gradient_listener(tmp_path):
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train.listeners import (
+        ParamAndGradientIterationListener)
+
+    path = str(tmp_path / "pg.tsv")
+    conf = (NeuralNetConfiguration(seed=1, updater="sgd",
+                                   learning_rate=0.1)
+            .list(DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ParamAndGradientIterationListener(
+        file_path=path, print_to_log=False))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(2)[rng.integers(0, 2, 16)].astype(np.float32)
+    for _ in range(3):
+        net.fit(x, y)
+    lines = open(path).read().strip().splitlines()
+    assert lines[0].startswith("iteration\tscore")
+    assert len(lines) == 4  # header + 3 iterations
+    last = lines[-1].split("\t")
+    assert float(last[2]) > 0          # param mean |.|
+    assert float(last[3]) > 0          # update mean |.|
