@@ -69,6 +69,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             log.warning("native IO library load failed: %s", e)
             _load_failed = True
             return None
+        # ABI gate FIRST: a stale library must fall back gracefully, not
+        # crash on a missing newer symbol below
+        if lib.dl4jtpu_io_abi_version() != 2:
+            log.warning("native IO library ABI mismatch; rebuild needed")
+            _load_failed = True
+            return None
         lib.idx_read.restype = ctypes.c_int
         lib.idx_read.argtypes = [ctypes.c_char_p,
                                  ctypes.POINTER(ctypes.c_uint8),
@@ -99,10 +105,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                       ctypes.c_int64]
         lib.prefetch_destroy.restype = None
         lib.prefetch_destroy.argtypes = [ctypes.c_void_p]
-        if lib.dl4jtpu_io_abi_version() != 1:
-            log.warning("native IO library ABI mismatch; rebuild needed")
-            _load_failed = True
-            return None
+        lib.vocab_count_buffer.restype = ctypes.c_int64
+        lib.vocab_count_buffer.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -168,6 +175,33 @@ def cifar_read(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         labels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n.value, ctypes.byref(n))
     return (images, labels) if rc == 0 else None
+
+
+def vocab_count(text: str, *, lowercase: bool = True, min_count: int = 1,
+                nthreads: int = 0) -> Optional[dict]:
+    """Parallel token-frequency count over a whitespace-tokenized corpus
+    (the reference's VocabConstructor parallel scan,
+    VocabConstructor.java:168, in C++). Returns {word: count} or None
+    when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = text.encode("utf-8")
+    needed = lib.vocab_count_buffer(data, len(data), int(lowercase),
+                                    min_count, nthreads, None, 0)
+    if needed < 0:
+        return None
+    buf = ctypes.create_string_buffer(needed)
+    n = lib.vocab_count_buffer(data, len(data), int(lowercase), min_count,
+                               nthreads, buf, needed)
+    if n < 0:
+        return None
+    out = {}
+    for line in buf.raw[:n].decode("utf-8").splitlines():
+        word, _, count = line.rpartition("\t")
+        if word:
+            out[word] = int(count)
+    return out
 
 
 class FilePrefetcher:

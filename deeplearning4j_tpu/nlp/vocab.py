@@ -148,6 +148,34 @@ class VocabConstructor:
         counts: Counter = Counter()
         for seq in sequences:
             counts.update(seq)
+        return self._cache_from_counts(counts)
+
+    def build_vocab_from_text(self, text: str, *, lowercase: bool = False
+                              ) -> AbstractCache:
+        """Whitespace-tokenized corpus fast path: counts run in the
+        parallel C++ scanner (native_bridge.vocab_count — the
+        reference's VocabConstructor thread pool analog) with a pure-
+        Python fallback."""
+        from deeplearning4j_tpu import native_bridge
+        counts = native_bridge.vocab_count(
+            text, lowercase=lowercase,
+            min_count=self.min_word_frequency)
+        if counts is None:
+            # fallback matches the native path's semantics exactly:
+            # ASCII-only lowercase, split on space/tab/CR/LF only (NOT
+            # str.lower()/str.split(), whose Unicode handling would make
+            # the vocab depend on whether the library loaded)
+            src = text
+            if lowercase:
+                src = src.translate(str.maketrans(
+                    "ABCDEFGHIJKLMNOPQRSTUVWXYZ",
+                    "abcdefghijklmnopqrstuvwxyz"))
+            counts = Counter(
+                t for t in src.replace("\t", " ").replace("\r", " ")
+                .replace("\n", " ").split(" ") if t)
+        return self._cache_from_counts(counts)
+
+    def _cache_from_counts(self, counts) -> AbstractCache:
         cache = AbstractCache()
         for word, c in counts.items():
             if c >= self.min_word_frequency:

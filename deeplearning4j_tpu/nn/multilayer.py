@@ -251,16 +251,20 @@ class MultiLayerNetwork:
             from deeplearning4j_tpu.train.solvers import Solver
             if self._solver is None:
                 self._solver = Solver(self)
-            score = self._solver.optimize(x, y, mask)
-            self.score_value = score
-            for l in self.listeners:
-                if hasattr(l, "record_batch"):
-                    l.record_batch(int(x.shape[0]))
-                if hasattr(l, "record_input"):
-                    l.record_input(x)
-                l.iteration_done(self, self.iteration_count,
-                                 self.score_value)
-            self.iteration_count += 1
+
+            def _notify(score):
+                # listeners fire per internal solver step, matching the
+                # SGD path's per-iteration granularity (reference:
+                # BaseOptimizer notifies each iteration)
+                for l in self.listeners:
+                    if hasattr(l, "record_batch"):
+                        l.record_batch(int(x.shape[0]))
+                    if hasattr(l, "record_input"):
+                        l.record_input(x)
+                    l.iteration_done(self, self.iteration_count, score)
+                self.iteration_count += 1
+
+            self._solver.optimize(x, y, mask, iteration_callback=_notify)
             return
         step = self._get_train_step((x.shape, y.shape,
                                      mask is not None))

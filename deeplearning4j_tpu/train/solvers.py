@@ -112,15 +112,21 @@ class BaseSolver:
                  *, max_iterations: int = 10,
                  terminations: Optional[Sequence[TerminationCondition]]
                  = None,
-                 learning_rate: float = 1.0):
+                 learning_rate: float = 1.0,
+                 value_fn: Optional[Callable[[Array], float]] = None):
         self.value_and_grad = value_and_grad
         self.max_iterations = max_iterations
         self.terminations = list(terminations) if terminations is not None \
             else [EpsTermination(), ZeroDirection()]
         self.learning_rate = learning_rate
+        # forward-only loss for line-search probes — probes don't need
+        # the gradient, so don't pay for the backward pass on each one
+        self.value_fn = value_fn
         self.score_history: List[float] = []
 
     def _value(self, w: Array) -> float:
+        if self.value_fn is not None:
+            return float(self.value_fn(w))
         s, _ = self.value_and_grad(w)
         return float(s)
 
@@ -131,7 +137,12 @@ class BaseSolver:
                    grad_old: Array, grad_new: Array) -> None:
         pass
 
-    def optimize(self, w0: Array) -> Tuple[Array, float]:
+    def optimize(self, w0: Array,
+                 callback: Optional[Callable[[Array, float], None]] = None
+                 ) -> Tuple[Array, float]:
+        """``callback(w, score)`` fires after every accepted step
+        (reference: BaseOptimizer notifies IterationListeners each
+        iteration)."""
         w = jnp.asarray(w0)
         score, grad = self.value_and_grad(w)
         score = float(score)
@@ -160,6 +171,8 @@ class BaseSolver:
             old_score, score = score, new_score
             w, grad = w_new, grad_new
             self.score_history.append(score)
+            if callback is not None:
+                callback(w, score)
             if any(t.terminate(score, old_score, grad)
                    for t in self.terminations):
                 break
@@ -240,15 +253,16 @@ class StochasticGradientDescent(BaseSolver):
     -lr·grad via NegativeGradientStepFunction). The jitted updater path
     in MultiLayerNetwork subsumes this; kept for Solver-API parity."""
 
-    def optimize(self, w0):
+    def optimize(self, w0, callback=None):
         w = jnp.asarray(w0)
         self.score_history = []
         for _ in range(self.max_iterations):
             score, grad = self.value_and_grad(w)
             self.score_history.append(float(score))
             w = w - self.learning_rate * grad
-        score, _ = self.value_and_grad(w)  # score at the returned point
-        score = float(score)
+            if callback is not None:
+                callback(w, float(score))
+        score = self._value(w)  # score at the returned point
         self.score_history.append(score)
         return w, score
 
@@ -283,15 +297,18 @@ class Solver:
         self.terminations = terminations
         self._vg_cache = {}
 
-    def _flat_value_and_grad(self, x, y, mask):
-        """Jitted (score, grad) of the flat params; layer state (BN
-        running stats, center-loss centers) is threaded through as an
-        argument and written back to the net on every evaluation — the
-        eager reference likewise updates running stats on each forward
-        pass (BaseOptimizer.gradientAndScore:156)."""
+    def _flat_fns(self, x, y, mask):
+        """Jitted (score, grad) + forward-only score of the flat params.
+        Layer state (BN running stats, center-loss centers) threads
+        through the gradient path and writes back to the net on each
+        accepted evaluation — the eager reference likewise updates
+        running stats on each forward pass
+        (BaseOptimizer.gradientAndScore:156). Line-search probes use the
+        forward-only program and leave state untouched (exploratory
+        points should not pollute running statistics)."""
         key = (x.shape, y.shape, mask is not None)
-        jitted = self._vg_cache.get(key)
-        if jitted is None:
+        pair = self._vg_cache.get(key)
+        if pair is None:
             net = self.net
             _, unravel = ravel_pytree(net.params)
 
@@ -301,36 +318,55 @@ class Solver:
                                             train=True)
                 return s, new_state
 
-            jitted = jax.jit(jax.value_and_grad(loss_flat, has_aux=True))
-            self._vg_cache[key] = jitted
+            jitted_vg = jax.jit(jax.value_and_grad(loss_flat,
+                                                   has_aux=True))
+            jitted_val = jax.jit(
+                lambda w, state, x, y, mask:
+                loss_flat(w, state, x, y, mask)[0])
+            pair = (jitted_vg, jitted_val)
+            self._vg_cache[key] = pair
+        jitted_vg, jitted_val = pair
 
         def vg(w):
-            (score, new_state), grad = jitted(w, self.net.state, x, y,
-                                              mask)
+            (score, new_state), grad = jitted_vg(w, self.net.state, x, y,
+                                                 mask)
             self.net.state = new_state
             return score, grad
 
-        return vg
+        def value(w):
+            return jitted_val(w, self.net.state, x, y, mask)
 
-    def optimize(self, x, y, mask=None) -> float:
+        return vg, value
+
+    def optimize(self, x, y, mask=None, iteration_callback=None) -> float:
         """One Solver.optimize() call: full-batch second-order fit of the
         net's params on (x, y). Updates net.params in place; returns the
-        final score."""
+        final score. ``iteration_callback(score)`` fires after each
+        internal optimization step with net.params already updated
+        (reference: BaseOptimizer listener notification per iteration)."""
         net = self.net
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         mask = None if mask is None else jnp.asarray(mask)
-        vg = self._flat_value_and_grad(x, y, mask)
+        vg, value = self._flat_fns(x, y, mask)
         flat, unravel = ravel_pytree(net.params)
         cls = _ALGOS[self.algo]
         kw = dict(max_iterations=self.max_iterations,
                   learning_rate=(net.conf.training.learning_rate
                                  if cls is StochasticGradientDescent
-                                 else 1.0))
+                                 else 1.0),
+                  value_fn=value)
         if self.terminations is not None:
             kw["terminations"] = self.terminations
         solver = cls(vg, **kw)
-        w, score = solver.optimize(flat)
+
+        def cb(w, score):
+            net.params = unravel(w)
+            net.score_value = score
+            if iteration_callback is not None:
+                iteration_callback(score)
+
+        w, score = solver.optimize(flat, callback=cb)
         net.params = unravel(w)
         net.score_value = score
         return score

@@ -4,9 +4,19 @@ from deeplearning4j_tpu.ui.storage import (
     FileStatsStorage, SqliteStatsStorage, RemoteUIStatsStorageRouter)
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.components import (
+    Component, ComponentText, ComponentTable, ComponentDiv,
+    DecoratorAccordion, ChartLine, ChartScatter, ChartHistogram,
+    ChartHorizontalBar, ChartStackedArea, ChartTimeline, Style,
+    StyleChart, StyleTable, StyleText, StyleDiv, StaticPageUtil)
 
 __all__ = [
     "Persistable", "StatsStorage", "StatsStorageRouter",
     "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
     "RemoteUIStatsStorageRouter", "StatsListener", "UIServer",
+    "Component", "ComponentText", "ComponentTable", "ComponentDiv",
+    "DecoratorAccordion", "ChartLine", "ChartScatter", "ChartHistogram",
+    "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline", "Style",
+    "StyleChart", "StyleTable", "StyleText", "StyleDiv",
+    "StaticPageUtil",
 ]

@@ -1,0 +1,609 @@
+"""Server-renderable UI component model.
+
+Parity with the reference's ui-components module (reference:
+deeplearning4j-ui-parent/deeplearning4j-ui-components — api/Component,
+api/Style, components/chart/{Chart,ChartLine,ChartScatter,
+ChartHistogram,ChartHorizontalBar,ChartStackedArea,ChartTimeline},
+components/component/ComponentDiv, components/decorator/
+DecoratorAccordion, components/table/ComponentTable,
+components/text/ComponentText, standalone/StaticPageUtil). Components
+serialize to JSON tagged with ``componentType`` for a front end;
+``StaticPageUtil.render_to_html`` emits a self-contained page. The
+reference ships a jQuery/flot front end; here charts render to inline
+SVG so the exported page has zero external dependencies.
+"""
+from __future__ import annotations
+
+import json
+import html as _html
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+# ------------------------------------------------------------------- styles
+class Style:
+    """Base style (reference: api/Style.java — width/height/margins with
+    LengthUnit; here plain CSS-ish units)."""
+
+    def __init__(self, *, width: Optional[float] = None,
+                 height: Optional[float] = None,
+                 width_unit: str = "px", height_unit: str = "px",
+                 margin_top: float = 0, margin_bottom: float = 0,
+                 margin_left: float = 0, margin_right: float = 0,
+                 background_color: Optional[str] = None):
+        self.width = width
+        self.height = height
+        self.width_unit = width_unit
+        self.height_unit = height_unit
+        self.margin_top = margin_top
+        self.margin_bottom = margin_bottom
+        self.margin_left = margin_left
+        self.margin_right = margin_right
+        self.background_color = background_color
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+class StyleChart(Style):
+    """reference: chart/style/StyleChart.java"""
+
+    def __init__(self, *, stroke_width: float = 1.0,
+                 point_size: float = 3.0,
+                 series_colors: Optional[List[str]] = None,
+                 axis_stroke_width: float = 1.0,
+                 title_font_size: float = 14.0, **kw):
+        super().__init__(**kw)
+        self.stroke_width = stroke_width
+        self.point_size = point_size
+        self.series_colors = series_colors or [
+            "#2969b0", "#d0542c", "#3b8746", "#8d5bb8", "#b5a03c"]
+        self.axis_stroke_width = axis_stroke_width
+        self.title_font_size = title_font_size
+
+
+class StyleTable(Style):
+    """reference: table/style/StyleTable.java"""
+
+    def __init__(self, *, border_width: float = 1.0,
+                 header_color: str = "#dddddd",
+                 column_widths: Optional[List[float]] = None,
+                 whitespace_mode: str = "normal", **kw):
+        super().__init__(**kw)
+        self.border_width = border_width
+        self.header_color = header_color
+        self.column_widths = column_widths
+        self.whitespace_mode = whitespace_mode
+
+
+class StyleText(Style):
+    """reference: text/style/StyleText.java"""
+
+    def __init__(self, *, font: str = "sans-serif",
+                 font_size: float = 12.0, underline: bool = False,
+                 color: str = "#000000", **kw):
+        super().__init__(**kw)
+        self.font = font
+        self.font_size = font_size
+        self.underline = underline
+        self.color = color
+
+
+class StyleDiv(Style):
+    """reference: component/style/StyleDiv.java (floatValue)."""
+
+    def __init__(self, *, float_value: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        self.float_value = float_value
+
+
+# --------------------------------------------------------------- components
+_COMPONENT_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls):
+    _COMPONENT_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Component:
+    """reference: api/Component.java — every component carries a type tag
+    for polymorphic JSON deserialization."""
+
+    def __init__(self, style: Optional[Style] = None):
+        self.style = style
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"componentType": type(self).__name__}
+        if self.style is not None:
+            d["style"] = self.style.to_dict()
+        d.update(self._fields())
+        return d
+
+    def _fields(self) -> Dict[str, Any]:
+        return {}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_json(s: str) -> "Component":
+        return _component_from_dict(json.loads(s))
+
+    # minimal inline-SVG/HTML rendering (standalone static pages)
+    def render_html(self) -> str:
+        return f"<pre>{_html.escape(self.to_json())}</pre>"
+
+
+class _RawStyle(Style):
+    """Deserialized style: keeps the exact dict so a round trip is
+    lossless even though the concrete Style subclass isn't tagged."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self._d = dict(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._d)
+
+
+def _component_from_dict(d: Dict[str, Any]) -> Component:
+    kind = d.get("componentType")
+    cls = _COMPONENT_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown componentType '{kind}'")
+    comp = cls._from_fields(d)
+    if "style" in d and comp.style is None:
+        comp.style = _RawStyle(d["style"])
+    return comp
+
+
+@_register
+class ComponentText(Component):
+    """reference: text/ComponentText.java"""
+
+    def __init__(self, text: str, style: Optional[StyleText] = None):
+        super().__init__(style)
+        self.text = text
+
+    def _fields(self):
+        return {"text": self.text}
+
+    @classmethod
+    def _from_fields(cls, d):
+        return cls(d["text"])
+
+    def render_html(self):
+        st = self.style
+        css = ""
+        if isinstance(st, StyleText):
+            css = (f"font-family:{st.font};font-size:{st.font_size}px;"
+                   f"color:{st.color};"
+                   + ("text-decoration:underline;" if st.underline else ""))
+        return f'<p style="{css}">{_html.escape(self.text)}</p>'
+
+
+@_register
+class ComponentTable(Component):
+    """reference: table/ComponentTable.java (header + content rows)."""
+
+    def __init__(self, header: Optional[Sequence[str]] = None,
+                 content: Optional[Sequence[Sequence[Any]]] = None,
+                 style: Optional[StyleTable] = None):
+        super().__init__(style)
+        self.header = list(header) if header else None
+        self.content = [list(r) for r in content] if content else []
+
+    def _fields(self):
+        return {"header": self.header, "content": self.content}
+
+    @classmethod
+    def _from_fields(cls, d):
+        return cls(d.get("header"), d.get("content"))
+
+    def render_html(self):
+        rows = []
+        if self.header:
+            cells = "".join(f"<th>{_html.escape(str(h))}</th>"
+                            for h in self.header)
+            rows.append(f"<tr>{cells}</tr>")
+        for r in self.content:
+            cells = "".join(f"<td>{_html.escape(str(c))}</td>" for c in r)
+            rows.append(f"<tr>{cells}</tr>")
+        return ('<table border="1" style="border-collapse:collapse">'
+                + "".join(rows) + "</table>")
+
+
+@_register
+class ComponentDiv(Component):
+    """reference: component/ComponentDiv.java — container of children."""
+
+    def __init__(self, style: Optional[StyleDiv] = None,
+                 *children: Component):
+        super().__init__(style)
+        self.children = list(children)
+
+    def _fields(self):
+        return {"components": [c.to_dict() for c in self.children]}
+
+    @classmethod
+    def _from_fields(cls, d):
+        kids = [_component_from_dict(c) for c in d.get("components", [])]
+        return cls(None, *kids)
+
+    def render_html(self):
+        return ("<div>" + "".join(c.render_html() for c in self.children)
+                + "</div>")
+
+
+@_register
+class DecoratorAccordion(Component):
+    """reference: decorator/DecoratorAccordion.java — collapsible section
+    wrapping inner components."""
+
+    def __init__(self, title: str = "", default_collapsed: bool = False,
+                 *children: Component, style: Optional[Style] = None):
+        super().__init__(style)
+        self.title = title
+        self.default_collapsed = default_collapsed
+        self.children = list(children)
+
+    def _fields(self):
+        return {"title": self.title,
+                "defaultCollapsed": self.default_collapsed,
+                "components": [c.to_dict() for c in self.children]}
+
+    @classmethod
+    def _from_fields(cls, d):
+        kids = [_component_from_dict(c) for c in d.get("components", [])]
+        return cls(d.get("title", ""), d.get("defaultCollapsed", False),
+                   *kids)
+
+    def render_html(self):
+        inner = "".join(c.render_html() for c in self.children)
+        open_attr = "" if self.default_collapsed else " open"
+        return (f"<details{open_attr}><summary>"
+                f"{_html.escape(self.title)}</summary>{inner}</details>")
+
+
+class Chart(Component):
+    """reference: chart/Chart.java — title + axis bounds."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 set_x_min: Optional[float] = None,
+                 set_x_max: Optional[float] = None,
+                 set_y_min: Optional[float] = None,
+                 set_y_max: Optional[float] = None):
+        super().__init__(style)
+        self.title = title
+        self.set_x_min = set_x_min
+        self.set_x_max = set_x_max
+        self.set_y_min = set_y_min
+        self.set_y_max = set_y_max
+
+    def _axis_fields(self):
+        return {"title": self.title, "xMin": self.set_x_min,
+                "xMax": self.set_x_max, "yMin": self.set_y_min,
+                "yMax": self.set_y_max}
+
+    # shared SVG scaffolding for xy-series charts
+    def _svg(self, series: List[Tuple[str, List[float], List[float]]],
+             *, mode: str = "line", w: int = 480, h: int = 280) -> str:
+        colors = (self.style.series_colors if isinstance(self.style,
+                                                         StyleChart)
+                  else StyleChart().series_colors)
+        all_x = [v for _, xs, _ in series for v in xs] or [0.0, 1.0]
+        all_y = [v for _, _, ys in series for v in ys] or [0.0, 1.0]
+        x0 = self.set_x_min if self.set_x_min is not None else min(all_x)
+        x1 = self.set_x_max if self.set_x_max is not None else max(all_x)
+        y0 = self.set_y_min if self.set_y_min is not None else min(all_y)
+        y1 = self.set_y_max if self.set_y_max is not None else max(all_y)
+        xr = (x1 - x0) or 1.0
+        yr = (y1 - y0) or 1.0
+        pad = 30
+
+        def sx(v):
+            return pad + (v - x0) / xr * (w - 2 * pad)
+
+        def sy(v):
+            return h - pad - (v - y0) / yr * (h - 2 * pad)
+
+        parts = [f'<svg width="{w}" height="{h}" '
+                 'xmlns="http://www.w3.org/2000/svg">',
+                 f'<text x="{w//2}" y="16" text-anchor="middle">'
+                 f'{_html.escape(self.title)}</text>',
+                 f'<line x1="{pad}" y1="{h-pad}" x2="{w-pad}" '
+                 f'y2="{h-pad}" stroke="black"/>',
+                 f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{h-pad}" '
+                 'stroke="black"/>']
+        for i, (name, xs, ys) in enumerate(series):
+            color = colors[i % len(colors)]
+            if mode == "line" and xs:
+                pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}"
+                               for x, y in zip(xs, ys))
+                parts.append(f'<polyline fill="none" stroke="{color}" '
+                             f'points="{pts}"/>')
+            elif mode == "scatter":
+                for x, y in zip(xs, ys):
+                    parts.append(f'<circle cx="{sx(x):.1f}" '
+                                 f'cy="{sy(y):.1f}" r="3" '
+                                 f'fill="{color}"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartLine(Chart):
+    """reference: chart/ChartLine.java — named x/y series."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 **kw):
+        super().__init__(title, style, **kw)
+        self.series: List[Tuple[str, List[float], List[float]]] = []
+
+    def add_series(self, name: str, x: Sequence[float],
+                   y: Sequence[float]) -> "ChartLine":
+        if len(x) != len(y):
+            raise ValueError(f"series '{name}': len(x)={len(x)} != "
+                             f"len(y)={len(y)}")
+        self.series.append((name, [float(v) for v in x],
+                            [float(v) for v in y]))
+        return self
+
+    def _fields(self):
+        d = self._axis_fields()
+        d.update({"seriesNames": [s[0] for s in self.series],
+                  "x": [s[1] for s in self.series],
+                  "y": [s[2] for s in self.series]})
+        return d
+
+    @classmethod
+    def _from_fields(cls, d):
+        c = cls(d.get("title", ""))
+        for name, xs, ys in zip(d.get("seriesNames", []), d.get("x", []),
+                                d.get("y", [])):
+            c.add_series(name, xs, ys)
+        return c
+
+    def render_html(self):
+        return self._svg(self.series, mode="line")
+
+
+@_register
+class ChartScatter(ChartLine):
+    """reference: chart/ChartScatter.java"""
+
+    def render_html(self):
+        return self._svg(self.series, mode="scatter")
+
+
+@_register
+class ChartHistogram(Chart):
+    """reference: chart/ChartHistogram.java — (binLower, binUpper, count)
+    triples."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 **kw):
+        super().__init__(title, style, **kw)
+        self.bins: List[Tuple[float, float, float]] = []
+
+    def add_bin(self, lower: float, upper: float,
+                y: float) -> "ChartHistogram":
+        self.bins.append((float(lower), float(upper), float(y)))
+        return self
+
+    def _fields(self):
+        d = self._axis_fields()
+        d.update({"lowerBounds": [b[0] for b in self.bins],
+                  "upperBounds": [b[1] for b in self.bins],
+                  "yValues": [b[2] for b in self.bins]})
+        return d
+
+    @classmethod
+    def _from_fields(cls, d):
+        c = cls(d.get("title", ""))
+        for lo, hi, y in zip(d.get("lowerBounds", []),
+                             d.get("upperBounds", []),
+                             d.get("yValues", [])):
+            c.add_bin(lo, hi, y)
+        return c
+
+    def render_html(self):
+        if not self.bins:
+            return self._svg([])
+        w, h, pad = 480, 280, 30
+        x0 = min(b[0] for b in self.bins)
+        x1 = max(b[1] for b in self.bins)
+        ymax = max(b[2] for b in self.bins) or 1.0
+        xr = (x1 - x0) or 1.0
+        color = (self.style.series_colors[0]
+                 if isinstance(self.style, StyleChart)
+                 else StyleChart().series_colors[0])
+        parts = [f'<svg width="{w}" height="{h}" '
+                 'xmlns="http://www.w3.org/2000/svg">',
+                 f'<text x="{w//2}" y="16" text-anchor="middle">'
+                 f'{_html.escape(self.title)}</text>']
+        for lo, hi, y in self.bins:
+            bx = pad + (lo - x0) / xr * (w - 2 * pad)
+            bw = max((hi - lo) / xr * (w - 2 * pad), 1.0)
+            bh = y / ymax * (h - 2 * pad)
+            parts.append(f'<rect x="{bx:.1f}" y="{h-pad-bh:.1f}" '
+                         f'width="{bw:.1f}" height="{bh:.1f}" '
+                         f'fill="{color}" stroke="white"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartHorizontalBar(Chart):
+    """reference: chart/ChartHorizontalBar.java — named values."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 **kw):
+        super().__init__(title, style, **kw)
+        self.names: List[str] = []
+        self.values: List[float] = []
+
+    def add_value(self, name: str, value: float) -> "ChartHorizontalBar":
+        self.names.append(name)
+        self.values.append(float(value))
+        return self
+
+    def _fields(self):
+        d = self._axis_fields()
+        d.update({"names": self.names, "values": self.values})
+        return d
+
+    @classmethod
+    def _from_fields(cls, d):
+        c = cls(d.get("title", ""))
+        for n, v in zip(d.get("names", []), d.get("values", [])):
+            c.add_value(n, v)
+        return c
+
+    def render_html(self):
+        w, row_h, pad = 480, 22, 100
+        vmax = max(self.values, default=1.0) or 1.0
+        color = StyleChart().series_colors[0]
+        h = 30 + row_h * len(self.names)
+        parts = [f'<svg width="{w}" height="{h}" '
+                 'xmlns="http://www.w3.org/2000/svg">',
+                 f'<text x="{w//2}" y="16" text-anchor="middle">'
+                 f'{_html.escape(self.title)}</text>']
+        for i, (n, v) in enumerate(zip(self.names, self.values)):
+            y = 24 + i * row_h
+            bw = max(v / vmax * (w - pad - 10), 0.0)
+            parts.append(f'<text x="{pad-6}" y="{y+14}" '
+                         f'text-anchor="end">{_html.escape(n)}</text>')
+            parts.append(f'<rect x="{pad}" y="{y}" width="{bw:.1f}" '
+                         f'height="{row_h-4}" fill="{color}"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartStackedArea(Chart):
+    """reference: chart/ChartStackedArea.java — shared x, stacked y
+    series."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 **kw):
+        super().__init__(title, style, **kw)
+        self.x: List[float] = []
+        self.labels: List[str] = []
+        self.ys: List[List[float]] = []
+
+    def set_x_values(self, x: Sequence[float]) -> "ChartStackedArea":
+        self.x = [float(v) for v in x]
+        return self
+
+    def add_series(self, name: str,
+                   y: Sequence[float]) -> "ChartStackedArea":
+        if self.x and len(y) != len(self.x):
+            raise ValueError("series length != x length")
+        self.labels.append(name)
+        self.ys.append([float(v) for v in y])
+        return self
+
+    def _fields(self):
+        d = self._axis_fields()
+        d.update({"x": self.x, "labels": self.labels, "y": self.ys})
+        return d
+
+    @classmethod
+    def _from_fields(cls, d):
+        c = cls(d.get("title", ""))
+        c.set_x_values(d.get("x", []))
+        for n, ys in zip(d.get("labels", []), d.get("y", [])):
+            c.add_series(n, ys)
+        return c
+
+    def render_html(self):
+        # cumulative stacking, rendered as successive line series
+        acc = [0.0] * len(self.x)
+        series = []
+        for name, ys in zip(self.labels, self.ys):
+            acc = [a + y for a, y in zip(acc, ys)]
+            series.append((name, self.x, list(acc)))
+        return self._svg(series, mode="line")
+
+
+@_register
+class ChartTimeline(Chart):
+    """reference: chart/ChartTimeline.java — lanes of (start, end,
+    label, color) entries."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 **kw):
+        super().__init__(title, style, **kw)
+        self.lanes: List[Tuple[str, List[Dict[str, Any]]]] = []
+
+    def add_lane(self, name: str,
+                 entries: Sequence[Dict[str, Any]]) -> "ChartTimeline":
+        """entries: dicts with startTimeMs, endTimeMs, optional
+        entryLabel, color."""
+        self.lanes.append((name, list(entries)))
+        return self
+
+    def _fields(self):
+        d = self._axis_fields()
+        d.update({"laneNames": [l[0] for l in self.lanes],
+                  "laneData": [l[1] for l in self.lanes]})
+        return d
+
+    @classmethod
+    def _from_fields(cls, d):
+        c = cls(d.get("title", ""))
+        for n, entries in zip(d.get("laneNames", []),
+                              d.get("laneData", [])):
+            c.add_lane(n, entries)
+        return c
+
+    def render_html(self):
+        w, row_h, pad = 600, 26, 100
+        times = [t for _, es in self.lanes
+                 for e in es for t in (e["startTimeMs"], e["endTimeMs"])]
+        t0, t1 = (min(times), max(times)) if times else (0.0, 1.0)
+        tr = (t1 - t0) or 1.0
+        h = 30 + row_h * len(self.lanes)
+        parts = [f'<svg width="{w}" height="{h}" '
+                 'xmlns="http://www.w3.org/2000/svg">',
+                 f'<text x="{w//2}" y="16" text-anchor="middle">'
+                 f'{_html.escape(self.title)}</text>']
+        for i, (name, entries) in enumerate(self.lanes):
+            y = 24 + i * row_h
+            parts.append(f'<text x="{pad-6}" y="{y+16}" '
+                         f'text-anchor="end">{_html.escape(name)}</text>')
+            for e in entries:
+                bx = pad + (e["startTimeMs"] - t0) / tr * (w - pad - 10)
+                bw = max((e["endTimeMs"] - e["startTimeMs"]) / tr
+                         * (w - pad - 10), 1.0)
+                color = e.get("color", "#2969b0")
+                parts.append(f'<rect x="{bx:.1f}" y="{y}" '
+                             f'width="{bw:.1f}" height="{row_h-6}" '
+                             f'fill="{color}"/>')
+                label = e.get("entryLabel")
+                if label:
+                    parts.append(f'<text x="{bx+2:.1f}" y="{y+14}" '
+                                 f'font-size="10">'
+                                 f'{_html.escape(label)}</text>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+# ------------------------------------------------------------- static pages
+class StaticPageUtil:
+    """reference: standalone/StaticPageUtil.java — render components to a
+    single self-contained HTML page."""
+
+    @staticmethod
+    def render_html(components: Sequence[Component],
+                    title: str = "deeplearning4j_tpu report") -> str:
+        body = "\n".join(c.render_html() for c in components)
+        return ("<!DOCTYPE html><html><head><meta charset=\"utf-8\">"
+                f"<title>{_html.escape(title)}</title></head>"
+                f"<body>{body}</body></html>")
+
+    @staticmethod
+    def save_html(components: Sequence[Component], path: str,
+                  title: str = "deeplearning4j_tpu report") -> None:
+        with open(path, "w") as f:
+            f.write(StaticPageUtil.render_html(components, title))

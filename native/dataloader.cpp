@@ -227,6 +227,102 @@ void prefetch_destroy(void* handle) {
 }
 
 // ---------------------------------------------------------------------------
-int dl4jtpu_io_abi_version() { return 1; }
+// Parallel tokenizer + vocabulary counter (VocabConstructor's hot loop)
+// ---------------------------------------------------------------------------
+// Role parity: the reference builds vocabularies with a parallel corpus
+// scan (VocabConstructor.buildJointVocabulary spawning VocabRunnables,
+// reference: deeplearning4j-nlp-parent/.../wordvectors/vocab/
+// VocabConstructor.java:168). Same design: the corpus buffer is split at
+// newline boundaries, each thread tokenizes (whitespace, optional ASCII
+// lowercase) into a private hash map, maps merge at the end. Output is a
+// deterministic "word\tcount\n" text blob sorted by (count desc, word
+// asc), two-phase: call with out == nullptr to size, then fill.
+
+}  // extern "C"
+
+#include <algorithm>
+#include <unordered_map>
+
+static void count_chunk(const char* text, int64_t begin, int64_t end,
+                        bool lowercase,
+                        std::unordered_map<std::string, int64_t>* out) {
+    std::string word;
+    for (int64_t i = begin; i < end; ++i) {
+        unsigned char ch = static_cast<unsigned char>(text[i]);
+        if (ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r') {
+            if (!word.empty()) { ++(*out)[word]; word.clear(); }
+        } else {
+            if (lowercase && ch >= 'A' && ch <= 'Z') ch += 32;
+            word.push_back(static_cast<char>(ch));
+        }
+    }
+    if (!word.empty()) ++(*out)[word];
+}
+
+extern "C" {
+
+// Returns bytes needed (out == nullptr) or written; -1 on bad args, -2 if
+// cap is too small. nthreads <= 0 selects hardware concurrency.
+int64_t vocab_count_buffer(const char* text, int64_t len,
+                           int32_t lowercase, int64_t min_count,
+                           int32_t nthreads, char* out, int64_t cap) {
+    if (text == nullptr || len < 0) return -1;
+    int nt = nthreads > 0 ? nthreads
+                          : std::max(1u, std::thread::hardware_concurrency());
+    if (static_cast<int64_t>(nt) > len / (1 << 16) + 1)
+        nt = static_cast<int>(len / (1 << 16) + 1);  // small input: fewer
+
+    // chunk boundaries snapped forward to the next newline so no token
+    // straddles two threads
+    std::vector<int64_t> bounds(nt + 1, 0);
+    bounds[nt] = len;
+    for (int t = 1; t < nt; ++t) {
+        int64_t b = len * t / nt;
+        while (b < len && text[b] != '\n') ++b;
+        bounds[t] = b;
+    }
+    std::sort(bounds.begin(), bounds.end());
+
+    std::vector<std::unordered_map<std::string, int64_t>> locals(nt);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nt; ++t)
+        threads.emplace_back(count_chunk, text, bounds[t], bounds[t + 1],
+                             lowercase != 0, &locals[t]);
+    for (auto& th : threads) th.join();
+
+    std::unordered_map<std::string, int64_t> merged;
+    for (auto& m : locals)
+        for (auto& kv : m) merged[kv.first] += kv.second;
+
+    std::vector<std::pair<std::string, int64_t>> items;
+    items.reserve(merged.size());
+    for (auto& kv : merged)
+        if (kv.second >= min_count) items.push_back(kv);
+    std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
+        if (a.second != b.second) return a.second > b.second;
+        return a.first < b.first;
+    });
+
+    int64_t needed = 0;
+    for (auto& kv : items)
+        needed += static_cast<int64_t>(kv.first.size()) + 1 +
+                  std::to_string(kv.second).size() + 1;
+    if (out == nullptr) return needed;
+    if (cap < needed) return -2;
+    char* w = out;
+    for (auto& kv : items) {
+        std::memcpy(w, kv.first.data(), kv.first.size());
+        w += kv.first.size();
+        *w++ = '\t';
+        std::string c = std::to_string(kv.second);
+        std::memcpy(w, c.data(), c.size());
+        w += c.size();
+        *w++ = '\n';
+    }
+    return needed;
+}
+
+// ---------------------------------------------------------------------------
+int dl4jtpu_io_abi_version() { return 2; }
 
 }  // extern "C"

@@ -99,3 +99,36 @@ def test_record_reader_native_path_matches_fallback(tmp_path):
     bf, bs = next(iter(fast)), next(iter(slow))
     np.testing.assert_allclose(bf.features, bs.features)
     np.testing.assert_allclose(bf.labels, bs.labels)
+
+
+def test_native_vocab_count_matches_python():
+    from collections import Counter
+    from deeplearning4j_tpu import native_bridge
+    if not native_bridge.native_available():
+        import pytest
+        pytest.skip("native IO library unavailable")
+    rng = __import__("random").Random(5)
+    words = ["alpha", "beta", "Gamma", "delta-x", "e"]
+    corpus = "\n".join(
+        " ".join(rng.choice(words) for _ in range(rng.randint(1, 30)))
+        for _ in range(500))
+    got = native_bridge.vocab_count(corpus, lowercase=True, min_count=1)
+    want = Counter(corpus.lower().split())
+    assert got == dict(want)
+    # min_count filters
+    got2 = native_bridge.vocab_count(corpus, lowercase=False, min_count=2)
+    want2 = {w: c for w, c in Counter(corpus.split()).items() if c >= 2}
+    assert got2 == want2
+    # multithreaded run is deterministic
+    assert native_bridge.vocab_count(corpus, nthreads=7) \
+        == native_bridge.vocab_count(corpus, nthreads=1)
+
+
+def test_vocab_constructor_text_fast_path():
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    text = "the cat sat\nthe cat ran\nthe dog sat\n"
+    cache = VocabConstructor(min_word_frequency=2).build_vocab_from_text(
+        text)
+    words = set(cache.words())
+    assert words == {"the", "cat", "sat"}
+    assert cache.word_frequency("the") == 3.0

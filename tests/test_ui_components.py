@@ -1,0 +1,77 @@
+"""UI component model tests (reference: deeplearning4j-ui-components —
+JSON round-trip per component type + standalone page rendering, the
+TestRendering/TestComponentSerialization analog)."""
+import json
+
+import pytest
+
+from deeplearning4j_tpu.ui import (ChartHistogram, ChartHorizontalBar,
+                                   ChartLine, ChartScatter,
+                                   ChartStackedArea, ChartTimeline,
+                                   Component, ComponentDiv, ComponentTable,
+                                   ComponentText, DecoratorAccordion,
+                                   StaticPageUtil, StyleChart, StyleText)
+
+
+def _sample_components():
+    line = (ChartLine("score", StyleChart())
+            .add_series("train", [0, 1, 2, 3], [1.0, 0.6, 0.4, 0.3])
+            .add_series("val", [0, 1, 2, 3], [1.1, 0.8, 0.6, 0.55]))
+    scatter = ChartScatter("embedding").add_series(
+        "pts", [0.1, 0.5, 0.9], [0.2, 0.7, 0.3])
+    hist = (ChartHistogram("weights")
+            .add_bin(-1.0, -0.5, 3).add_bin(-0.5, 0.0, 10)
+            .add_bin(0.0, 0.5, 12).add_bin(0.5, 1.0, 2))
+    bars = (ChartHorizontalBar("per-class F1")
+            .add_value("cat", 0.91).add_value("dog", 0.84))
+    stacked = (ChartStackedArea("time breakdown")
+               .set_x_values([0, 1, 2])
+               .add_series("fwd", [1, 1, 1]).add_series("bwd", [2, 2, 1]))
+    timeline = ChartTimeline("phases").add_lane(
+        "worker0", [{"startTimeMs": 0, "endTimeMs": 40,
+                     "entryLabel": "fit", "color": "#3b8746"},
+                    {"startTimeMs": 40, "endTimeMs": 55}])
+    table = ComponentTable(header=["metric", "value"],
+                           content=[["accuracy", 0.97], ["f1", 0.95]])
+    text = ComponentText("Training report", StyleText(font_size=16))
+    acc = DecoratorAccordion("details", False, table, hist)
+    div = ComponentDiv(None, text, line)
+    return [line, scatter, hist, bars, stacked, timeline, table, text,
+            acc, div]
+
+
+@pytest.mark.parametrize("comp", _sample_components(),
+                         ids=lambda c: type(c).__name__)
+def test_json_round_trip(comp):
+    s = comp.to_json()
+    d = json.loads(s)
+    assert d["componentType"] == type(comp).__name__
+    back = Component.from_json(s)
+    assert type(back) is type(comp)
+    # data fields survive the round trip (style is presentation-only)
+    d2 = back.to_dict()
+    for k, v in comp._fields().items():
+        assert d2[k] == d[k], k
+
+
+def test_render_static_page(tmp_path):
+    comps = _sample_components()
+    html = StaticPageUtil.render_html(comps, title="report")
+    assert html.startswith("<!DOCTYPE html>")
+    assert html.count("<svg") >= 6
+    assert "Training report" in html
+    assert "<table" in html and "accuracy" in html
+    assert "<details open>" in html
+    path = tmp_path / "report.html"
+    StaticPageUtil.save_html(comps, str(path), title="report")
+    assert path.read_text() == html
+
+
+def test_chart_line_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        ChartLine("x").add_series("bad", [1, 2], [1.0])
+
+
+def test_unknown_component_type_raises():
+    with pytest.raises(ValueError):
+        Component.from_json(json.dumps({"componentType": "Nope"}))
