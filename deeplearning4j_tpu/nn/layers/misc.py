@@ -34,7 +34,10 @@ class ActivationLayer(Layer):
 
     @property
     def input_family(self):
-        return self._family
+        # passthrough: applies elementwise to whatever family arrives —
+        # input_family is queried before update_input_type runs, so it
+        # must not claim 'ff' and trigger a flattening preprocessor
+        return "any"
 
     def weight_param_keys(self):
         return ()
@@ -66,7 +69,7 @@ class DropoutLayer(Layer):
 
     @property
     def input_family(self):
-        return self._family
+        return "any"  # elementwise passthrough, as ActivationLayer
 
     def weight_param_keys(self):
         return ()

@@ -46,7 +46,12 @@ class BatchNormalization(BaseLayer):
 
     @property
     def input_family(self) -> str:
-        return self._family
+        # 'any': normalizes whatever family arrives (NHWC puts the
+        # channel/feature axis last for ff, cnn AND rnn activations) —
+        # must not trigger a preprocessor before update_input_type has
+        # seen the real input type (shape inference queries input_family
+        # first)
+        return "any"
 
     def update_input_type(self, input_type):
         if isinstance(input_type, it.InputTypeConvolutional):
