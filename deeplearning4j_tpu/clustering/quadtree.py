@@ -1,0 +1,162 @@
+"""QuadTree for 2-D Barnes-Hut force approximation.
+
+Parity with the reference (reference: deeplearning4j-core/.../clustering/
+quadtree/QuadTree.java — 2-D tree with node capacity 1, center-of-mass
+accumulation, `insert`/`subDivide`, Barnes-Hut `computeNonEdgeForces`
+and `computeEdgeForces` per van der Maaten arXiv:1301.3342; Cell.java
+boundary boxes). Host-side numpy by design: tree construction is
+pointer-chasing the MXU can't help with — the device-side alternative
+is the dense jitted kernel in `clustering/tsne.py`, and this tree backs
+the `BarnesHutTsne` API for CPU parity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class Cell:
+    """Axis-aligned box: center (x, y), half-width/height
+    (`clustering/quadtree/Cell.java`)."""
+
+    __slots__ = ("x", "y", "hw", "hh")
+
+    def __init__(self, x: float, y: float, hw: float, hh: float):
+        self.x, self.y, self.hw, self.hh = x, y, hw, hh
+
+    def contains_point(self, point) -> bool:
+        return (self.x - self.hw <= point[0] <= self.x + self.hw
+                and self.y - self.hh <= point[1] <= self.y + self.hh)
+
+
+class QuadTree:
+    """2-D quadtree, node capacity 1 (`QuadTree.java:QT_NODE_CAPACITY`)."""
+
+    def __init__(self, data: Optional[np.ndarray] = None, *,
+                 boundary: Optional[Cell] = None,
+                 _root_data: Optional[np.ndarray] = None):
+        self.north_west: Optional[QuadTree] = None
+        self.north_east: Optional[QuadTree] = None
+        self.south_west: Optional[QuadTree] = None
+        self.south_east: Optional[QuadTree] = None
+        self.is_leaf = True
+        self.size = 0
+        self.cum_size = 0
+        self.center_of_mass = np.zeros(2)
+        self.index = -1          # row stored at this leaf
+
+        if data is not None:
+            data = np.asarray(data, dtype=np.float64)
+            mean = data.mean(0)
+            half = np.maximum(np.max(np.abs(data - mean), axis=0), 1e-5)
+            # widen slightly so boundary points land strictly inside
+            self.boundary = Cell(mean[0], mean[1],
+                                 half[0] * 1.001 + 1e-5,
+                                 half[1] * 1.001 + 1e-5)
+            self._data = data
+            for i in range(data.shape[0]):
+                self.insert(i)
+        else:
+            self.boundary = boundary
+            self._data = _root_data
+
+    # -- construction --------------------------------------------------
+    def insert(self, idx: int) -> bool:
+        point = self._data[idx]
+        if not self.boundary.contains_point(point):
+            return False
+        # center-of-mass running update
+        self.cum_size += 1
+        mult1 = (self.cum_size - 1) / self.cum_size
+        self.center_of_mass = self.center_of_mass * mult1 + point / self.cum_size
+
+        if self.is_leaf and self.size == 0:
+            self.index = idx
+            self.size = 1
+            return True
+        # duplicate point: don't split forever (QuadTree.java insert dup check)
+        if (self.is_leaf and self.size > 0
+                and np.array_equal(self._data[self.index], point)):
+            self.size += 1
+            return True
+        if self.is_leaf:
+            self.sub_divide()
+        for child in (self.north_west, self.north_east,
+                      self.south_west, self.south_east):
+            if child.insert(idx):
+                return True
+        return False  # pragma: no cover — boundary guaranteed to contain
+
+    def sub_divide(self) -> None:
+        """Split into four quadrants and push the stored point down
+        (`QuadTree.java:subDivide`)."""
+        b = self.boundary
+        hw, hh = b.hw / 2, b.hh / 2
+        mk = lambda cx, cy: QuadTree(boundary=Cell(cx, cy, hw, hh),
+                                     _root_data=self._data)
+        self.north_west = mk(b.x - hw, b.y + hh)
+        self.north_east = mk(b.x + hw, b.y + hh)
+        self.south_west = mk(b.x - hw, b.y - hh)
+        self.south_east = mk(b.x + hw, b.y - hh)
+        old_idx, old_size = self.index, self.size
+        self.is_leaf = False
+        self.index = -1
+        self.size = 0
+        if old_idx >= 0:
+            for _ in range(old_size):
+                for child in (self.north_west, self.north_east,
+                              self.south_west, self.south_east):
+                    if child.insert(old_idx):
+                        break
+
+    # -- Barnes-Hut forces ---------------------------------------------
+    def compute_non_edge_forces(self, point_index: int, theta: float,
+                                neg_f: np.ndarray) -> float:
+        """Accumulate repulsive force on `neg_f` (len-2) for one point;
+        returns this subtree's contribution to sum_Q
+        (`QuadTree.java:computeNonEdgeForces`, t-SNE repulsion with
+        Barnes-Hut opening criterion max_width/dist < theta)."""
+        if self.cum_size == 0 or (self.is_leaf and self.size > 0
+                                  and self.index == point_index
+                                  and self.cum_size == self.size):
+            return 0.0
+        point = self._data[point_index]
+        diff = point - self.center_of_mass
+        dist2 = float(diff @ diff)
+        max_width = max(self.boundary.hw, self.boundary.hh) * 2
+        if self.is_leaf or max_width * max_width < theta * theta * dist2:
+            # treat cell as a single body
+            n = self.cum_size
+            if self.is_leaf and self.index == point_index:
+                n -= self.size  # exclude self
+                if n == 0:
+                    return 0.0
+            q = 1.0 / (1.0 + dist2)
+            mult = n * q
+            sum_q = mult
+            neg_f += mult * q * diff
+            return sum_q
+        sum_q = 0.0
+        for child in (self.north_west, self.north_east,
+                      self.south_west, self.south_east):
+            sum_q += child.compute_non_edge_forces(point_index, theta, neg_f)
+        return sum_q
+
+    def compute_edge_forces(self, row_p, col_p, val_p, n: int,
+                            pos_f: np.ndarray) -> None:
+        """Attractive forces from the sparse P matrix (CSR row_p/col_p/
+        val_p) into pos_f [n, 2] (`QuadTree.java:computeEdgeForces`)."""
+        for i in range(n):
+            for ofs in range(row_p[i], row_p[i + 1]):
+                j = col_p[ofs]
+                diff = self._data[i] - self._data[j]
+                q = val_p[ofs] / (1.0 + float(diff @ diff))
+                pos_f[i] += q * diff
+
+    # -- introspection --------------------------------------------------
+    def depth(self) -> int:
+        if self.is_leaf:
+            return 1
+        return 1 + max(c.depth() for c in (self.north_west, self.north_east,
+                                           self.south_west, self.south_east))

@@ -12,7 +12,8 @@ from deeplearning4j_tpu.modelimport.keras import (
 from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
 from deeplearning4j_tpu.modelimport.trained_models import (vgg16,
                                                            vgg16_preprocess,
-                                                           load_vgg16)
+                                                           load_vgg16,
+                                                           resnet50)
 
 __all__ = [
     "import_keras_model_and_weights",
@@ -23,5 +24,5 @@ __all__ = [
     "KerasModel", "KerasSequentialModel", "Hdf5Archive",
     "InvalidKerasConfigurationException",
     "UnsupportedKerasConfigurationException",
-    "vgg16", "vgg16_preprocess", "load_vgg16",
+    "vgg16", "vgg16_preprocess", "load_vgg16", "resnet50",
 ]

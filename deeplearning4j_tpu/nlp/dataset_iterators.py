@@ -1,0 +1,114 @@
+"""Word2Vec-featurized DataSet iterators.
+
+Parity with the reference (reference: deeplearning4j-nlp/.../models/
+word2vec/iterator/Word2VecDataSetIterator.java — moving word windows
+over a label-aware sentence iterator, featurized through a pretrained
+Word2Vec: each example is the concatenation of the window's word
+vectors, labelled with the sentence's label (one-hot); batches of
+`batch` windows; text/movingwindow/Window.java + Windows.java — the
+window extraction with <s>/</s> edge padding).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.nlp.sentenceiterator import LabelAwareIterator
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+
+
+class Window:
+    """A centred token window with edge padding
+    (`text/movingwindow/Window.java` — pads with <s>/</s>)."""
+
+    def __init__(self, words: List[str], focus: int, label: str = ""):
+        self.words = words
+        self.focus = focus
+        self.label = label
+
+    def get_words(self) -> List[str]:
+        return self.words
+
+
+def windows(tokens: Sequence[str], window_size: int,
+            label: str = "") -> List[Window]:
+    """All centred windows of `window_size` over a token list
+    (`text/movingwindow/Windows.java:windows`)."""
+    if not tokens:
+        return []
+    half = window_size // 2
+    padded = ["<s>"] * half + list(tokens) + ["</s>"] * half
+    out = []
+    for i in range(len(tokens)):
+        out.append(Window(padded[i:i + window_size], half, label))
+    return out
+
+
+class Word2VecDataSetIterator:
+    """Featurize labelled sentences into window DataSets via a trained
+    Word2Vec (`Word2VecDataSetIterator.java:48`). Features:
+    [batch, window_size * layer_size] concatenated vectors (zeros for
+    OOV/pad tokens); labels: one-hot sentence label."""
+
+    def __init__(self, vec, iterator: LabelAwareIterator,
+                 labels: Sequence[str], batch: int = 10,
+                 window_size: int = 5,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.vec = vec
+        self.iterator = iterator
+        self.labels = list(labels)
+        self.batch = batch
+        self.window_size = window_size
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self._layer = vec.lookup_table.vector_length
+        self._windows: List[Window] = []
+        self._pos = 0
+        self._materialize()
+
+    def _materialize(self) -> None:
+        self._windows = []
+        self.iterator.reset()
+        for doc in self.iterator:
+            label = doc.labels[0] if doc.labels else ""
+            toks = self.tokenizer.create(doc.content).get_tokens()
+            self._windows.extend(windows(toks, self.window_size, label))
+
+    def _featurize(self, ws: List[Window]) -> DataSet:
+        feats = np.zeros((len(ws), self.window_size * self._layer),
+                         dtype=np.float32)
+        labels = np.zeros((len(ws), len(self.labels)), dtype=np.float32)
+        for r, w in enumerate(ws):
+            for c, word in enumerate(w.get_words()):
+                v = self.vec.word_vector(word)
+                if v is not None:
+                    feats[r, c * self._layer:(c + 1) * self._layer] = v
+            if w.label in self.labels:
+                labels[r, self.labels.index(w.label)] = 1.0
+        return DataSet(feats, labels)
+
+    # -- DataSetIterator surface ------------------------------------------
+    def __iter__(self) -> Iterator[DataSet]:
+        self._pos = 0
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._pos >= len(self._windows):
+            raise StopIteration
+        ws = self._windows[self._pos:self._pos + self.batch]
+        self._pos += len(ws)
+        return self._featurize(ws)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def input_columns(self) -> int:
+        return self.window_size * self._layer
+
+    def total_outcomes(self) -> int:
+        return len(self.labels)
+
+    def num_examples(self) -> int:
+        return len(self._windows)

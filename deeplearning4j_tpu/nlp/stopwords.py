@@ -1,0 +1,49 @@
+"""English stop-word list + StopWords accessor.
+
+Parity with the reference's stop-word support (reference:
+deeplearning4j-nlp/.../text/stopwords/StopWords.java — loads a
+classpath `stopwords` resource once and serves it as a List<String>).
+The word list here is the standard English set the reference resource
+ships (articles, pronouns, auxiliaries, prepositions, single letters),
+inlined because the framework has no classpath-resource mechanism.
+"""
+from __future__ import annotations
+
+from typing import List
+
+_ENGLISH = """
+a about above after again against all am an and any are aren't as at be
+because been before being below between both but by can't cannot could
+couldn't did didn't do does doesn't doing don't down during each few for
+from further had hadn't has hasn't have haven't having he he'd he'll
+he's her here here's hers herself him himself his how how's i i'd i'll
+i'm i've if in into is isn't it it's its itself let's me more most
+mustn't my myself no nor not of off on once only or other ought our ours
+ourselves out over own same shan't she she'd she'll she's should
+shouldn't so some such than that that's the their theirs them themselves
+then there there's these they they'd they'll they're they've this those
+through to too under until up very was wasn't we we'd we'll we're we've
+were weren't what what's when when's where where's which while who who's
+whom why why's with won't would wouldn't you you'd you'll you're you've
+your yours yourself yourselves
+b c d e f g h j k l m n o p q r s t u v w x y z
+""".split()
+
+
+class StopWords:
+    """Static accessor mirroring `StopWords.getStopWords()`."""
+
+    _cached: List[str] = None
+
+    @classmethod
+    def get_stop_words(cls) -> List[str]:
+        if cls._cached is None:
+            cls._cached = list(_ENGLISH)
+        return cls._cached
+
+
+def is_stop_word(word: str) -> bool:
+    return word.lower() in _STOP_SET
+
+
+_STOP_SET = frozenset(_ENGLISH)

@@ -1,0 +1,116 @@
+"""Cluster-style distributed SequenceVectors / Word2Vec.
+
+Parity with the reference's Spark NLP stack (reference:
+dl4j-spark-nlp-java8/.../SparkSequenceVectors.java:  fit() counts
+element frequencies per corpus partition (map), reduces the counters
+into one vocabulary + Huffman tree, broadcasts it, then trains per
+partition and aggregates weight deltas; dl4j-spark-nlp/.../Word2Vec.java
++ Word2VecPerformer.java — the same map/reduce shape with per-partition
+hogwild updates).
+
+TPU reshaping: partitions are host-side corpus shards (the map/reduce
+vocab count is real and parallel via the native C++ counter when
+available); training is NOT per-partition hogwild — every shard's
+(center, context) pair batches feed the same batched skip-gram XLA step,
+sharded over the mesh's `data` axis when a mesh is given, and GSPMD
+inserts the gradient allreduce that replaces the reference's
+driver-side delta aggregation (SURVEY §3.4 consequence).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import (AbstractCache, VocabWord,
+                                          build_huffman_tree)
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+
+
+def count_partition(sentences: Sequence[str],
+                    tokenizer: TokenizerFactory) -> dict:
+    """Frequency counter for one corpus partition — the map side of
+    `SparkSequenceVectors.fit()`'s distributed vocab count. Uses the
+    native C++ parallel counter when built."""
+    from deeplearning4j_tpu import native_bridge
+    text = "\n".join(sentences)
+    counts = native_bridge.vocab_count(text, lowercase=True, min_count=1)
+    if counts is not None:
+        return counts
+    out: dict = {}
+    for s in sentences:
+        for tok in tokenizer.create(s).get_tokens():
+            out[tok] = out.get(tok, 0) + 1
+    return out
+
+
+def merge_counters(counters: Iterable[dict]) -> dict:
+    """Reduce side: merge per-partition counters
+    (`SparkSequenceVectors` treeAggregate of Counter<T>)."""
+    merged: dict = {}
+    for c in counters:
+        for w, n in c.items():
+            merged[w] = merged.get(w, 0) + n
+    return merged
+
+
+class DistributedSequenceVectors(SequenceVectors):
+    """SequenceVectors whose vocab build is a parallel map/reduce over
+    corpus partitions and whose training step shards pair batches over
+    a mesh (`SparkSequenceVectors.java` shape)."""
+
+    def __init__(self, *, corpus: Sequence[str],
+                 num_partitions: int = 4,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.corpus = list(corpus)
+        self.num_partitions = max(1, num_partitions)
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _partitions(self) -> List[List[str]]:
+        return [list(p) for p in
+                np.array_split(np.asarray(self.corpus, dtype=object),
+                               self.num_partitions)]
+
+    def _sequences(self) -> Iterable[List[str]]:
+        for s in self.corpus:
+            yield self.tokenizer.create(s).get_tokens()
+
+    def build_vocab(self) -> None:
+        """Map partitions → counters, reduce, then build the shared
+        vocabulary + Huffman codes once on the driver
+        (`SparkSequenceVectors.fit()` vocab phase)."""
+        parts = self._partitions()
+        with ThreadPoolExecutor(max_workers=len(parts)) as pool:
+            counters = list(pool.map(
+                lambda p: count_partition(p, self.tokenizer), parts))
+        merged = merge_counters(counters)
+
+        cache = AbstractCache()
+        for word, freq in merged.items():
+            if freq >= self.min_word_frequency:
+                vw = VocabWord(word, float(freq))
+                cache.add_token(vw)
+        cache.finalize_vocab()
+        if self.use_hs:
+            build_huffman_tree(cache)
+        self.vocab = cache
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, seed=self.seed,
+            use_hs=self.use_hs, use_neg=self.negative > 0)
+        self.lookup_table.reset_weights()
+
+
+class SparkWord2Vec(DistributedSequenceVectors):
+    """User-facing alias mirroring `dl4j-spark-nlp/.../Word2Vec.java` —
+    sentence-corpus skip-gram with distributed vocab count and
+    mesh-sharded training."""
+
+    def __init__(self, *, sentences: Sequence[str], **kwargs):
+        kwargs.setdefault("elements_learning_algorithm", "skipgram")
+        super().__init__(corpus=sentences, **kwargs)

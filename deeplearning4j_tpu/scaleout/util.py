@@ -1,0 +1,105 @@
+"""Distributed-data interop + split/repartition helpers.
+
+Parity with the reference's Spark utility pair (reference:
+dl4j-spark/.../util/MLLibUtil.java — conversions between MLlib
+Vector/LabeledPoint and INDArray/DataSet (toVector, toLabeledPoint,
+fromLabeledPoint with one-hot label expansion); util/SparkUtils.java —
+splitData/randomSplit, repartitionBalancedIfRequired (equal-size
+partitions for even worker load), writeObjectToFile/readObjectFromFile,
+checkKryoConfiguration). Spark RDDs/MLlib types don't exist here; the
+equivalents operate on numpy arrays and `DataSet` lists — the host-side
+currency that feeds the sharded jitted step — and balanced
+"repartition" becomes exact per-shard batch slicing for a mesh's data
+axis.
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import DataSet
+
+
+@dataclass
+class LabeledPoint:
+    """label + dense feature vector (MLlib LabeledPoint stand-in)."""
+    label: float
+    features: np.ndarray
+
+
+def to_labeled_point(features: np.ndarray, labels: np.ndarray
+                     ) -> List[LabeledPoint]:
+    """DataSet arrays → labeled points; one-hot labels collapse to the
+    class index (`MLLibUtil.toLabeledPoint`)."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    if labels.ndim == 2 and labels.shape[1] > 1:
+        labels = labels.argmax(1)
+    labels = labels.reshape(-1)
+    return [LabeledPoint(float(l), f) for f, l in zip(features, labels)]
+
+
+def from_labeled_point(points: Sequence[LabeledPoint], num_classes: int
+                       ) -> DataSet:
+    """Labeled points → DataSet with one-hot labels
+    (`MLLibUtil.fromLabeledPoint` + FeatureUtil.toOutcomeVector)."""
+    feats = np.stack([np.asarray(p.features) for p in points])
+    idx = np.asarray([int(p.label) for p in points])
+    labels = np.eye(num_classes, dtype=feats.dtype)[idx]
+    return DataSet(feats, labels)
+
+
+def split_data(datasets: Sequence[DataSet], fraction: float,
+               seed: int = 123) -> Tuple[List[DataSet], List[DataSet]]:
+    """Random train/held-out split of a batch list
+    (`SparkUtils.splitData` / randomSplit)."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(datasets))
+    n_train = int(round(len(datasets) * fraction))
+    train = [datasets[i] for i in order[:n_train]]
+    rest = [datasets[i] for i in order[n_train:]]
+    return train, rest
+
+
+def repartition_balanced(features: np.ndarray, labels: np.ndarray,
+                         num_partitions: int
+                         ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Split arrays into `num_partitions` near-equal shards (sizes
+    differ by ≤1) — the even-worker-load guarantee of
+    `SparkUtils.repartitionBalancedIfRequired`, exact here because we
+    slice instead of shuffling an RDD."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    idx = np.array_split(np.arange(features.shape[0]), num_partitions)
+    return [(features[i], labels[i]) for i in idx]
+
+
+def pad_to_multiple(features: np.ndarray, labels: np.ndarray,
+                    multiple: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pad the batch axis up to a multiple (repeating the last row) so
+    a global batch divides a mesh data axis; returns (f, l,
+    n_real_rows). The sharded-fit equivalent of the reference's
+    repartitioning-to-worker-count concern."""
+    n = features.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return features, labels, n
+    pad_f = np.repeat(features[-1:], rem, axis=0)
+    pad_l = np.repeat(labels[-1:], rem, axis=0)
+    return (np.concatenate([features, pad_f], 0),
+            np.concatenate([labels, pad_l], 0), n)
+
+
+def write_object_to_file(path: str, obj) -> None:
+    """Pickle an object to a file (`SparkUtils.writeObjectToFile`)."""
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+
+
+def read_object_from_file(path: str):
+    """(`SparkUtils.readObjectFromFile`)"""
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,220 @@
+"""Counting / pairing utility classes.
+
+Parity with the reference's vendored Berkeley-NLP utilities (reference:
+deeplearning4j-nn/.../berkeley/ — Pair.java, Triple.java, Counter.java,
+CounterMap.java, PriorityQueue.java; used throughout the NLP and
+clustering code for counting and best-first search). These are thin,
+idiomatic-Python equivalents: `Counter` adds the reference's
+argmax/normalize/scale operations missing from `collections.Counter`,
+and `PriorityQueue` is a max-heap with the reference's
+`next`/`peek`/`getPriority` surface.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from collections import defaultdict
+from typing import Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+T = TypeVar("T")
+
+
+class Pair(Generic[K, V]):
+    """Ordered pair (`berkeley/Pair.java`)."""
+
+    __slots__ = ("first", "second")
+
+    def __init__(self, first: K, second: V):
+        self.first = first
+        self.second = second
+
+    def reverse(self) -> "Pair[V, K]":
+        return Pair(self.second, self.first)
+
+    def __iter__(self):
+        return iter((self.first, self.second))
+
+    def __eq__(self, other):
+        return (isinstance(other, Pair) and self.first == other.first
+                and self.second == other.second)
+
+    def __hash__(self):
+        return hash((self.first, self.second))
+
+    def __repr__(self):
+        return f"({self.first}, {self.second})"
+
+
+class Triple(Generic[K, V, T]):
+    """Ordered triple (`berkeley/Triple.java`)."""
+
+    __slots__ = ("first", "second", "third")
+
+    def __init__(self, first: K, second: V, third: T):
+        self.first = first
+        self.second = second
+        self.third = third
+
+    def __iter__(self):
+        return iter((self.first, self.second, self.third))
+
+    def __eq__(self, other):
+        return (isinstance(other, Triple) and tuple(self) == tuple(other))
+
+    def __hash__(self):
+        return hash(tuple(self))
+
+    def __repr__(self):
+        return f"({self.first}, {self.second}, {self.third})"
+
+
+class Counter(Generic[K]):
+    """A map from keys to float counts (`berkeley/Counter.java`)."""
+
+    def __init__(self):
+        self._counts: Dict[K, float] = {}
+
+    def increment_count(self, key: K, amount: float = 1.0) -> None:
+        self._counts[key] = self._counts.get(key, 0.0) + amount
+
+    def increment_all(self, keys, amount: float = 1.0) -> None:
+        for k in keys:
+            self.increment_count(k, amount)
+
+    def set_count(self, key: K, count: float) -> None:
+        self._counts[key] = count
+
+    def get_count(self, key: K) -> float:
+        return self._counts.get(key, 0.0)
+
+    def remove_key(self, key: K) -> float:
+        return self._counts.pop(key, 0.0)
+
+    def contains_key(self, key: K) -> bool:
+        return key in self._counts
+
+    def key_set(self):
+        return self._counts.keys()
+
+    def total_count(self) -> float:
+        return sum(self._counts.values())
+
+    def size(self) -> int:
+        return len(self._counts)
+
+    def is_empty(self) -> bool:
+        return not self._counts
+
+    def argmax(self) -> Optional[K]:
+        if not self._counts:
+            return None
+        return max(self._counts, key=lambda k: self._counts[k])
+
+    def max_count(self) -> float:
+        return max(self._counts.values()) if self._counts else 0.0
+
+    def normalize(self) -> None:
+        total = self.total_count()
+        if total:
+            for k in self._counts:
+                self._counts[k] /= total
+
+    def scale(self, factor: float) -> "Counter[K]":
+        out: Counter[K] = Counter()
+        for k, v in self._counts.items():
+            out.set_count(k, v * factor)
+        return out
+
+    def keys_sorted_by_count(self, descending: bool = True) -> List[K]:
+        return sorted(self._counts, key=lambda k: self._counts[k],
+                      reverse=descending)
+
+    def items(self):
+        return self._counts.items()
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._counts)
+
+    def __repr__(self):
+        top = self.keys_sorted_by_count()[:10]
+        inner = ", ".join(f"{k}: {self._counts[k]:g}" for k in top)
+        return "{" + inner + ("…" if self.size() > 10 else "") + "}"
+
+
+class CounterMap(Generic[K, V]):
+    """Nested counters: key → (key → count) (`berkeley/CounterMap.java`)."""
+
+    def __init__(self):
+        self._maps: Dict[K, Counter[V]] = defaultdict(Counter)
+
+    def increment_count(self, key: K, value: V, amount: float = 1.0) -> None:
+        self._maps[key].increment_count(value, amount)
+
+    def set_count(self, key: K, value: V, count: float) -> None:
+        self._maps[key].set_count(value, count)
+
+    def get_count(self, key: K, value: V) -> float:
+        return self._maps[key].get_count(value) if key in self._maps else 0.0
+
+    def get_counter(self, key: K) -> Counter[V]:
+        return self._maps[key]
+
+    def key_set(self):
+        return self._maps.keys()
+
+    def total_count(self) -> float:
+        return sum(c.total_count() for c in self._maps.values())
+
+    def total_size(self) -> int:
+        return sum(c.size() for c in self._maps.values())
+
+    def normalize(self) -> None:
+        for c in self._maps.values():
+            c.normalize()
+
+    def is_empty(self) -> bool:
+        return all(c.is_empty() for c in self._maps.values())
+
+
+class PriorityQueue(Generic[T]):
+    """Max-priority queue with `next`/`peek`/`get_priority`
+    (`berkeley/PriorityQueue.java` — a binary max-heap used for
+    best-first beam search)."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, T]] = []
+        self._tie = itertools.count()
+
+    def put(self, item: T, priority: float) -> None:
+        # negate: heapq is a min-heap, reference queue is max-first
+        heapq.heappush(self._heap, (-priority, next(self._tie), item))
+
+    # reference name
+    add = put
+
+    def next(self) -> T:
+        return heapq.heappop(self._heap)[2]
+
+    def peek(self) -> T:
+        return self._heap[0][2]
+
+    def get_priority(self) -> float:
+        return -self._heap[0][0]
+
+    def has_next(self) -> bool:
+        return bool(self._heap)
+
+    def size(self) -> int:
+        return len(self._heap)
+
+    def is_empty(self) -> bool:
+        return not self._heap
+
+    def __iter__(self) -> Iterator[T]:
+        while self.has_next():
+            yield self.next()
+
+    def __len__(self):
+        return len(self._heap)

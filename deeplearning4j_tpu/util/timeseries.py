@@ -1,0 +1,124 @@
+"""Time-series shape/mask utilities + masked reductions.
+
+Parity with the reference's utility trio (reference:
+deeplearning4j-nn/.../util/TimeSeriesUtils.java — movingAverage :44,
+reshapeTimeSeriesMaskToVector :58, reshapeVectorToTimeSeriesMask :74,
+reshape3dTo2d :93, reshape2dTo3d :105;
+util/MaskedReductionUtil.java — maskedPoolingTimeSeries :29,
+maskedPoolingConvolution :163; nn/api/MaskState.java — Active /
+Passthrough).
+
+Shape conventions differ by design: the reference stores RNN
+activations as [miniBatch, size, timeSeriesLength] (NCW); here time
+series are [batch, time, features] (the XLA/TPU-friendly layout used
+by `nn/layers/recurrent.py`), and CNN activations are NHWC rather than
+NCHW. All functions are jit-safe (pure jnp, static shapes).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class MaskState(enum.Enum):
+    """How a mask propagates past a layer (`nn/api/MaskState.java`):
+    ACTIVE — mask should be applied to this layer's activations;
+    PASSTHROUGH — mask exists but this layer doesn't use it (e.g.
+    after a global pooling collapsed the time axis)."""
+    ACTIVE = "active"
+    PASSTHROUGH = "passthrough"
+
+
+def moving_average(x, n: int):
+    """Trailing moving average over the last axis, first valid window
+    onward — output length is `x.shape[-1] - n + 1`
+    (`TimeSeriesUtils.movingAverage:44`, cumsum formulation)."""
+    x = jnp.asarray(x)
+    c = jnp.cumsum(x, axis=-1)
+    head = c[..., n - 1:n]
+    rest = c[..., n:] - c[..., :-n]
+    return jnp.concatenate([head, rest], axis=-1) / n
+
+
+def reshape_time_series_mask_to_vector(mask):
+    """[B, T] mask → [B*T, 1] column vector
+    (`TimeSeriesUtils.reshapeTimeSeriesMaskToVector:58`)."""
+    mask = jnp.asarray(mask)
+    return mask.reshape(-1, 1)
+
+
+def reshape_vector_to_time_series_mask(vec, minibatch: int):
+    """[B*T, 1] (or flat) → [B, T]
+    (`TimeSeriesUtils.reshapeVectorToTimeSeriesMask:74`)."""
+    vec = jnp.asarray(vec)
+    return vec.reshape(minibatch, -1)
+
+
+def reshape_3d_to_2d(x):
+    """[B, T, F] → [B*T, F]: collapse batch and time so per-step ops
+    (e.g. an output layer) see a plain 2-D batch
+    (`TimeSeriesUtils.reshape3dTo2d:93`)."""
+    x = jnp.asarray(x)
+    b, t, f = x.shape
+    return x.reshape(b * t, f)
+
+
+def reshape_2d_to_3d(x, minibatch: int):
+    """[B*T, F] → [B, T, F] (`TimeSeriesUtils.reshape2dTo3d:105`)."""
+    x = jnp.asarray(x)
+    return x.reshape(minibatch, -1, x.shape[-1])
+
+
+def reshape_per_output_time_series_mask_to_2d(mask):
+    """Per-output mask [B, T, O] → [B*T, O]
+    (`TimeSeriesUtils.reshapePerOutputTimeSeriesMaskTo2d:83`)."""
+    mask = jnp.asarray(mask)
+    return mask.reshape(-1, mask.shape[-1])
+
+
+_NEG = -1e30  # large-negative fill for masked max (safe in bf16/f32)
+
+
+def masked_pooling_time_series(pooling_type: str, x, mask, pnorm: int = 2):
+    """Pool [B, T, F] over time with a [B, T] validity mask — masked
+    steps contribute nothing (`MaskedReductionUtil.
+    maskedPoolingTimeSeries:29`). pooling_type: max|avg|sum|pnorm."""
+    x = jnp.asarray(x)
+    m = jnp.asarray(mask).astype(x.dtype)[..., None]        # [B, T, 1]
+    ptype = pooling_type.lower()
+    if ptype == "max":
+        return jnp.max(jnp.where(m > 0, x, _NEG), axis=1)
+    if ptype == "sum":
+        return jnp.sum(x * m, axis=1)
+    if ptype in ("avg", "mean"):
+        denom = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        return jnp.sum(x * m, axis=1) / denom
+    if ptype == "pnorm":
+        p = float(pnorm)
+        s = jnp.sum(jnp.abs(x * m) ** p, axis=1)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pooling type: {pooling_type}")
+
+
+def masked_pooling_convolution(pooling_type: str, x, mask, pnorm: int = 2):
+    """Pool NHWC [B, H, W, C] over the spatial axes with a [B, H, W]
+    (or broadcastable) validity mask
+    (`MaskedReductionUtil.maskedPoolingConvolution:163`; reference is
+    NCHW — NHWC here, see module docstring)."""
+    x = jnp.asarray(x)
+    m = jnp.asarray(mask).astype(x.dtype)
+    if m.ndim == 3:
+        m = m[..., None]                                     # [B, H, W, 1]
+    ptype = pooling_type.lower()
+    if ptype == "max":
+        return jnp.max(jnp.where(m > 0, x, _NEG), axis=(1, 2))
+    if ptype == "sum":
+        return jnp.sum(x * m, axis=(1, 2))
+    if ptype in ("avg", "mean"):
+        denom = jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
+        return jnp.sum(x * m, axis=(1, 2)) / denom
+    if ptype == "pnorm":
+        p = float(pnorm)
+        return jnp.sum(jnp.abs(x * m) ** p, axis=(1, 2)) ** (1.0 / p)
+    raise ValueError(f"unknown pooling type: {pooling_type}")

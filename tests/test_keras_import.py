@@ -358,3 +358,22 @@ def test_functional_training_config_and_enforce(tmp_path):
     _write_keras_file(path2, model_config, weights)
     with _pytest.raises(InvalidKerasConfigurationException):
         import_keras_model_and_weights(path2, enforce_training_config=True)
+
+
+def test_resnet50_builds_and_runs_forward():
+    """ResNet-50 graph (BASELINE.md's other Keras-import benchmark
+    model): builds, inserts NO preprocessor anywhere — in particular no
+    flattening CnnToFeedForward mid-residual (ActivationLayer/
+    BatchNormalization declare input_family='any', and GlobalPooling
+    already emits FF type, so the fc head needs no flatten either) —
+    and runs forward at a small resolution."""
+    from deeplearning4j_tpu.modelimport import resnet50
+    from deeplearning4j_tpu.nn.graph.computation_graph import (
+        ComputationGraph)
+
+    conf = resnet50(num_classes=10, height=32, width=32, dtype="float32")
+    graph = ComputationGraph(conf).init(seed=0)
+    assert graph._preprocessors == {}
+    out = graph.output(np.zeros((2, 32, 32, 3), np.float32))[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-4)

@@ -1,0 +1,266 @@
+"""Tests for the SURVEY §2 long-tail utilities added after the grep
+audit: stopwords, berkeley counters/queues, time-series + masked
+reductions, QuadTree, MagicQueue/AsyncIterator, MLLibUtil/SparkUtils
+analogs, VanillaStatsStorageRouter, distributed SequenceVectors,
+Word2VecDataSetIterator."""
+import numpy as np
+import pytest
+
+
+def test_stopwords():
+    from deeplearning4j_tpu.nlp.stopwords import StopWords, is_stop_word
+
+    words = StopWords.get_stop_words()
+    assert "the" in words and "and" in words
+    assert is_stop_word("The") and not is_stop_word("tensor")
+    assert StopWords.get_stop_words() is StopWords.get_stop_words()
+
+
+def test_berkeley_counter_and_queue():
+    from deeplearning4j_tpu.util.berkeley import (Counter, CounterMap, Pair,
+                                                  PriorityQueue, Triple)
+
+    c = Counter()
+    c.increment_all(["a", "b", "a", "c", "a"])
+    assert c.get_count("a") == 3 and c.argmax() == "a"
+    assert c.total_count() == 5
+    c.normalize()
+    assert abs(c.total_count() - 1.0) < 1e-12
+    assert c.keys_sorted_by_count()[0] == "a"
+
+    cm = CounterMap()
+    cm.increment_count("x", "y", 2.0)
+    cm.increment_count("x", "z")
+    assert cm.get_count("x", "y") == 2.0
+    assert cm.get_counter("x").argmax() == "y"
+    assert cm.total_count() == 3.0
+
+    pq = PriorityQueue()
+    pq.put("low", 1.0)
+    pq.put("high", 9.0)
+    pq.put("mid", 5.0)
+    assert pq.peek() == "high" and pq.get_priority() == 9.0
+    assert list(pq) == ["high", "mid", "low"]
+
+    p = Pair(1, "a")
+    assert p.reverse().first == "a" and tuple(p) == (1, "a")
+    assert hash(Triple(1, 2, 3)) == hash(Triple(1, 2, 3))
+
+
+def test_timeseries_reshapes_and_moving_average():
+    from deeplearning4j_tpu.util import timeseries as ts
+
+    x = np.arange(1.0, 7.0)  # 1..6
+    ma = np.asarray(ts.moving_average(x, 3))
+    np.testing.assert_allclose(ma, [2.0, 3.0, 4.0, 5.0])
+
+    arr = np.arange(24.0).reshape(2, 3, 4)  # [B=2, T=3, F=4]
+    flat = np.asarray(ts.reshape_3d_to_2d(arr))
+    assert flat.shape == (6, 4)
+    back = np.asarray(ts.reshape_2d_to_3d(flat, 2))
+    np.testing.assert_array_equal(back, arr)
+
+    mask = np.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    v = np.asarray(ts.reshape_time_series_mask_to_vector(mask))
+    assert v.shape == (6, 1)
+    m2 = np.asarray(ts.reshape_vector_to_time_series_mask(v, 2))
+    np.testing.assert_array_equal(m2, mask)
+
+
+def test_masked_pooling_matches_manual():
+    from deeplearning4j_tpu.util.timeseries import (
+        masked_pooling_convolution, masked_pooling_time_series)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+
+    mx = np.asarray(masked_pooling_time_series("max", x, mask))
+    np.testing.assert_allclose(mx[0], x[0, :3].max(0), rtol=1e-6)
+    np.testing.assert_allclose(mx[1], x[1].max(0), rtol=1e-6)
+
+    avg = np.asarray(masked_pooling_time_series("avg", x, mask))
+    np.testing.assert_allclose(avg[0], x[0, :3].mean(0), rtol=1e-5)
+
+    s = np.asarray(masked_pooling_time_series("sum", x, mask))
+    np.testing.assert_allclose(s[0], x[0, :3].sum(0), rtol=1e-5)
+
+    pn = np.asarray(masked_pooling_time_series("pnorm", x, mask, pnorm=2))
+    np.testing.assert_allclose(
+        pn[0], np.sqrt((np.abs(x[0, :3]) ** 2).sum(0)), rtol=1e-5)
+
+    # CNN variant: NHWC with a [B,H,W] mask
+    img = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+    imask = np.zeros((1, 4, 4), np.float32)
+    imask[0, :2, :2] = 1.0
+    mavg = np.asarray(masked_pooling_convolution("avg", img, imask))
+    np.testing.assert_allclose(mavg[0], img[0, :2, :2].reshape(-1, 2).mean(0),
+                               rtol=1e-5)
+
+
+def test_quadtree_structure_and_forces():
+    from deeplearning4j_tpu.clustering.quadtree import QuadTree
+
+    rng = np.random.default_rng(42)
+    pts = rng.normal(size=(64, 2))
+    tree = QuadTree(pts)
+    assert tree.cum_size == 64
+    np.testing.assert_allclose(tree.center_of_mass, pts.mean(0), atol=1e-8)
+    assert tree.depth() > 1
+
+    # theta=0 forces exact evaluation -> matches brute-force repulsion
+    i = 7
+    neg = np.zeros(2)
+    sum_q = tree.compute_non_edge_forces(i, 0.0, neg)
+    diff = pts[i] - pts  # [n, 2]
+    d2 = (diff ** 2).sum(1)
+    q = 1.0 / (1.0 + d2)
+    q[i] = 0.0
+    expect_sum_q = q.sum()
+    expect_neg = (q[:, None] ** 2 * diff).sum(0)
+    np.testing.assert_allclose(sum_q, expect_sum_q, rtol=1e-8)
+    np.testing.assert_allclose(neg, expect_neg, rtol=1e-8)
+
+    # theta>0 approximates it
+    neg_a = np.zeros(2)
+    sq_a = tree.compute_non_edge_forces(i, 0.5, neg_a)
+    assert abs(sq_a - expect_sum_q) / expect_sum_q < 0.15
+
+
+def test_magic_queue_round_robin_and_global():
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+    from deeplearning4j_tpu.parallel.magicqueue import (AsyncIterator,
+                                                        MagicQueue)
+
+    q = MagicQueue(num_devices=4)
+    for i in range(8):
+        q.put(DataSet(np.full((2, 3), i, np.float32),
+                      np.full((2, 1), i, np.float32)))
+    assert q.size() == 2  # two complete rounds
+    g = q.next_global()
+    assert g.features.shape == (8, 3)  # one batch from every bucket
+    assert sorted(set(g.features[:, 0])) == [0.0, 1.0, 2.0, 3.0]
+    # device 0's remaining batch is the round-2 one
+    assert q.poll(0).features[0, 0] == 4
+    assert q.poll(0) is None
+    assert not q.is_empty()
+
+    items = list(AsyncIterator(range(10), buffer_size=3))
+    assert items == list(range(10))
+
+    def boom():
+        yield 1
+        raise RuntimeError("worker died")
+
+    it = AsyncIterator(boom())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError):
+        next(it)
+
+
+def test_mllib_util_and_spark_utils(tmp_path):
+    from deeplearning4j_tpu.scaleout.util import (
+        from_labeled_point, pad_to_multiple, read_object_from_file,
+        repartition_balanced, split_data, to_labeled_point,
+        write_object_to_file)
+    from deeplearning4j_tpu.datasets.iterators import DataSet
+
+    feats = np.arange(12.0).reshape(6, 2)
+    labels = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+    pts = to_labeled_point(feats, labels)
+    assert [p.label for p in pts] == [0, 1, 2, 0, 1, 2]
+    ds = from_labeled_point(pts, 3)
+    np.testing.assert_array_equal(ds.features, feats)
+    np.testing.assert_array_equal(ds.labels, labels)
+
+    parts = repartition_balanced(feats, labels, 4)
+    sizes = [p[0].shape[0] for p in parts]
+    assert sum(sizes) == 6 and max(sizes) - min(sizes) <= 1
+
+    f, l, n = pad_to_multiple(feats, labels, 4)
+    assert f.shape[0] == 8 and n == 6
+    np.testing.assert_array_equal(f[6], f[5])
+
+    datasets = [DataSet(feats[i:i + 1], labels[i:i + 1]) for i in range(6)]
+    train, test = split_data(datasets, 2 / 3, seed=1)
+    assert len(train) == 4 and len(test) == 2
+
+    path = str(tmp_path / "obj.pkl")
+    write_object_to_file(path, {"a": 1})
+    assert read_object_from_file(path) == {"a": 1}
+
+
+def test_vanilla_stats_storage_router():
+    from deeplearning4j_tpu.scaleout.listeners import (
+        VanillaStatsStorageRouter)
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, Persistable
+
+    router = VanillaStatsStorageRouter()
+    rec = Persistable(session_id="s1", type_id="t", worker_id="w0",
+                      timestamp=1.0, score=0.5)
+    router.put_update(rec)
+    router.put_static_info(Persistable(session_id="s1", type_id="t",
+                                       worker_id="w0", timestamp=0.0))
+    assert len(router.updates) == 1
+    storage = InMemoryStatsStorage()
+    moved = router.drain_to(storage)
+    assert moved == 2
+    assert router.updates == [] and router.static_info == []
+    assert "s1" in storage.list_session_ids()
+
+
+def test_distributed_sequencevectors_vocab_and_fit():
+    from deeplearning4j_tpu.scaleout.sequencevectors import (
+        SparkWord2Vec, count_partition, merge_counters)
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+    corpus = ["the cat sat on the mat",
+              "the dog sat on the log",
+              "cats and dogs are animals",
+              "the cat chased the dog"] * 6
+    tok = DefaultTokenizerFactory()
+    c1 = count_partition(corpus[:12], tok)
+    c2 = count_partition(corpus[12:], tok)
+    merged = merge_counters([c1, c2])
+    assert merged["the"] == c1["the"] + c2["the"]
+
+    w2v = SparkWord2Vec(sentences=corpus, num_partitions=3, layer_size=16,
+                        window=2, epochs=2, negative=3, seed=5,
+                        min_word_frequency=1)
+    w2v.fit()
+    assert w2v.vocab.contains_word("cat")
+    assert w2v.word_vector("cat").shape == (16,)
+    assert -1.0 <= w2v.similarity("cat", "dog") <= 1.0
+
+
+def test_word2vec_dataset_iterator():
+    from deeplearning4j_tpu.nlp.dataset_iterators import (
+        Word2VecDataSetIterator, windows)
+    from deeplearning4j_tpu.nlp.sentenceiterator import (LabelAwareIterator,
+                                                         LabelledDocument)
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    ws = windows(["a", "b", "c"], 3)
+    assert len(ws) == 3
+    assert ws[0].get_words() == ["<s>", "a", "b"]
+    assert ws[2].get_words() == ["b", "c", "</s>"]
+
+    sents = ["good great fine nice good", "bad awful poor bad sad"] * 4
+    vec = Word2Vec(sentences=sents, layer_size=8, window=2, epochs=2,
+                   min_word_frequency=1, seed=3)
+    vec.fit()
+
+    docs = [LabelledDocument("good great fine", ["pos"]),
+            LabelledDocument("bad awful poor", ["neg"])]
+    it = Word2VecDataSetIterator(vec, LabelAwareIterator(docs),
+                                 labels=["pos", "neg"], batch=4,
+                                 window_size=3)
+    assert it.num_examples() == 6
+    assert it.input_columns() == 3 * 8
+    batches = list(it)
+    assert batches[0].features.shape == (4, 24)
+    assert batches[1].features.shape == (2, 24)
+    # every window of doc 0 is labelled pos
+    np.testing.assert_array_equal(batches[0].labels[0], [1.0, 0.0])
+    # featurization uses real vectors: the centre word's slice is non-zero
+    assert np.abs(batches[0].features[1, 8:16]).sum() > 0
