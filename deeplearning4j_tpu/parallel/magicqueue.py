@@ -81,9 +81,22 @@ class MagicQueue:
     def next_global(self, timeout: Optional[float] = None):
         """Take one batch from every device bucket and stack features/
         labels along the batch axis — the global batch a sharded-jit
-        step consumes (TPU-native composition; no reference analog)."""
-        items = [self._buckets[d].get(timeout=timeout)
-                 for d in range(self.num_devices)]
+        step consumes (TPU-native composition; no reference analog).
+
+        All-or-nothing: if any bucket can't supply a batch (immediately
+        with the default timeout=None, else within `timeout` seconds),
+        already-dequeued batches are returned to their buckets and
+        queue.Empty is raised — a partial tail-of-epoch round is never
+        silently dropped and never deadlocks the training loop."""
+        items = []
+        try:
+            for d in range(self.num_devices):
+                items.append(self._buckets[d].get(
+                    block=timeout is not None, timeout=timeout))
+        except queue.Empty:
+            for d, item in enumerate(items):
+                self._buckets[d].put_nowait(item)
+            raise
         first = items[0]
         if hasattr(first, "features"):
             feats = np.concatenate([np.asarray(i.features) for i in items], 0)
@@ -119,8 +132,11 @@ class AsyncIterator:
         return self
 
     def __next__(self):
+        if getattr(self, "_finished", False):
+            raise StopIteration
         item = self._queue.get()
         if item is self._DONE:
+            self._finished = True
             if self._exc is not None:
                 raise self._exc
             raise StopIteration

@@ -29,33 +29,40 @@ from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
 from deeplearning4j_tpu.nlp.vocab import (AbstractCache, VocabWord,
                                           build_huffman_tree)
 from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.util.berkeley import Counter
 
 
 def count_partition(sentences: Sequence[str],
                     tokenizer: TokenizerFactory) -> dict:
     """Frequency counter for one corpus partition — the map side of
-    `SparkSequenceVectors.fit()`'s distributed vocab count. Uses the
-    native C++ parallel counter when built."""
-    from deeplearning4j_tpu import native_bridge
-    text = "\n".join(sentences)
-    counts = native_bridge.vocab_count(text, lowercase=True, min_count=1)
-    if counts is not None:
-        return counts
-    out: dict = {}
+    `SparkSequenceVectors.fit()`'s distributed vocab count. The native
+    C++ parallel counter is used only when its tokenization (whitespace
+    split, no preprocessing) matches the given tokenizer exactly, so the
+    vocabulary always agrees with the tokens `_sequences()` emits at
+    training time; any custom factory/preprocessor takes the Python
+    path."""
+    plain_whitespace = (type(tokenizer) is DefaultTokenizerFactory
+                       and tokenizer._pre is None)
+    if plain_whitespace:
+        from deeplearning4j_tpu import native_bridge
+        counts = native_bridge.vocab_count("\n".join(sentences),
+                                           lowercase=False, min_count=1)
+        if counts is not None:
+            return counts
+    counter: Counter = Counter()
     for s in sentences:
-        for tok in tokenizer.create(s).get_tokens():
-            out[tok] = out.get(tok, 0) + 1
-    return out
+        counter.increment_all(tokenizer.create(s).get_tokens())
+    return {w: int(n) for w, n in counter.items()}
 
 
 def merge_counters(counters: Iterable[dict]) -> dict:
     """Reduce side: merge per-partition counters
     (`SparkSequenceVectors` treeAggregate of Counter<T>)."""
-    merged: dict = {}
+    merged: Counter = Counter()
     for c in counters:
         for w, n in c.items():
-            merged[w] = merged.get(w, 0) + n
-    return merged
+            merged.increment_count(w, n)
+    return {w: int(n) for w, n in merged.items()}
 
 
 class DistributedSequenceVectors(SequenceVectors):

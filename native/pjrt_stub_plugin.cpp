@@ -1,0 +1,328 @@
+// Hermetic stub PJRT plugin — the bridge's CI test double.
+//
+// Implements just enough of the PJRT C API (pjrt_c_api.h) to exercise
+// every code path in pjrt_bridge.cpp without TPU hardware: one fake
+// device whose "HBM" is host memory, a "compiler" that recognises two
+// one-op programs by substring ("stablehlo.add" / "stablehlo.multiply"
+// in an MLIR module with two f32 arguments), and a synchronous executor
+// that applies the op elementwise. This mirrors the reference's test
+// philosophy of a pluggable backend under one test suite (SURVEY §4:
+// the nd4j-native "fake" backend standing in for CUDA): the bridge's
+// protocol handling — struct_size conventions, error and event
+// lifecycles, buffer transfer, execute marshalling — is the code under
+// test; real compilation belongs to libtpu/XLA behind the same ABI.
+//
+// Not derived from any OpenXLA implementation; written against the
+// header's documented contracts only.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+// Opaque API types are defined here, in the implementation.
+struct PJRT_Error {
+  std::string message;
+  PJRT_Error_Code code;
+};
+
+struct PJRT_Device {
+  int id;
+};
+
+struct PJRT_Client {
+  PJRT_Device device{0};
+  std::vector<PJRT_Device*> devices;
+};
+
+struct PJRT_Event {};  // stub events are born ready
+
+struct PJRT_Buffer {
+  std::vector<uint8_t> data;
+  std::vector<int64_t> dims;
+  PJRT_Buffer_Type type;
+};
+
+struct PJRT_Executable {
+  std::string op;  // "add" | "mul"
+};
+
+struct PJRT_LoadedExecutable {
+  PJRT_Executable exec;
+};
+
+namespace {
+
+PJRT_Error* make_error(PJRT_Error_Code code, const std::string& msg) {
+  return new PJRT_Error{msg, code};
+}
+
+size_t dtype_size(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+      return 8;
+    default:
+      return 0;
+  }
+}
+
+// ---- error ----
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) { delete args->error; }
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  args->message = args->error->message.c_str();
+  args->message_size = args->error->message.size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = args->error->code;
+  return nullptr;
+}
+
+// ---- plugin / event ----
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  delete args->event;
+  return nullptr;
+}
+
+PJRT_Error* EventIsReady(PJRT_Event_IsReady_Args* args) {
+  args->is_ready = true;
+  return nullptr;
+}
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args*) { return nullptr; }
+
+// ---- client ----
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  auto* client = new PJRT_Client();
+  client->devices.push_back(&client->device);
+  args->client = client;
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args* args) {
+  delete args->client;
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "dl4j_stub";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
+  args->devices = args->client->devices.data();
+  args->num_devices = args->client->devices.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = args->client->devices.data();
+  args->num_addressable_devices = args->client->devices.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  std::string fmt(args->program->format, args->program->format_size);
+  if (fmt != "mlir") {
+    return make_error(PJRT_Error_Code_UNIMPLEMENTED,
+                      "stub compiles only 'mlir' programs, got: " + fmt);
+  }
+  std::string code(args->program->code, args->program->code_size);
+  std::string op;
+  if (code.find("stablehlo.add") != std::string::npos) {
+    op = "add";
+  } else if (code.find("stablehlo.multiply") != std::string::npos) {
+    op = "mul";
+  } else {
+    return make_error(
+        PJRT_Error_Code_UNIMPLEMENTED,
+        "stub recognises only stablehlo.add / stablehlo.multiply");
+  }
+  auto* le = new PJRT_LoadedExecutable();
+  le->exec.op = op;
+  args->executable = le;
+  return nullptr;
+}
+
+PJRT_Error* BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (args->num_byte_strides != 0) {
+    return make_error(PJRT_Error_Code_UNIMPLEMENTED,
+                      "stub supports dense layouts only");
+  }
+  size_t elems = 1;
+  for (size_t i = 0; i < args->num_dims; ++i) {
+    elems *= static_cast<size_t>(args->dims[i]);
+  }
+  size_t nbytes = elems * dtype_size(args->type);
+  auto* buf = new PJRT_Buffer();
+  buf->type = args->type;
+  buf->dims.assign(args->dims, args->dims + args->num_dims);
+  buf->data.resize(nbytes);
+  std::memcpy(buf->data.data(), args->data, nbytes);
+  args->buffer = buf;
+  args->done_with_host_buffer = new PJRT_Event();
+  return nullptr;
+}
+
+// ---- executable ----
+PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args*) {
+  // stub: PJRT_Executable* aliases the loaded executable's member —
+  // the loaded executable owns it
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableDestroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  delete args->executable;
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableGetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = &args->loaded_executable->exec;
+  return nullptr;
+}
+
+PJRT_Error* ExecutableNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableExecute(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->num_devices != 1) {
+    return make_error(PJRT_Error_Code_UNIMPLEMENTED,
+                      "stub executes on exactly one device");
+  }
+  if (args->num_args != 2) {
+    return make_error(PJRT_Error_Code_INVALID_ARGUMENT,
+                      "stub programs take exactly two arguments");
+  }
+  const PJRT_Buffer* a = args->argument_lists[0][0];
+  const PJRT_Buffer* b = args->argument_lists[0][1];
+  if (a->type != PJRT_Buffer_Type_F32 || b->type != PJRT_Buffer_Type_F32 ||
+      a->data.size() != b->data.size()) {
+    return make_error(PJRT_Error_Code_INVALID_ARGUMENT,
+                      "stub needs two equal-shape f32 buffers");
+  }
+  auto* out = new PJRT_Buffer();
+  out->type = a->type;
+  out->dims = a->dims;
+  out->data.resize(a->data.size());
+  const float* fa = reinterpret_cast<const float*>(a->data.data());
+  const float* fb = reinterpret_cast<const float*>(b->data.data());
+  float* fo = reinterpret_cast<float*>(out->data.data());
+  size_t n = a->data.size() / sizeof(float);
+  const std::string& op = args->executable->exec.op;
+  for (size_t i = 0; i < n; ++i) {
+    fo[i] = op == "add" ? fa[i] + fb[i] : fa[i] * fb[i];
+  }
+  args->output_lists[0][0] = out;
+  if (args->device_complete_events != nullptr) {
+    args->device_complete_events[0] = new PJRT_Event();
+  }
+  return nullptr;
+}
+
+// ---- buffer ----
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  delete args->buffer;
+  return nullptr;
+}
+
+PJRT_Error* BufferElementType(PJRT_Buffer_ElementType_Args* args) {
+  args->type = args->buffer->type;
+  return nullptr;
+}
+
+PJRT_Error* BufferDimensions(PJRT_Buffer_Dimensions_Args* args) {
+  args->dims = args->buffer->dims.data();
+  args->num_dims = args->buffer->dims.size();
+  return nullptr;
+}
+
+PJRT_Error* BufferOnDeviceSizeInBytes(
+    PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes = args->buffer->data.size();
+  return nullptr;
+}
+
+PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  if (args->dst == nullptr) {
+    args->dst_size = args->src->data.size();
+    return nullptr;
+  }
+  if (args->dst_size < args->src->data.size()) {
+    return make_error(PJRT_Error_Code_INVALID_ARGUMENT,
+                      "dst buffer too small");
+  }
+  std::memcpy(args->dst, args->src->data.data(), args->src->data.size());
+  args->event = new PJRT_Event();
+  return nullptr;
+}
+
+PJRT_Api* build_api() {
+  static PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_GetCode = ErrorGetCode;
+  api.PJRT_Plugin_Initialize = PluginInitialize;
+  api.PJRT_Event_Destroy = EventDestroy;
+  api.PJRT_Event_IsReady = EventIsReady;
+  api.PJRT_Event_Await = EventAwait;
+  api.PJRT_Client_Create = ClientCreate;
+  api.PJRT_Client_Destroy = ClientDestroy;
+  api.PJRT_Client_PlatformName = ClientPlatformName;
+  api.PJRT_Client_Devices = ClientDevices;
+  api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+  api.PJRT_Client_Compile = ClientCompile;
+  api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+  api.PJRT_Executable_Destroy = ExecutableDestroy;
+  api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
+  api.PJRT_LoadedExecutable_Destroy = LoadedExecutableDestroy;
+  api.PJRT_LoadedExecutable_GetExecutable = LoadedExecutableGetExecutable;
+  api.PJRT_LoadedExecutable_Execute = LoadedExecutableExecute;
+  api.PJRT_Buffer_Destroy = BufferDestroy;
+  api.PJRT_Buffer_ElementType = BufferElementType;
+  api.PJRT_Buffer_Dimensions = BufferDimensions;
+  api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSizeInBytes;
+  api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
+  return &api;
+}
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() { return build_api(); }
+
+}  // extern "C"

@@ -264,3 +264,24 @@ def test_word2vec_dataset_iterator():
     np.testing.assert_array_equal(batches[0].labels[0], [1.0, 0.0])
     # featurization uses real vectors: the centre word's slice is non-zero
     assert np.abs(batches[0].features[1, 8:16]).sum() > 0
+
+
+def test_magic_queue_partial_round_restores_items():
+    import queue as _queue
+
+    from deeplearning4j_tpu.parallel.magicqueue import (AsyncIterator,
+                                                        MagicQueue)
+
+    q = MagicQueue(num_devices=4)
+    for i in range(2):  # only half a round
+        q.put(i)
+    with pytest.raises(_queue.Empty):
+        q.next_global()
+    # nothing lost: both items still pollable from their buckets
+    assert q.poll(0) == 0 and q.poll(1) == 1
+
+    # exhausted AsyncIterator keeps raising StopIteration
+    it = AsyncIterator([])
+    with pytest.raises(StopIteration):
+        next(it)
+    assert next(it, "sentinel") == "sentinel"
