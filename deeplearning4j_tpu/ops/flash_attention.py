@@ -16,10 +16,14 @@ holds one q block plus the full K/V rows for its batch-head in VMEM
 sequence parallelism instead: parallel/ring.py shards T across the mesh
 and calls this kernel on local blocks.
 
-Backward pass: recompute (flash-attention's own trick, and the
-`jax.checkpoint` idiom): the VJP re-runs the jnp reference attention
-under vjp, trading FLOPs for never materializing [T,S] probabilities in
-HBM during the forward.
+Backward pass: Pallas kernels too (Dao et al.'s two-kernel split). The
+forward additionally emits the per-row logsumexp; the backward
+recomputes probabilities blockwise from (q, k, lse) in VMEM — never
+materializing [T,S] in HBM in either direction — with one kernel
+gridded over q-blocks producing dQ and one over k-blocks producing
+dK/dV. Shapes the kernels can't tile (kv length not block-divisible)
+fall back to a jnp-recompute VJP, same dispatch philosophy as the
+forward.
 """
 from __future__ import annotations
 
@@ -50,8 +54,29 @@ def _reference_attention(q, k, v, scale: float, causal: bool,
     return jnp.einsum("bts,bsd->btd", p.astype(q.dtype), v)
 
 
-def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *,
-                  scale: float, causal: bool):
+def _masked_scores(q, k, scale, causal, qi_base, ki_base):
+    """Scaled (and causally masked) score block — the one definition
+    shared by the forward and both backward kernels so their masking
+    can never drift apart. Returns (scores, valid) where valid is the
+    boolean keep-mask (None when not causal): the backward must zero
+    dS at masked positions, because in the reference formulation the
+    mask's where() makes masked scores constants that carry no
+    gradient — p=0 handles that for ordinary rows, but a fully-masked
+    row has uniform nonzero p and still must not push gradient into
+    q/k."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if not causal:
+        return s, None
+    qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi_base
+    ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki_base
+    valid = qi >= ki
+    return jnp.where(valid, s, NEG_INF), valid
+
+
+def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                  logl_ref, *, scale: float, causal: bool):
     """One (batch-head, q-block) program: full-K online attention.
 
     qo_ref/ko_ref: [1,1] SMEM global position offsets (sequence-parallel
@@ -62,14 +87,9 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *,
     q = q_ref[0]                      # [BQ, D]
     k = k_ref[0]                      # [S, D]
     v = v_ref[0]                      # [S, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale     # [BQ, S]
-    if causal:
-        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-            + pl.program_id(1) * q.shape[0] + qo_ref[0, 0]
-        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ko_ref[0, 0]
-        s = jnp.where(qi >= ki, s, NEG_INF)
+    s, _ = _masked_scores(q, k, scale, causal,
+                          pl.program_id(1) * q.shape[0] + qo_ref[0, 0],
+                          ko_ref[0, 0])                 # [BQ, S]
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -77,6 +97,130 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *,
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) / l
     o_ref[0] = o.astype(o_ref.dtype)
+    # Softmax statistics saved for the Pallas backward, as SEPARATE
+    # [BQ, 1] columns (trailing singleton keeps TPU block tiling happy).
+    # m and log(l) must not be pre-summed into one logsumexp: for a
+    # fully-masked row m is -1e30 and log(l)=log(S) would be absorbed
+    # by f32 rounding, making the backward reconstruct p=1 instead of
+    # the forward's uniform 1/S. exp((s - m) - log l) is exact.
+    m_ref[0] = m
+    logl_ref[0] = jnp.log(l)
+
+
+def _flash_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
+                     logl_ref, delta_ref, dq_ref, *, scale: float,
+                     causal: bool):
+    """One (batch-head, q-block) program of the backward: recompute this
+    block's probabilities from the saved softmax statistics, then
+    dS = P ∘ (dO Vᵀ − Δ), dQ = dS K · scale."""
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0]                      # [BQ, D]
+    k = k_ref[0]                      # [S, D]
+    v = v_ref[0]                      # [S, D]
+    do = do_ref[0]                    # [BQ, D]
+    s, valid = _masked_scores(q, k, scale, causal,
+                              pl.program_id(1) * q.shape[0] + qo_ref[0, 0],
+                              ko_ref[0, 0])             # [BQ, S]
+    p = jnp.exp((s - m_ref[0]) - logl_ref[0])           # [BQ, S]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BQ, S]
+    ds = p * (dp - delta_ref[0])                        # [BQ, S]
+    if valid is not None:
+        ds = jnp.where(valid, ds, 0.0)
+    dq = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
+                      logl_ref, delta_ref, dk_ref, dv_ref, *,
+                      scale: float, causal: bool):
+    """One (batch-head, k-block) program of the backward: full Q rows vs
+    this key block; dV = Pᵀ dO, dK = dSᵀ Q · scale."""
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0]                      # [T, D]
+    k = k_ref[0]                      # [BK, D]
+    v = v_ref[0]                      # [BK, D]
+    do = do_ref[0]                    # [T, D]
+    s, valid = _masked_scores(q, k, scale, causal, qo_ref[0, 0],
+                              pl.program_id(1) * k.shape[0] + ko_ref[0, 0])
+    p = jnp.exp((s - m_ref[0]) - logl_ref[0])           # [T, BK]
+    dv = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [BK, D]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)             # [T, BK]
+    ds = p * (dp - delta_ref[0])                        # [T, BK]
+    if valid is not None:
+        ds = jnp.where(valid, ds, 0.0)
+    dk = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
+                    kv_offset, interpret):
+    """Pallas backward: dQ gridded over q-blocks, dK/dV over k-blocks."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(BLOCK_Q, tq)
+    bk = min(BLOCK_Q, sk)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
+    # Δ_i = Σ_d dO_id · O_id — rowwise, XLA fuses this into one pass
+    delta = jnp.sum(g.astype(jnp.float32) * o3.astype(jnp.float32), -1,
+                    keepdims=True)                       # [BH, T, 1]
+
+    smem = functools.partial(pl.BlockSpec, (1, 1), lambda b, i: (0, 0),
+                             memory_space=pltpu.SMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+        grid=(bh, tq // bq),
+        in_specs=[
+            smem(), smem(),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qo, ko, q3, k3, v3, g, m, logl, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal),
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
+        grid=(bh, sk // bk),
+        in_specs=[
+            smem(), smem(),
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0))],
+        interpret=interpret,
+    )(qo, ko, q3, k3, v3, g, m, logl, delta)
+    return dq, dk, dv
 
 
 def _flash_forward(q3, k3, v3, scale: float, causal: bool,
@@ -93,7 +237,9 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1), lambda b, i: (0, 0),
@@ -104,7 +250,9 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0))],
         interpret=interpret,
     )(qo, ko, q3, k3, v3)
 
@@ -112,19 +260,24 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention3(q3, k3, v3, scale, causal, q_offset, kv_offset,
                       interpret):
-    return _flash_forward(q3, k3, v3, scale, causal, q_offset, kv_offset,
-                          interpret)
+    out, _, _ = _flash_forward(q3, k3, v3, scale, causal, q_offset,
+                               kv_offset, interpret)
+    return out
 
 
 def _fwd(q3, k3, v3, scale, causal, q_offset, kv_offset, interpret):
-    out = _flash_forward(q3, k3, v3, scale, causal, q_offset, kv_offset,
-                         interpret)
-    return out, (q3, k3, v3)
+    out, m, logl = _flash_forward(q3, k3, v3, scale, causal, q_offset,
+                                  kv_offset, interpret)
+    return out, (q3, k3, v3, out, m, logl)
 
 
 def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
-    q3, k3, v3 = res
-    # recompute-based backward (see module docstring)
+    q3, k3, v3, o3, m, logl = res
+    sk = k3.shape[1]
+    if sk % min(BLOCK_Q, sk) == 0:
+        return _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal,
+                               q_offset, kv_offset, interpret)
+    # kv length doesn't tile: jnp-recompute fallback
     _, vjp = jax.vjp(
         lambda q, k, v: _reference_attention(q, k, v, scale, causal,
                                              q_offset, kv_offset),

@@ -97,3 +97,31 @@ def test_eligibility_rules():
     os.environ["DL4JTPU_FLASH"] = "interpret"
     q_small = _rand((1, 5, 2, 16), 0)
     assert not flash_attention_available(q_small, q_small, None)
+
+
+def test_gradients_with_fully_masked_rows():
+    """kv_offset > q_offset creates causal rows with zero valid keys;
+    the forward degenerates to a uniform average and the Pallas
+    backward must reproduce the reference VJP exactly (regression:
+    a single pre-summed logsumexp lost log(l) to f32 rounding on
+    those rows, inflating p from 1/S to 1)."""
+    b, t, h, d = 1, 128, 2, 16
+    q, k, v = (_rand((b, t, h, d), s) for s in (7, 8, 9))
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                q_offset=0, kv_offset=64) ** 2).sum()
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+
+    os.environ["DL4JTPU_FLASH"] = "0"
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True,
+                                      q_offset=0, kv_offset=64) ** 2).sum()
+
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    os.environ["DL4JTPU_FLASH"] = "interpret"
+    for g1, g2 in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-5)
