@@ -112,3 +112,21 @@ class Word2VecDataSetIterator:
 
     def num_examples(self) -> int:
         return len(self._windows)
+
+
+class InputHomogenization:
+    """Text normalization ahead of windowing (reference:
+    text/inputsanitation/InputHomogenization.java — lowercases and
+    strips punctuation, optionally preserving a given character list,
+    so window features are case/punctuation-invariant)."""
+
+    def __init__(self, input_text: str, preserve: Sequence[str] = ()):
+        self._input = input_text
+        self._preserve = set(preserve)
+
+    def transform(self) -> str:
+        out = []
+        for ch in self._input:
+            if ch.isalnum() or ch.isspace() or ch in self._preserve:
+                out.append(ch.lower())
+        return "".join(out)

@@ -5,12 +5,17 @@ from deeplearning4j_tpu.scaleout.training_master import (
     SparkDl4jMultiLayer, SparkComputationGraph)
 from deeplearning4j_tpu.scaleout.stats import (SparkTrainingStats,
                                                timed_phase)
-from deeplearning4j_tpu.scaleout.parallel_trainer import \
-    EarlyStoppingParallelTrainer
+from deeplearning4j_tpu.scaleout.parallel_trainer import (
+    EarlyStoppingParallelTrainer, SparkEarlyStoppingTrainer)
+from deeplearning4j_tpu.scaleout.listeners import VanillaStatsStorageRouter
+from deeplearning4j_tpu.scaleout.sequencevectors import (
+    DistributedSequenceVectors, SparkWord2Vec)
 
 __all__ = [
     "TrainingMaster", "ParameterAveragingTrainingMaster",
     "DistributedDl4jMultiLayer", "DistributedComputationGraph",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "SparkTrainingStats",
     "timed_phase", "EarlyStoppingParallelTrainer",
+    "SparkEarlyStoppingTrainer", "VanillaStatsStorageRouter",
+    "DistributedSequenceVectors", "SparkWord2Vec",
 ]

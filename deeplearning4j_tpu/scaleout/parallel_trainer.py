@@ -29,3 +29,33 @@ class EarlyStoppingParallelTrainer(BaseEarlyStoppingTrainer):
         feats, labs, fmask, lmask = _unpack_batch(batch)
         self.wrapper.fit(feats, labs,
                          lmask if lmask is not None else fmask)
+
+
+class SparkEarlyStoppingTrainer(BaseEarlyStoppingTrainer):
+    """Early stopping driven through the cluster-style distributed
+    wrappers (reference: dl4j-spark/.../earlystopping/
+    BaseSparkEarlyStoppingTrainer.java + SparkEarlyStoppingTrainer —
+    each epoch fits via SparkDl4jMultiLayer/TrainingMaster instead of
+    local fit). Here each epoch's batches run through a
+    DistributedDl4jMultiLayer/DistributedComputationGraph, whose
+    TrainingMaster shards the global batch over the mesh; the
+    early-stopping control loop (score calculators, termination
+    conditions, model savers) is the shared base."""
+
+    def __init__(self, config: EarlyStoppingConfiguration,
+                 distributed_model, train_iter):
+        # the underlying net is what score calculators / savers see
+        super().__init__(config, distributed_model.get_network(),
+                         train_iter)
+        self.distributed = distributed_model
+
+    def _fit_batch(self, batch) -> None:
+        feats, labs, fmask, lmask = _unpack_batch(batch)
+        mask = lmask if lmask is not None else fmask
+        if mask is not None:
+            # the TrainingMaster facade fits plain arrays; masked
+            # (padded-sequence) batches go through the underlying
+            # sharded wrapper, which honors them
+            self.distributed.pw.fit(feats, labs, mask)
+        else:
+            self.distributed.fit(feats, labs)

@@ -105,3 +105,31 @@ def test_stats_timed_phase():
         pass
     assert set(st.get_keys()) == {"broadcast", "fit"}
     assert st.total_ms("fit") >= 0
+
+
+def test_spark_early_stopping_trainer(devices8):
+    """Reference: BaseSparkEarlyStoppingTrainer — early stopping whose
+    per-epoch fitting goes through the cluster wrapper instead of local
+    fit."""
+    from deeplearning4j_tpu.earlystopping.scorecalc import (
+        DataSetLossCalculator)
+    from deeplearning4j_tpu.scaleout.parallel_trainer import (
+        SparkEarlyStoppingTrainer)
+
+    x, y = _data(64, seed=5)
+    batches = [DataSet(x[i:i + 32], y[i:i + 32]) for i in (0, 32)]
+    net = _make_net(seed=2)
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=4)
+          .workers(8).build())
+    dist = SparkDl4jMultiLayer(net, tm)
+    conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        score_calculator=DataSetLossCalculator(
+            ListDataSetIterator(batches, 32)))
+    result = SparkEarlyStoppingTrainer(
+        conf, dist, ListDataSetIterator(batches, 32)).fit()
+    assert result.total_epochs == 3
+    assert result.best_model is not None
+    scores = list(result.score_vs_epoch.values())
+    assert all(np.isfinite(s) for s in scores)
+    assert result.best_model_score == min(scores)
