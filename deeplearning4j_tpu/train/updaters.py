@@ -79,8 +79,12 @@ def compute_learning_rate(tc: TrainingConfig, iteration) -> Array:
 def _init_leaf(updater: str, p: Array) -> Dict[str, Array]:
     # Each slot gets its OWN zeros buffer — the train step donates the whole
     # opt-state pytree, and XLA rejects the same buffer donated twice.
+    # State is kept in ≥f32 regardless of param dtype: moments in bf16
+    # lose too much precision, and the update math below runs in f32
+    # anyway (f32 lr), so this also keeps the state dtype stable across
+    # steps (a lax.scan carry requirement for fit_batched).
     def z():
-        return jnp.zeros(p.shape, p.dtype)
+        return jnp.zeros(p.shape, jnp.promote_types(p.dtype, jnp.float32))
 
     u = updater.lower()
     if u in ("sgd", "none"):
@@ -101,6 +105,8 @@ def _init_leaf(updater: str, p: Array) -> Dict[str, Array]:
 def _update_leaf(updater: str, tc: TrainingConfig, g: Array,
                  s: Dict[str, Array], lr, t) -> Tuple[Array, Dict[str, Array]]:
     """Returns (update, new_state); caller applies params -= update."""
+    # update math in ≥f32 (state is ≥f32, see _init_leaf)
+    g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
     u = updater.lower()
     if u == "none":
         return jnp.zeros_like(g), s

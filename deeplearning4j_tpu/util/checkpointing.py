@@ -22,8 +22,12 @@ import shutil
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+import ml_dtypes
 import numpy as np
 import jax
+
+# same-width integer container for dtypes numpy can't round-trip via npz
+_UINT_OF_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32}
 
 from deeplearning4j_tpu.train.listeners import IterationListener
 
@@ -67,14 +71,23 @@ class CheckpointManager:
             d = self.directory / f"step_{step}"
             d.mkdir(parents=True, exist_ok=True)
             flat = {}
+            exotic: Dict[str, str] = {}
             for k, tree in payload.items():
                 leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
                 for path, leaf in leaves:
                     name = k + "|" + "/".join(
                         str(getattr(p, "key", getattr(p, "idx", p)))
                         for p in path)
-                    flat[name] = np.asarray(leaf)
+                    a = np.asarray(leaf)
+                    # np.load returns raw void for ml_dtypes dtypes
+                    # (bf16/fp8); persist them as same-width uints plus a
+                    # dtype sidecar so the round-trip is exact.
+                    if not hasattr(np, a.dtype.name):
+                        exotic[name] = a.dtype.name
+                        a = a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
+                    flat[name] = a
             np.savez(d / "arrays.npz", **flat)
+            (d / "dtypes.json").write_text(json.dumps(exotic))
             self._retain()
         meta = {"step": step,
                 "iteration_count": int(net.iteration_count),
@@ -118,7 +131,11 @@ class CheckpointManager:
             restored = self._ocp_mgr.restore(
                 step, args=ocp.args.StandardRestore(template))
         else:
-            data = np.load(self.directory / f"step_{step}" / "arrays.npz")
+            d = self.directory / f"step_{step}"
+            data = np.load(d / "arrays.npz")
+            exotic: Dict[str, str] = {}
+            if (d / "dtypes.json").exists():
+                exotic = json.loads((d / "dtypes.json").read_text())
             restored = {}
             for k, tree in template.items():
                 leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
@@ -127,12 +144,22 @@ class CheckpointManager:
                     name = k + "|" + "/".join(
                         str(getattr(p, "key", getattr(p, "idx", p)))
                         for p in path)
-                    vals.append(jax.numpy.asarray(data[name]))
+                    a = data[name]
+                    if name in exotic:
+                        a = a.view(getattr(ml_dtypes, exotic[name]))
+                    vals.append(jax.numpy.asarray(a))
                 restored[k] = jax.tree_util.tree_unflatten(
                     jax.tree_util.tree_structure(tree), vals)
         net.params = restored["params"]
         net.state = restored["state"]
-        net.updater_state = restored["updater_state"]
+        # Cast to the freshly-initialized skeleton's dtypes: updater state
+        # is canonically >=f32 even for bf16 params (updaters._init_leaf),
+        # but older checkpoints hold bf16 moments, and an uncast carry
+        # would flip dtype across a lax.scan step in fit_batched.
+        net.updater_state = jax.tree.map(
+            lambda skel, got: (got.astype(skel.dtype)
+                               if hasattr(skel, "dtype") else got),
+            net.updater_state, restored["updater_state"])
         meta_path = self.directory / f"meta_{step}.json"
         if meta_path.exists():
             meta = json.loads(meta_path.read_text())

@@ -53,15 +53,24 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return tree
 
 
-def _merge_into(skeleton: Any, loaded: Any) -> Any:
+def _merge_into(skeleton: Any, loaded: Any, cast: bool = False) -> Any:
     """Overlay loaded leaves onto a freshly-initialized skeleton so empty
-    dicts (e.g. SGD's stateless updater slots) survive the npz round-trip."""
+    dicts (e.g. SGD's stateless updater slots) survive the npz round-trip.
+    With ``cast``, loaded leaves are cast to the skeleton leaf's dtype —
+    used for updater state, whose canonical dtype is >=f32 even for bf16
+    params (updaters._init_leaf); checkpoints written before that policy
+    hold bf16 moments, and an uncast carry would flip dtype across a
+    lax.scan step in fit_batched."""
     if isinstance(skeleton, dict):
         if not isinstance(loaded, dict):
             return skeleton
-        return {k: (_merge_into(v, loaded[k]) if k in loaded else v)
+        return {k: (_merge_into(v, loaded[k], cast) if k in loaded else v)
                 for k, v in skeleton.items()}
-    return skeleton if loaded is None else loaded
+    if loaded is None:
+        return skeleton
+    if cast and hasattr(skeleton, "dtype"):
+        return jnp.asarray(loaded).astype(skeleton.dtype)
+    return loaded
 
 
 def write_model(model, path: str, save_updater: bool = True) -> None:
@@ -176,7 +185,7 @@ def _restore_arrays(zf: zipfile.ZipFile, net, load_updater: bool) -> None:
         upd = _read_npz(zf, UPDATER_ENTRY)
         if upd is not None:
             net.updater_state = _merge_into(net.updater_state,
-                                            _unflatten(upd))
+                                            _unflatten(upd), cast=True)
     net.iteration_count = int(meta.get("iteration_count", 0))
     net.epoch_count = int(meta.get("epoch_count", 0))
 

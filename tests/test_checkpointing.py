@@ -106,3 +106,31 @@ def test_fault_tolerant_trainer_gives_up(tmp_path):
                                    use_orbax=False)
     with pytest.raises(RuntimeError):
         trainer.fit(it)
+
+
+def test_restore_casts_legacy_bf16_updater_state(tmp_path):
+    """Checkpoints written before the >=f32 updater-state policy hold bf16
+    moments; restore must cast to the skeleton dtype or the fit_batched
+    lax.scan carry flips dtype mid-scan."""
+    import jax
+    import jax.numpy as jnp
+
+    net = _net()
+    x, y = _data(n=16)
+    net.fit(x, y)
+    # simulate a legacy checkpoint: bf16 moment buffers
+    net.updater_state = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if hasattr(a, "astype") else a,
+        net.updater_state)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+
+    net2 = _net()
+    assert mgr.restore(net2, step=1) == 1
+    dtypes = {str(a.dtype) for a in jax.tree_util.tree_leaves(
+        net2.updater_state)}
+    assert dtypes == {"float32"}, dtypes
+    xs = np.stack([x, x])
+    ys = np.stack([y, y])
+    scores = np.asarray(net2.fit_batched(xs, ys))  # must not raise
+    assert scores.shape == (2,)
