@@ -3,6 +3,7 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     ListDataSetIterator,
     ExistingDataSetIterator,
     AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
     MultipleEpochsIterator,
 )
 from deeplearning4j_tpu.datasets.impl import (  # noqa: F401

@@ -181,6 +181,13 @@ class AsyncDataSetIterator:
         self._thread = None
 
 
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background-thread prefetch over a MultiDataSetIterator (reference:
+    datasets/iterator/AsyncMultiDataSetIterator.java — wrapped by
+    ComputationGraph.fit(MultiDataSetIterator)). The queue machinery is
+    payload-agnostic, so this shares AsyncDataSetIterator's worker."""
+
+
 class MultipleEpochsIterator:
     """Repeat a base iterator for N epochs (reference:
     MultipleEpochsIterator.java)."""

@@ -30,9 +30,16 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6,
     """Returns True if all checked parameters pass. Checks a random subset of
     ``max_params_to_check`` parameters (None = all), like the reference's
     per-parameter loop but vectorized per evaluation."""
-    x = jnp.asarray(x)
-    y = jnp.asarray(y)
-    mask = None if mask is None else jnp.asarray(mask)
+    if hasattr(net, "_as_input_dict"):
+        # ComputationGraph: loss fn takes name->array dicts
+        x = net._as_input_dict(x, net.conf.network_inputs)
+        y = net._as_input_dict(y, net.conf.network_outputs)
+        mask = None if mask is None else net._as_input_dict(
+            mask, net.conf.network_inputs)
+    else:
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        mask = None if mask is None else jnp.asarray(mask)
     params = net.params
     state = net.state
     flat, unravel = ravel_pytree(params)

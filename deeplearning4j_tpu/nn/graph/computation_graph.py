@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from deeplearning4j_tpu.common import promote_score
@@ -242,6 +243,72 @@ class ComputationGraph:
             return new_params, new_state, new_opt, score
 
         return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
+
+    def _make_scan_fit(self):
+        """Whole-epoch program: `lax.scan` of the minibatch step, keeping
+        the per-step loop on device (the MultiLayerNetwork.fit_batched
+        analog for the DAG runtime)."""
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def epoch(params, state, opt_state, start_iteration, inputs_stack,
+                  labels_stack, base_key):
+            def body(carry, il):
+                params, state, opt, it = carry
+                inputs, labels = il
+                key = jax.random.fold_in(base_key, it)
+
+                def loss_fn(p):
+                    return self._loss_fn(p, state, inputs, labels, key,
+                                         None)
+                (score, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                new_params, new_opt = apply_updater(
+                    tc, params, grads, opt, it,
+                    lr_multipliers=lr_mult, trainable=trainable)
+                return (new_params, new_state, new_opt, it + 1), score
+
+            (params, state, opt_state, _), scores = jax.lax.scan(
+                body, (params, state, opt_state, start_iteration),
+                (inputs_stack, labels_stack))
+            return params, state, opt_state, scores
+
+        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+
+    def fit_batched(self, feats, labs):
+        """Train on a pre-staged stack of minibatches in ONE compiled
+        program. ``feats``/``labs`` follow the same shapes fit() accepts
+        (single array, list per input/output, or name->array dict), with
+        an extra leading [N] batches axis; returns per-step scores [N]."""
+        if not self._initialized:
+            self.init()
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        labels = self._as_input_dict(labs, self.conf.network_outputs)
+        fn = self._jit_cache.get(("scanfit",))
+        if fn is None:
+            fn = self._make_scan_fit()
+            self._jit_cache[("scanfit",)] = fn
+        base_key = jax.random.PRNGKey(self.conf.training.seed)
+        start = jnp.asarray(self.iteration_count, jnp.int32)
+        self.params, self.state, self.updater_state, scores = fn(
+            self.params, self.state, self.updater_state, start, inputs,
+            labels, base_key)
+        n = int(scores.shape[0])
+        if n == 0:
+            return scores
+        if not self.listeners:
+            self.iteration_count += n
+            self.score_value = float(scores[-1])
+            return scores
+        host_scores = np.asarray(scores)
+        for i in range(n):
+            self.score_value = float(host_scores[i])
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration_count,
+                                 self.score_value)
+            self.iteration_count += 1
+        return scores
 
     def fit(self, data, labels=None, masks=None) -> None:
         """Train on a (Multi)DataSetIterator or arrays (reference:

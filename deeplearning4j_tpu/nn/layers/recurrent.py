@@ -116,6 +116,15 @@ class LSTM(BaseLayer):
         b = x.shape[0]
         if carry is None:
             carry = self.initial_carry(b, x.dtype)
+        # Fused Pallas path (the accelerated-LSTM analog of the
+        # reference's cuDNN helper plug point; ops/lstm.py) — whole
+        # recurrence in one kernel, weights/h/c pinned in VMEM.
+        from deeplearning4j_tpu.ops.lstm import (fused_lstm_available,
+                                                 fused_lstm_scan)
+        if fused_lstm_available(x, self.n_out, mask,
+                                self.gate_activation,
+                                self.activation or "tanh"):
+            return fused_lstm_scan(params, x, carry, reverse=reverse)
         xw = jnp.matmul(x, params["W"])  # [B, T, 4H]
         xw_t = jnp.swapaxes(xw, 0, 1)    # [T, B, 4H] time-major for scan
         if mask is not None:

@@ -11,7 +11,7 @@ as the reference's activate()).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +32,11 @@ class VariationalAutoencoder(BaseLayer):
     n_out: Optional[int] = None          # latent size
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
-    reconstruction_distribution: str = "gaussian"  # gaussian|bernoulli|exponential
+    # "gaussian" | "bernoulli" | "exponential", or a composite: a sequence
+    # of (feature_count, kind) pairs modeling successive feature slices
+    # with different distributions (reference: nn/conf/layers/variational/
+    # CompositeReconstructionDistribution.java — addDistribution(size, dist))
+    reconstruction_distribution: Any = "gaussian"
     pzx_activation: str = "identity"
     num_samples: int = 1
 
@@ -46,8 +50,27 @@ class VariationalAutoencoder(BaseLayer):
             return it.InputType.feed_forward(self.n_out)
         raise ValueError(f"VAE cannot take input {input_type}")
 
-    def _recon_params_per_feature(self) -> int:
-        return 1 if self.reconstruction_distribution == "bernoulli" else 2
+    def _components(self) -> Tuple[Tuple[int, str], ...]:
+        """Normalize to ((feature_count, kind), ...); a plain string kind
+        covers all n_in features."""
+        rd = self.reconstruction_distribution
+        if isinstance(rd, str):
+            return ((self.n_in, rd),)
+        comps = tuple((int(n), str(k)) for n, k in rd)
+        total = sum(n for n, _ in comps)
+        if total != self.n_in:
+            raise ValueError(
+                f"Composite reconstruction distribution covers {total} "
+                f"features but n_in={self.n_in}")
+        return comps
+
+    @staticmethod
+    def _params_per_feature(kind: str) -> int:
+        return 1 if kind == "bernoulli" else 2
+
+    def _recon_out_size(self) -> int:
+        return sum(n * self._params_per_feature(k)
+                   for n, k in self._components())
 
     def init_params(self, key, dtype=jnp.float32) -> Dict[str, Array]:
         params: Dict[str, Array] = {}
@@ -78,7 +101,7 @@ class VariationalAutoencoder(BaseLayer):
                 sizes_dec[i + 1], scheme, self.dist, dtype); ki += 1
             params[f"db{i}"] = jnp.zeros((sizes_dec[i + 1],), dtype)
         last_dec = sizes_dec[-1]
-        out_size = self.n_in * self._recon_params_per_feature()
+        out_size = self._recon_out_size()
         params["xW"] = init_weights(keys[ki], (last_dec, out_size), last_dec,
                                     out_size, scheme, self.dist, dtype)
         params["xb"] = jnp.zeros((out_size,), dtype)
@@ -112,23 +135,38 @@ class VariationalAutoencoder(BaseLayer):
         mu, _ = self._encode(params, x)
         return mu, state
 
-    def _recon_log_prob(self, recon_raw, x):
+    @staticmethod
+    def _component_log_prob(kind: str, raw, x):
+        """Per-example log p(x|raw) for one distribution over one feature
+        slice; ``raw`` carries params_per_feature(kind) params per feature."""
         eps = 1e-7
-        kind = self.reconstruction_distribution
         if kind == "bernoulli":
-            p = jnp.clip(jax.nn.sigmoid(recon_raw), eps, 1 - eps)
-            return jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            p = jnp.clip(jax.nn.sigmoid(raw), eps, 1 - eps)
+            return jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p),
+                           axis=-1)
         if kind == "gaussian":
-            mean, logvar = jnp.split(recon_raw, 2, axis=-1)
+            mean, logvar = jnp.split(raw, 2, axis=-1)
             var = jnp.exp(logvar)
             return jnp.sum(
-                -0.5 * (jnp.log(2 * jnp.pi) + logvar + (x - mean) ** 2 / var),
-                axis=-1)
+                -0.5 * (jnp.log(2 * jnp.pi) + logvar
+                        + (x - mean) ** 2 / var), axis=-1)
         if kind == "exponential":
             # rate = exp(gamma); log p = gamma - rate*x
-            gamma, _ = jnp.split(recon_raw, 2, axis=-1)
+            gamma, _ = jnp.split(raw, 2, axis=-1)
             return jnp.sum(gamma - jnp.exp(gamma) * x, axis=-1)
         raise ValueError(f"Unknown reconstruction distribution '{kind}'")
+
+    def _recon_log_prob(self, recon_raw, x):
+        total = 0.0
+        x_off = raw_off = 0
+        for n, kind in self._components():
+            width = n * self._params_per_feature(kind)
+            total = total + self._component_log_prob(
+                kind, recon_raw[..., raw_off:raw_off + width],
+                x[..., x_off:x_off + n])
+            x_off += n
+            raw_off += width
+        return total
 
     def pretrain_loss(self, params, x, key):
         """-ELBO = -E[log p(x|z)] + KL(q(z|x) || N(0,1))."""

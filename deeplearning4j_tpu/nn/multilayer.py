@@ -56,6 +56,7 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self.score_value: float = float("nan")
         self._jit_cache: Dict[Any, Any] = {}
+        self._pretrain_counts: Dict[int, int] = {}
         self._rnn_carries: Optional[Dict[str, Any]] = None
         self._solver = None
         self._initialized = False
@@ -275,6 +276,8 @@ class MultiLayerNetwork:
             self.params, self.state, self.updater_state, start, xs, ys,
             base_key)
         n = int(xs.shape[0])
+        if n == 0:
+            return scores
         if not self.listeners:
             # no per-step host work in the hot path (bench case)
             self.iteration_count += n
@@ -450,18 +453,14 @@ class MultiLayerNetwork:
             if hasattr(data, "reset"):
                 data.reset()
 
-    def pretrain_layer(self, layer_idx: int, data) -> None:
+    def _make_pretrain_step(self, layer_idx: int):
         layer = self.layers[layer_idx]
         name = self.layer_names[layer_idx]
-        if not layer.is_pretrain_layer():
-            return
         tc = self.conf.training
 
-        @jax.jit
-        def pstep(params, opt_state, iteration, x, key):
+        def pstep(below_params, below_state, params, opt_state, iteration,
+                  x, key):
             def loss_fn(p):
-                full = dict(self.params)
-                full[name] = p
                 h = x.astype(self.dtype)
                 for j in range(layer_idx):
                     jn = self.layer_names[j]
@@ -469,8 +468,8 @@ class MultiLayerNetwork:
                     if pp is not None:
                         h = pp.pre_process(h)
                     h, _ = self.layers[j].apply(
-                        jax.lax.stop_gradient(full[jn]),
-                        self.state.get(jn, {}), h, train=False)
+                        jax.lax.stop_gradient(below_params[jn]),
+                        below_state.get(jn, {}), h, train=False)
                 pp = self.conf.input_preprocessors.get(str(layer_idx))
                 if pp is not None:
                     h = pp.pre_process(h)
@@ -484,16 +483,37 @@ class MultiLayerNetwork:
                                          iteration)
             return new_p[name], new_s[name], score
 
-        it = 0
+        return jax.jit(pstep)
+
+    def pretrain_layer(self, layer_idx: int, data) -> None:
+        layer = self.layers[layer_idx]
+        name = self.layer_names[layer_idx]
+        if not layer.is_pretrain_layer():
+            return
+        tc = self.conf.training
+        pstep = self._jit_cache.get(("pretrain", layer_idx))
+        if pstep is None:
+            pstep = self._make_pretrain_step(layer_idx)
+            self._jit_cache[("pretrain", layer_idx)] = pstep
+        below = {self.layer_names[j]: self.params[self.layer_names[j]]
+                 for j in range(layer_idx)}
+        below_state = {self.layer_names[j]:
+                       self.state.get(self.layer_names[j], {})
+                       for j in range(layer_idx)}
+        # persistent per-layer counter: repeated calls keep advancing the
+        # updater's t (Adam bias correction) and the RNG stream
+        it = self._pretrain_counts.get(layer_idx, 0)
         batches = data if not hasattr(data, "__array__") else [(data, None)]
         for batch in batches:
             feats, _, _, _ = _unpack_batch(batch)
             key = jax.random.fold_in(jax.random.PRNGKey(tc.seed), it)
             (self.params[name], self.updater_state[name],
-             score) = pstep(self.params[name], self.updater_state[name], it,
+             score) = pstep(below, below_state, self.params[name],
+                            self.updater_state[name], it,
                             jnp.asarray(feats), key)
             self.score_value = score
             it += 1
+        self._pretrain_counts[layer_idx] = it
 
     # ------------------------------------------------------------- inference
     def output(self, x, train: bool = False) -> Array:

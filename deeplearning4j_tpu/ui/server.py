@@ -15,6 +15,14 @@ Endpoints:
   GET  /train/model?sid=      per-parameter norms/histograms (latest)
   GET  /train/system?sid=     static hardware/model info
   POST /remote/receive        remote StatsStorageRouter records
+  POST /tsne/upload           t-SNE coords (+labels) (reference: TsneModule)
+  GET  /tsne                  scatter viewer HTML
+  GET  /tsne/coords           uploaded coords JSON
+  GET  /activations           conv activation grids captured by
+                              ConvolutionalIterationListener
+                              (reference: ActivationsModule)
+  GET  /flow                  layer flow graph written by
+                              FlowIterationListener (reference: FlowModule)
 """
 from __future__ import annotations
 
@@ -26,6 +34,40 @@ from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.ui.storage import (InMemoryStatsStorage,
                                            Persistable, StatsStorage)
+
+_TSNE_PAGE = """<!DOCTYPE html>
+<html><head><title>t-SNE viewer</title></head>
+<body><h1>t-SNE</h1>
+<svg id="plot" width="700" height="700" style="border:1px solid #ccc">
+</svg>
+<script>
+fetch('/tsne/coords').then(r => r.json()).then(d => {
+  const svg = document.getElementById('plot'), W = 700, pad = 20;
+  const NS = 'http://www.w3.org/2000/svg';
+  const xs = d.coords.map(c => c[0]), ys = d.coords.map(c => c[1]);
+  if (!xs.length) return;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs),
+        ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => pad + (W - 2*pad) * (x - xmin) / ((xmax - xmin) || 1);
+  const sy = y => pad + (W - 2*pad) * (y - ymin) / ((ymax - ymin) || 1);
+  d.coords.forEach((c, i) => {
+    const dot = document.createElementNS(NS, 'circle');
+    dot.setAttribute('cx', sx(c[0]));
+    dot.setAttribute('cy', sy(c[1]));
+    dot.setAttribute('r', 3);
+    svg.appendChild(dot);
+    if (d.labels[i]) {
+      const t = document.createElementNS(NS, 'text');
+      t.setAttribute('x', sx(c[0]) + 4);
+      t.setAttribute('y', sy(c[1]));
+      t.setAttribute('font-size', 9);
+      t.textContent = String(d.labels[i]);  // text node: no markup
+      svg.appendChild(t);
+    }
+  });
+});
+</script></body></html>
+"""
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
@@ -87,6 +129,9 @@ setInterval(refresh, 2000); refresh();
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpu-ui/1.0"
     storage: StatsStorage = None  # injected
+    tsne_data = None              # {"coords": [...], "labels": [...]}
+    activations_dir = None        # Path written by Conv listener
+    flow_path = None              # Path written by Flow listener
 
     def log_message(self, *args) -> None:  # silence request logging
         pass
@@ -99,6 +144,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page: str) -> None:
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @classmethod
+    def set_tsne(cls, coords, labels=None) -> None:
+        """The one normalizer for t-SNE uploads (HTTP and Python API)."""
+        coords = [[float(v) for v in c] for c in coords]
+        cls.tsne_data = {"coords": coords,
+                         "labels": [str(l) for l in labels]
+                         if labels else [""] * len(coords)}
+
     def _first_worker(self, sid: str) -> Optional[str]:
         workers = self.storage.list_worker_ids_for_session(sid)
         return workers[0] if workers else None
@@ -107,15 +168,42 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         if url.path in ("/", "/train", "/train/overview.html"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
             return
         if url.path == "/train/sessions":
             self._json(self.storage.list_session_ids())
+            return
+        if url.path == "/tsne":
+            self._html(_TSNE_PAGE)
+            return
+        if url.path == "/tsne/coords":
+            self._json(type(self).tsne_data
+                       or {"coords": [], "labels": []})
+            return
+        if url.path == "/activations":
+            d = type(self).activations_dir
+            if d is None:
+                self._json({"grids": []})
+                return
+            import numpy as np
+            name = q.get("name")
+            if name:
+                p = d / name
+                if not p.resolve().is_relative_to(d.resolve()) \
+                        or not p.exists():
+                    self._json({"error": "not found"}, 404)
+                    return
+                self._json({"name": name,
+                            "grid": np.load(p).tolist()})
+                return
+            self._json({"grids": sorted(p.name for p in d.glob("*.npy"))})
+            return
+        if url.path == "/flow":
+            p = type(self).flow_path
+            if p is None or not p.exists():
+                self._json({"layers": []})
+                return
+            self._json(json.loads(p.read_text()))
             return
         sid = q.get("sid", "")
         if url.path == "/train/overview":
@@ -144,7 +232,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._json({"error": "not found"}, 404)
 
     def do_POST(self) -> None:
-        if urlparse(self.path).path != "/remote/receive":
+        path = urlparse(self.path).path
+        if path == "/tsne/upload":
+            length = int(self.headers.get("Content-Length", 0))
+            obj = json.loads(self.rfile.read(length) or b"{}")
+            coords = obj.get("coords", [])
+            type(self).set_tsne(coords, obj.get("labels"))
+            self._json({"ok": True, "n": len(coords)})
+            return
+        if path != "/remote/receive":
             self._json({"error": "not found"}, 404)
             return
         length = int(self.headers.get("Content-Length", 0))
@@ -171,6 +267,7 @@ class UIServer:
         self.storage: StatsStorage = InMemoryStatsStorage()
         handler = type("BoundHandler", (_Handler,),
                        {"storage": self.storage})
+        self._handler = handler
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -182,6 +279,24 @@ class UIServer:
         if cls._instance is None:
             cls._instance = UIServer(port)
         return cls._instance
+
+    def attach_activations_dir(self, path) -> None:
+        """Serve ConvolutionalIterationListener grids at /activations
+        (reference: ActivationsModule over ConvolutionalIterationListener
+        output)."""
+        from pathlib import Path
+        self._handler.activations_dir = Path(path)
+
+    def attach_flow(self, path) -> None:
+        """Serve FlowIterationListener JSON at /flow (reference:
+        FlowModule)."""
+        from pathlib import Path
+        self._handler.flow_path = Path(path)
+
+    def upload_tsne(self, coords, labels=None) -> None:
+        """Publish t-SNE coordinates to the /tsne viewer (reference:
+        TsneModule upload)."""
+        self._handler.set_tsne(coords, labels)
 
     def attach(self, storage: StatsStorage) -> None:
         """Mirror records from `storage` into the server's own store

@@ -1,0 +1,127 @@
+"""Parse tree for recursive autoencoder / recursive neural tensor nets.
+
+Capability parity with the reference's recursive-autoencoder tree
+(reference: deeplearning4j-nn/.../nn/layers/feedforward/autoencoder/
+recursive/Tree.java): labeled n-ary tree over token spans with per-node
+vectors/predictions/error, leaf/preterminal queries, depth, ancestor
+lookup, yield, and deep clone. Vectors are jax/numpy arrays instead of
+INDArrays; the structure itself is host-side (tree recursion is not an
+XLA-friendly shape, so batching over trees happens at a higher level).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class Tree:
+    def __init__(self, tokens: Optional[List[str]] = None,
+                 parent: Optional["Tree"] = None):
+        self.parent = parent
+        self.tokens: List[str] = list(tokens or [])
+        self.children_: List["Tree"] = []
+        self.vector: Any = None
+        self.prediction: Any = None
+        self.error_value: float = 0.0
+        self.head_word: Optional[str] = None
+        self.value: Optional[str] = None
+        self.label_: Optional[str] = None
+        self.type_: Optional[str] = None
+        self.gold_label: int = 0
+        self.tags: List[str] = []
+        self.parse: Optional[str] = None
+        self.begin: int = 0
+        self.end: int = 0
+
+    # -- structure ---------------------------------------------------------
+    def children(self) -> List["Tree"]:
+        return self.children_
+
+    def add_child(self, child: "Tree") -> "Tree":
+        child.parent = self
+        self.children_.append(child)
+        return child
+
+    def is_leaf(self) -> bool:
+        return not self.children_
+
+    def is_pre_terminal(self) -> bool:
+        """One level above the leaves (POS-tag level in a parse tree)."""
+        return bool(self.children_) and all(c.is_leaf()
+                                            for c in self.children_)
+
+    def first_child(self) -> Optional["Tree"]:
+        return self.children_[0] if self.children_ else None
+
+    def last_child(self) -> Optional["Tree"]:
+        return self.children_[-1] if self.children_ else None
+
+    def depth(self) -> int:
+        """Height of the subtree below this node (leaf = 0)."""
+        if self.is_leaf():
+            return 0
+        return 1 + max(c.depth() for c in self.children_)
+
+    def distance_to(self, node: "Tree") -> int:
+        """Depth of ``node`` below this subtree root (-1 if absent)."""
+        if node is self:
+            return 0
+        for c in self.children_:
+            d = c.distance_to(node)
+            if d >= 0:
+                return d + 1
+        return -1
+
+    def ancestor(self, height: int) -> Optional["Tree"]:
+        """The ancestor ``height`` levels up (0 = self)."""
+        node: Optional[Tree] = self
+        for _ in range(height):
+            if node is None:
+                return None
+            node = node.parent
+        return node
+
+    def get_leaves(self) -> List["Tree"]:
+        if self.is_leaf():
+            return [self]
+        out: List[Tree] = []
+        for c in self.children_:
+            out.extend(c.get_leaves())
+        return out
+
+    def yield_(self) -> List[str]:
+        """All tokens under this node, left to right."""
+        if self.is_leaf():
+            return list(self.tokens)
+        out: List[str] = []
+        for c in self.children_:
+            out.extend(c.yield_())
+        return out
+
+    # -- labels / error ----------------------------------------------------
+    def label(self) -> Optional[str]:
+        return self.label_
+
+    def set_label(self, label: str) -> None:
+        self.label_ = label
+
+    def error_sum(self) -> float:
+        """Total error over this subtree."""
+        return self.error_value + sum(c.error_sum()
+                                      for c in self.children_)
+
+    def clone(self) -> "Tree":
+        t = Tree(self.tokens)
+        for name in ("vector", "prediction", "error_value", "head_word",
+                     "value", "label_", "type_", "gold_label", "parse",
+                     "begin", "end"):
+            setattr(t, name, getattr(self, name))
+        t.tags = list(self.tags)
+        for c in self.children_:
+            t.add_child(c.clone())
+        return t
+
+    def __repr__(self) -> str:
+        if self.is_leaf():
+            return f"Tree(leaf {self.tokens or self.value!r})"
+        return (f"Tree({self.label_ or self.value!r}, "
+                f"{len(self.children_)} children)")

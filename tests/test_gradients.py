@@ -130,3 +130,98 @@ def test_gradcheck_global_pooling():
                   GlobalPoolingLayer(pooling_type="avg"),
                   OutputLayer(n_in=5, n_out=2, activation="softmax")))
     _check(conf, x, y)
+
+
+def test_gradcheck_cnn1d():
+    """Reference analog: CNN1DGradientCheckTest.java."""
+    from deeplearning4j_tpu.nn.layers import (Convolution1DLayer,
+                                              Subsampling1DLayer)
+    x = RNG.randn(3, 10, 4).astype(np.float64)  # [B, T, C]
+    y = np.eye(2)[RNG.randint(0, 2, (3, 10))].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=1, activation="tanh",
+                                   dtype="float64")
+            .list(Convolution1DLayer(n_in=4, n_out=5, kernel_size=3,
+                                     convolution_mode="same"),
+                  Subsampling1DLayer(kernel_size=2, stride=1,
+                                     convolution_mode="same"),
+                  RnnOutputLayer(n_in=5, n_out=2, activation="softmax",
+                                 loss_function="mcxent")))
+    _check(conf, x, y)
+
+
+def test_gradcheck_lrn():
+    """Reference analog: LRNGradientCheckTests.java."""
+    from deeplearning4j_tpu.nn.layers import LocalResponseNormalization
+    x = RNG.randn(2, 5, 5, 3).astype(np.float64)
+    y = np.eye(2)[RNG.randint(0, 2, 2)].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=2, activation="tanh",
+                                   dtype="float64")
+            .list(ConvolutionLayer(n_out=4, kernel_size=(2, 2)),
+                  LocalResponseNormalization(),
+                  DenseLayer(n_out=6, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax",
+                              loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(5, 5, 3)))
+    _check(conf, x, y)
+
+
+@pytest.mark.parametrize("loss,act,regression", [
+    ("mse", "identity", True),
+    ("mae", "identity", True),
+    ("l1", "identity", True),
+    ("l2", "identity", True),
+    ("xent", "sigmoid", False),
+    ("mcxent", "softmax", False),
+    ("negativeloglikelihood", "softmax", False),
+    ("kl_divergence", "softmax", False),
+    ("poisson", "softplus", True),
+    ("msle", "softplus", True),
+    ("squared_hinge", "identity", False),
+    ("cosine_proximity", "identity", True),
+])
+def test_gradcheck_loss_functions(loss, act, regression):
+    """Reference analog: LossFunctionGradientCheck.java — every loss
+    function paired with a compatible output activation."""
+    n, f, c = 4, 5, 3
+    x = RNG.randn(n, f).astype(np.float64)
+    if regression:
+        y = RNG.randn(n, c).astype(np.float64)
+        if loss in ("msle", "poisson"):
+            y = np.abs(y) + 0.1
+    elif loss in ("squared_hinge",):
+        y = (np.eye(c)[RNG.randint(0, c, n)] * 2 - 1).astype(np.float64)
+    else:
+        y = np.eye(c)[RNG.randint(0, c, n)].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=4, activation="tanh",
+                                   dtype="float64")
+            .list(DenseLayer(n_in=f, n_out=8),
+                  OutputLayer(n_in=8, n_out=c, activation=act,
+                              loss_function=loss)))
+    _check(conf, x, y)
+
+
+def test_gradcheck_computation_graph_vertices():
+    """Reference analog: GradientCheckTestsComputationGraph.java — merge
+    + elementwise vertices in a DAG."""
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.graph.vertices import (ElementWiseVertex,
+                                                      MergeVertex)
+    x = RNG.randn(3, 6).astype(np.float64)
+    y = np.eye(2)[RNG.randint(0, 2, 3)].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=5, activation="tanh",
+                                   dtype="float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_in=6, n_out=5), "in")
+            .add_layer("b", DenseLayer(n_in=6, n_out=5), "in")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+            .add_vertex("cat", MergeVertex(), "a", "sum")
+            .add_layer("out", OutputLayer(n_in=10, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "cat")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    assert check_gradients(net, x, y, print_results=True)

@@ -254,3 +254,39 @@ def test_fit_batched_learns_digits():
     ev = net.evaluate(DigitsDataSetIterator(batch_size=128))
     assert ev.accuracy() > 0.85
     assert scores[-1] < 1.0
+
+
+def test_graph_fit_batched_matches_per_step_fit():
+    """ComputationGraph.fit_batched (scanned DAG epoch) equals per-step
+    fit() on the same minibatches."""
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+
+    rng = np.random.default_rng(5)
+    n_steps, batch = 4, 16
+    xs = rng.random((n_steps, batch, 6), dtype=np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (n_steps, batch))]
+
+    def make():
+        conf = (NeuralNetConfiguration(seed=9, updater="adam",
+                                       learning_rate=0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=6, n_out=10,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=10, n_out=2,
+                                              activation="softmax",
+                                              loss_function="mcxent"), "h")
+                .set_outputs("out")
+                .build())
+        return ComputationGraph(conf).init()
+
+    ref = make()
+    for i in range(n_steps):
+        ref.fit(xs[i], ys[i])
+    net = make()
+    scores = np.asarray(net.fit_batched(xs, ys))
+    assert scores.shape == (n_steps,)
+    np.testing.assert_allclose(np.asarray(net.params_flat()),
+                               np.asarray(ref.params_flat()),
+                               rtol=1e-4, atol=1e-5)

@@ -130,3 +130,50 @@ def test_ui_server_endpoints_and_remote_router():
         assert ov2["scores"] == [9.9]
     finally:
         server.stop()
+
+
+def test_ui_server_tsne_activations_flow_modules(tmp_path):
+    """The reference Play UI's extra modules (TsneModule,
+    ActivationsModule, FlowModule) — viewer routes over listener
+    artifacts."""
+    server = UIServer(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(server.url + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        # t-SNE: upload via API, read back via route; page served
+        server.upload_tsne([[0.0, 1.0], [2.0, 3.0]], labels=["a", "b"])
+        d = get("/tsne/coords")
+        assert d["coords"] == [[0.0, 1.0], [2.0, 3.0]]
+        assert d["labels"] == ["a", "b"]
+        with urllib.request.urlopen(server.url + "/tsne", timeout=5) as r:
+            assert b"t-SNE" in r.read()
+        # also via HTTP POST (remote client)
+        req = urllib.request.Request(
+            server.url + "/tsne/upload",
+            data=json.dumps({"coords": [[5, 6]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["n"] == 1
+        assert get("/tsne/coords")["coords"] == [[5, 6]]
+
+        # activations: serve ConvolutionalIterationListener .npy grids
+        grid = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+        np.save(tmp_path / "iter0_layer_0.npy", grid)
+        server.attach_activations_dir(tmp_path)
+        assert get("/activations")["grids"] == ["iter0_layer_0.npy"]
+        got = get("/activations?name=iter0_layer_0.npy")
+        np.testing.assert_array_equal(np.asarray(got["grid"]), grid)
+        with pytest.raises(urllib.error.HTTPError):
+            get("/activations?name=../etc/passwd")
+
+        # flow: serve FlowIterationListener JSON
+        flow = {"iteration": 3, "score": 1.5,
+                "layers": [{"name": "l0", "type": "DenseLayer",
+                            "inputs": []}]}
+        (tmp_path / "flow.json").write_text(json.dumps(flow))
+        server.attach_flow(tmp_path / "flow.json")
+        assert get("/flow") == flow
+    finally:
+        server.stop()

@@ -1,0 +1,171 @@
+"""VAE tests (reference analogs: VaeGradientCheckTests.java, the
+variational reconstruction-distribution suite, and
+TestVAE.reconstructionProbability in deeplearning4j-core)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _vae(recon="gaussian", n_in=8):
+    conf = NeuralNetConfiguration(seed=3, updater="adam",
+                                  learning_rate=0.01).list(
+        VariationalAutoencoder(n_in=n_in, n_out=3,
+                               encoder_layer_sizes=(12,),
+                               decoder_layer_sizes=(12,),
+                               reconstruction_distribution=recon),
+        OutputLayer(n_out=2, activation="softmax",
+                    loss_function="mcxent"))
+    conf.set_pretrain(True)
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, n_in=8, seed=0, binary=False):
+    rng = np.random.default_rng(seed)
+    if binary:
+        return (rng.random((n, n_in)) < 0.4).astype(np.float32)
+    return rng.normal(0.5, 0.2, (n, n_in)).astype(np.float32)
+
+
+def test_vae_pretrain_reduces_elbo_loss():
+    net = _vae("gaussian")
+    x = _data()
+    vae = net.layers[0]
+    key = jax.random.PRNGKey(0)
+    before = float(vae.pretrain_loss(net.params["layer_0"],
+                                     jnp.asarray(x), key))
+    for _ in range(60):
+        net.pretrain_layer(0, x)
+    after = float(vae.pretrain_loss(net.params["layer_0"],
+                                    jnp.asarray(x), key))
+    assert after < before
+
+
+def test_vae_reconstruction_prob_higher_for_in_distribution():
+    net = _vae("bernoulli")
+    x = _data(binary=True)
+    for _ in range(80):
+        net.pretrain_layer(0, x)
+    vae = net.layers[0]
+    key = jax.random.PRNGKey(7)
+    lp_data = np.asarray(vae.reconstruction_prob(
+        net.params["layer_0"], jnp.asarray(x[:16]), key, num_samples=8))
+    noise = (np.random.default_rng(9).random((16, 8)) < 0.9
+             ).astype(np.float32)
+    lp_noise = np.asarray(vae.reconstruction_prob(
+        net.params["layer_0"], jnp.asarray(noise), key, num_samples=8))
+    assert lp_data.mean() > lp_noise.mean()
+
+
+def test_vae_composite_reconstruction_distribution():
+    """First 5 features gaussian, last 3 bernoulli (reference:
+    CompositeReconstructionDistribution.addDistribution)."""
+    comp = ((5, "gaussian"), (3, "bernoulli"))
+    net = _vae(comp)
+    vae = net.layers[0]
+    # decoder head sizes: 5*2 + 3*1
+    assert vae._recon_out_size() == 13
+    assert net.params["layer_0"]["xW"].shape[1] == 13
+    rng = np.random.default_rng(1)
+    x = np.concatenate([
+        rng.normal(0.0, 1.0, (32, 5)),
+        (rng.random((32, 3)) < 0.5).astype(float)], axis=1
+    ).astype(np.float32)
+    key = jax.random.PRNGKey(0)
+    before = float(vae.pretrain_loss(net.params["layer_0"],
+                                     jnp.asarray(x), key))
+    assert np.isfinite(before)
+    for _ in range(40):
+        net.pretrain_layer(0, x)
+    after = float(vae.pretrain_loss(net.params["layer_0"],
+                                    jnp.asarray(x), key))
+    assert after < before
+    # composite log-prob == sum of slice log-probs computed independently
+    raw = jnp.asarray(rng.normal(size=(4, 13)).astype(np.float32))
+    xs = jnp.asarray(x[:4])
+    got = vae._recon_log_prob(raw, xs)
+    want = (vae._component_log_prob("gaussian", raw[:, :10], xs[:, :5])
+            + vae._component_log_prob("bernoulli", raw[:, 10:], xs[:, 5:]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
+
+
+def test_vae_composite_size_mismatch_raises():
+    import pytest
+    with pytest.raises(ValueError, match="covers 6"):
+        _vae(((3, "gaussian"), (3, "bernoulli")), n_in=8)
+
+
+def test_vae_pretrain_loss_gradcheck():
+    """Central-difference check of the -ELBO gradient wrt VAE params
+    (reference: VaeGradientCheckTests — same idea, AD vs numeric)."""
+    net = _vae("gaussian")
+    x = jnp.asarray(_data(n=8))
+    vae = net.layers[0]
+    key = jax.random.PRNGKey(5)
+    params = jax.tree.map(lambda a: a.astype(jnp.float64)
+                          if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                          net.params["layer_0"])
+
+    def loss(p):
+        return vae.pretrain_loss(p, x.astype(jnp.float64), key)
+
+    grads = jax.grad(loss)(params)
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    gflat = jax.flatten_util.ravel_pytree(grads)[0]
+    rng = np.random.default_rng(0)
+    idx = rng.choice(flat.shape[0], size=40, replace=False)
+    eps = 1e-5
+    for i in idx:
+        e = jnp.zeros_like(flat).at[i].set(eps)
+        num = (float(loss(unravel(flat + e)))
+               - float(loss(unravel(flat - e)))) / (2 * eps)
+        ana = float(gflat[i])
+        denom = max(abs(num), abs(ana), 1e-8)
+        assert abs(num - ana) / denom < 1e-3 or abs(num - ana) < 1e-7, (
+            i, num, ana)
+
+
+def test_async_multi_dataset_iterator():
+    from deeplearning4j_tpu.datasets import AsyncMultiDataSetIterator
+    from deeplearning4j_tpu.datasets.records import MultiDataSet
+    base = [MultiDataSet(features=[np.ones((4, 2)) * i],
+                         labels=[np.zeros((4, 1))]) for i in range(5)]
+    it = AsyncMultiDataSetIterator(base, queue_size=2)
+    seen = [float(np.asarray(m.features[0]).mean()) for m in it]
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0]
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_recursive_tree():
+    """Tree structure parity (reference: recursive/Tree.java)."""
+    from deeplearning4j_tpu.util.tree import Tree
+    root = Tree()
+    root.set_label("S")
+    np_ = root.add_child(Tree())
+    np_.set_label("NP")
+    vp = root.add_child(Tree())
+    vp.set_label("VP")
+    the = np_.add_child(Tree(["the"]))
+    cat = np_.add_child(Tree(["cat"]))
+    sat = vp.add_child(Tree(["sat"]))
+    assert root.yield_() == ["the", "cat", "sat"]
+    assert root.depth() == 2
+    assert the.is_leaf() and not np_.is_leaf()
+    assert np_.is_pre_terminal() and not root.is_pre_terminal()
+    assert [t.tokens[0] for t in root.get_leaves()] == ["the", "cat",
+                                                        "sat"]
+    assert root.distance_to(cat) == 2
+    assert cat.ancestor(2) is root
+    np_.error_value = 0.5
+    cat.error_value = 0.25
+    assert root.error_sum() == 0.75
+    c = root.clone()
+    assert c.yield_() == root.yield_()
+    assert c is not root and c.children()[0] is not np_
+    assert root.first_child() is np_ and root.last_child() is vp
