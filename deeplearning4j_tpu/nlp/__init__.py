@@ -4,9 +4,10 @@ from deeplearning4j_tpu.nlp.tokenization import (
     LowCasePreProcessor, EndingPreProcessor)
 from deeplearning4j_tpu.nlp.sentenceiterator import (
     CollectionSentenceIterator, BasicLineIterator, FileSentenceIterator,
-    LabelAwareIterator, LabelledDocument, LabelsSource)
+    LabelAwareIterator, LabelledDocument, LabelsSource, StreamLineIterator)
 from deeplearning4j_tpu.nlp.vocab import (VocabConstructor, AbstractCache,
-                                          VocabWord, build_huffman_tree)
+                                          VocabWord, VocabularyHolder,
+                                          build_huffman_tree)
 from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec, ParagraphVectors, Glove

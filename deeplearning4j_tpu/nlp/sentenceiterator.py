@@ -7,6 +7,7 @@ SentencePreProcessor; documentiterator/: LabelAwareIterator, LabelsSource).
 """
 from __future__ import annotations
 
+import io
 import os
 from typing import Iterable, Iterator, List, Optional
 
@@ -177,3 +178,48 @@ class LabelAwareIterator:
         self.reset()
         while self.has_next_document():
             yield self.next_document()
+
+
+class StreamLineIterator(SentenceIterator):
+    """One sentence per line from any text stream / file-like object,
+    read lazily in constant memory (reference:
+    sentenceiterator/StreamLineIterator.java — line iteration over an
+    InputStream). reset() seeks seekable streams back to the position
+    the iterator started at; non-seekable streams can't rewind (same
+    constraint as an InputStream)."""
+
+    def __init__(self, stream):
+        super().__init__()
+        self._stream = stream
+        self._start = stream.tell() if self._seekable() else None
+        self._it = iter(stream)
+        self._peek: Optional[str] = None
+        self._advance()
+
+    def _seekable(self) -> bool:
+        s = self._stream
+        try:
+            return bool(s.seekable()) if hasattr(s, "seekable") \
+                else hasattr(s, "seek")
+        except Exception:
+            return False
+
+    def _advance(self) -> None:
+        self._peek = next(self._it, None)
+
+    def has_next(self) -> bool:
+        return self._peek is not None
+
+    def next_sentence(self) -> str:
+        s = self._peek.rstrip("\n")
+        self._advance()
+        return self._apply(s)
+
+    def reset(self) -> None:
+        if self._start is None:
+            raise io.UnsupportedOperation(
+                "StreamLineIterator over a non-seekable stream cannot "
+                "reset")
+        self._stream.seek(self._start)
+        self._it = iter(self._stream)
+        self._advance()

@@ -217,3 +217,41 @@ def padded_huffman_arrays(cache: AbstractCache):
         points[i, :l] = w.points
         mask[i, :l] = 1.0
     return codes, points, mask
+
+
+class VocabularyHolder:
+    """Mutable vocab builder with min-frequency truncation, convertible
+    to an AbstractCache (reference: wordstore/VocabularyHolder.java —
+    scavenging/truncation staging area used during vocab construction)."""
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self._counts: Dict[str, float] = {}
+
+    def add_word(self, word: str, count: float = 1.0) -> None:
+        self._counts[word] = self._counts.get(word, 0.0) + count
+
+    def word_frequency(self, word: str) -> float:
+        return self._counts.get(word, 0.0)
+
+    def truncate_vocabulary(self,
+                            threshold: Optional[int] = None) -> None:
+        """Drop words below threshold (reference:
+        VocabularyHolder.truncateVocabulary)."""
+        t = self.min_word_frequency if threshold is None else threshold
+        self._counts = {w: c for w, c in self._counts.items() if c >= t}
+
+    def num_words(self) -> int:
+        return len(self._counts)
+
+    def transfer_back_to_vocab_cache(self, cache: "AbstractCache",
+                                     build_huffman: bool = True
+                                     ) -> "AbstractCache":
+        """Materialize into an AbstractCache, assigning indices by
+        descending frequency (+ Huffman codes as in VocabConstructor)."""
+        for w, c in self._counts.items():
+            cache.add_token(VocabWord(w, c))
+        cache.finalize_vocab()
+        if build_huffman:
+            build_huffman_tree(cache)
+        return cache

@@ -27,6 +27,7 @@ served by this runtime without jax in the serving process.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -151,6 +152,27 @@ def get_bridge() -> Optional[ctypes.CDLL]:
         lib.dl4j_pjrt_execute.argtypes = [
             c_ptr, c_ptr, ctypes.POINTER(c_ptr), c_int,
             ctypes.POINTER(c_ptr), c_int, c_char_p, c_int]
+        lib.dl4j_exec_cache_create.restype = c_ptr
+        lib.dl4j_exec_cache_create.argtypes = [c_ptr]
+        lib.dl4j_exec_cache_get_or_compile.restype = c_ptr
+        lib.dl4j_exec_cache_get_or_compile.argtypes = [
+            c_ptr, c_ptr, c_ptr, c_char_p, c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(c_int), c_char_p, c_int]
+        lib.dl4j_exec_cache_size.restype = c_int
+        lib.dl4j_exec_cache_size.argtypes = [c_ptr]
+        lib.dl4j_exec_cache_destroy.restype = c_int
+        lib.dl4j_exec_cache_destroy.argtypes = [c_ptr, c_ptr]
+        lib.dl4j_async_create.restype = c_ptr
+        lib.dl4j_async_create.argtypes = [c_ptr]
+        lib.dl4j_async_submit.restype = c_ll
+        lib.dl4j_async_submit.argtypes = [c_ptr, c_ptr,
+                                          ctypes.POINTER(c_ptr), c_int]
+        lib.dl4j_async_wait.restype = c_int
+        lib.dl4j_async_wait.argtypes = [c_ptr, c_ll,
+                                        ctypes.POINTER(c_ptr), c_int,
+                                        c_char_p, c_int]
+        lib.dl4j_async_destroy.restype = c_int
+        lib.dl4j_async_destroy.argtypes = [c_ptr]
         _bridge = lib
         return _bridge
 
@@ -234,6 +256,7 @@ class PjrtExecutable:
     def __init__(self, runtime: "PjrtRuntime", handle: int):
         self._rt = runtime
         self._handle = handle
+        self._cache_owned = False  # set by PjrtRuntime.compile_cached
 
     @property
     def num_outputs(self) -> int:
@@ -268,10 +291,49 @@ class PjrtExecutable:
                 o.close()
 
     def close(self) -> None:
-        if self._handle:
+        if self._handle and not self._cache_owned:
             self._rt._lib.dl4j_pjrt_executable_destroy(self._rt._api,
                                                        self._handle)
-            self._handle = 0
+        self._handle = 0
+
+
+class PjrtAsyncExecutor:
+    """Native FIFO dispatch queue over the bridge (worker thread runs
+    execute+await off the host thread; tickets order results)."""
+
+    def __init__(self, runtime: "PjrtRuntime"):
+        self._rt = runtime
+        self._handle = runtime._lib.dl4j_async_create(runtime._api)
+
+    def submit(self, exe: PjrtExecutable,
+               inputs: Sequence[PjrtBuffer]) -> int:
+        in_arr = (ctypes.c_void_p * len(inputs))(
+            *[b._handle for b in inputs])
+        ticket = self._rt._lib.dl4j_async_submit(
+            self._handle, exe._handle, in_arr, len(inputs))
+        if ticket < 0:
+            raise PjrtError("async executor is shut down")
+        return int(ticket)
+
+    def wait(self, ticket: int, max_outputs: int = 8) -> List[PjrtBuffer]:
+        out_arr = (ctypes.c_void_p * max_outputs)()
+        err = ctypes.create_string_buffer(_ERRLEN)
+        n = self._rt._lib.dl4j_async_wait(self._handle, ticket, out_arr,
+                                          max_outputs, err, _ERRLEN)
+        if n < 0:
+            raise PjrtError(err.value.decode(errors="replace"))
+        return [PjrtBuffer(self._rt, out_arr[i]) for i in range(n)]
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._rt._lib.dl4j_async_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class PjrtRuntime:
@@ -332,6 +394,47 @@ class PjrtRuntime:
                             f"{err.value.decode(errors='replace')}")
         return PjrtExecutable(self, h)
 
+    def compile_cached(self, stablehlo: str,
+                       key: Optional[str] = None) -> "PjrtExecutable":
+        """Shape-keyed compilation through the native executable cache
+        (SURVEY §7 hard parts: "executable caching keyed on shapes").
+        Default key = the program text itself; pass an explicit shape
+        signature to share one entry across textually-distinct programs.
+        Cached executables are owned by the cache (closed with the
+        runtime), so the returned handle must not be .close()d."""
+        if getattr(self, "_exec_cache", None) is None:
+            self._exec_cache = self._lib.dl4j_exec_cache_create(self._api)
+        code = stablehlo.encode() if isinstance(stablehlo, str) \
+            else stablehlo
+        # default key = content hash (the C key is a NUL-terminated
+        # string, so raw MLIR bytecode can't be the key itself)
+        key_b = key.encode() if key is not None \
+            else hashlib.sha256(code).hexdigest().encode()
+        hit = ctypes.c_int(0)
+        err = ctypes.create_string_buffer(_ERRLEN)
+        h = self._lib.dl4j_exec_cache_get_or_compile(
+            self._api, self._client, self._exec_cache, key_b, code,
+            len(code), ctypes.byref(hit), err, _ERRLEN)
+        if not h:
+            raise PjrtError(f"compile failed: "
+                            f"{err.value.decode(errors='replace')}")
+        exe = PjrtExecutable(self, h)
+        exe._cache_owned = True
+        exe.cache_hit = bool(hit.value)
+        return exe
+
+    @property
+    def exec_cache_size(self) -> int:
+        if getattr(self, "_exec_cache", None) is None:
+            return 0
+        return int(self._lib.dl4j_exec_cache_size(self._exec_cache))
+
+    def async_executor(self) -> "PjrtAsyncExecutor":
+        """Native FIFO dispatch queue: submit executions from the host
+        thread, overlap host work, wait on tickets (the async dispatch
+        role ND4J's op queue plays over libnd4j)."""
+        return PjrtAsyncExecutor(self)
+
     def to_device(self, array: np.ndarray,
                   device_ordinal: int = 0) -> PjrtBuffer:
         arr = np.ascontiguousarray(array)
@@ -349,6 +452,9 @@ class PjrtRuntime:
         return PjrtBuffer(self, h)
 
     def close(self) -> None:
+        if getattr(self, "_exec_cache", None):
+            self._lib.dl4j_exec_cache_destroy(self._api, self._exec_cache)
+            self._exec_cache = None
         if getattr(self, "_client", None):
             self._lib.dl4j_pjrt_client_destroy(self._api, self._client)
             self._client = None

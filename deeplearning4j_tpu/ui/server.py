@@ -130,6 +130,7 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpu-ui/1.0"
     storage: StatsStorage = None  # injected
     tsne_data = None              # {"coords": [...], "labels": [...]}
+    remote_enabled = True         # --no-remote turns off /remote/receive
     activations_dir = None        # Path written by Conv listener
     flow_path = None              # Path written by Flow listener
 
@@ -243,6 +244,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path != "/remote/receive":
             self._json({"error": "not found"}, 404)
             return
+        if not type(self).remote_enabled:
+            self._json({"error": "remote receiver disabled"}, 403)
+            return
         length = int(self.headers.get("Content-Length", 0))
         obj = json.loads(self.rfile.read(length) or b"{}")
         kind = obj.pop("_kind", "update")
@@ -319,3 +323,44 @@ class UIServer:
         self._httpd.server_close()
         if UIServer._instance is self:
             UIServer._instance = None
+
+
+def main(argv=None) -> None:
+    """CLI entry (reference: PlayUIServer's JCommander flags —
+    uiPort / enableRemote): `python -m deeplearning4j_tpu.ui.server
+    --port 9000 [--no-remote]`."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(description="deeplearning4j_tpu UI server")
+    ap.add_argument("--port", type=int, default=9000,
+                    help="HTTP port (0 = ephemeral)")
+    ap.add_argument("--no-remote", action="store_true",
+                    help="reject POST /remote/receive (reference: "
+                         "PlayUIServer enableRemote off by default)")
+    ap.add_argument("--activations-dir", default=None,
+                    help="serve ConvolutionalIterationListener grids")
+    ap.add_argument("--flow", default=None,
+                    help="serve FlowIterationListener JSON")
+    args = ap.parse_args(argv)
+    server = UIServer(port=args.port)
+    if args.no_remote:
+        server._handler.remote_enabled = False
+    if args.activations_dir:
+        server.attach_activations_dir(args.activations_dir)
+    if args.flow:
+        server.attach_flow(args.flow)
+    remote = ("disabled" if args.no_remote
+              else "POST /remote/receive accepts remote stats")
+    print(f"UI server listening on {server.url} ({remote})")
+    # block the signals BEFORE sigwait (POSIX: sigwait on unblocked
+    # signals is undefined; unblocked SIGTERM would just kill us and
+    # skip the clean stop())
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGINT, signal.SIGTERM})
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

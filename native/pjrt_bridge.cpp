@@ -20,9 +20,14 @@
 
 #include <dlfcn.h>
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <string>
+#include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "pjrt_c_api.h"
@@ -451,6 +456,212 @@ int dl4j_pjrt_execute(const void* api_p, void* lexec, void** in_bufs,
     out_bufs[i] = outputs[static_cast<size_t>(i)];
   }
   return num_outputs;
+}
+
+// ---------------------------------------------------------------------------
+// Executable cache — keyed compilation (SURVEY §7 "hard parts":
+// "executable caching keyed on shapes"). The key is caller-provided
+// (the host API uses the program's shape signature), the value a
+// PJRT_LoadedExecutable* owned by the cache until destroy.
+// ---------------------------------------------------------------------------
+
+struct Dl4jExecCache {
+  std::mutex mu;
+  std::unordered_map<std::string, void*> map;
+  const void* api;
+};
+
+void* dl4j_exec_cache_create(const void* api_p) {
+  auto* c = new Dl4jExecCache();
+  c->api = api_p;
+  return c;
+}
+
+// Returns the cached executable or compiles + inserts (one compile per
+// key even under concurrent callers). hits/misses are reported via the
+// out_hit flag so the host can track cache effectiveness.
+void* dl4j_exec_cache_get_or_compile(const void* api_p, void* client,
+                                     void* cache_p, const char* key,
+                                     const char* mlir, size_t mlir_size,
+                                     int* out_hit, char* err,
+                                     int errlen) {
+  auto* cache = static_cast<Dl4jExecCache*>(cache_p);
+  {
+    std::lock_guard<std::mutex> lock(cache->mu);
+    auto it = cache->map.find(key);
+    if (it != cache->map.end()) {
+      if (out_hit != nullptr) *out_hit = 1;
+      return it->second;
+    }
+  }
+  if (out_hit != nullptr) *out_hit = 0;
+  void* exec = dl4j_pjrt_compile_mlir(api_p, client, mlir, mlir_size,
+                                      nullptr, 0, err, errlen);
+  if (exec == nullptr) return nullptr;
+  std::lock_guard<std::mutex> lock(cache->mu);
+  auto it = cache->map.find(key);
+  if (it != cache->map.end()) {
+    // lost a compile race: keep the first entry, drop ours
+    dl4j_pjrt_executable_destroy(api_p, exec);
+    return it->second;
+  }
+  cache->map.emplace(key, exec);
+  return exec;
+}
+
+int dl4j_exec_cache_size(void* cache_p) {
+  auto* cache = static_cast<Dl4jExecCache*>(cache_p);
+  std::lock_guard<std::mutex> lock(cache->mu);
+  return static_cast<int>(cache->map.size());
+}
+
+int dl4j_exec_cache_destroy(const void* api_p, void* cache_p) {
+  auto* cache = static_cast<Dl4jExecCache*>(cache_p);
+  int rc = 0;
+  for (auto& kv : cache->map) {
+    if (dl4j_pjrt_executable_destroy(api_p, kv.second) != 0) rc = -1;
+  }
+  delete cache;
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
+// Async executor — a native dispatch queue so the host thread can
+// enqueue steps and overlap Python-side work (data prep, logging) with
+// device execution; the libnd4j-flush analog of ND4J's async op queue.
+// One worker thread executes submissions FIFO (PJRT execution itself
+// is async on-device; this queue removes the host dispatch+await from
+// the caller's thread).
+// ---------------------------------------------------------------------------
+
+struct Dl4jAsyncTask {
+  long long ticket;
+  void* lexec;
+  std::vector<void*> inputs;
+  bool done = false;
+  int num_outputs = -1;
+  std::vector<void*> outputs;
+  std::string error;
+};
+
+struct Dl4jAsyncExecutor {
+  const void* api;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Dl4jAsyncTask*> pending;
+  std::unordered_map<long long, Dl4jAsyncTask*> tasks;
+  long long next_ticket = 1;
+  bool shutting_down = false;
+  std::thread worker;
+};
+
+void* dl4j_async_create(const void* api_p) {
+  auto* ex = new Dl4jAsyncExecutor();
+  ex->api = api_p;
+  ex->worker = std::thread([ex]() {
+    for (;;) {
+      Dl4jAsyncTask* task = nullptr;
+      {
+        std::unique_lock<std::mutex> lock(ex->mu);
+        ex->cv.wait(lock, [ex]() {
+          return ex->shutting_down || !ex->pending.empty();
+        });
+        if (ex->pending.empty()) return;  // shutdown + drained
+        task = ex->pending.front();
+        ex->pending.pop_front();
+      }
+      char err[512] = {0};
+      std::vector<void*> outs(64, nullptr);
+      int n = dl4j_pjrt_execute(ex->api, task->lexec,
+                                task->inputs.data(),
+                                static_cast<int>(task->inputs.size()),
+                                outs.data(),
+                                static_cast<int>(outs.size()), err,
+                                sizeof(err));
+      {
+        std::lock_guard<std::mutex> lock(ex->mu);
+        task->num_outputs = n;
+        if (n < 0) {
+          task->error = err;
+        } else {
+          task->outputs.assign(outs.begin(), outs.begin() + n);
+        }
+        task->done = true;
+      }
+      ex->cv.notify_all();
+    }
+  });
+  return ex;
+}
+
+long long dl4j_async_submit(void* ex_p, void* lexec, void** in_bufs,
+                            int num_args) {
+  auto* ex = static_cast<Dl4jAsyncExecutor*>(ex_p);
+  auto* task = new Dl4jAsyncTask();
+  task->lexec = lexec;
+  task->inputs.assign(in_bufs, in_bufs + num_args);
+  long long ticket;
+  {
+    std::lock_guard<std::mutex> lock(ex->mu);
+    if (ex->shutting_down) {
+      delete task;
+      return -1;
+    }
+    ticket = ex->next_ticket++;
+    task->ticket = ticket;
+    ex->tasks.emplace(ticket, task);
+    ex->pending.push_back(task);
+  }
+  ex->cv.notify_all();
+  return ticket;
+}
+
+// Blocks until the ticket's execution finishes; fills out_bufs and
+// removes the task. Returns output count or -1 (error text in err).
+int dl4j_async_wait(void* ex_p, long long ticket, void** out_bufs,
+                    int max_outputs, char* err, int errlen) {
+  auto* ex = static_cast<Dl4jAsyncExecutor*>(ex_p);
+  Dl4jAsyncTask* task = nullptr;
+  {
+    std::unique_lock<std::mutex> lock(ex->mu);
+    auto it = ex->tasks.find(ticket);
+    if (it == ex->tasks.end()) {
+      set_err(err, errlen, "unknown ticket");
+      return -1;
+    }
+    task = it->second;
+    ex->cv.wait(lock, [task]() { return task->done; });
+    ex->tasks.erase(it);
+  }
+  int n = task->num_outputs;
+  if (n < 0) {
+    set_err(err, errlen, task->error.c_str());
+  } else if (n > max_outputs) {
+    // free the materialized device buffers before failing, or they
+    // leak HBM with no handle left to reclaim them
+    for (void* b : task->outputs) dl4j_pjrt_buffer_destroy(ex->api, b);
+    set_err(err, errlen, "output buffer array too small");
+    n = -1;
+  } else {
+    for (int i = 0; i < n; ++i) out_bufs[i] = task->outputs[i];
+  }
+  delete task;
+  return n;
+}
+
+int dl4j_async_destroy(void* ex_p) {
+  auto* ex = static_cast<Dl4jAsyncExecutor*>(ex_p);
+  {
+    std::lock_guard<std::mutex> lock(ex->mu);
+    ex->shutting_down = true;
+  }
+  ex->cv.notify_all();
+  if (ex->worker.joinable()) ex->worker.join();
+  // any never-waited tasks leak their output buffers by design (the
+  // caller owns buffer lifetime); free task records only
+  for (auto& kv : ex->tasks) delete kv.second;
+  delete ex;
+  return 0;
 }
 
 }  // extern "C"

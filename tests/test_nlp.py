@@ -276,3 +276,25 @@ def test_nan_guard_listener():
     soft = NanScoreGuardListener(raise_on_invalid=False)
     soft.iteration_done(None, 3, float("inf"))
     assert soft.tripped_at == 3
+
+
+def test_stream_line_iterator_and_vocabulary_holder():
+    """Reference analogs: sentenceiterator/StreamLineIterator.java,
+    wordstore/VocabularyHolder.java."""
+    import io
+    from deeplearning4j_tpu.nlp import (AbstractCache, StreamLineIterator,
+                                        VocabularyHolder)
+    it = StreamLineIterator(io.StringIO("a b c\nd e\n"))
+    assert list(it) == ["a b c", "d e"]
+    assert list(it) == ["a b c", "d e"]  # reset works
+
+    holder = VocabularyHolder(min_word_frequency=2)
+    for w in ["the", "the", "the", "cat", "cat", "rare"]:
+        holder.add_word(w)
+    assert holder.word_frequency("the") == 3
+    holder.truncate_vocabulary()
+    assert holder.num_words() == 2  # 'rare' dropped
+    cache = holder.transfer_back_to_vocab_cache(AbstractCache())
+    assert cache.contains_word("the") and not cache.contains_word("rare")
+    assert cache.word_for("the").index == 0  # most frequent first
+    assert cache.word_for("the").code  # Huffman built

@@ -123,3 +123,68 @@ def test_jax_lowering_feeds_the_bridge(runtime):
     (out,) = exe(a, a)
     np.testing.assert_allclose(out, 2 * np.ones(8, np.float32))
     exe.close()
+
+
+def test_executable_cache_hit_and_miss(runtime):
+    """Shape-keyed native executable cache (SURVEY §7: 'executable
+    caching keyed on shapes')."""
+    e1 = runtime.compile_cached(_STABLEHLO_ADD, key="add:8xf32")
+    assert not e1.cache_hit
+    assert runtime.exec_cache_size == 1
+    e2 = runtime.compile_cached(_STABLEHLO_ADD, key="add:8xf32")
+    assert e2.cache_hit
+    assert runtime.exec_cache_size == 1
+    e3 = runtime.compile_cached(_STABLEHLO_MUL, key="mul:2x3xf32")
+    assert not e3.cache_hit
+    assert runtime.exec_cache_size == 2
+    a = np.arange(8, dtype=np.float32)
+    (out,) = e2(a, a)
+    np.testing.assert_allclose(out, a + a)
+    # cached handles are cache-owned: close() must be a safe no-op
+    e1.close()
+    e4 = runtime.compile_cached(_STABLEHLO_ADD, key="add:8xf32")
+    assert e4.cache_hit
+    (out2,) = e4(a, a)
+    np.testing.assert_allclose(out2, a + a)
+
+
+def test_async_executor_fifo(runtime):
+    """Native dispatch queue: submit N executions, wait out of order."""
+    exe = runtime.compile(_STABLEHLO_ADD)
+    with runtime.async_executor() as ex:
+        bufs = []
+        tickets = []
+        for i in range(4):
+            a = np.full(8, float(i), np.float32)
+            b1, b2 = runtime.to_device(a), runtime.to_device(a)
+            bufs += [b1, b2]
+            tickets.append(ex.submit(exe, [b1, b2]))
+        # wait in reverse order: results must match their own ticket
+        for i in reversed(range(4)):
+            (out,) = ex.wait(tickets[i])
+            np.testing.assert_allclose(out.to_numpy(),
+                                       np.full(8, 2.0 * i, np.float32))
+            out.close()
+        for b in bufs:
+            b.close()
+    exe.close()
+
+
+def test_async_executor_error_path(runtime):
+    """A failing execution surfaces its error at wait() and doesn't
+    poison the queue."""
+    exe = runtime.compile(_STABLEHLO_ADD)
+    b = runtime.to_device(np.arange(8, dtype=np.float32))
+    with runtime.async_executor() as ex:
+        bad = ex.submit(exe, [b])  # wrong arity
+        good_b2 = runtime.to_device(np.arange(8, dtype=np.float32))
+        good = ex.submit(exe, [b, good_b2])
+        with pytest.raises(pjrt.PjrtError):
+            ex.wait(bad)
+        (out,) = ex.wait(good)
+        np.testing.assert_allclose(out.to_numpy(),
+                                   2 * np.arange(8, dtype=np.float32))
+        out.close()
+        good_b2.close()
+    b.close()
+    exe.close()
