@@ -1,0 +1,120 @@
+"""Rule-based part-of-speech tagging + POS-filtered tokenization.
+
+Capability parity with the reference's UIMA NLP module (reference:
+deeplearning4j-nlp-uima/.../tokenization/tokenizer/PosUimaTokenizer.java
+— tokenize, POS-tag via a UIMA annotator pipeline, keep only tokens
+whose tags are in an allow-list — and uima/UimaResource.java). UIMA is
+JVM middleware, not a capability; what survives the port is the
+capability itself: tagging and tag-filtered token streams. The tagger
+here is a deterministic closed-class-lexicon + suffix-rule English
+tagger (the Brill-tagger baseline stage) — small, dependency-free, and
+deterministic, which is what embedding-pipeline filtering needs.
+
+Tags follow the Penn Treebank conventions the reference's allow-lists
+use (NN, NNS, NNP, VB, VBD, VBG, JJ, RB, CD, DT, IN, PRP, CC, ...).
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.nlp.tokenization import (Tokenizer,
+                                                 TokenizerFactory)
+
+# closed-class lexicon: unambiguous (or dominant-reading) function words
+_LEXICON = {
+    "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
+    "these": "DT", "those": "DT", "some": "DT", "any": "DT", "no": "DT",
+    "and": "CC", "or": "CC", "but": "CC", "nor": "CC", "yet": "CC",
+    "in": "IN", "on": "IN", "at": "IN", "by": "IN", "for": "IN",
+    "with": "IN", "from": "IN", "of": "IN", "to": "TO", "as": "IN",
+    "into": "IN", "over": "IN", "under": "IN", "after": "IN",
+    "before": "IN", "between": "IN", "through": "IN", "during": "IN",
+    "i": "PRP", "you": "PRP", "he": "PRP", "she": "PRP", "it": "PRP",
+    "we": "PRP", "they": "PRP", "me": "PRP", "him": "PRP", "her": "PRP",
+    "us": "PRP", "them": "PRP",
+    "my": "PRP$", "your": "PRP$", "his": "PRP$", "its": "PRP$",
+    "our": "PRP$", "their": "PRP$",
+    "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD", "am": "VBP",
+    "be": "VB", "been": "VBN", "being": "VBG",
+    "have": "VBP", "has": "VBZ", "had": "VBD",
+    "do": "VBP", "does": "VBZ", "did": "VBD",
+    "will": "MD", "would": "MD", "can": "MD", "could": "MD",
+    "shall": "MD", "should": "MD", "may": "MD", "might": "MD",
+    "must": "MD",
+    "not": "RB", "n't": "RB", "very": "RB", "too": "RB", "also": "RB",
+    "there": "EX", "who": "WP", "what": "WP", "which": "WDT",
+    "when": "WRB", "where": "WRB", "why": "WRB", "how": "WRB",
+    # common irregular past forms (no -ed suffix to key on)
+    "ran": "VBD", "went": "VBD", "said": "VBD", "made": "VBD",
+    "got": "VBD", "took": "VBD", "came": "VBD", "saw": "VBD",
+    "knew": "VBD", "found": "VBD", "gave": "VBD", "told": "VBD",
+    "became": "VBD", "left": "VBD", "put": "VBD", "kept": "VBD",
+    "began": "VBD", "brought": "VBD", "wrote": "VBD", "stood": "VBD",
+    "held": "VBD", "heard": "VBD", "let": "VBD", "meant": "VBD",
+    "set": "VBD", "met": "VBD", "paid": "VBD", "sent": "VBD",
+    "built": "VBD", "spent": "VBD", "lost": "VBD", "thought": "VBD",
+    "sat": "VBD", "ate": "VBD", "slept": "VBD", "fell": "VBD",
+    "spoke": "VBD", "read": "VBD", "drove": "VBD", "grew": "VBD",
+    # frequent adjectives the suffix rules can't see
+    "quick": "JJ", "good": "JJ", "bad": "JJ", "new": "JJ", "old": "JJ",
+    "big": "JJ", "small": "JJ", "high": "JJ", "low": "JJ",
+    "long": "JJ", "short": "JJ", "great": "JJ", "same": "JJ",
+    "own": "JJ", "few": "JJ", "many": "JJ", "much": "JJ",
+}
+
+_NUMBER = re.compile(r"^[+-]?(\d+([.,]\d+)*|[.,]\d+)$")
+_PUNCT = re.compile(r"^[^\w\s]+$")
+
+# (suffix, tag) rules, first match wins — the Brill baseline stage
+_SUFFIX_RULES: Sequence[Tuple[str, str]] = (
+    ("ing", "VBG"), ("edly", "RB"), ("ed", "VBD"), ("ies", "NNS"),
+    ("ously", "RB"), ("ly", "RB"), ("ment", "NN"), ("ness", "NN"),
+    ("tion", "NN"), ("sion", "NN"), ("ity", "NN"), ("ism", "NN"),
+    ("ible", "JJ"), ("able", "JJ"), ("ful", "JJ"), ("ous", "JJ"),
+    ("ive", "JJ"), ("ic", "JJ"), ("al", "JJ"), ("est", "JJS"),
+    ("er", "NN"), ("ers", "NNS"), ("s", "NNS"),
+)
+
+
+def pos_tag_word(word: str, *, sentence_initial: bool = False) -> str:
+    """Tag one token (Penn Treebank tag)."""
+    low = word.lower()
+    if low in _LEXICON:
+        return _LEXICON[low]
+    if _NUMBER.match(word):
+        return "CD"
+    if _PUNCT.match(word):
+        return "."
+    if word[:1].isupper() and not sentence_initial:
+        return "NNP"
+    for suffix, tag in _SUFFIX_RULES:
+        if low.endswith(suffix) and len(low) > len(suffix) + 1:
+            return tag
+    return "NN"
+
+
+def pos_tag(tokens: Sequence[str]) -> List[Tuple[str, str]]:
+    """Tag a token sequence: [(token, tag), ...]."""
+    return [(t, pos_tag_word(t, sentence_initial=(i == 0)))
+            for i, t in enumerate(tokens)]
+
+
+class PosTaggedTokenizerFactory(TokenizerFactory):
+    """Tokenize then keep only tokens whose POS tag is in the allow-list
+    — exact set membership, matching the reference PosUimaTokenizer's
+    `allowedPosTags` semantics (list "NN" and "NNS" separately, as its
+    users do). Wraps any base TokenizerFactory; tags with the rule
+    tagger above."""
+
+    def __init__(self, base: TokenizerFactory,
+                 allowed_pos_tags: Sequence[str],
+                 preprocessor=None):
+        super().__init__(preprocessor)
+        self.base = base
+        self.allowed = set(allowed_pos_tags)
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self.base.create(text).get_tokens()
+        kept = [t for t, tag in pos_tag(toks) if tag in self.allowed]
+        return Tokenizer(kept, self._pre)

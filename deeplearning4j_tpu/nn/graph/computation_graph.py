@@ -43,6 +43,7 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self.score_value = float("nan")
         self._jit_cache: Dict[Any, Any] = {}
+        self._solver = None
         self._preprocessors: Dict[str, Any] = {}
         self._initialized = False
         self._resolve_shapes()
@@ -283,6 +284,13 @@ class ComputationGraph:
         an extra leading [N] batches axis; returns per-step scores [N]."""
         if not self._initialized:
             self.init()
+        tc = self.conf.training
+        if tc.optimization_algo not in ("stochastic_gradient_descent",
+                                        "sgd"):
+            raise ValueError(
+                "fit_batched supports first-order optimization only; "
+                f"optimization_algo={tc.optimization_algo!r} dispatches "
+                "to the Solver path — use fit() instead")
         inputs = self._as_input_dict(feats, self.conf.network_inputs)
         labels = self._as_input_dict(labs, self.conf.network_outputs)
         fn = self._jit_cache.get(("scanfit",))
@@ -338,6 +346,23 @@ class ComputationGraph:
         mask_dict = None
         if masks is not None:
             mask_dict = self._as_input_dict(masks, self.conf.network_inputs)
+        if self.conf.training.optimization_algo not in (
+                "stochastic_gradient_descent", "sgd"):
+            # Second-order path (reference: ComputationGraph training also
+            # dispatches through Solver.java:48 to LBFGS/CG/LineGD)
+            from deeplearning4j_tpu.train.solvers import Solver
+            if self._solver is None:
+                self._solver = Solver(self)
+
+            def _notify(score):
+                self.score_value = score
+                for l in self.listeners:
+                    l.iteration_done(self, self.iteration_count, score)
+                self.iteration_count += 1
+
+            self._solver.optimize(inputs, labels, mask_dict,
+                                  iteration_callback=_notify)
+            return
         shape_key = tuple(sorted((k, v.shape) for k, v in inputs.items()))
         step = self._jit_cache.get(("train", shape_key))
         if step is None:

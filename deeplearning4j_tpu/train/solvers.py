@@ -276,9 +276,18 @@ _ALGOS = {
 }
 
 
+def _shape_key(v):
+    """Hashable shape signature for an array or a name->array dict
+    (ComputationGraph passes input/label dicts)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, a.shape) for k, a in v.items()))
+    return v.shape
+
+
 class Solver:
     """Dispatch a model + minibatch onto an optimizer (reference:
-    optimize/Solver.java:41-74). Builds ONE jitted flat
+    optimize/Solver.java:41-74 — serves both MultiLayerNetwork and
+    ComputationGraph, as in the reference). Builds ONE jitted flat
     ``value_and_grad`` of the network score (cached per input shape) and
     hands it to the algorithm selected by
     ``conf.training.optimization_algo``."""
@@ -306,7 +315,7 @@ class Solver:
         (BaseOptimizer.gradientAndScore:156). Line-search probes use the
         forward-only program and leave state untouched (exploratory
         points should not pollute running statistics)."""
-        key = (x.shape, y.shape, mask is not None)
+        key = (_shape_key(x), _shape_key(y), mask is not None)
         pair = self._vg_cache.get(key)
         if pair is None:
             net = self.net
@@ -344,10 +353,17 @@ class Solver:
         final score. ``iteration_callback(score)`` fires after each
         internal optimization step with net.params already updated
         (reference: BaseOptimizer listener notification per iteration)."""
+        def as_dev(v):
+            if v is None:
+                return None
+            if isinstance(v, dict):
+                return {k: jnp.asarray(a) for k, a in v.items()}
+            return jnp.asarray(v)
+
         net = self.net
-        x = jnp.asarray(x)
-        y = jnp.asarray(y)
-        mask = None if mask is None else jnp.asarray(mask)
+        x = as_dev(x)
+        y = as_dev(y)
+        mask = as_dev(mask)
         vg, value = self._flat_fns(x, y, mask)
         flat, unravel = ravel_pytree(net.params)
         cls = _ALGOS[self.algo]

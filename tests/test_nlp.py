@@ -298,3 +298,29 @@ def test_stream_line_iterator_and_vocabulary_holder():
     assert cache.contains_word("the") and not cache.contains_word("rare")
     assert cache.word_for("the").index == 0  # most frequent first
     assert cache.word_for("the").code  # Huffman built
+
+
+def test_pos_tagging_and_filtered_tokenizer():
+    """POS tagging + allow-list filtering (reference capability:
+    deeplearning4j-nlp-uima PosUimaTokenizer allowedPosTags)."""
+    from deeplearning4j_tpu.nlp.pos import (PosTaggedTokenizerFactory,
+                                            pos_tag)
+    from deeplearning4j_tpu.nlp.tokenization import \
+        DefaultTokenizerFactory
+
+    tags = dict(pos_tag("the quick dogs ran quickly to 42 rivers".split()))
+    assert tags["the"] == "DT"
+    assert tags["dogs"] == "NNS"
+    assert tags["quickly"] == "RB"
+    assert tags["to"] == "TO"
+    assert tags["42"] == "CD"
+    # mid-sentence capitalization → proper noun
+    assert dict(pos_tag("visit London today".split()))["London"] == "NNP"
+
+    # noun-only stream, PosUimaTokenizer-style
+    fac = PosTaggedTokenizerFactory(DefaultTokenizerFactory(),
+                                    allowed_pos_tags=["NN", "NNS"])
+    toks = fac.create("the quick movement of dogs ran to the station"
+                      ).get_tokens()
+    assert "movement" in toks and "dogs" in toks and "station" in toks
+    assert "the" not in toks and "of" not in toks and "ran" not in toks

@@ -120,3 +120,36 @@ def test_network_fit_with_lbfgs_and_cg():
         net.fit(x, y)
         after = float(net.score_value)
         assert after < before, f"{algo}: {after} !< {before}"
+
+
+def test_lbfgs_on_computation_graph():
+    """Second-order solvers drive ComputationGraph too (reference:
+    ComputationGraph training dispatches through Solver.java like
+    MultiLayerNetwork)."""
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    labels = (x.sum(axis=1) > 0).astype(np.int64)
+    y = np.eye(3, dtype=np.float32)[np.minimum(labels * 2, 2)]
+    conf = (NeuralNetConfiguration(seed=1, optimization_algo="lbfgs",
+                                   num_iterations=20, activation="tanh")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=4, n_out=12), "in")
+            .add_layer("out", OutputLayer(n_in=12, n_out=3,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "h")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    s0 = g.score(x, y)
+    g.fit(x, y)
+    s1 = g.score(x, y)
+    assert s1 < s0 * 0.7, (s0, s1)
+    assert g.iteration_count > 1  # per-internal-step listener advances

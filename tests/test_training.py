@@ -290,3 +290,19 @@ def test_graph_fit_batched_matches_per_step_fit():
     np.testing.assert_allclose(np.asarray(net.params_flat()),
                                np.asarray(ref.params_flat()),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_graph_fit_batched_rejects_second_order():
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    conf = (NeuralNetConfiguration(seed=1, optimization_algo="lbfgs")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "in")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    with pytest.raises(ValueError, match="first-order"):
+        g.fit_batched(np.zeros((2, 8, 4), np.float32),
+                      np.zeros((2, 8, 2), np.float32))
