@@ -262,10 +262,16 @@ class ViewIterator:
 
 class IteratorDataSetIterator:
     """Wrap any python iterable of DataSets (reference:
-    datasets/iterator/IteratorDataSetIterator.java)."""
+    datasets/iterator/IteratorDataSetIterator.java). One-shot sources
+    (generators/iterators) cannot be reset — a second epoch raises, as
+    the reference reports resetSupported()==false, rather than silently
+    yielding nothing."""
 
     def __init__(self, iterable):
         self._factory = iterable
+        self._one_shot = (not callable(iterable)
+                          and iter(iterable) is iterable)
+        self._consumed = False
         self._it = None
 
     def __iter__(self):
@@ -279,6 +285,15 @@ class IteratorDataSetIterator:
 
     def reset(self):
         it = self._factory
+        if self._one_shot:
+            if self._consumed:
+                raise ValueError(
+                    "reset not supported: the wrapped source is a "
+                    "one-shot iterator (pass a list or a factory "
+                    "callable for multi-epoch use)")
+            self._consumed = True
+            self._it = it
+            return
         self._it = iter(it() if callable(it) else it)
 
 
@@ -333,3 +348,102 @@ class MovingWindowDataSetIterator:
 
     def reset(self):
         self._inner.reset()
+
+
+class AbstractDataSetIterator(BaseDatasetIterator):
+    """Minibatch iterator over an iterable of (features, labels) pairs
+    (reference: datasets/iterator/AbstractDataSetIterator.java and its
+    element-typed subclasses Floats/Doubles/INDArrayDataSetIterator —
+    numpy erases the element-type distinction, so the three are
+    aliases)."""
+
+    def __init__(self, pairs, batch_size: int):
+        pairs = list(pairs)
+        if not pairs:
+            super().__init__(np.zeros((0, 0)), np.zeros((0, 0)),
+                             batch_size)
+            return
+        feats, labs = zip(*pairs)
+        super().__init__(np.stack([np.asarray(f) for f in feats]),
+                         np.stack([np.asarray(l) for l in labs]),
+                         batch_size)
+
+
+# reference parity aliases (FloatsDataSetIterator.java,
+# DoublesDataSetIterator.java, INDArrayDataSetIterator.java)
+FloatsDataSetIterator = AbstractDataSetIterator
+DoublesDataSetIterator = AbstractDataSetIterator
+INDArrayDataSetIterator = AbstractDataSetIterator
+
+
+class DummyPreProcessor:
+    """No-op DataSet preprocessor (reference:
+    datasets/iterator/DummyPreProcessor.java)."""
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        return dataset
+
+
+class CombinedPreProcessor:
+    """Chain DataSet preprocessors in order (reference:
+    datasets/iterator/CombinedPreProcessor.java — Builder.addPreProcessor
+    ordering)."""
+
+    def __init__(self, *preprocessors):
+        self._pre = list(preprocessors)
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        for p in self._pre:
+            out = p.pre_process(dataset)
+            dataset = dataset if out is None else out
+        return dataset
+
+
+class IteratorMultiDataSetIterator(IteratorDataSetIterator):
+    """Wrap any python iterable of MultiDataSets (reference:
+    datasets/iterator/IteratorMultiDataSetIterator.java). The wrapper is
+    payload-agnostic, so this shares IteratorDataSetIterator."""
+
+
+class SingletonMultiDataSetIterator:
+    """Yield one fixed MultiDataSet per epoch (reference:
+    datasets/iterator/impl/SingletonMultiDataSetIterator.java)."""
+
+    def __init__(self, mds):
+        self.mds = mds
+        self._done = False
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        self._done = True
+        return self.mds
+
+    def reset(self):
+        self._done = False
+
+
+class MultiDataSetIteratorAdapter:
+    """Present a DataSetIterator as a MultiDataSetIterator (reference:
+    datasets/iterator/impl/MultiDataSetIteratorAdapter.java)."""
+
+    def __init__(self, base):
+        self.base = base
+
+    def __iter__(self):
+        from deeplearning4j_tpu.datasets.records import MultiDataSet
+        for ds in self.base:
+            yield MultiDataSet(
+                features=[ds.features], labels=[ds.labels],
+                features_masks=(None if ds.features_mask is None
+                                else [ds.features_mask]),
+                labels_masks=(None if ds.labels_mask is None
+                              else [ds.labels_mask]))
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()

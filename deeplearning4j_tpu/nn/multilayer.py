@@ -620,11 +620,17 @@ class MultiLayerNetwork:
 
 
 def _unpack_batch(batch):
-    """Accept (x, y), (x, y, fmask, lmask), or DataSet-like objects."""
+    """Accept (x, y), (x, y, fmask, lmask), or (Multi)DataSet-like
+    objects (MultiDataSet carries plural features_masks/labels_masks)."""
     if hasattr(batch, "features"):
+        fmask = getattr(batch, "features_mask", None)
+        lmask = getattr(batch, "labels_mask", None)
+        if fmask is None:
+            fmask = getattr(batch, "features_masks", None)
+        if lmask is None:
+            lmask = getattr(batch, "labels_masks", None)
         return (batch.features, getattr(batch, "labels", None),
-                getattr(batch, "features_mask", None),
-                getattr(batch, "labels_mask", None))
+                fmask, lmask)
     if isinstance(batch, (tuple, list)):
         if len(batch) == 2:
             return batch[0], batch[1], None, None

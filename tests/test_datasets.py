@@ -146,3 +146,79 @@ def test_reconstruction_and_moving_window():
                                      window_h=4, window_w=4)
     b = next(iter(mw))
     assert b.features.shape == (8, 4, 4, 1)
+
+
+def test_iterator_longtail_parity():
+    """AbstractDataSetIterator aliases, preprocessor chaining, multi
+    adapters (reference: datasets/iterator/{AbstractDataSetIterator,
+    CombinedPreProcessor,DummyPreProcessor,IteratorMultiDataSetIterator,
+    impl/SingletonMultiDataSetIterator,impl/MultiDataSetIteratorAdapter})."""
+    from deeplearning4j_tpu.datasets.iterators import (
+        AbstractDataSetIterator, CombinedPreProcessor, DataSet,
+        DoublesDataSetIterator, DummyPreProcessor, FloatsDataSetIterator,
+        INDArrayDataSetIterator, IteratorMultiDataSetIterator,
+        ListDataSetIterator, MultiDataSetIteratorAdapter,
+        SingletonMultiDataSetIterator)
+    from deeplearning4j_tpu.datasets.records import MultiDataSet
+
+    pairs = [(np.full(3, i, np.float32), np.eye(2, dtype=np.float32)[i % 2])
+             for i in range(6)]
+    it = AbstractDataSetIterator(pairs, batch_size=4)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [4, 2]
+    assert FloatsDataSetIterator is AbstractDataSetIterator
+    assert DoublesDataSetIterator is INDArrayDataSetIterator
+
+    class AddOne:
+        def pre_process(self, ds):
+            return DataSet(ds.features + 1, ds.labels)
+
+    chain = CombinedPreProcessor(DummyPreProcessor(), AddOne(), AddOne())
+    out = chain.pre_process(DataSet(np.zeros((2, 3)), np.zeros((2, 2))))
+    assert float(out.features.max()) == 2.0
+
+    mds = MultiDataSet(features=[np.ones((4, 2))], labels=[np.zeros((4, 1))])
+    single = SingletonMultiDataSetIterator(mds)
+    assert len(list(single)) == 1 and len(list(single)) == 1  # resets
+    multi = IteratorMultiDataSetIterator([mds, mds])
+    assert len(list(multi)) == 2
+
+    base = ListDataSetIterator(
+        [DataSet(np.ones((6, 2), np.float32),
+                 np.zeros((6, 2), np.float32))], batch_size=3)
+    adapted = list(MultiDataSetIteratorAdapter(base))
+    assert len(adapted) == 2
+    assert isinstance(adapted[0], MultiDataSet)
+    assert adapted[0].features[0].shape == (3, 2)
+
+
+def test_iterator_wrapper_edge_cases():
+    """Review-hardened paths: one-shot generators refuse silent empty
+    epochs; empty pair sources construct; adapter masks survive."""
+    from deeplearning4j_tpu.datasets.iterators import (
+        AbstractDataSetIterator, DataSet, IteratorMultiDataSetIterator,
+        MultiDataSetIteratorAdapter, ListDataSetIterator)
+    from deeplearning4j_tpu.datasets.records import MultiDataSet
+    from deeplearning4j_tpu.nn.multilayer import _unpack_batch
+
+    gen = (MultiDataSet(features=[np.ones((2, 2))],
+                        labels=[np.zeros((2, 1))]) for _ in range(2))
+    it = IteratorMultiDataSetIterator(gen)
+    assert len(list(it)) == 2
+    with pytest.raises(ValueError, match="one-shot"):
+        list(it)
+
+    empty = AbstractDataSetIterator([], batch_size=4)
+    assert list(empty) == []
+
+    class _MaskedIter:
+        def __iter__(self):
+            yield DataSet(np.ones((2, 3, 4)), np.zeros((2, 3, 2)),
+                          features_mask=np.array([[1, 1, 0], [1, 0, 0]],
+                                                 np.float32))
+        def reset(self):
+            pass
+
+    mds = next(iter(MultiDataSetIteratorAdapter(_MaskedIter())))
+    feats, labs, fmask, lmask = _unpack_batch(mds)
+    assert fmask is not None and np.asarray(fmask[0]).shape == (2, 3)
