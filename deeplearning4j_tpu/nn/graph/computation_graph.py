@@ -9,6 +9,7 @@ output losses + backward + update jit into a single XLA program.
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -102,14 +103,32 @@ class ComputationGraph:
         self.listeners = list(listeners)
 
     # --------------------------------------------------------------- forward
+    def _apply_layer_vertex(self, v, name, params, state, h, *, train,
+                            lkey, mask, carries, new_state, new_carries):
+        """Run one Layer vertex: scan_sequence from the given carry when
+        streaming/TBPTT, plain apply otherwise. Shared by _forward and
+        _forward_preout so the dispatch can't drift."""
+        if carries is not None and name in carries \
+                and hasattr(v, "scan_sequence"):
+            h, carry = v.scan_sequence(params[name], h,
+                                       carry=carries[name], mask=mask)
+            new_carries[name] = carry
+            new_state[name] = state.get(name, {})
+        else:
+            h, st = v.apply(params[name], state.get(name, {}), h,
+                            train=train, key=lkey, mask=mask)
+            new_state[name] = st
+        return h
+
     def _forward(self, params, state, inputs: Dict[str, Array], *,
-                 train: bool, key, masks: Optional[Dict[str, Array]] = None
-                 ) -> Tuple[Dict[str, Array], Dict[str, Any]]:
+                 train: bool, key, masks: Optional[Dict[str, Array]] = None,
+                 carries: Optional[Dict[str, Any]] = None):
         values: Dict[str, Array] = {}
         for k, v in inputs.items():
             values[k] = v.astype(self.dtype) \
                 if jnp.issubdtype(v.dtype, jnp.floating) else v
         new_state: Dict[str, Any] = {}
+        new_carries: Dict[str, Any] = {}
         masks = masks or {}
         for i, name in enumerate(self.topo):
             spec = self.conf.vertices[name]
@@ -124,15 +143,18 @@ class ComputationGraph:
                 lkey = jax.random.fold_in(key, i) if key is not None else None
                 if train and (v.dropout or 0.0) > 0 and lkey is not None:
                     h = apply_dropout(h, v.dropout, lkey)
-                h, st = v.apply(params[name], state.get(name, {}), h,
-                                train=train, key=lkey, mask=in_masks[0])
+                h = self._apply_layer_vertex(
+                    v, name, params, state, h, train=train, lkey=lkey,
+                    mask=in_masks[0], carries=carries,
+                    new_state=new_state, new_carries=new_carries)
                 values[name] = h
-                new_state[name] = st
                 if in_masks[0] is not None and v.family == "rnn":
                     masks[name] = in_masks[0]
             else:
                 values[name] = v.apply(ins, masks=in_masks)
                 new_state[name] = state.get(name, {})
+        if carries is not None:
+            return values, new_state, new_carries
         return values, new_state
 
     def _loss_fn(self, params, state, inputs, labels: Dict[str, Array], key,
@@ -150,14 +172,19 @@ class ComputationGraph:
         return total, new_state
 
     def _forward_preout(self, params, state, inputs, *, key, masks=None,
-                        train=True):
+                        train=True, carries=None):
         """Forward in train mode, but for output layers record their INPUT
-        (pre-layer activation) so the loss can use fused pre-output forms."""
+        (pre-layer activation) so the loss can use fused pre-output forms.
+        With ``carries`` (name -> RNN carry), recurrent layers run
+        `scan_sequence` from the given state and the new carries are
+        returned — the TBPTT/streaming path (reference:
+        ComputationGraph.doTruncatedBPTT:2042 / rnnTimeStep)."""
         values: Dict[str, Array] = {}
         for k, v in inputs.items():
             values[k] = v.astype(self.dtype) \
                 if jnp.issubdtype(v.dtype, jnp.floating) else v
         new_state: Dict[str, Any] = {}
+        new_carries: Dict[str, Any] = {}
         masks = dict(masks or {})
         out_records: Dict[str, Tuple[Array, Optional[Array]]] = {}
         outputs = set(self.conf.network_outputs)
@@ -176,10 +203,11 @@ class ComputationGraph:
                     h = apply_dropout(h, v.dropout, lkey)
                 if name in outputs and hasattr(v, "loss"):
                     out_records[name] = (h, in_masks[0])
-                h, st = v.apply(params[name], state.get(name, {}), h,
-                                train=train, key=lkey, mask=in_masks[0])
+                h = self._apply_layer_vertex(
+                    v, name, params, state, h, train=train, lkey=lkey,
+                    mask=in_masks[0], carries=carries,
+                    new_state=new_state, new_carries=new_carries)
                 values[name] = h
-                new_state[name] = st
                 if in_masks[0] is not None and v.family == "rnn":
                     masks[name] = in_masks[0]
             else:
@@ -189,6 +217,8 @@ class ComputationGraph:
             if name not in out_records:
                 raise ValueError(f"Output '{name}' is not a loss-bearing "
                                  f"layer")
+        if carries is not None:
+            return out_records, new_state, new_carries
         return out_records, new_state
 
     def _regularization_score(self, params) -> Array:
@@ -346,8 +376,23 @@ class ComputationGraph:
         mask_dict = None
         if masks is not None:
             mask_dict = self._as_input_dict(masks, self.conf.network_inputs)
-        if self.conf.training.optimization_algo not in (
-                "stochastic_gradient_descent", "sgd"):
+        first_order = self.conf.training.optimization_algo in (
+            "stochastic_gradient_descent", "sgd")
+        if self.conf.backprop_type == "tbptt" and all(
+                v.ndim == 3 for v in inputs.values()) and all(
+                v.ndim == 3 for v in labels.values()):
+            # TBPTT needs temporal labels to slice; 2D labels (e.g. via
+            # LastTimeStepVertex) fall through to standard BPTT
+            if not first_order:
+                raise ValueError(
+                    "TBPTT supports first-order optimization only "
+                    "(reference runs the Solver per chunk; here the "
+                    "chunk step is a compiled first-order update) — "
+                    f"optimization_algo="
+                    f"{self.conf.training.optimization_algo!r}")
+            self._fit_tbptt(inputs, labels, mask_dict)
+            return
+        if not first_order:
             # Second-order path (reference: ComputationGraph training also
             # dispatches through Solver.java:48 to LBFGS/CG/LineGD)
             from deeplearning4j_tpu.train.solvers import Solver
@@ -378,6 +423,120 @@ class ComputationGraph:
         for l in self.listeners:
             l.iteration_done(self, self.iteration_count, self.score_value)
         self.iteration_count += 1
+
+    # --------------------------------------------------------------- tbptt
+    def _init_carries(self, batch: int) -> Dict[str, Any]:
+        carries = {}
+        for name in self.topo:
+            v = self.conf.vertices[name].vertex
+            if isinstance(v, Layer) and hasattr(v, "initial_carry") \
+                    and getattr(v, "supports_streaming", True):
+                carries[name] = v.initial_carry(batch, self.dtype)
+        return carries
+
+    def _make_tbptt_step(self):
+        """Jitted TBPTT chunk step over the DAG (reference:
+        ComputationGraph.doTruncatedBPTT:2042)."""
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def chunk_step(params, state, opt_state, iteration, inputs,
+                       labels, carries, key, masks):
+            def loss_fn(p):
+                out_records, new_state, new_carries = self._forward_preout(
+                    p, state, inputs, key=key, masks=masks, train=True,
+                    carries=carries)
+                total = jnp.asarray(0.0)
+                for out_name in self.conf.network_outputs:
+                    layer = self.conf.vertices[out_name].vertex
+                    h_in, mask = out_records[out_name]
+                    total = total + promote_score(layer.loss(
+                        p[out_name], h_in, labels[out_name], mask))
+                total = total + self._regularization_score(p)
+                new_carries = jax.tree_util.tree_map(
+                    jax.lax.stop_gradient, new_carries)
+                return total, (new_state, new_carries)
+
+            (score, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = apply_updater(
+                tc, params, grads, opt_state, iteration,
+                lr_multipliers=lr_mult, trainable=trainable)
+            return new_params, new_state, new_opt, new_carries, score
+
+        return jax.jit(chunk_step)
+
+    def _fit_tbptt(self, inputs: Dict[str, Array],
+                   labels: Dict[str, Array], masks=None) -> None:
+        """Truncated BPTT over the DAG: chunk the time axis, carry RNN
+        state (stop-gradient) across chunks."""
+        T = next(iter(inputs.values())).shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_chunks = math.ceil(T / L)
+        batch = next(iter(inputs.values())).shape[0]
+        carries = self._init_carries(batch)
+        tc = self.conf.training
+        # key by (batch, feature dims) — NOT total T: the same compiled
+        # chunk step serves every sequence length (the chunk shapes
+        # retrace inside the one wrapper, as in the MLN analog)
+        shape_key = ("tbptt",) + tuple(sorted(
+            (k, v.shape[0], v.shape[2:]) for k, v in inputs.items()))
+        chunk_step = self._jit_cache.get(shape_key)
+        if chunk_step is None:
+            chunk_step = self._make_tbptt_step()
+            self._jit_cache[shape_key] = chunk_step
+
+        def time_slice(d, sl):
+            return {k: v[:, sl] for k, v in d.items()}
+
+        for c in range(n_chunks):
+            sl = slice(c * L, min((c + 1) * L, T))
+            key = jax.random.fold_in(jax.random.PRNGKey(tc.seed),
+                                     self.iteration_count)
+            (self.params, self.state, self.updater_state, carries,
+             score) = chunk_step(
+                self.params, self.state, self.updater_state,
+                self.iteration_count, time_slice(inputs, sl),
+                time_slice(labels, sl), carries, key,
+                None if masks is None else time_slice(masks, sl))
+            self.score_value = score
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration_count,
+                                 self.score_value)
+            self.iteration_count += 1
+
+    # ----------------------------------------------------------- streaming
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
+
+    def rnn_time_step(self, *data) -> List[Array]:
+        """Stateful single/multi-step inference over the DAG (reference:
+        ComputationGraph.rnnTimeStep)."""
+        for name in self.topo:
+            v = self.conf.vertices[name].vertex
+            if isinstance(v, Layer) and hasattr(v, "initial_carry") \
+                    and not getattr(v, "supports_streaming", True):
+                raise ValueError(
+                    f"rnn_time_step unsupported: vertex '{name}' "
+                    f"({type(v).__name__}) needs the full sequence")
+        if len(data) == 1:
+            inputs = self._as_input_dict(data[0], self.conf.network_inputs)
+        else:
+            inputs = self._as_input_dict(list(data),
+                                         self.conf.network_inputs)
+        squeeze = next(iter(inputs.values())).ndim == 2
+        if squeeze:
+            inputs = {k: v[:, None, :] for k, v in inputs.items()}
+        batch = next(iter(inputs.values())).shape[0]
+        if getattr(self, "_rnn_carries", None) is None:
+            self._rnn_carries = self._init_carries(batch)
+        values, _, new_carries = self._forward(
+            self.params, self.state, inputs, train=False, key=None,
+            carries=self._rnn_carries)
+        self._rnn_carries.update(new_carries)
+        outs = [values[n] for n in self.conf.network_outputs]
+        return [o[:, 0] if squeeze else o for o in outs]
 
     # ------------------------------------------------------------- inference
     def output(self, *data, train: bool = False) -> List[Array]:

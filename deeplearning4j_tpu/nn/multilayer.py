@@ -334,6 +334,12 @@ class MultiLayerNetwork:
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         if self.conf.backprop_type == "tbptt" and x.ndim == 3:
+            if self.conf.training.optimization_algo not in (
+                    "stochastic_gradient_descent", "sgd"):
+                raise ValueError(
+                    "TBPTT supports first-order optimization only — "
+                    f"optimization_algo="
+                    f"{self.conf.training.optimization_algo!r}")
             self._fit_tbptt(x, y, mask)
             return
         if self.conf.training.optimization_algo not in (

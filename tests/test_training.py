@@ -306,3 +306,37 @@ def test_graph_fit_batched_rejects_second_order():
     with pytest.raises(ValueError, match="first-order"):
         g.fit_batched(np.zeros((2, 8, 4), np.float32),
                       np.zeros((2, 8, 2), np.float32))
+
+
+def test_graph_tbptt_and_rnn_time_step():
+    """ComputationGraph TBPTT + streaming (reference:
+    ComputationGraph.doTruncatedBPTT:2042, rnnTimeStep)."""
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 20, 3).astype(np.float32)
+    y = np.tile(np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)][:, None],
+                (1, 20, 1))
+    conf = (NeuralNetConfiguration(seed=1, learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=6), "in")
+            .add_layer("out", RnnOutputLayer(n_in=6, n_out=2,
+                                             activation="softmax"),
+                       "lstm")
+            .set_outputs("out")
+            .backprop_type_tbptt(8, 8)
+            .build())
+    g = ComputationGraph(conf).init()
+    g.fit(x, y)
+    assert np.isfinite(float(g.score_value))
+    # 20 steps, chunks of 8 -> 3 chunk iterations
+    assert g.iteration_count == 3
+
+    # streaming: per-timestep output == full-sequence forward
+    g.rnn_clear_previous_state()
+    full = np.asarray(g.output(x)[0])
+    steps = [np.asarray(g.rnn_time_step(x[:, t])[0]) for t in range(20)]
+    np.testing.assert_allclose(np.stack(steps, 1), full, rtol=2e-3,
+                               atol=2e-3)
