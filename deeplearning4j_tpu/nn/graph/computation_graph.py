@@ -45,6 +45,7 @@ class ComputationGraph:
         self.score_value = float("nan")
         self._jit_cache: Dict[Any, Any] = {}
         self._solver = None
+        self._pretrain_counts: Dict[Any, int] = {}
         self._preprocessors: Dict[str, Any] = {}
         self._initialized = False
         self._resolve_shapes()
@@ -423,6 +424,94 @@ class ComputationGraph:
         for l in self.listeners:
             l.iteration_done(self, self.iteration_count, self.score_value)
         self.iteration_count += 1
+
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data) -> None:
+        """Greedy layerwise unsupervised pretraining of AE/RBM/VAE
+        vertices in topological order (reference:
+        ComputationGraph.pretrain:527)."""
+        if not self._initialized:
+            self.init()
+        for name in self.topo:
+            v = self.conf.vertices[name].vertex
+            if isinstance(v, Layer) and v.is_pretrain_layer():
+                self.pretrain_vertex(name, data)
+                if hasattr(data, "reset"):
+                    data.reset()
+
+    def _make_pretrain_step(self, name: str):
+        layer = self.conf.vertices[name].vertex
+        tc = self.conf.training
+
+        def vertex_input(up_params, up_state, inputs, key):
+            """Forward through the frozen upstream subgraph to the
+            target vertex's (preprocessed) input activation."""
+            values: Dict[str, Array] = {
+                k: (v.astype(self.dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in inputs.items()}
+            for i, n in enumerate(self.topo):
+                if n == name:
+                    spec = self.conf.vertices[n]
+                    h = values[spec.inputs[0]]
+                    pre = self._preprocessors.get(n)
+                    return pre.pre_process(h) if pre is not None else h
+                spec = self.conf.vertices[n]
+                v = spec.vertex
+                ins = [values[m] for m in spec.inputs if m in values]
+                if not ins and not isinstance(v, Layer):
+                    continue
+                if isinstance(v, Layer):
+                    h = ins[0]
+                    pre = self._preprocessors.get(n)
+                    if pre is not None:
+                        h = pre.pre_process(h)
+                    h, _ = v.apply(
+                        jax.lax.stop_gradient(up_params[n]),
+                        up_state.get(n, {}), h, train=False)
+                    values[n] = h
+                else:
+                    values[n] = v.apply(ins, masks=[None] * len(ins))
+            raise ValueError(f"vertex '{name}' not reached in topo order")
+
+        def pstep(up_params, up_state, params, opt_state, iteration,
+                  inputs, key):
+            def loss_fn(p):
+                h = vertex_input(up_params, up_state, inputs, key)
+                return layer.pretrain_loss(p, h, key)
+
+            score, grads = jax.value_and_grad(loss_fn)(params)
+            new_p, new_s = apply_updater(
+                tc, {name: params}, {name: grads}, {name: opt_state},
+                iteration)
+            return new_p[name], new_s[name], score
+
+        return jax.jit(pstep)
+
+    def pretrain_vertex(self, name: str, data) -> None:
+        layer = self.conf.vertices[name].vertex
+        if not (isinstance(layer, Layer) and layer.is_pretrain_layer()):
+            return
+        tc = self.conf.training
+        pstep = self._jit_cache.get(("pretrain", name))
+        if pstep is None:
+            pstep = self._make_pretrain_step(name)
+            self._jit_cache[("pretrain", name)] = pstep
+        upstream = self.topo[:self.topo.index(name)]
+        up_params = {n: self.params[n] for n in upstream}
+        up_state = {n: self.state.get(n, {}) for n in upstream}
+        it = self._pretrain_counts.get(name, 0)
+        batches = data if not hasattr(data, "__array__") else [(data, None)]
+        for batch in batches:
+            feats, _, _, _ = _unpack_batch(batch)
+            inputs = self._as_input_dict(feats, self.conf.network_inputs)
+            key = jax.random.fold_in(jax.random.PRNGKey(tc.seed), it)
+            (self.params[name], self.updater_state[name],
+             score) = pstep(up_params, up_state, self.params[name],
+                            self.updater_state[name], it, inputs, key)
+            self.score_value = score
+            it += 1
+        self._pretrain_counts[name] = it
 
     # --------------------------------------------------------------- tbptt
     def _init_carries(self, batch: int) -> Dict[str, Any]:

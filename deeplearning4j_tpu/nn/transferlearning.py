@@ -51,7 +51,12 @@ class FineTuneConfiguration:
             v = getattr(self, name)
             if v is not None:
                 setattr(tc, name, v)
-        for layer in conf.layers:
+        if hasattr(conf, "vertices"):  # ComputationGraphConfiguration
+            layers = [s.vertex for s in conf.vertices.values()
+                      if isinstance(s.vertex, Layer)]
+        else:
+            layers = conf.layers
+        for layer in layers:
             inner = layer.inner if isinstance(layer, FrozenLayer) else layer
             if self.learning_rate is not None:
                 inner.learning_rate = self.learning_rate
@@ -184,6 +189,141 @@ class TransferLearning:
                 if name in state:
                     new_net.state[name] = state[name]
             return new_net
+
+
+class _GraphBuilder:
+    """Transfer learning over a ComputationGraph (reference:
+    TransferLearning.GraphBuilder — setFeatureExtractor(vertexName)
+    freezes the named vertices and everything upstream; nOutReplace,
+    removeVertexAndConnections, addLayer, setOutputs)."""
+
+    def __init__(self, graph):
+        if not graph._initialized:
+            raise ValueError("source graph must be initialized")
+        self._graph = graph
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_at: List[str] = []
+        self._nout_replace: Dict[str, tuple] = {}
+        self._removed: List[str] = []
+        self._added: List[tuple] = []
+        self._new_outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration
+                                ) -> "_GraphBuilder":
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str) -> "_GraphBuilder":
+        self._freeze_at.extend(vertex_names)
+        return self
+
+    def n_out_replace(self, vertex_name: str, n_out: int,
+                      weight_init: str = "xavier") -> "_GraphBuilder":
+        self._nout_replace[vertex_name] = (n_out, weight_init)
+        return self
+
+    def remove_vertex_and_connections(self, name: str) -> "_GraphBuilder":
+        self._removed.append(name)
+        return self
+
+    def add_layer(self, name: str, layer: Layer,
+                  *inputs: str) -> "_GraphBuilder":
+        self._added.append((name, layer, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str) -> "_GraphBuilder":
+        self._new_outputs = list(names)
+        return self
+
+    def _upstream_closure(self, conf, names: List[str]) -> set:
+        out = set()
+        stack = list(names)
+        while stack:
+            n = stack.pop()
+            if n in out or n not in conf.vertices:
+                continue
+            out.add(n)
+            stack.extend(conf.vertices[n].inputs)
+        return out
+
+    def build(self):
+        from deeplearning4j_tpu.nn.graph.computation_graph import \
+            ComputationGraph
+        src = self._graph
+        conf = copy.deepcopy(src.conf)
+        params = jax.tree_util.tree_map(lambda a: a, src.params)
+        state = jax.tree_util.tree_map(lambda a: a, src.state)
+        reinit: set = set()
+
+        for name in self._removed:
+            if name not in conf.vertices:
+                raise ValueError(f"unknown vertex '{name}'")
+            del conf.vertices[name]
+            params.pop(name, None)
+            state.pop(name, None)
+            conf.network_outputs = [o for o in conf.network_outputs
+                                    if o != name]
+            # strip the edges too (reference: removeVertexAndConnections)
+            for spec in conf.vertices.values():
+                spec.inputs = [i for i in spec.inputs if i != name]
+
+        for name, (n_out, w_init) in self._nout_replace.items():
+            if name not in conf.vertices:
+                raise ValueError(f"unknown vertex '{name}'")
+            v = conf.vertices[name].vertex
+            inner = v.inner if isinstance(v, FrozenLayer) else v
+            inner.n_out = n_out
+            inner.weight_init = w_init
+            reinit.add(name)
+            for cname, spec in conf.vertices.items():
+                if name in spec.inputs and isinstance(spec.vertex, Layer):
+                    cv = spec.vertex
+                    cinner = cv.inner if isinstance(cv, FrozenLayer) else cv
+                    if getattr(cinner, "n_in", None) is not None:
+                        cinner.n_in = n_out
+                    reinit.add(cname)
+
+        for name, layer, inputs in self._added:
+            from deeplearning4j_tpu.nn.conf.configuration import \
+                GraphVertexSpec
+            conf.vertices[name] = GraphVertexSpec(
+                vertex=copy.deepcopy(layer), inputs=inputs)
+            conf.vertices[name].vertex.name = name
+            reinit.add(name)
+
+        if self._new_outputs is not None:
+            conf.network_outputs = list(self._new_outputs)
+
+        if self._freeze_at:
+            for name in self._upstream_closure(conf, self._freeze_at):
+                spec = conf.vertices.get(name)
+                if spec is not None and isinstance(spec.vertex, Layer) \
+                        and not isinstance(spec.vertex, FrozenLayer):
+                    spec.vertex = FrozenLayer(inner=spec.vertex,
+                                              name=spec.vertex.name)
+
+        if self._fine_tune is not None:
+            self._fine_tune.apply_to(conf)
+
+        for name, spec in conf.vertices.items():
+            if not spec.inputs:
+                raise ValueError(
+                    f"vertex '{name}' has no inputs after transfer "
+                    "surgery — rewire it (add_layer/remove it) before "
+                    "build()")
+        conf.topological_order()  # validate the rewired DAG
+        new_graph = ComputationGraph(conf).init(seed=conf.training.seed)
+        for name in conf.vertices:
+            if name in reinit:
+                continue
+            if name in params:
+                new_graph.params[name] = params[name]
+            if name in state:
+                new_graph.state[name] = state[name]
+        return new_graph
+
+
+TransferLearning.GraphBuilder = _GraphBuilder
 
 
 class TransferLearningHelper:

@@ -105,3 +105,55 @@ def test_helper_fit_featurized_updates_composite(rng):
     helper.fit_featurized(feats, y)
     assert np.abs(np.asarray(helper.net.params["layer_2"]["W"])
                   - w_before).max() > 0
+
+
+def test_graph_transfer_learning_builder():
+    """TransferLearning.GraphBuilder (reference:
+    TransferLearning.GraphBuilder — freeze upstream of a vertex, replace
+    an output head, fine-tune overrides)."""
+    import jax
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+
+    conf = (NeuralNetConfiguration(seed=5, updater="adam",
+                                   learning_rate=0.01, activation="tanh")
+            .graph_builder().add_inputs("in")
+            .add_layer("f1", DenseLayer(n_in=4, n_out=10), "in")
+            .add_layer("f2", DenseLayer(n_in=10, n_out=8), "f1")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "f2")
+            .set_outputs("out").build())
+    src = ComputationGraph(conf).init()
+    w_f1 = np.asarray(src.params["f1"]["W"]).copy()
+
+    new = (TransferLearning.GraphBuilder(src)
+           .fine_tune_configuration(FineTuneConfiguration(
+               learning_rate=0.005))
+           .set_feature_extractor("f2")
+           .remove_vertex_and_connections("out")
+           .add_layer("out2", OutputLayer(n_in=8, n_out=5,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "f2")
+           .set_outputs("out2")
+           .build())
+    # frozen closure: f1, f2 wrapped; params carried over
+    assert isinstance(new.conf.vertices["f1"].vertex, FrozenLayer)
+    assert isinstance(new.conf.vertices["f2"].vertex, FrozenLayer)
+    np.testing.assert_array_equal(np.asarray(new.params["f1"]["W"]), w_f1)
+    assert new.conf.network_outputs == ["out2"]
+    assert new.conf.training.learning_rate == 0.005
+
+    # training updates only the new head
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 4), dtype=np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 32)]
+    before_head = np.asarray(new.params["out2"]["W"]).copy()
+    for _ in range(5):
+        new.fit(x, y)
+    np.testing.assert_array_equal(np.asarray(new.params["f1"]["W"]), w_f1)
+    assert np.abs(np.asarray(new.params["out2"]["W"])
+                  - before_head).max() > 0
+    outs = new.output(x)
+    assert np.asarray(outs[0]).shape == (32, 5)

@@ -169,3 +169,46 @@ def test_recursive_tree():
     assert c.yield_() == root.yield_()
     assert c is not root and c.children()[0] is not np_
     assert root.first_child() is np_ and root.last_child() is vp
+
+
+def test_graph_pretrain_vae_vertex():
+    """ComputationGraph layerwise pretraining (reference:
+    ComputationGraph.pretrain:527) — a VAE vertex behind a frozen dense
+    vertex learns to reconstruct."""
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration(seed=3, updater="adam",
+                                   learning_rate=0.01, activation="tanh")
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=8, n_out=6), "in")
+            .add_layer("vae", VariationalAutoencoder(
+                n_in=6, n_out=2, encoder_layer_sizes=(10,),
+                decoder_layer_sizes=(10,),
+                reconstruction_distribution="gaussian"), "d")
+            .add_layer("out", OutputLayer(n_in=2, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "vae")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    x = _data(n=64, n_in=8)
+    vae = conf.vertices["vae"].vertex
+    d_before = np.asarray(g.params["d"]["W"]).copy()
+
+    def vae_loss():
+        import jax
+        h = np.tanh(x @ np.asarray(g.params["d"]["W"])
+                    + np.asarray(g.params["d"]["b"]))
+        return float(vae.pretrain_loss(g.params["vae"], jnp.asarray(h),
+                                       jax.random.PRNGKey(0)))
+
+    before = vae_loss()
+    for _ in range(50):
+        g.pretrain(x)
+    after = vae_loss()
+    assert after < before
+    # upstream vertex stayed frozen during pretraining
+    np.testing.assert_array_equal(d_before, np.asarray(g.params["d"]["W"]))
