@@ -79,7 +79,11 @@ class ROC:
 
     def calculate_auc(self) -> float:
         fpr, tpr = self.get_roc_curve()
-        order = np.argsort(fpr, kind="stable")
+        # sort by (fpr, tpr): ties in fpr must order by ascending tpr or
+        # a (fpr_min, tpr=0) point (threshold above every probability)
+        # lands next to (1, 1) and the trapezoid collapses toward 0.5
+        # for perfectly-separated extreme probabilities
+        order = np.lexsort((tpr, fpr))
         x = np.concatenate([[0.0], fpr[order], [1.0]])
         y = np.concatenate([[0.0], tpr[order], [1.0]])
         return float(np.trapezoid(y, x))

@@ -665,17 +665,42 @@ class ComputationGraph:
                              train=False)
         return float(s)
 
-    def evaluate(self, iterator):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        ev = Evaluation()
+    def _run_evaluation(self, iterator, ev):
+        """Feed the FIRST output's predictions into an IEvaluation
+        (reference: ComputationGraph.evaluate uses output 0)."""
+        first = self.conf.network_outputs[0]
         for batch in iterator:
             feats, labs, _, lmask = _unpack_batch(batch)
             out = self.output(feats)
             labs_d = self._as_input_dict(labs, self.conf.network_outputs)
-            ev.eval(labs_d[self.conf.network_outputs[0]], out[0], mask=lmask)
+            if isinstance(lmask, (list, tuple)):
+                # MultiDataSet: per-output masks; pick output 0's,
+                # mirroring the labels selection
+                lmask = lmask[0]
+            ev.eval(labs_d[first], out[0], mask=lmask)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._run_evaluation(iterator, Evaluation())
+
+    def evaluate_regression(self, iterator):
+        """reference: ComputationGraph.evaluateRegression."""
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        return self._run_evaluation(iterator, RegressionEvaluation())
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        """reference: ComputationGraph.evaluateROC."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._run_evaluation(iterator, ROC(threshold_steps))
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 30):
+        """reference: ComputationGraph.evaluateROCMultiClass."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._run_evaluation(iterator, ROCMultiClass(threshold_steps))
 
     # ------------------------------------------------------------ flat views
     def params_flat(self) -> Array:

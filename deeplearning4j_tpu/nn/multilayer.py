@@ -562,17 +562,40 @@ class MultiLayerNetwork:
                         jnp.asarray(y),
                         None if mask is None else jnp.asarray(mask)))
 
-    def evaluate(self, iterator):
-        """Classification evaluation over an iterator (reference:
-        MultiLayerNetwork.evaluate)."""
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        ev = Evaluation()
+    def _run_evaluation(self, iterator, ev):
+        """Feed every batch's predictions into an IEvaluation instance."""
         for batch in iterator:
             feats, labs, _, lmask = _unpack_batch(batch)
             out = self.output(feats)
             ev.eval(labs, out, mask=lmask)
         if hasattr(iterator, "reset"):
             iterator.reset()
+        return ev
+
+    def evaluate_regression(self, iterator):
+        """Regression metrics over an iterator (reference:
+        MultiLayerNetwork.evaluateRegression:2422)."""
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        return self._run_evaluation(iterator, RegressionEvaluation())
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        """Binary ROC over an iterator (reference:
+        MultiLayerNetwork.evaluateROC:2436)."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._run_evaluation(iterator, ROC(threshold_steps))
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 30):
+        """One-vs-all ROC over an iterator (reference:
+        MultiLayerNetwork.evaluateROCMultiClass:2449)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._run_evaluation(iterator, ROCMultiClass(threshold_steps))
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (reference:
+        MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = self._run_evaluation(iterator, Evaluation())
         return ev
 
     # --------------------------------------------------------- rnn inference

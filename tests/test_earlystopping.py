@@ -93,3 +93,90 @@ def test_local_file_saver_restores_best(tmp_path, rng):
     x = np.asarray(rng.rand(4, 4), np.float32)
     assert np.asarray(best.output(x)).shape == (4, 3)
     assert result.best_model_score < float("inf")
+
+
+def test_network_evaluate_roc_and_regression_methods():
+    """evaluateROC / evaluateRegression / evaluateROCMultiClass parity
+    (reference: MultiLayerNetwork.java:2422-2449, ComputationGraph
+    analogs)."""
+    import numpy as np
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((80, 4)).astype(np.float32)
+    cls = (x.sum(1) > 0).astype(int)
+    y_bin = np.eye(2, dtype=np.float32)[cls]
+
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    it = BaseDatasetIterator(x, y_bin, batch_size=40)
+    for _ in range(60):
+        net.fit(it)
+    roc = net.evaluate_roc(it)
+    assert roc.calculate_auc() > 0.9
+    rocm = net.evaluate_roc_multi_class(it)
+    assert rocm.calculate_auc(0) > 0.9 and rocm.calculate_auc(1) > 0.9
+
+    # regression head
+    y_reg = (x.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    rconf = (NeuralNetConfiguration(seed=2, updater="adam",
+                                    learning_rate=0.05, activation="tanh")
+             .list(DenseLayer(n_in=4, n_out=8),
+                   OutputLayer(n_in=8, n_out=1, activation="identity",
+                               loss_function="mse")))
+    rnet = MultiLayerNetwork(rconf).init()
+    rit = BaseDatasetIterator(x, y_reg, batch_size=40)
+    for _ in range(80):
+        rnet.fit(rit)
+    reg = rnet.evaluate_regression(rit)
+    assert reg.pearson_correlation(0) > 0.8
+    assert reg.average_mean_squared_error() < 0.5
+
+
+def test_graph_evaluate_roc():
+    import numpy as np
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((80, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, activation="tanh")
+            .graph_builder().add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "h")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    it = BaseDatasetIterator(x, y, batch_size=40)
+    for _ in range(60):
+        g.fit(it)
+    assert g.evaluate_roc(it).calculate_auc() > 0.9
+
+
+def test_roc_auc_extreme_probabilities():
+    """Regression: tied-FPR ordering must not collapse AUC to 0.5 for a
+    perfectly separated classifier with saturated probabilities."""
+    import numpy as np
+    from deeplearning4j_tpu.eval.roc import ROC
+    l = np.array([0] * 23 + [1] * 17)
+    p = np.where(l == 1, 0.9999, 1e-5)
+    r = ROC()
+    r.eval(np.eye(2)[l], np.stack([1 - p, p], 1))
+    assert r.calculate_auc() > 0.99
+    # and an anti-classifier scores near 0
+    r2 = ROC()
+    r2.eval(np.eye(2)[l], np.stack([p, 1 - p], 1))
+    assert r2.calculate_auc() < 0.1
