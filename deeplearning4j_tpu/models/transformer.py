@@ -45,6 +45,10 @@ class TransformerConfig:
     n_experts: int = 0              # >0 switches the MLP to MoE every block
     capacity_factor: float = 1.25
     eps: float = 1e-5
+    # rematerialize each block on the backward pass (jax.checkpoint):
+    # activations are NOT kept through the scan, trading recompute FLOPs
+    # for HBM — the long-context lever when T*L activations outgrow HBM
+    remat: bool = False
 
     @property
     def d_head(self) -> int:
@@ -172,6 +176,10 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     def body(h, p):
         return block_forward(h, p, cfg), None
 
+    if cfg.remat:
+        # prevent_cse=False: under lax.scan the loop structure already
+        # prevents the CSE the default barrier guards against
+        body = jax.checkpoint(body, prevent_cse=False)
     h, _ = lax.scan(body, h, params["blocks"])
     h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
     return jnp.matmul(h, params["Wout"].astype(h.dtype))

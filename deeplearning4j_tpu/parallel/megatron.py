@@ -203,6 +203,10 @@ def _stage_fn(x: Array, blocks_local, cfg, mesh) -> Array:
     def body(h, p):
         return _block_fwd_sharded(h, p, cfg, mesh), None
 
+    if getattr(cfg, "remat", False):
+        # blockwise rematerialization under the scan (prevent_cse=False:
+        # the loop structure already blocks the CSE the default guards)
+        body = jax.checkpoint(body, prevent_cse=False)
     y, _ = lax.scan(body, x, blocks_local)
     return y
 

@@ -99,3 +99,26 @@ def test_parallel_loss_decreases(devices8):
     _, l8 = _train(CFG, MeshSpec(pipe=2, data=2, model=2), toks, tgts,
                    steps=8)
     assert l8 < l0
+
+
+def test_transformer_remat_same_loss_and_grads():
+    """jax.checkpoint remat path is numerically identical to the
+    standard path (memory-for-FLOPs only; net-new TPU capability,
+    task-required long-context lever)."""
+    from deeplearning4j_tpu.models.transformer import loss_fn
+
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=3,
+                max_len=32)
+    cfg = TransformerConfig(**base)
+    cfg_r = TransformerConfig(**base, remat=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss_fn(cfg, p, tok, tgt))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss_fn(cfg_r, p, tok, tgt))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
