@@ -20,6 +20,8 @@ off. Design:
 """
 from __future__ import annotations
 
+import functools as _ft
+
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -143,8 +145,10 @@ def moe_mlp(h: Array, p: Dict[str, Array], cfg: TransformerConfig) -> Array:
 
 
 def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
-                  mask: Optional[Array] = None) -> Array:
-    """One pre-LN transformer block on [B, T, D] (full, unsharded)."""
+                  mask: Optional[Array] = None, return_kv: bool = False):
+    """One pre-LN transformer block on [B, T, D] (full, unsharded).
+    ``return_kv`` additionally returns the block's K/V heads — the
+    batched cache-prefill path for decoding."""
     d = cfg.d_model
     x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
 
@@ -162,6 +166,8 @@ def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
         h = h + moe_mlp(x, p, cfg)
     else:
         h = h + dense_mlp(x, p)
+    if return_kv:
+        return h, (k, v)
     return h
 
 
@@ -183,6 +189,145 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     h, _ = lax.scan(body, h, params["blocks"])
     h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
     return jnp.matmul(h, params["Wout"].astype(h.dtype))
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding — the rnnTimeStep analog for the flagship family
+# (reference capability: MultiLayerNetwork.rnnTimeStep:2234 streams RNN
+# state; here the streamed state is the per-layer KV cache, static-shaped
+# for XLA: one compiled step regardless of position)
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_len: Optional[int] = None) -> Tuple[Array, Array]:
+    """Stacked per-layer KV caches [L, B, S, H, Dh] (k, v)."""
+    s = max_len or cfg.max_len
+    shape = (cfg.n_layers, batch, s, cfg.n_heads, cfg.d_head)
+    dt = cfg.activation_dtype()
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def _block_decode(h: Array, p: Dict[str, Array], ck: Array, cv: Array,
+                  pos: Array, cfg: TransformerConfig
+                  ) -> Tuple[Array, Array, Array]:
+    """One block, one new position: h [B, 1, D]; cache [B, S, H, Dh]."""
+    d = cfg.d_model
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+
+    def heads(y):
+        return y.reshape(y.shape[0], 1, cfg.n_heads, cfg.d_head)
+
+    q = heads(jnp.matmul(x, p["Wq"].astype(x.dtype)))
+    k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
+    v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
+    z = jnp.asarray(0, pos.dtype)
+    ck = jax.lax.dynamic_update_slice(ck, k, (z, pos, z, z))
+    cv = jax.lax.dynamic_update_slice(cv, v, (z, pos, z, z))
+    # the single query attends the filled cache prefix through the shared
+    # attention core (causal with global q position = pos; the traced
+    # offset takes the jnp path, same masking semantics as training)
+    a = dot_product_attention(q, ck, cv, causal=True, q_offset=pos,
+                              kv_offset=0)
+    h = h + jnp.matmul(a.reshape(a.shape[0], 1, d),
+                       p["Wo"].astype(h.dtype))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    if cfg.n_experts > 0:
+        h = h + moe_mlp(x, p, cfg)
+    else:
+        h = h + dense_mlp(x, p)
+    return h, ck, cv
+
+
+def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
+                token: Array, caches: Tuple[Array, Array], pos: Array
+                ) -> Tuple[Array, Tuple[Array, Array]]:
+    """token [B] int32 at position ``pos`` -> (logits [B, V], caches)."""
+    dt = cfg.activation_dtype()
+    # embed + positional row at pos
+    emb = params["embed"].astype(dt)[token]                      # [B, D]
+    posv = jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                        axis=0).astype(dt)       # [1, D]
+    h = (emb + posv)[:, None, :]                                 # [B, 1, D]
+    ck_all, cv_all = caches
+
+    def body(h, xs):
+        p, ck, cv = xs
+        h, ck, cv = _block_decode(h, p, ck, cv, pos, cfg)
+        return h, (ck, cv)
+
+    h, (ck_all, cv_all) = lax.scan(body, h,
+                                   (params["blocks"], ck_all, cv_all))
+    h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+    logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
+    return logits, (ck_all, cv_all)
+
+
+def prefill(cfg: TransformerConfig, params: Dict[str, Any],
+            prompt: Array) -> Tuple[Array, Tuple[Array, Array]]:
+    """ONE batched pass over the prompt: last-position logits + filled
+    KV caches (O(T0^2) parallel work instead of T0 sequential decode
+    steps)."""
+    dt = cfg.activation_dtype()
+    b, t0 = prompt.shape
+    h = (params["embed"].astype(dt)[prompt]
+         + params["pos"].astype(dt)[:t0][None])
+
+    def body(h, p):
+        return block_forward(h, p, cfg, return_kv=True)
+
+    h, (ks, vs) = lax.scan(body, h, params["blocks"])  # [L, B, T0, H, Dh]
+    ck, cv = init_cache(cfg, b)
+    ck = ck.at[:, :, :t0].set(ks.astype(ck.dtype))
+    cv = cv.at[:, :, :t0].set(vs.astype(cv.dtype))
+    h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+    last_logits = jnp.matmul(h[:, -1], params["Wout"].astype(h.dtype))
+    return last_logits, (ck, cv)
+
+
+@_ft.lru_cache(maxsize=64)
+def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
+                  temperature: float):
+    """One compiled prefill+sample program per (cfg, length, temp) —
+    jax.jit caches by function identity, so the closure must be reused
+    across generate() calls."""
+
+    def run(params, prompt, key):
+        last_logits, caches = prefill(cfg, params, prompt)
+        pos = jnp.asarray(prompt.shape[1], jnp.int32)
+
+        def sample(carry, k):
+            caches, pos, logits = carry
+            if temperature <= 0:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                tok = jax.random.categorical(
+                    k, logits.astype(jnp.float32) / temperature, axis=-1
+                ).astype(jnp.int32)
+            new_logits, caches = decode_step(cfg, params, tok, caches,
+                                             pos)
+            return (caches, pos + 1, new_logits), tok
+
+        keys = jax.random.split(key, max_new_tokens)
+        _, toks = lax.scan(sample, (caches, pos, last_logits), keys)
+        return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)], axis=1)
+
+    return jax.jit(run)
+
+
+def generate(cfg: TransformerConfig, params: Dict[str, Any], prompt: Array,
+             max_new_tokens: int, key: Array,
+             temperature: float = 1.0) -> Array:
+    """Autoregressive sampling with a KV cache, ONE compiled program:
+    batched prefill fills the cache, then the sampling loop scans
+    max_new_tokens cached decode steps. temperature<=0 means greedy
+    argmax. Returns [B, T0 + max_new_tokens]."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    total = prompt.shape[1] + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError(f"generation length {total} exceeds "
+                         f"max_len={cfg.max_len}")
+    run = _generate_jit(cfg, int(max_new_tokens), float(temperature))
+    return run(params, prompt, key)
 
 
 def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: Array,
@@ -210,3 +355,10 @@ class TransformerLM:
     def loss(self, tokens, targets) -> float:
         return float(loss_fn(self.cfg, self.params, jnp.asarray(tokens),
                              jnp.asarray(targets)))
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 1.0, seed: int = 0) -> Array:
+        """KV-cached autoregressive sampling (the rnnTimeStep-streaming
+        analog for this family)."""
+        return generate(self.cfg, self.params, prompt, max_new_tokens,
+                        jax.random.PRNGKey(seed), temperature)
