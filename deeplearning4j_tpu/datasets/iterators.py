@@ -384,6 +384,65 @@ class DummyPreProcessor:
         return dataset
 
 
+class ZeroMeanPreProcessor:
+    """Subtract the per-batch feature mean (reference:
+    datasets/.../ZeroMeanPrePreProcessor.java)."""
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        f = np.asarray(dataset.features, np.float32)
+        return DataSet(f - f.mean(axis=0, keepdims=True), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+
+class UnitVarianceProcessor:
+    """Divide features by their per-column std (reference:
+    datasets/.../UnitVarianceProcessor.java)."""
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        f = np.asarray(dataset.features, np.float32)
+        std = f.std(axis=0, keepdims=True)
+        return DataSet(f / np.where(std > 0, std, 1.0), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+
+class ZeroMeanAndUnitVariancePreProcessor:
+    """Standardize features per batch (reference:
+    datasets/.../ZeroMeanAndUnitVariancePreProcessor.java)."""
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        f = np.asarray(dataset.features, np.float32)
+        f = f - f.mean(axis=0, keepdims=True)
+        std = f.std(axis=0, keepdims=True)
+        return DataSet(f / np.where(std > 0, std, 1.0), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+
+class BinomialSamplingPreProcessor:
+    """Sample binary features from probabilities (reference:
+    datasets/.../BinomialSamplingPreProcessor.java — used for RBM
+    binary visible units)."""
+
+    def __init__(self, seed: int = 123):
+        self._rng = np.random.RandomState(seed)
+
+    def pre_process(self, dataset: DataSet) -> DataSet:
+        f = np.clip(np.asarray(dataset.features, np.float32), 0.0, 1.0)
+        return DataSet((self._rng.uniform(size=f.shape) < f
+                        ).astype(np.float32), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+
+class TestDataSetIterator(BaseDatasetIterator):
+    """Split one DataSet into batches — the reference's lightweight test
+    iterator (reference: datasets/test/TestDataSetIterator.java);
+    inherits the full iterator surface (num_examples/input_columns/
+    total_outcomes/reset)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int = 10):
+        super().__init__(dataset.features, dataset.labels, batch_size,
+                         dataset.features_mask, dataset.labels_mask)
+
+
 class CombinedPreProcessor:
     """Chain DataSet preprocessors in order (reference:
     datasets/iterator/CombinedPreProcessor.java — Builder.addPreProcessor

@@ -22,15 +22,37 @@ from deeplearning4j_tpu.nn.multilayer import _unpack_batch
 log = logging.getLogger("deeplearning4j_tpu")
 
 
+class EarlyStoppingListener:
+    """Callbacks around the early-stopping loop (reference:
+    earlystopping/listener/EarlyStoppingListener.java: onStart,
+    onEpoch, onCompletion)."""
+
+    def on_start(self, config, net) -> None:
+        pass
+
+    def on_epoch(self, epoch: int, score: float, config, net) -> None:
+        pass
+
+    def on_completion(self, result) -> None:
+        pass
+
+
 class BaseEarlyStoppingTrainer:
 
-    def __init__(self, config: EarlyStoppingConfiguration, net, train_iter):
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iter,
+                 listener: "EarlyStoppingListener" = None):
         self.config = config
         self.net = net
         self.train_iter = train_iter
+        self.listener = listener
+
+    def set_listener(self, listener: "EarlyStoppingListener") -> None:
+        self.listener = listener
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        if self.listener is not None:
+            self.listener.on_start(cfg, self.net)
         for c in cfg.epoch_termination_conditions:
             c.initialize()
         for c in cfg.iteration_termination_conditions:
@@ -71,6 +93,8 @@ class BaseEarlyStoppingTrainer:
                 else:
                     score = float(self.net.score_value)
                 score_vs_epoch[epoch] = score
+                if self.listener is not None:
+                    self.listener.on_epoch(epoch, score, cfg, self.net)
                 if score < best_score:
                     best_score = score
                     best_epoch = epoch
@@ -99,11 +123,14 @@ class BaseEarlyStoppingTrainer:
         best_model = cfg.model_saver.get_best_model()
         if best_model is None:
             best_model = self.net
-        return EarlyStoppingResult(
+        result = EarlyStoppingResult(
             termination_reason=reason, termination_details=details,
             score_vs_epoch=score_vs_epoch, best_model_epoch=best_epoch,
             best_model_score=best_score, total_epochs=epoch + 1,
             best_model=best_model)
+        if self.listener is not None:
+            self.listener.on_completion(result)
+        return result
 
     def _fit_batch(self, batch) -> None:
         feats, labels, fmask, lmask = _unpack_batch(batch)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import numpy as np
 from collections import defaultdict
 from typing import Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
 
@@ -218,3 +219,48 @@ class PriorityQueue(Generic[T]):
 
     def __len__(self):
         return len(self._heap)
+
+
+class SummaryStatistics:
+    """Streaming count/mean/min/max/variance (reference:
+    util/SummaryStatistics.java + berkeley counters' summary use)."""
+
+    def __init__(self):
+        self.n = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, x) -> None:
+        # vectorized Chan et al. parallel-Welford merge of the batch
+        xs = np.ravel(np.asarray(x, dtype=float))
+        m = xs.size
+        if m == 0:
+            return
+        b_mean = float(xs.mean())
+        b_m2 = float(((xs - b_mean) ** 2).sum())
+        d = b_mean - self._mean
+        n = self.n + m
+        self._mean += d * m / n
+        self._m2 += b_m2 + d * d * self.n * m / n
+        self.n = n
+        self.min = min(self.min, float(xs.min()))
+        self.max = max(self.max, float(xs.max()))
+
+    @property
+    def mean(self) -> float:
+        return self._mean
+
+    @property
+    def variance(self) -> float:
+        return self._m2 / self.n if self.n else 0.0
+
+    @property
+    def std(self) -> float:
+        return self.variance ** 0.5
+
+    def __repr__(self):
+        return (f"SummaryStatistics(n={self.n}, mean={self.mean:.6g}, "
+                f"std={self.std:.6g}, min={self.min:.6g}, "
+                f"max={self.max:.6g})")

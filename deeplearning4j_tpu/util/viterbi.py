@@ -1,0 +1,70 @@
+"""Viterbi decoding.
+
+Parity with the reference's Viterbi utility (reference:
+deeplearning4j-core/.../util/Viterbi.java — most-likely state sequence
+given emission likelihoods and a possible-state transition prior). The
+dynamic program is expressed as a `lax.scan` over time — one compiled
+program for any sequence length, batched over independent sequences.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.jit
+def _viterbi_scan(log_emit: Array, log_trans: Array, log_init: Array):
+    """log_emit [T, S], log_trans [S, S] (from->to), log_init [S] →
+    (best_path [T], best_logp)."""
+
+    def step(delta, emit_t):
+        # delta [S]: best log-prob ending in each state at t-1
+        scores = delta[:, None] + log_trans          # [S_from, S_to]
+        best_prev = jnp.argmax(scores, axis=0)       # [S_to]
+        delta_t = jnp.max(scores, axis=0) + emit_t
+        return delta_t, best_prev
+
+    delta0 = log_init + log_emit[0]
+    delta_f, backptr = jax.lax.scan(step, delta0, log_emit[1:])
+    last = jnp.argmax(delta_f)
+
+    def backtrack(state, bp_t):
+        prev = bp_t[state]
+        return prev, prev
+
+    _, rev_path = jax.lax.scan(backtrack, last, backptr, reverse=True)
+    path = jnp.concatenate([rev_path, last[None]])
+    return path, jnp.max(delta_f)
+
+
+class Viterbi:
+    """Decode the most likely hidden-state sequence.
+
+    ``transition`` [S, S] row-stochastic (from -> to); ``initial`` [S]
+    prior (uniform when omitted). ``decode(emissions)`` takes per-step
+    state likelihoods [T, S] (or log-likelihoods with
+    ``log_input=True``) and returns (path [T] int, log-probability).
+    """
+
+    def __init__(self, transition, initial=None, eps: float = 1e-12):
+        self.log_trans = jnp.log(jnp.asarray(transition, jnp.float32)
+                                 + eps)
+        s = self.log_trans.shape[0]
+        if initial is None:
+            self.log_init = jnp.full((s,), -np.log(s), jnp.float32)
+        else:
+            self.log_init = jnp.log(jnp.asarray(initial, jnp.float32)
+                                    + eps)
+        self.eps = eps
+
+    def decode(self, emissions, log_input: bool = False
+               ) -> Tuple[np.ndarray, float]:
+        e = jnp.asarray(emissions, jnp.float32)
+        log_e = e if log_input else jnp.log(e + self.eps)
+        path, logp = _viterbi_scan(log_e, self.log_trans, self.log_init)
+        return np.asarray(path), float(logp)

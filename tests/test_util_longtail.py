@@ -1,0 +1,129 @@
+"""Long-tail util parity: Viterbi, SummaryStatistics, DataSet
+normalization preprocessors, EvaluationTools HTML, early-stopping
+listener (reference: util/Viterbi.java, util/SummaryStatistics.java,
+datasets/.../{ZeroMean,UnitVariance,...}PreProcessor.java,
+evaluation/EvaluationTools.java, earlystopping/listener/)."""
+import numpy as np
+import pytest
+
+
+def test_viterbi_decodes_known_sequence():
+    from deeplearning4j_tpu.util.viterbi import Viterbi
+    # two states: sticky transitions; emissions strongly identify state
+    trans = np.array([[0.9, 0.1], [0.1, 0.9]])
+    v = Viterbi(trans)
+    emissions = np.array([[0.9, 0.1]] * 4 + [[0.1, 0.9]] * 4)
+    path, logp = v.decode(emissions)
+    assert path.tolist() == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert np.isfinite(logp)
+    # sticky prior smooths a single flickered observation
+    emissions2 = np.array([[0.9, 0.1]] * 3 + [[0.45, 0.55]]
+                          + [[0.9, 0.1]] * 3)
+    path2, _ = v.decode(emissions2)
+    assert path2.tolist() == [0] * 7
+
+
+def test_summary_statistics_streaming():
+    from deeplearning4j_tpu.util.berkeley import SummaryStatistics
+    rng = np.random.default_rng(0)
+    data = rng.normal(3.0, 2.0, 500)
+    s = SummaryStatistics()
+    s.add(data[:200])
+    s.add(data[200:])
+    assert s.n == 500
+    np.testing.assert_allclose(s.mean, data.mean(), rtol=1e-9)
+    np.testing.assert_allclose(s.std, data.std(), rtol=1e-9)
+    assert s.min == data.min() and s.max == data.max()
+
+
+def test_normalization_preprocessors():
+    from deeplearning4j_tpu.datasets.iterators import (
+        BinomialSamplingPreProcessor, DataSet, TestDataSetIterator,
+        UnitVarianceProcessor, ZeroMeanAndUnitVariancePreProcessor,
+        ZeroMeanPreProcessor)
+    rng = np.random.default_rng(1)
+    ds = DataSet(rng.normal(5.0, 3.0, (64, 4)).astype(np.float32),
+                 np.zeros((64, 2), np.float32))
+    zm = ZeroMeanPreProcessor().pre_process(ds)
+    np.testing.assert_allclose(zm.features.mean(0), 0.0, atol=1e-5)
+    uv = UnitVarianceProcessor().pre_process(ds)
+    np.testing.assert_allclose(uv.features.std(0), 1.0, atol=1e-4)
+    zs = ZeroMeanAndUnitVariancePreProcessor().pre_process(ds)
+    np.testing.assert_allclose(zs.features.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(zs.features.std(0), 1.0, atol=1e-4)
+    probs = DataSet(np.full((2000, 3), 0.3, np.float32),
+                    np.zeros((2000, 1)))
+    sampled = BinomialSamplingPreProcessor(seed=7).pre_process(probs)
+    assert set(np.unique(sampled.features)) <= {0.0, 1.0}
+    assert abs(sampled.features.mean() - 0.3) < 0.03
+    # TestDataSetIterator batches a single DataSet
+    sizes = [b.features.shape[0] for b in TestDataSetIterator(ds, 24)]
+    assert sizes == [24, 24, 16]
+
+
+def test_evaluation_tools_html(tmp_path):
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.eval.roc import ROC
+    from deeplearning4j_tpu.eval.tools import (
+        export_evaluation_to_html_file, export_roc_charts_to_html_file)
+    l = np.array([0] * 10 + [1] * 10)
+    p = np.clip(l + np.random.default_rng(0).normal(0, 0.3, 20), 0, 1)
+    roc = ROC()
+    roc.eval(np.eye(2)[l], np.stack([1 - p, p], 1))
+    out = tmp_path / "roc.html"
+    export_roc_charts_to_html_file(roc, str(out))
+    html = out.read_text()
+    assert "AUC" in html and "<svg" in html and "Precision" in html
+
+    ev = Evaluation()
+    ev.eval(np.eye(2)[l], np.stack([1 - p, p], 1))
+    out2 = tmp_path / "eval.html"
+    export_evaluation_to_html_file(ev, str(out2))
+    html2 = out2.read_text()
+    assert "Confusion" in html2 or "Accuracy" in html2
+
+
+def test_early_stopping_listener_callbacks():
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+    from deeplearning4j_tpu.earlystopping.config import \
+        EarlyStoppingConfiguration
+    from deeplearning4j_tpu.earlystopping.saver import InMemoryModelSaver
+    from deeplearning4j_tpu.earlystopping.termination import \
+        MaxEpochsTerminationCondition
+    from deeplearning4j_tpu.earlystopping.trainer import (
+        EarlyStoppingListener, EarlyStoppingTrainer)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 4), dtype=np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    conf = (NeuralNetConfiguration(seed=1, learning_rate=0.05)
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+
+    events = []
+
+    class Rec(EarlyStoppingListener):
+        def on_start(self, config, net):
+            events.append("start")
+
+        def on_epoch(self, epoch, score, config, net):
+            events.append(("epoch", epoch))
+
+        def on_completion(self, result):
+            events.append(("done", result.termination_reason))
+
+    escfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        model_saver=InMemoryModelSaver())
+    trainer = EarlyStoppingTrainer(escfg, net,
+                                   BaseDatasetIterator(x, y, 16),
+                                   listener=Rec())
+    result = trainer.fit()
+    assert events[0] == "start"
+    assert ("epoch", 0) in events
+    assert events[-1] == ("done", "EpochTerminationCondition")
+    assert result.total_epochs >= 3
