@@ -4,7 +4,8 @@ from deeplearning4j_tpu.nlp.tokenization import (
     LowCasePreProcessor, EndingPreProcessor)
 from deeplearning4j_tpu.nlp.sentenceiterator import (
     CollectionSentenceIterator, BasicLineIterator, FileSentenceIterator,
-    LabelAwareIterator, LabelledDocument, LabelsSource, StreamLineIterator)
+    LabelAwareIterator, LabelledDocument, LabelsSource, StreamLineIterator,
+    AggregatingSentenceIterator)
 from deeplearning4j_tpu.nlp.vocab import (VocabConstructor, AbstractCache,
                                           VocabWord, VocabularyHolder,
                                           build_huffman_tree)
@@ -26,3 +27,5 @@ __all__ = [
     "Word2Vec", "ParagraphVectors", "Glove", "WordVectorSerializer",
     "BagOfWordsVectorizer", "TfidfVectorizer", "InvertedIndex",
 ]
+from deeplearning4j_tpu.nlp.cnn_sentence import (  # noqa: F401
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)

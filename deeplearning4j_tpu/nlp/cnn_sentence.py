@@ -1,0 +1,137 @@
+"""Sentences → CNN-ready word-vector tensors.
+
+Parity with the reference's CnnSentenceDataSetIterator (reference:
+deeplearning4j-nlp/.../iterator/CnnSentenceDataSetIterator.java —
+tokenize labeled sentences, embed each token with pretrained word
+vectors, pad/truncate to a fixed length, emit [B, T, D] "sentence
+images" + one-hot labels + padding masks for text-CNN classifiers).
+NHWC-style [B, T, D, 1] is the natural layout for this framework's
+Convolution2D/1D layers on TPU.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import DataSet
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 TokenizerFactory)
+
+
+class LabeledSentenceProvider:
+    """Reference: iterator/provider/CollectionLabeledSentenceProvider —
+    (sentence, label) pairs with a known label set."""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str]):
+        if len(sentences) != len(labels):
+            raise ValueError("sentences and labels differ in length")
+        self.sentences = list(sentences)
+        self.labels = list(labels)
+        self.all_labels = sorted(set(labels))
+
+    def __len__(self) -> int:
+        return len(self.sentences)
+
+
+# reference alias
+CollectionLabeledSentenceProvider = LabeledSentenceProvider
+
+
+class CnnSentenceDataSetIterator:
+    """UNKNOWN handling matches the reference's UnknownWordHandling:
+    'remove' skips unknown tokens, 'zero' keeps a zero vector."""
+
+    def __init__(self, provider: LabeledSentenceProvider, word_vectors,
+                 batch_size: int = 32, max_sentence_length: int = 64,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 unknown_word_handling: str = "remove",
+                 sentences_along_height: bool = True):
+        self.provider = provider
+        self.wv = word_vectors
+        self.batch_size = batch_size
+        self.max_len = max_sentence_length
+        self.tf = tokenizer_factory or DefaultTokenizerFactory()
+        if unknown_word_handling not in ("remove", "zero"):
+            raise ValueError("unknown_word_handling: 'remove' or 'zero'")
+        self.unknown = unknown_word_handling
+        self.sentences_along_height = sentences_along_height
+        self._dim = int(np.asarray(
+            self._vec(self._any_known_word())).shape[0])
+        self._cursor = 0
+
+    def _vec(self, word: str) -> np.ndarray:
+        return np.asarray(self.wv.word_vector(word), np.float32)
+
+    def _any_known_word(self) -> str:
+        for s in self.provider.sentences:
+            for t in self.tf.create(s).get_tokens():
+                if self.wv.has_word(t):
+                    return t
+        raise ValueError("no sentence token is in the word-vector vocab")
+
+    # -- reference API surface --------------------------------------------
+    def get_labels(self) -> List[str]:
+        return list(self.provider.all_labels)
+
+    def input_columns(self) -> int:
+        return self.max_len * self._dim
+
+    def total_outcomes(self) -> int:
+        return len(self.provider.all_labels)
+
+    def load_single_sentence(self, sentence: str) -> np.ndarray:
+        """[1, T, D, 1] (or [1, D, T, 1] with sentences_along_height
+        False) tensor for inference (reference: loadSingleSentence)."""
+        m, _ = self._embed(sentence)
+        return self._orient(m[None, :, :, None])
+
+    def _orient(self, batch: np.ndarray) -> np.ndarray:
+        """reference: sentencesAlongHeight — True keeps time on the
+        height axis [B, T, D, 1]; False transposes to [B, D, T, 1]."""
+        if self.sentences_along_height:
+            return batch
+        return np.transpose(batch, (0, 2, 1, 3))
+
+    def _embed(self, sentence: str) -> Tuple[np.ndarray, int]:
+        """One tokenizer pass → ([max_len, D] matrix, used length)."""
+        toks = self.tf.create(sentence).get_tokens()
+        vecs = []
+        for t in toks:
+            if self.wv.has_word(t):
+                vecs.append(self._vec(t))
+            elif self.unknown == "zero":
+                vecs.append(np.zeros(self._dim, np.float32))
+        vecs = vecs[:self.max_len]
+        out = np.zeros((self.max_len, self._dim), np.float32)
+        if vecs:
+            out[:len(vecs)] = np.stack(vecs)
+        return out, len(vecs)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        n = len(self.provider)
+        if self._cursor >= n:
+            raise StopIteration
+        end = min(self._cursor + self.batch_size, n)
+        idx = range(self._cursor, end)
+        self._cursor = end
+        embedded = [self._embed(self.provider.sentences[i])
+                    for i in idx]
+        feats = np.stack([m for m, _ in embedded])[..., None]
+        label_ix = [self.provider.all_labels.index(
+            self.provider.labels[i]) for i in idx]
+        labels = np.eye(len(self.provider.all_labels),
+                        dtype=np.float32)[label_ix]
+        mask = np.zeros((len(label_ix), self.max_len), np.float32)
+        for row, (_, length) in enumerate(embedded):
+            # an all-OOV sentence keeps ONE (zero-vector) step so
+            # mask-normalized pooling never divides by zero
+            mask[row, :max(length, 1)] = 1.0
+        return DataSet(self._orient(feats), labels, features_mask=mask)
+
+    def reset(self) -> None:
+        self._cursor = 0

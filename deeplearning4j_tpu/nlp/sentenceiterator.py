@@ -223,3 +223,31 @@ class StreamLineIterator(SentenceIterator):
         self._stream.seek(self._start)
         self._it = iter(self._stream)
         self._advance()
+
+
+class AggregatingSentenceIterator(SentenceIterator):
+    """Concatenate several sentence iterators (reference:
+    sentenceiterator/AggregatingSentenceIterator.java — Builder
+    .addSentenceIterator)."""
+
+    def __init__(self, *iterators: SentenceIterator):
+        super().__init__()
+        self._its = list(iterators)
+        self.reset()
+
+    def reset(self) -> None:
+        for it in self._its:
+            it.reset()
+        self._idx = 0
+
+    def has_next(self) -> bool:
+        while self._idx < len(self._its):
+            if self._its[self._idx].has_next():
+                return True
+            self._idx += 1
+        return False
+
+    def next_sentence(self) -> str:
+        if not self.has_next():
+            raise StopIteration
+        return self._apply(self._its[self._idx].next_sentence())

@@ -324,3 +324,77 @@ def test_pos_tagging_and_filtered_tokenizer():
                       ).get_tokens()
     assert "movement" in toks and "dogs" in toks and "station" in toks
     assert "the" not in toks and "of" not in toks and "ran" not in toks
+
+
+def test_cnn_sentence_dataset_iterator():
+    """CnnSentenceDataSetIterator parity (reference:
+    iterator/CnnSentenceDataSetIterator.java) — text-CNN pipeline from
+    trained word vectors through a Convolution1D classifier."""
+    from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                        Word2Vec)
+    from deeplearning4j_tpu.nlp.cnn_sentence import (
+        CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
+
+    pos = ["good great fine nice", "great nice good happy",
+           "fine happy great good"] * 6
+    neg = ["bad awful poor sad", "awful sad bad gloomy",
+           "poor gloomy awful bad"] * 6
+    sents = pos + neg
+    labels = ["pos"] * len(pos) + ["neg"] * len(neg)
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                   layer_size=12, min_word_frequency=1, epochs=3, seed=1)
+    w2v.fit()
+
+    provider = CollectionLabeledSentenceProvider(sents, labels)
+    it = CnnSentenceDataSetIterator(provider, w2v, batch_size=12,
+                                    max_sentence_length=6)
+    assert it.get_labels() == ["neg", "pos"]
+    b = next(iter(it))
+    assert b.features.shape == (12, 6, 12, 1)
+    assert b.labels.shape == (12, 2)
+    assert b.features_mask.shape == (12, 6)
+    assert b.features_mask[0].sum() == 4  # 4 known tokens
+    single = it.load_single_sentence("good bad")
+    assert single.shape == (1, 6, 12, 1)
+    # padding rows are zero
+    assert float(np.abs(single[0, 2:]).max()) == 0.0
+
+    # unknown handling: zero keeps position with zero vector
+    it_zero = CnnSentenceDataSetIterator(
+        provider, w2v, batch_size=4, max_sentence_length=6,
+        unknown_word_handling="zero")
+    s = it_zero.load_single_sentence("good UNKNOWNWORD bad")
+    assert float(np.abs(s[0, 1]).max()) == 0.0  # zero slot kept
+    assert float(np.abs(s[0, 2]).max()) > 0.0   # 'bad' after it
+
+
+def test_aggregating_sentence_iterator():
+    from deeplearning4j_tpu.nlp import CollectionSentenceIterator
+    from deeplearning4j_tpu.nlp.sentenceiterator import \
+        AggregatingSentenceIterator
+    a = CollectionSentenceIterator(["one", "two"])
+    b = CollectionSentenceIterator(["three"])
+    agg = AggregatingSentenceIterator(a, b)
+    assert list(agg) == ["one", "two", "three"]
+    assert list(agg) == ["one", "two", "three"]  # reset works
+
+
+def test_cnn_sentence_orientation_and_oov_mask():
+    from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                        CollectionLabeledSentenceProvider,
+                                        CollectionSentenceIterator,
+                                        Word2Vec)
+    sents = ["alpha beta", "beta alpha", "zzz qqq"]  # last is all-OOV
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(
+        ["alpha beta"] * 10), layer_size=8, min_word_frequency=1,
+        epochs=1, seed=1)
+    w2v.fit()
+    provider = CollectionLabeledSentenceProvider(sents, ["a", "b", "a"])
+    it = CnnSentenceDataSetIterator(provider, w2v, batch_size=3,
+                                    max_sentence_length=5,
+                                    sentences_along_height=False)
+    b = next(iter(it))
+    assert b.features.shape == (3, 8, 5, 1)  # [B, D, T, 1] transposed
+    # all-OOV row keeps one masked step (no zero-sum masks)
+    assert b.features_mask[2].sum() == 1
+    assert b.features_mask.min(axis=1).sum() == 0
