@@ -73,3 +73,42 @@ def test_parallel_wrapper_iterator(devices8, rng):
         pw.fit(it)
     assert net.iteration_count == 10
     assert float(net.score_value) < 1.2
+
+
+def _cli_iterator_provider():
+    """Module-level factory for the ParallelWrapperMain-analog test."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return BaseDatasetIterator(x, y, batch_size=16)
+
+
+def test_parallel_wrapper_main_cli(tmp_path):
+    """reference: parallelism/main/ParallelWrapperMain.java — load saved
+    model + named iterator factory, train data-parallel, save."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.main import main
+    from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+    from deeplearning4j_tpu.util.model_serializer import write_model
+
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    src = tmp_path / "model.zip"
+    out = tmp_path / "trained.zip"
+    write_model(net, str(src))
+
+    main(["--model-path", str(src),
+          "--iterator-provider",
+          "tests.test_parallel:_cli_iterator_provider",
+          "--workers", "2", "--epochs", "8",
+          "--model-output", str(out)])
+    trained = ModelGuesser.load_model_guess(str(out))
+    it = _cli_iterator_provider()
+    assert trained.evaluate(it).accuracy() > 0.8
