@@ -118,16 +118,23 @@ class _DistributedModelBase:
                 self.pw.fit(xb, yb)
 
     def fit(self, data, labels=None):
-        """fit(iterator) or fit(features, labels) (reference:
-        SparkDl4jMultiLayer.fit(JavaRDD<DataSet>):218 / fit(String path)).
-        """
+        """fit(iterator), fit(features, labels), or fit(path) over
+        exported minibatch files (reference:
+        SparkDl4jMultiLayer.fit(JavaRDD<DataSet>):218 / fit(String path)
+        :234 with the Export approach's batch files)."""
+        import os
+        if isinstance(data, (str, os.PathLike)):
+            from deeplearning4j_tpu.scaleout.util import PathDataSetIterator
+            data = PathDataSetIterator(os.fspath(data))
         if labels is not None:
             self._fit_arrays(np.asarray(data), np.asarray(labels))
             return self.model
         stats = self.stats
         if stats is not None:
+            # time only the split setup; keep batches LAZY — the Export
+            # approach exists because the dataset may not fit in RAM
             with timed_phase(stats, "split"):
-                batches = list(self.tm.batches(data))
+                batches = self.tm.batches(data)
         else:
             batches = self.tm.batches(data)
         for ds in batches:

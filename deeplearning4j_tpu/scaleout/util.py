@@ -103,3 +103,69 @@ def read_object_from_file(path: str):
     """(`SparkUtils.readObjectFromFile`)"""
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+def export_dataset_batches(iterator, directory: str,
+                           prefix: str = "dataset") -> List[str]:
+    """Write every batch as one npz file (reference: the Export training
+    approach — BatchAndExportDataSetsFunction writes batched DataSet
+    files to HDFS, ParameterAveragingTrainingMaster.java:101; here plain
+    files, same role). Returns the written paths."""
+    import os
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    for i, batch in enumerate(iterator):
+        feats = np.asarray(batch.features)
+        labels = np.asarray(batch.labels)
+        payload = {"features": feats, "labels": labels}
+        fm = getattr(batch, "features_mask", None)
+        lm = getattr(batch, "labels_mask", None)
+        if fm is not None:
+            payload["features_mask"] = np.asarray(fm)
+        if lm is not None:
+            payload["labels_mask"] = np.asarray(lm)
+        p = os.path.join(directory, f"{prefix}_{i:09d}.npz")
+        np.savez(p, **payload)
+        paths.append(p)
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    return paths
+
+
+class PathDataSetIterator:
+    """Iterate DataSet batch files written by export_dataset_batches
+    (reference: fit(String path) + ExecuteWorkerPathFlatMap — workers
+    stream minibatch files by path instead of serialized RDDs)."""
+
+    def __init__(self, path_or_paths):
+        import glob
+        import os
+        if isinstance(path_or_paths, str):
+            if os.path.isdir(path_or_paths):
+                self.paths = sorted(glob.glob(
+                    os.path.join(glob.escape(path_or_paths), "*.npz")))
+            else:
+                self.paths = sorted(glob.glob(path_or_paths))
+        else:
+            self.paths = list(path_or_paths)
+        if not self.paths:
+            raise ValueError(f"no dataset files at {path_or_paths!r}")
+        self._idx = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._idx >= len(self.paths):
+            raise StopIteration
+        with np.load(self.paths[self._idx]) as z:
+            ds = DataSet(z["features"], z["labels"],
+                         z["features_mask"] if "features_mask" in z
+                         else None,
+                         z["labels_mask"] if "labels_mask" in z else None)
+        self._idx += 1
+        return ds
+
+    def reset(self) -> None:
+        self._idx = 0

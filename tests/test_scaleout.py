@@ -133,3 +133,40 @@ def test_spark_early_stopping_trainer(devices8):
     scores = list(result.score_vs_epoch.values())
     assert all(np.isfinite(s) for s in scores)
     assert result.best_model_score == min(scores)
+
+
+def test_export_approach_and_fit_path(tmp_path):
+    """Export minibatches to files, train from the path (reference:
+    RDDTrainingApproach.Export -> BatchAndExportDataSetsFunction +
+    SparkDl4jMultiLayer.fit(String path):234)."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.scaleout.training_master import (
+        DistributedDl4jMultiLayer, ParameterAveragingTrainingMaster)
+    from deeplearning4j_tpu.scaleout.util import (PathDataSetIterator,
+                                                  export_dataset_batches)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((96, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    src_it = BaseDatasetIterator(x, y, batch_size=24)
+    paths = export_dataset_batches(src_it, str(tmp_path / "batches"))
+    assert len(paths) == 4 and all(p.endswith(".npz") for p in paths)
+
+    # round-trip check
+    loaded = list(PathDataSetIterator(str(tmp_path / "batches")))
+    assert len(loaded) == 4
+    np.testing.assert_allclose(loaded[0].features, x[:24])
+
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    tm = ParameterAveragingTrainingMaster.Builder(24).workers(2).build()
+    sm = DistributedDl4jMultiLayer(net, tm)
+    for _ in range(25):
+        sm.fit(str(tmp_path / "batches"))
+    assert sm.evaluate(BaseDatasetIterator(x, y, 48)).accuracy() > 0.9
