@@ -6,7 +6,8 @@ deeplearning4j-nn/.../MultiLayerNetwork.java:947).
 """
 from __future__ import annotations
 
-from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.configuration import (
+    MultiLayerConfiguration, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
                                           OutputLayer, SubsamplingLayer)
@@ -68,3 +69,27 @@ def mlp_mnist(seed: int = 12345, learning_rate: float = 0.006,
             .list(DenseLayer(n_in=784, n_out=hidden, activation="relu"),
                   OutputLayer(n_out=10, activation="softmax",
                               loss_function="negativeloglikelihood")))
+
+
+def text_cnn(embedding_dim: int, num_classes: int,
+             max_sentence_length: int = 64, filters: int = 100,
+             kernel_size: int = 3, seed: int = 12345,
+             learning_rate: float = 1e-3,
+             dtype: str = "float32") -> MultiLayerConfiguration:
+    """Kim-2014-style sentence classifier over word-vector inputs
+    [B, T, D] (pair with nlp.CnnSentenceDataSetIterator, squeeze the
+    trailing channel): Conv1D -> global max pool -> softmax."""
+    from deeplearning4j_tpu.nn.layers import (Convolution1DLayer,
+                                              GlobalPoolingLayer)
+    return (NeuralNetConfiguration(
+        seed=seed, updater="adam", learning_rate=learning_rate,
+        dtype=dtype,
+    ).list(
+        Convolution1DLayer(n_in=embedding_dim, n_out=filters,
+                           kernel_size=kernel_size,
+                           convolution_mode="same", activation="relu"),
+        GlobalPoolingLayer(pooling_type="max"),
+        OutputLayer(n_in=filters, n_out=num_classes,
+                    activation="softmax", loss_function="mcxent"),
+    ).set_input_type(InputType.recurrent(embedding_dim,
+                                         max_sentence_length)))

@@ -398,3 +398,30 @@ def test_cnn_sentence_orientation_and_oov_mask():
     # all-OOV row keeps one masked step (no zero-sum masks)
     assert b.features_mask[2].sum() == 1
     assert b.features_mask.min(axis=1).sum() == 0
+
+
+def test_text_cnn_zoo_builder_with_sentence_iterator():
+    """models/zoo.text_cnn + CnnSentenceDataSetIterator end to end."""
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.models.zoo import text_cnn
+    from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                        CollectionLabeledSentenceProvider,
+                                        CollectionSentenceIterator,
+                                        Word2Vec)
+    pos = ["good great fine nice"] * 8
+    neg = ["bad awful poor sad"] * 8
+    sents, labels = pos + neg, ["p"] * 8 + ["n"] * 8
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                   layer_size=12, min_word_frequency=1, epochs=2, seed=2)
+    w2v.fit()
+    it = CnnSentenceDataSetIterator(
+        CollectionLabeledSentenceProvider(sents, labels), w2v,
+        batch_size=16, max_sentence_length=5)
+    net = MultiLayerNetwork(text_cnn(embedding_dim=12, num_classes=2,
+                                     learning_rate=0.01)).init()
+    for _ in range(25):
+        for b in it:
+            net.fit(b.features[..., 0], b.labels)
+    b = next(iter(it))
+    preds = np.asarray(net.output(b.features[..., 0])).argmax(1)
+    assert (preds == b.labels.argmax(1)).mean() > 0.9
