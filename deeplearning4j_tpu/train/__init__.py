@@ -1,6 +1,7 @@
 from deeplearning4j_tpu.train.updaters import (  # noqa: F401
     init_updater_state,
     apply_updater,
+    apply_score_decay,
     compute_learning_rate,
 )
 from deeplearning4j_tpu.train.solvers import (  # noqa: F401

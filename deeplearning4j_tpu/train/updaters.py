@@ -50,9 +50,12 @@ def compute_learning_rate(tc: TrainingConfig, iteration) -> Array:
     if policy == "inverse":
         return lr0 / jnp.power(1.0 + tc.lr_policy_decay_rate * it,
                                tc.lr_policy_power)
-    if policy == "step":
+    if policy in ("step", "torchstep"):
+        # TorchStep's multiply-every-`steps` recurrence closes to the
+        # same form as Step (reference: LayerUpdater.applyLrDecayPolicy)
         return lr0 * jnp.power(tc.lr_policy_decay_rate,
-                               jnp.floor(it / tc.lr_policy_steps))
+                               jnp.floor(it / jnp.maximum(
+                                   tc.lr_policy_steps, 1.0)))
     if policy == "poly":
         frac = jnp.clip(it / jnp.maximum(float(tc.num_iterations), 1.0),
                         0.0, 1.0)
@@ -60,6 +63,13 @@ def compute_learning_rate(tc: TrainingConfig, iteration) -> Array:
     if policy == "sigmoid":
         return lr0 / (1.0 + jnp.exp(-tc.lr_policy_decay_rate
                                     * (it - tc.lr_policy_steps)))
+    if policy == "score":
+        # reference: Score policy decays on score plateau — a HOST-side
+        # decision (BaseOptimizer.applyLrDecayPolicy reads the score).
+        # Inside the compiled step the schedule is identity; the host
+        # loop calls apply_score_decay(net, prev, cur) which rescales
+        # the base LR and invalidates the jit cache on decay events.
+        return jnp.asarray(lr0, jnp.float32)
     if policy == "schedule":
         sched = tc.lr_schedule or {}
         # piecewise-constant: lr takes the value of the largest key <= iter
@@ -241,3 +251,36 @@ def apply_updater(tc: TrainingConfig, params, grads, opt_state, iteration,
         new_params[lname] = np_
         new_state[lname] = ns_
     return new_params, new_state
+
+
+def apply_score_decay(net, previous_score: float, current_score: float
+                      ) -> bool:
+    """Host-side half of the 'score' LR policy (reference:
+    LayerUpdater.applyLrDecayPolicy, Score case — multiply LR by
+    decayRate when the score stopped improving). The base LR lives in
+    the compiled step as a trace-time constant, so a decay event
+    rescales it and clears the model's jit cache (recompile on the next
+    step — decay events are rare). Returns True if a decay fired."""
+    tc = net.conf.training
+    if tc.lr_policy.lower() != "score":
+        return False
+    if current_score < previous_score:
+        return False
+    if not (0.0 < tc.lr_policy_decay_rate < 1.0):
+        raise ValueError(
+            "lr_policy='score' needs 0 < lr_policy_decay_rate < 1 "
+            f"(got {tc.lr_policy_decay_rate}) — the decay multiplier")
+    tc.learning_rate *= tc.lr_policy_decay_rate
+    # per-layer LRs are baked absolutes (layer.learning_rate); the step
+    # computes multiplier = layer_lr / base at trace time, so the layer
+    # values must scale WITH the base or the multipliers cancel the decay
+    layers = ([s.vertex for s in net.conf.vertices.values()]
+              if hasattr(net.conf, "vertices") else net.conf.layers)
+    for layer in layers:
+        inner = getattr(layer, "inner", None) or layer
+        for attr in ("learning_rate", "bias_learning_rate"):
+            v = getattr(inner, attr, None)
+            if v is not None:
+                setattr(inner, attr, v * tc.lr_policy_decay_rate)
+    net._jit_cache.clear()
+    return True

@@ -153,3 +153,54 @@ def test_lbfgs_on_computation_graph():
     s1 = g.score(x, y)
     assert s1 < s0 * 0.7, (s0, s1)
     assert g.iteration_count > 1  # per-internal-step listener advances
+
+
+def test_lr_policies_torchstep_and_score():
+    """reference: LearningRatePolicy TorchStep (periodic multiply) and
+    Score (host-side plateau decay)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import (apply_score_decay,
+                                                   compute_learning_rate)
+
+    conf = NeuralNetConfiguration(seed=1, learning_rate=0.1,
+                                  lr_policy="torchstep",
+                                  lr_policy_decay_rate=0.5,
+                                  lr_policy_steps=10).list(
+        DenseLayer(n_in=4, n_out=4),
+        OutputLayer(n_out=2, activation="softmax"))
+    tc = conf.training
+    assert float(compute_learning_rate(tc, 0)) == pytest.approx(0.1)
+    assert float(compute_learning_rate(tc, 10)) == pytest.approx(0.05)
+    assert float(compute_learning_rate(tc, 25)) == pytest.approx(0.025)
+
+    sconf = NeuralNetConfiguration(seed=1, learning_rate=0.1,
+                                   lr_policy="score",
+                                   lr_policy_decay_rate=0.5).list(
+        DenseLayer(n_in=4, n_out=4),
+        OutputLayer(n_out=2, activation="softmax"))
+    net = MultiLayerNetwork(sconf).init()
+    assert float(compute_learning_rate(net.conf.training, 7)) \
+        == pytest.approx(0.1)
+    assert not apply_score_decay(net, previous_score=1.0,
+                                 current_score=0.9)  # improving: no decay
+    assert apply_score_decay(net, previous_score=0.9, current_score=0.95)
+    assert net.conf.training.learning_rate == pytest.approx(0.05)
+    assert float(compute_learning_rate(net.conf.training, 7)) \
+        == pytest.approx(0.05)
+    # per-layer baked LRs scale with the base: multipliers must NOT
+    # cancel the decay (effective per-layer lr == decayed base)
+    mults = net._lr_multipliers()
+    for name, m in mults.items():
+        assert m == pytest.approx(1.0), (name, m)
+    # net still trains after the cache invalidation
+    import numpy as np
+    x = np.random.default_rng(0).random((8, 4), np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(
+        0, 2, 8)]
+    net.fit(x, y)
+    assert np.isfinite(float(net.score_value))
