@@ -67,6 +67,12 @@ class Evaluation:
         self.predictions: List = []  # Prediction records (eval/meta)
 
     # ------------------------------------------------------------------ eval
+    def eval_time_series(self, labels, predictions, mask=None) -> None:
+        """Sequence-output convenience (reference:
+        Evaluation.evalTimeSeries — eval() already flattens [B,T,C] with
+        the mask applied; this is the parity name)."""
+        self.eval(labels, predictions, mask=mask)
+
     def eval(self, labels, predictions, mask=None, metadata=None) -> None:
         """Accumulate one batch. ``labels`` one-hot (or class indices),
         ``predictions`` probabilities/scores [B, C] (reference:

@@ -541,6 +541,109 @@ class MultiLayerNetwork:
                                       mask=None, collect=True)
         return acts
 
+    def feed_forward_to_layer(self, layer_idx: int, x,
+                              train: bool = False) -> List[Array]:
+        """Activations of layers [0..layer_idx] ONLY — layers beyond the
+        index are not executed (reference: feedForwardToLayer,
+        MultiLayerNetwork.java:698)."""
+        x = jnp.asarray(x)
+        h = x.astype(self.dtype) \
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+        acts = []
+        for i, layer in enumerate(self.layers[:layer_idx + 1]):
+            name = self.layer_names[i]
+            pp = self.conf.input_preprocessors.get(str(i))
+            if pp is not None:
+                h = pp.pre_process(h)
+            h, _ = layer.apply(self.params[name],
+                               self.state.get(name, {}), h, train=train)
+            acts.append(h)
+        return acts
+
+    def predict(self, x) -> np.ndarray:
+        """Predicted class index per example (reference:
+        MultiLayerNetwork.predict)."""
+        out = np.asarray(self.output(x))
+        return out.argmax(axis=-1)
+
+    def label_probabilities(self, x) -> Array:
+        """Output-layer probabilities (reference: labelProbabilities)."""
+        return self.output(x)
+
+    def num_labels(self) -> int:
+        """Output dimension (reference: numLabels)."""
+        n = getattr(self.layers[-1], "n_out", None)
+        if n is not None:
+            return int(n)
+        # LossLayer has no params/n_out: infer from the layer below
+        for layer in reversed(self.layers[:-1]):
+            n = getattr(layer, "n_out", None)
+            if n is not None:
+                return int(n)
+        raise ValueError("cannot infer label count: no layer declares "
+                         "n_out")
+
+    def f1_score(self, x, y) -> float:
+        """Macro F1 on one batch (reference: Classifier.f1Score)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        ev.eval(y, self.output(x))
+        return ev.f1()
+
+    def score_examples(self, x, y, add_regularization_terms: bool = True
+                       ) -> np.ndarray:
+        """Per-example loss values (reference:
+        MultiLayerNetwork.scoreExamples — regularization added uniformly
+        when requested). One vmapped program over _loss_fn, so the full
+        forward semantics (preprocessors, dtype guards, layer state)
+        match score() exactly."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+
+        def one(xi, yi):
+            s, _ = self._loss_fn(self.params, self.state, xi[None],
+                                 yi[None], None, None, train=False)
+            return s
+
+        per = jax.vmap(one)(x, y)
+        if not add_regularization_terms:
+            per = per - self._regularization_score(self.params)
+        return np.asarray(per)
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        """Stored streaming state of one RNN layer (reference:
+        rnnGetPreviousState)."""
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries.get(self.layer_names[layer_idx])
+
+    def rnn_set_previous_state(self, layer_idx: int, state) -> None:
+        """reference: rnnSetPreviousState. On a fresh/cleared network
+        the OTHER streaming layers are seeded with zero carries (a
+        partial carries dict would silently disable their streaming)."""
+        if self._rnn_carries is None:
+            batch = int(jax.tree_util.tree_leaves(state)[0].shape[0])
+            self._rnn_carries = self._init_carries(batch)
+        self._rnn_carries[self.layer_names[layer_idx]] = state
+
+    def summary(self) -> str:
+        """Printable per-layer table (reference:
+        MultiLayerNetwork.summary)."""
+        rows = [("idx", "name", "type", "n_params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            name = self.layer_names[i]
+            n = int(sum(np.prod(np.asarray(v).shape)
+                        for v in jax.tree_util.tree_leaves(
+                            self.params.get(name, {}))))
+            total += n
+            rows.append((str(i), name, type(layer).__name__, f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(v.ljust(widths[c]) for c, v in enumerate(r))
+                 for r in rows]
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
+
     def score(self, x, y=None, mask=None) -> float:
         """Mean score on a dataset/batch (reference:
         MultiLayerNetwork.score(DataSet))."""

@@ -340,3 +340,48 @@ def test_graph_tbptt_and_rnn_time_step():
     steps = [np.asarray(g.rnn_time_step(x[:, t])[0]) for t in range(20)]
     np.testing.assert_allclose(np.stack(steps, 1), full, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_classifier_convenience_methods():
+    """predict / f1_score / label_probabilities / num_labels / summary /
+    score_examples / rnn state get-set (reference: Classifier interface +
+    MultiLayerNetwork conveniences)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((24, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 24)]
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, l2=0.01,
+                                   activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(10):
+        net.fit(x, y)
+    preds = net.predict(x)
+    assert preds.shape == (24,) and preds.max() < 3
+    probs = np.asarray(net.label_probabilities(x))
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+    assert net.num_labels() == 3
+    assert 0.0 <= net.f1_score(x, y) <= 1.0
+    per = net.score_examples(x, y)
+    assert per.shape == (24,)
+    np.testing.assert_allclose(per.mean(), net.score(x, y), rtol=0.05)
+    s = net.summary()
+    assert "Total parameters" in s and "DenseLayer" in s
+    acts = net.feed_forward_to_layer(0, x)
+    assert len(acts) == 1 and np.asarray(acts[0]).shape == (24, 8)
+
+    # rnn state get/set round trip
+    rconf = (NeuralNetConfiguration(seed=2)
+             .list(GravesLSTM(n_in=3, n_out=4),
+                   RnnOutputLayer(n_in=4, n_out=2, activation="softmax")))
+    rnet = MultiLayerNetwork(rconf).init()
+    xa = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    rnet.rnn_time_step(xa[:, 0])
+    st = rnet.rnn_get_previous_state(0)
+    assert st is not None
+    out_a = np.asarray(rnet.rnn_time_step(xa[:, 1]))
+    rnet.rnn_set_previous_state(0, st)  # rewind
+    out_b = np.asarray(rnet.rnn_time_step(xa[:, 1]))
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-5)
