@@ -9,3 +9,20 @@ def promote_score(x: jax.Array) -> jax.Array:
     """Promote a loss value to at least float32 (bfloat16 training still
     accumulates scores in f32; float64 gradient-check mode stays f64)."""
     return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
+def render_summary_table(rows, total_params: int) -> str:
+    """Shared renderer for MultiLayerNetwork/ComputationGraph.summary():
+    header+rows (tuples of str) -> aligned table + total line."""
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    lines = ["  ".join(v.ljust(widths[c]) for c, v in enumerate(r))
+             for r in rows]
+    lines.append(f"Total parameters: {total_params:,}")
+    return "\n".join(lines)
+
+
+def count_params(tree) -> int:
+    import jax
+    import numpy as np
+    return int(sum(np.prod(np.asarray(v).shape)
+                   for v in jax.tree_util.tree_leaves(tree)))

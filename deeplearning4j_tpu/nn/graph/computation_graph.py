@@ -723,3 +723,66 @@ class ComputationGraph:
                                                    self.updater_state)
         net._initialized = self._initialized
         return net
+
+    # --------------------------------------------------- classifier surface
+    def predict(self, *data) -> np.ndarray:
+        """Predicted class index per example on output 0 (reference:
+        ComputationGraph classifier surface)."""
+        return np.asarray(self.output(*data)[0]).argmax(axis=-1)
+
+    def f1_score(self, feats, labs) -> float:
+        """Macro F1 on output 0 for one batch."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        labs_d = self._as_input_dict(labs, self.conf.network_outputs)
+        ev = Evaluation()
+        ev.eval(labs_d[self.conf.network_outputs[0]],
+                self.output(feats)[0])
+        return ev.f1()
+
+    def score_examples(self, feats, labs, masks=None,
+                       add_regularization_terms: bool = True
+                       ) -> np.ndarray:
+        """Per-example loss values (reference:
+        ComputationGraph.scoreExamples) — one jitted+cached vmapped
+        _loss_fn program, summed over all outputs like score(); masks
+        exclude padded timesteps exactly as score() does."""
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        labels = self._as_input_dict(labs, self.conf.network_outputs)
+        mask_d = None if masks is None else self._as_input_dict(
+            masks, self.conf.network_inputs)
+        key = ("score_examples", masks is not None)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def one(params, state, xi, yi, mi):
+                s, _ = self._loss_fn(
+                    params, state,
+                    {k: v[None] for k, v in xi.items()},
+                    {k: v[None] for k, v in yi.items()}, None,
+                    None if mi is None
+                    else {k: v[None] for k, v in mi.items()},
+                    train=False)
+                return s
+
+            fn = jax.jit(jax.vmap(one, in_axes=(None, None, 0, 0,
+                                                None if mask_d is None
+                                                else 0)))
+            self._jit_cache[key] = fn
+        per = fn(self.params, self.state, inputs, labels, mask_d)
+        if not add_regularization_terms:
+            per = per - self._regularization_score(self.params)
+        return np.asarray(per)
+
+    def summary(self) -> str:
+        """Printable per-vertex table in topological order (reference:
+        ComputationGraph.summary)."""
+        from deeplearning4j_tpu.common import (count_params,
+                                               render_summary_table)
+        rows = [("name", "type", "inputs", "n_params")]
+        total = 0
+        for name in self.topo:
+            spec = self.conf.vertices[name]
+            n = count_params(self.params.get(name, {}))
+            total += n
+            rows.append((name, type(spec.vertex).__name__,
+                         ",".join(spec.inputs) or "-", f"{n:,}"))
+        return render_summary_table(rows, total)

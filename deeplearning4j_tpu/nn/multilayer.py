@@ -629,20 +629,16 @@ class MultiLayerNetwork:
     def summary(self) -> str:
         """Printable per-layer table (reference:
         MultiLayerNetwork.summary)."""
+        from deeplearning4j_tpu.common import (count_params,
+                                               render_summary_table)
         rows = [("idx", "name", "type", "n_params")]
         total = 0
         for i, layer in enumerate(self.layers):
             name = self.layer_names[i]
-            n = int(sum(np.prod(np.asarray(v).shape)
-                        for v in jax.tree_util.tree_leaves(
-                            self.params.get(name, {}))))
+            n = count_params(self.params.get(name, {}))
             total += n
             rows.append((str(i), name, type(layer).__name__, f"{n:,}"))
-        widths = [max(len(r[c]) for r in rows) for c in range(4)]
-        lines = ["  ".join(v.ljust(widths[c]) for c, v in enumerate(r))
-                 for r in rows]
-        lines.append(f"Total parameters: {total:,}")
-        return "\n".join(lines)
+        return render_summary_table(rows, total)
 
     def score(self, x, y=None, mask=None) -> float:
         """Mean score on a dataset/batch (reference:

@@ -385,3 +385,33 @@ def test_classifier_convenience_methods():
     rnet.rnn_set_previous_state(0, st)  # rewind
     out_b = np.asarray(rnet.rnn_time_step(xa[:, 1]))
     np.testing.assert_allclose(out_a, out_b, rtol=1e-5)
+
+
+def test_graph_classifier_conveniences():
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration(seed=1, updater="adam",
+                                   learning_rate=0.05, l2=0.01,
+                                   activation="tanh")
+            .graph_builder().add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "h")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    for _ in range(40):
+        g.fit(x, y)
+    preds = g.predict(x)
+    assert (preds == y.argmax(1)).mean() > 0.9
+    assert g.f1_score(x, y) > 0.9
+    per = g.score_examples(x, y)
+    assert per.shape == (32,)
+    np.testing.assert_allclose(per.mean(), g.score(x, y), rtol=0.05)
+    per_noreg = g.score_examples(x, y, add_regularization_terms=False)
+    assert (per_noreg < per).all()
+    s = g.summary()
+    assert "Total parameters" in s and "out" in s
