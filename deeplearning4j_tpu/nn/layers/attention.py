@@ -12,7 +12,7 @@ layers are designed for sharding from the start:
   parallel/ring.py) can apply causal masks on global positions while holding
   only a local block;
 - matmuls are laid out [*, T, Dh] x [*, Dh, S] — MXU-shaped, bfloat16-safe
-  (softmax runs in f32).
+  (softmax accumulates in >=f32; f64 inputs keep f64).
 """
 from __future__ import annotations
 
@@ -44,7 +44,8 @@ def dot_product_attention(q: Array, k: Array, v: Array, *,
     ``mask``: optional [B, S] {0,1} key-validity mask.
     ``q_offset``/``kv_offset``: global positions of q[0] / k[0] — causal
     masking compares global positions, enabling blockwise/ring callers.
-    Scores and softmax are computed in float32 regardless of input dtype.
+    Scores and softmax accumulate in at least float32 (f64 inputs keep
+    f64 — the gradient-check suites run whole nets in float64).
     """
     dh = q.shape[-1]
     # dh is static — python math keeps scale concrete under jit (the
@@ -62,9 +63,11 @@ def dot_product_attention(q: Array, k: Array, v: Array, *,
             return flash_attention(q, k, v, causal=causal,
                                    q_offset=q_offset, kv_offset=kv_offset,
                                    scale=float(scale))
-    # [B, H, T, S]
+    # [B, H, T, S] — accumulate in >=f32 (f64 inputs keep f64: the
+    # gradient-check suites run the whole net in float64)
+    acc = jnp.promote_types(q.dtype, jnp.float32)
     scores = jnp.einsum("bthd,bshd->bhts", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=acc) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = kv_offset + jnp.arange(k.shape[1])
@@ -78,9 +81,13 @@ def dot_product_attention(q: Array, k: Array, v: Array, *,
     return out
 
 
+def _ln_dtype(dtype):
+    return jnp.promote_types(dtype, jnp.float32)
+
+
 def layer_norm(x: Array, gamma: Array, beta: Array,
                eps: float = 1e-5) -> Array:
-    xf = x.astype(jnp.float32)
+    xf = x.astype(_ln_dtype(x.dtype))
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + eps)
@@ -119,8 +126,8 @@ class LayerNormalization(Layer):
         return input_type
 
     def init_params(self, key, dtype=jnp.float32):
-        return {"gamma": jnp.ones((self.n_out,), jnp.float32),
-                "beta": jnp.zeros((self.n_out,), jnp.float32)}
+        return {"gamma": jnp.ones((self.n_out,), _ln_dtype(dtype)),
+                "beta": jnp.zeros((self.n_out,), _ln_dtype(dtype))}
 
     def apply(self, params, state, x, *, train=False, key=None, mask=None):
         return layer_norm(x, params["gamma"], params["beta"], self.eps), state
@@ -234,10 +241,12 @@ class TransformerBlock(BaseLayer):
             "Wv": w(ks[2], (d, d), d, d), "Wo": w(ks[3], (d, d), d, d),
             "W1": w(ks[4], (d, f), d, f), "W2": w(ks[5], (f, d), f, d),
             "b1": jnp.zeros((f,), dtype), "b2": jnp.zeros((d,), dtype),
-            "ln1g": jnp.ones((d,), jnp.float32),
-            "ln1b": jnp.zeros((d,), jnp.float32),
-            "ln2g": jnp.ones((d,), jnp.float32),
-            "ln2b": jnp.zeros((d,), jnp.float32),
+            # LN params stay >=f32 (bf16 LN scales lose precision); f64
+            # nets keep f64 so gradient checks see full precision
+            "ln1g": jnp.ones((d,), _ln_dtype(dtype)),
+            "ln1b": jnp.zeros((d,), _ln_dtype(dtype)),
+            "ln2g": jnp.ones((d,), _ln_dtype(dtype)),
+            "ln2b": jnp.zeros((d,), _ln_dtype(dtype)),
         }
 
     def weight_param_keys(self):

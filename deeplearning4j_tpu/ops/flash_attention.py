@@ -300,6 +300,10 @@ def flash_attention_available(q: Array, k: Array,
         return False
     if q.ndim != 4:
         return False
+    # f64 nets (gradient checks) must keep full-precision accumulation;
+    # the kernel computes in f32
+    if q.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        return False
     tq = q.shape[1]
     if tq % min(BLOCK_Q, tq) != 0 or tq < 8:
         return False

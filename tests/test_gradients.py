@@ -225,3 +225,32 @@ def test_gradcheck_computation_graph_vertices():
             .build())
     net = ComputationGraph(conf).init()
     assert check_gradients(net, x, y, print_results=True)
+
+
+def test_gradcheck_multi_head_attention():
+    """Net-new attention DSL layers get the same gradient-check backbone
+    as every reference layer family."""
+    from deeplearning4j_tpu.nn.layers import (MultiHeadAttention,
+                                              RnnOutputLayer)
+    x = RNG.randn(2, 6, 8).astype(np.float64)
+    y = np.eye(3)[RNG.randint(0, 3, (2, 6))].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=3, dtype="float64")
+            .list(MultiHeadAttention(n_in=8, n_out=8, n_heads=2,
+                                     causal=True),
+                  RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                 loss_function="mcxent")))
+    _check(conf, x, y)
+
+
+def test_gradcheck_transformer_block_and_layernorm():
+    from deeplearning4j_tpu.nn.layers import (LayerNormalization,
+                                              RnnOutputLayer,
+                                              TransformerBlock)
+    x = RNG.randn(2, 5, 8).astype(np.float64)
+    y = np.eye(2)[RNG.randint(0, 2, (2, 5))].astype(np.float64)
+    conf = (NeuralNetConfiguration(seed=4, dtype="float64")
+            .list(TransformerBlock(n_in=8, n_heads=2),
+                  LayerNormalization(),
+                  RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                 loss_function="mcxent")))
+    _check(conf, x, y)
