@@ -51,6 +51,11 @@ class TransformerConfig:
     # activations are NOT kept through the scan, trading recompute FLOPs
     # for HBM — the long-context lever when T*L activations outgrow HBM
     remat: bool = False
+    # sequence-parallel attention strategy when the mesh's 'seq' axis > 1:
+    # 'ring' (parallel/ring.py: K/V ppermute ring) or 'ulysses'
+    # (parallel/ulysses.py: all_to_all head resharding; needs
+    # n_heads/tp % sp == 0)
+    seq_impl: str = "ring"
 
     @property
     def d_head(self) -> int:

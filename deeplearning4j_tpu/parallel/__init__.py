@@ -8,12 +8,14 @@ Aeron parameter server, and Spark parameter averaging
 (SURVEY.md §2.6, §5.8). On TPU those collapse into ONE idiom: a sharded,
 jitted train step whose gradient synchronization is an XLA `psum` riding ICI.
 This package also provides the strategies the reference lacks — tensor,
-pipeline, sequence/context (ring attention), and expert parallelism — as
-sharding policies over the same traced step.
+pipeline, sequence/context (ring attention + Ulysses all-to-all), and
+expert parallelism — as sharding policies over the same traced step.
 """
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 
+from deeplearning4j_tpu.parallel.ring import ring_attention  # noqa: F401
+from deeplearning4j_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.multihost import (initialize_multihost,
                                                    process_info,
                                                    MultiHostLauncher)

@@ -12,8 +12,9 @@ sharding of one traced program over the named mesh (parallel/mesh.py):
 - pipeline ('pipe'): blocks stacked [L] -> stages [S, L/S]; GPipe microbatch
   schedule, activations hop stages via ppermute; loss is computed on the
   last stage and psum-masked across the axis.
-- sequence ('seq'): tokens sharded over time; ring attention
-  (parallel/ring.py) rotates K/V blocks with ppermute.
+- sequence ('seq'): tokens sharded over time; cfg.seq_impl picks the
+  strategy — 'ring' (parallel/ring.py: K/V blocks rotate via ppermute) or
+  'ulysses' (parallel/ulysses.py: all_to_all head resharding).
 - expert ('ep' rides the 'data' axis, Switch/GShard-style): experts sharded
   over 'data', tokens routed by all_to_all. n_experts % data-size == 0.
 
@@ -36,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.models.transformer import TransformerConfig
 from deeplearning4j_tpu.nn.layers.attention import layer_norm
 from deeplearning4j_tpu.parallel.ring import ring_attention
+from deeplearning4j_tpu.parallel.ulysses import ulysses_attention
 
 Array = jax.Array
 
@@ -136,7 +138,14 @@ def _block_fwd_sharded(h: Array, p: Dict[str, Array],
     k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
     v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
     if sp > 1:
-        a = ring_attention(q, k, v, "seq", causal=True)
+        if cfg.seq_impl == "ulysses":
+            a = ulysses_attention(q, k, v, "seq", causal=True)
+        elif cfg.seq_impl == "ring":
+            a = ring_attention(q, k, v, "seq", causal=True)
+        else:
+            raise ValueError(
+                f"unknown seq_impl {cfg.seq_impl!r}: expected 'ring' or "
+                "'ulysses'")
     else:
         from deeplearning4j_tpu.nn.layers.attention import \
             dot_product_attention

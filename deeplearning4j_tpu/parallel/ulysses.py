@@ -1,0 +1,65 @@
+"""Ulysses-style all-to-all sequence/context parallelism.
+
+NET-NEW vs the reference (no attention, no sequence parallelism;
+SURVEY.md §5.7 — its only long-sequence tool is truncated BPTT,
+`MultiLayerNetwork.java:1119`). Complements ring attention
+(parallel/ring.py) as the second first-class long-context strategy:
+
+- **ring**: K/V blocks rotate neighbor-to-neighbor (`ppermute`) while
+  queries stay put — communication O(T·D) per hop, overlapped with
+  compute; heads stay whole, so it works for any head count.
+- **ulysses** (this module): two `all_to_all` collectives re-shard the
+  activations from sequence-sharded to head-sharded and back, so each
+  device runs ordinary (flash) attention over the FULL sequence for a
+  subset of heads. Communication is 2 all-to-alls of the qkv/out tensors
+  — cheaper than a full all-gather by the axis size, and the inner
+  attention kernel is the unmodified single-device one (the Pallas flash
+  path on TPU). Requires n_local_heads % axis_size == 0.
+
+Both run inside `shard_map` over the mesh's 'seq' axis and are exact —
+bitwise-equivalent math to single-device causal attention up to float
+reassociation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+Array = jax.Array
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str, *,
+                      causal: bool = True,
+                      scale: Optional[float] = None) -> Array:
+    """All-to-all sequence-parallel attention inside a `shard_map`.
+
+    q, k, v: LOCAL sequence blocks [B, Tl, H, Dh]; global sequence length
+    is Tl * axis_size. Returns the local output block [B, Tl, H, Dh].
+
+    The first all_to_all splits the head axis across the 'seq' ranks and
+    concatenates the sequence blocks (rank order == sequence order), so
+    each rank holds [B, T, H/s, Dh] with the full sequence; the inverse
+    all_to_all restores [B, Tl, H, Dh] afterwards.
+    """
+    s = lax.psum(1, axis_name)
+    if q.shape[2] % s != 0:
+        raise ValueError(
+            f"ulysses_attention: local head count {q.shape[2]} not "
+            f"divisible by '{axis_name}' axis size {s}")
+
+    def seq_to_heads(x):
+        # [B, Tl, H, Dh] -> [B, Tl*s, H/s, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # [B, T, H/s, Dh] -> [B, T/s, H, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = dot_product_attention(qg, kg, vg, causal=causal, scale=scale)
+    return heads_to_seq(out)

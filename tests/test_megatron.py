@@ -2,6 +2,8 @@
 mesh — every strategy must reproduce single-device training numerically
 (the framework's version of the reference's spark-vs-single-machine proof,
 SURVEY.md §4)."""
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -14,6 +16,7 @@ from deeplearning4j_tpu.parallel.megatron import (init_adam_state,
                                                   shard_params)
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 from deeplearning4j_tpu.parallel.ring import ring_attention
+from deeplearning4j_tpu.parallel.ulysses import ulysses_attention
 
 
 CFG = TransformerConfig(vocab_size=50, d_model=32, n_heads=4, n_layers=4,
@@ -38,7 +41,11 @@ def _train(cfg, spec, toks, tgts, steps=2, lr=1e-2):
     return jax.tree_util.tree_map(np.asarray, ps), float(loss)
 
 
-def test_ring_attention_matches_full(devices8):
+@pytest.mark.parametrize("attn_fn", [ring_attention, ulysses_attention],
+                         ids=["ring", "ulysses"])
+def test_sequence_parallel_attention_matches_full(devices8, attn_fn):
+    """Both SP strategies (ring K/V rotation, Ulysses all-to-all head
+    resharding) == full single-device causal attention, fwd and grad."""
     from functools import partial
 
     from jax import shard_map
@@ -51,16 +58,30 @@ def test_ring_attention_matches_full(devices8):
     ref = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
                                 jnp.asarray(v), causal=True)
     fn = jax.jit(shard_map(
-        partial(ring_attention, axis_name="seq", causal=True), mesh=mesh,
+        partial(attn_fn, axis_name="seq", causal=True), mesh=mesh,
         in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq")))
     out = fn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
-    # gradients flow through the ring identically
+    # gradients flow through the collective identically
     gr = jax.grad(lambda a: jnp.sum(fn(a, k, v) ** 2))(jnp.asarray(q))
     gf = jax.grad(lambda a: jnp.sum(
         dot_product_attention(a, jnp.asarray(k), jnp.asarray(v),
                               causal=True) ** 2))(jnp.asarray(q))
     np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=1e-5)
+
+
+def test_ulysses_training_matches_single_device(devices8):
+    """Composite step with seq_impl='ulysses' reproduces single-device
+    training, including combined sp x tp (local heads 4/2=2, sp=2)."""
+    toks, tgts = _data()
+    base, base_loss = _train(CFG, MeshSpec(), toks, tgts)
+    cfg_u = dataclasses.replace(CFG, seq_impl="ulysses")
+    for spec in (MeshSpec(seq=2), MeshSpec(seq=2, model=2)):
+        got, gl = _train(cfg_u, spec, toks, tgts)
+        assert abs(gl - base_loss) < 1e-4
+        for a, b in zip(jax.tree_util.tree_leaves(base),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_allclose(a, b, atol=5e-4)
 
 
 @pytest.mark.parametrize("spec", [
