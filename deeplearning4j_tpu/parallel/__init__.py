@@ -14,6 +14,8 @@ expert parallelism — as sharding policies over the same traced step.
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 
+from deeplearning4j_tpu.parallel.fsdp import (  # noqa: F401
+    init_fsdp_adam_state, make_fsdp_train_step, shard_params_fsdp)
 from deeplearning4j_tpu.parallel.ring import ring_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.multihost import (initialize_multihost,

@@ -1,0 +1,103 @@
+"""Fully-sharded data parallelism (ZeRO-3/FSDP-style).
+
+NET-NEW vs the reference: its three data-parallel modes all keep a FULL
+model replica per worker (ParallelWrapper thread replicas,
+`ParallelWrapper.java:603`; Spark executors get the whole params
+broadcast, `ParameterAveragingTrainingMaster.java`), so model size is
+capped by one device's memory. Here parameters, gradients, AND optimizer
+state are sharded over the mesh's 'data' axis — per-device memory for
+the model + Adam state drops by the axis size — and XLA's SPMD
+partitioner (GSPMD) materializes each layer's weights just-in-time with
+`all_gather` in forward/backward and reduces gradients straight into the
+shards with `reduce_scatter`. This is the scaling-book recipe verbatim:
+pick a mesh, annotate shardings, let the compiler place the collectives
+on ICI.
+
+No wrapper classes, no gather/scatter hooks: FSDP is a *sharding policy*
+over the same traced train step the other strategies use — the whole
+module is the leaf-spec chooser plus a jitted Adam step with sharded
+in/out shardings.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params, loss_fn)
+from deeplearning4j_tpu.parallel.optim import (AdamState, adam_update_tree,
+                                               init_adam_state)
+
+Array = jax.Array
+
+
+def fsdp_leaf_spec(shape: Tuple[int, ...], axis_size: int,
+                   axis_name: str = "data") -> P:
+    """Shard the largest axis divisible by the mesh axis; scalars and
+    leaves with no divisible axis stay replicated (their memory is
+    negligible — norms/biases)."""
+    if not shape or axis_size <= 1:
+        return P()
+    for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
+        if shape[i] >= axis_size and shape[i] % axis_size == 0:
+            spec: list = [None] * len(shape)
+            spec[i] = axis_name
+            return P(*spec)
+    return P()
+
+
+def fsdp_shardings(params, mesh: Mesh, axis_name: str = "data"):
+    """NamedSharding pytree for a param (or same-shaped opt-state) tree."""
+    size = mesh.shape[axis_name]
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, fsdp_leaf_spec(jnp.shape(p), size,
+                                                     axis_name)), params)
+
+
+def shard_params_fsdp(params, mesh: Mesh, axis_name: str = "data"):
+    """Place a replicated param tree into its FSDP shards."""
+    return jax.device_put(params, fsdp_shardings(params, mesh, axis_name))
+
+
+def init_fsdp_adam_state(params) -> AdamState:
+    """Zeros with the params' sharding — `zeros_like` on placed shards
+    keeps the sharding, so the optimizer state is born sharded (the
+    ZeRO-1 half of the memory win). Same AdamState as the composite
+    step (parallel/optim.py)."""
+    return init_adam_state(params)
+
+
+def make_fsdp_train_step(cfg: TransformerConfig, mesh: Mesh, *,
+                         learning_rate: float = 1e-3,
+                         betas: Tuple[float, float] = (0.9, 0.999),
+                         eps: float = 1e-8):
+    """Jitted Adam train step with params/grads/opt-state sharded over
+    'data' and the batch sharded over 'data'. GSPMD inserts the
+    all_gathers (weights, just-in-time per layer) and reduce_scatters
+    (gradients) — the step body is the plain single-device math."""
+    example = jax.eval_shape(lambda k: init_params(cfg, k),
+                             jax.random.PRNGKey(0))
+    p_shard = fsdp_shardings(example, mesh)
+    opt_shard = AdamState(m=p_shard, v=p_shard,
+                          count=NamedSharding(mesh, P()))
+    batch_shard = NamedSharding(mesh, P("data"))
+    b1, b2 = betas
+
+    def step(params, opt: AdamState, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets))(params)
+        count = opt.count + 1
+        params, m, v = adam_update_tree(
+            params, grads, opt.m, opt.v, count.astype(jnp.float32),
+            learning_rate=learning_rate, b1=b1, b2=b2, eps=eps)
+        return params, AdamState(m, v, count), loss
+
+    return jax.jit(step,
+                   in_shardings=(p_shard, opt_shard, batch_shard,
+                                 batch_shard),
+                   out_shardings=(p_shard, opt_shard,
+                                  NamedSharding(mesh, P())),
+                   donate_argnums=(0, 1))

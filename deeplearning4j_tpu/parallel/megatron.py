@@ -36,6 +36,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.models.transformer import TransformerConfig
 from deeplearning4j_tpu.nn.layers.attention import layer_norm
+from deeplearning4j_tpu.parallel.optim import (AdamState,  # noqa: F401
+                                               adam_update_tree,
+                                               init_adam_state)
 from deeplearning4j_tpu.parallel.ring import ring_attention
 from deeplearning4j_tpu.parallel.ulysses import ulysses_attention
 
@@ -259,18 +262,6 @@ def _pipeline_apply(blocks_local, h_mb: Array, cfg, mesh) -> Array:
 # the train step factory
 # ---------------------------------------------------------------------------
 
-class AdamState(NamedTuple):
-    m: Any
-    v: Any
-    count: Array
-
-
-def init_adam_state(params) -> AdamState:
-    z = lambda: jax.tree_util.tree_map(  # noqa: E731
-        lambda p: jnp.zeros(p.shape, p.dtype), params)
-    return AdamState(m=z(), v=z(), count=jnp.zeros((), jnp.int32))
-
-
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
                              learning_rate: float = 1e-3,
                              n_microbatches: Optional[int] = None,
@@ -295,6 +286,14 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
         raise ValueError("n_heads and d_ff must divide by model size")
     if cfg.n_experts and cfg.n_experts % dp:
         raise ValueError("n_experts must divide by data size")
+    if cfg.seq_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown seq_impl {cfg.seq_impl!r}: expected "
+                         "'ring' or 'ulysses'")
+    if cfg.seq_impl == "ulysses" and sp > 1 and (cfg.n_heads // tp) % sp:
+        raise ValueError(
+            f"seq_impl='ulysses' needs local heads (n_heads/tp = "
+            f"{cfg.n_heads // tp}) divisible by seq size {sp}; use "
+            "seq_impl='ring' (any head count) or change the mesh")
     m_ = n_microbatches or s
     specs = param_specs(cfg)
 
@@ -340,30 +339,10 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
             grads, specs)
         # adam on local shards (identical math on every replica)
         cnt = count + 1
-        t = cnt.astype(jnp.float32)
-
-        def upd(p, g, m, v):
-            m2 = b1 * m + (1 - b1) * g
-            v2 = b2 * v + (1 - b2) * g * g
-            mhat = m2 / (1 - jnp.power(b1, t))
-            vhat = v2 / (1 - jnp.power(b2, t))
-            return (p - learning_rate * mhat / (jnp.sqrt(vhat) + eps),
-                    m2, v2)
-
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(opt_m)
-        flat_v = treedef.flatten_up_to(opt_v)
-        new_p, new_m, new_v = [], [], []
-        for pp, gg, mm, vv in zip(flat_p, flat_g, flat_m, flat_v):
-            a, b, c = upd(pp, gg, mm, vv)
-            new_p.append(a)
-            new_m.append(b)
-            new_v.append(c)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                jax.tree_util.tree_unflatten(treedef, new_m),
-                jax.tree_util.tree_unflatten(treedef, new_v),
-                cnt, loss)
+        new_p, new_m, new_v = adam_update_tree(
+            params, grads, opt_m, opt_v, cnt.astype(jnp.float32),
+            learning_rate=learning_rate, b1=b1, b2=b2, eps=eps)
+        return new_p, new_m, new_v, cnt, loss
 
     data_spec = P(("data",), ("seq",))
     smapped = shard_map(

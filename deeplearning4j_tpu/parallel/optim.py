@@ -1,0 +1,64 @@
+"""Sharding-agnostic Adam for the scale paths.
+
+One per-leaf update serves both distributed train steps: the
+composite-parallel step (parallel/megatron.py — replica-local shards
+inside `shard_map`) and the FSDP step (parallel/fsdp.py — GSPMD-sharded
+leaves under `jit`). Elementwise math is sharding-transparent, so the
+same function is correct in both regimes; keeping it in one place keeps
+the two steps' optimizer semantics from drifting.
+
+(The network-API updater semantics — LR policies, grad clipping, L1/L2
+ordering mirroring the reference's `LayerUpdater.java:74-186` — live in
+train/updaters.py; this module is the minimal optimizer for the
+composite/FSDP transformer steps.)
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class AdamState(NamedTuple):
+    m: Any
+    v: Any
+    count: Array
+
+
+def init_adam_state(params) -> AdamState:
+    """Zeros shaped (and sharded) like the params: `jnp.zeros_like` on an
+    already-placed tree inherits each leaf's sharding, so FSDP optimizer
+    state is born sharded."""
+    z = lambda: jax.tree_util.tree_map(  # noqa: E731
+        lambda p: jnp.zeros_like(p), params)
+    return AdamState(m=z(), v=z(), count=jnp.zeros((), jnp.int32))
+
+
+def adam_update_tree(params, grads, m, v, t: Array, *,
+                     learning_rate: float, b1: float, b2: float,
+                     eps: float) -> Tuple[Any, Any, Any]:
+    """Apply one Adam step leaf-wise; returns (params, m, v) trees.
+    ``t`` is the 1-based float32 step count (for bias correction)."""
+    def upd(p, g, m_, v_):
+        m2 = b1 * m_ + (1 - b1) * g
+        v2 = b2 * v_ + (1 - b2) * g * g
+        mhat = m2 / (1 - jnp.power(b1, t))
+        vhat = v2 / (1 - jnp.power(b2, t))
+        return (p - learning_rate * mhat / (jnp.sqrt(vhat) + eps), m2, v2)
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(m)
+    flat_v = treedef.flatten_up_to(v)
+    new_p, new_m, new_v = [], [], []
+    for pp, gg, mm, vv in zip(flat_p, flat_g, flat_m, flat_v):
+        a, b, c = upd(pp, gg, mm, vv)
+        new_p.append(a)
+        new_m.append(b)
+        new_v.append(c)
+    unflatten = jax.tree_util.tree_unflatten
+    return (unflatten(treedef, new_p), unflatten(treedef, new_m),
+            unflatten(treedef, new_v))

@@ -1,0 +1,88 @@
+"""FSDP/ZeRO-style fully-sharded data parallelism (parallel/fsdp.py).
+
+The reference's data-parallel modes replicate the full model per worker
+(ParallelWrapper.java:603; Spark broadcast) — sharded-state DP is
+net-new. Proof obligations: (1) numerics identical to single-device
+training, (2) per-device param/opt-state memory actually drops by the
+axis size for shardable leaves.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.parallel.fsdp import (fsdp_leaf_spec,
+                                              init_fsdp_adam_state,
+                                              make_fsdp_train_step,
+                                              shard_params_fsdp)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+CFG = TransformerConfig(vocab_size=50, d_model=32, n_heads=4, n_layers=4,
+                        max_len=32)
+
+
+def test_fsdp_leaf_spec_rules():
+    # largest divisible axis is sharded
+    assert fsdp_leaf_spec((4, 32, 64), 8) == P(None, None, "data")
+    # largest axis not divisible -> next largest divisible one
+    assert fsdp_leaf_spec((50, 32), 8) == P(None, "data")
+    # nothing divisible -> replicated
+    assert fsdp_leaf_spec((7, 3), 8) == P()
+    assert fsdp_leaf_spec((), 8) == P()
+    # axis of exactly the mesh size is eligible
+    assert fsdp_leaf_spec((8,), 8) == P("data")
+    # size-1 axis (no mesh) -> replicated
+    assert fsdp_leaf_spec((64, 64), 1) == P()
+
+
+def _data(seed=0, b=8, t=32):
+    rng = np.random.RandomState(seed)
+    toks = jnp.asarray(rng.randint(0, 50, (b, t)).astype(np.int32))
+    tgts = jnp.asarray(np.roll(np.asarray(toks), -1, 1).astype(np.int32))
+    return toks, tgts
+
+
+def _train(mesh_spec, steps=3):
+    mesh = make_mesh(mesh_spec)
+    params = shard_params_fsdp(init_params(CFG, jax.random.PRNGKey(0)),
+                               mesh)
+    opt = init_fsdp_adam_state(params)
+    step = make_fsdp_train_step(CFG, mesh, learning_rate=1e-2)
+    toks, tgts = _data()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, toks, tgts)
+    return params, opt, float(loss)
+
+
+def test_fsdp_matches_single_device(devices8):
+    base_p, _, base_loss = _train(MeshSpec())
+    got_p, _, got_loss = _train(MeshSpec(data=8))
+    assert abs(got_loss - base_loss) < 1e-4
+    for a, b in zip(jax.tree_util.tree_leaves(base_p),
+                    jax.tree_util.tree_leaves(got_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_fsdp_state_is_actually_sharded(devices8):
+    mesh = make_mesh(MeshSpec(data=8))
+    params = shard_params_fsdp(init_params(CFG, jax.random.PRNGKey(0)),
+                               mesh)
+    opt = init_fsdp_adam_state(params)
+    wq = params["blocks"]["Wq"]          # [L=4, 32, 32]: d axis sharded
+    assert wq.sharding.spec != P()
+    local = wq.addressable_shards[0].data
+    assert local.size == wq.size // 8
+    # optimizer state inherits the shards (ZeRO-1 half of the win)
+    mu_wq = opt.m["blocks"]["Wq"]
+    assert mu_wq.addressable_shards[0].data.size == mu_wq.size // 8
+    # non-divisible leaves remain replicated, not broken
+    emb = params["embed"]                # [50, 32] -> d axis sharded too
+    assert emb.addressable_shards[0].data.size == emb.size // 8
+
+
+def test_fsdp_loss_decreases(devices8):
+    _, _, l3 = _train(MeshSpec(data=8), steps=1)
+    _, _, l8 = _train(MeshSpec(data=8), steps=10)
+    assert l8 < l3
