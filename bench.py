@@ -10,8 +10,12 @@ across rounds.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Batch 2048: TPU-right sizing — the MXU wants large batched matmuls, and
-30 steps at 2048 is one full MNIST epoch per measured rep. (The CPU
+Batch 4096: TPU-right sizing — the MXU wants large batched matmuls. One
+MNIST epoch (15 x 4096 = 61,440 examples) is staged in HBM once and the
+measured program runs EPOCHS passes over it via the nested-scan path
+(fit_batched(..., epochs=N)): ~480 optimizer steps in one XLA program,
+so the per-dispatch tunnel latency (~250 ms against ~2 ms/step of
+compute) amortizes the way it does in a real multi-epoch run. (The CPU
 reference estimate is per-example throughput, which for the reference's
 eager per-op dispatch is roughly batch-size-independent.)
 """
@@ -27,9 +31,10 @@ import numpy as np
 
 
 REFERENCE_CPU_EXAMPLES_PER_SEC = 2500.0
-BATCH = 2048
-MEASURE_STEPS = 30
-REPS = 5
+BATCH = 4096
+POOL_STEPS = 15          # one staged MNIST epoch: 15 x 4096 = 61,440
+EPOCHS = 32              # in-program passes over the pool
+REPS = 4
 
 
 def main() -> None:
@@ -40,31 +45,31 @@ def main() -> None:
     conf = lenet_mnist(dtype="bfloat16")
     net = MultiLayerNetwork(conf).init()
 
-    # Distinct minibatches staged in HBM; the epoch is ONE compiled
-    # program (fit_batched: lax.scan of the train step — per-step loop
-    # on device, no host dispatch between steps; SURVEY §3.1's TPU
-    # design consequence applied to the step loop itself).
+    # Distinct minibatches staged in HBM once; the measured region is ONE
+    # compiled program spanning EPOCHS passes over the pool (nested
+    # lax.scan — per-step loop on device, no host dispatch between steps
+    # or between passes; SURVEY §3.1's TPU design consequence applied to
+    # the whole training run).
     rng = np.random.default_rng(0)
-    xs = jnp.asarray(rng.random((MEASURE_STEPS, BATCH, 784),
+    xs = jnp.asarray(rng.random((POOL_STEPS, BATCH, 784),
                                 dtype=np.float32))
     ys = jax.nn.one_hot(
-        jnp.asarray(rng.integers(0, 10, (MEASURE_STEPS, BATCH))), 10)
+        jnp.asarray(rng.integers(0, 10, (POOL_STEPS, BATCH))), 10)
 
-    # warmup = compile + one full epoch at the measured shape
-    scores = net.fit_batched(xs, ys)
+    # warmup = compile + one full run at the measured shape
+    scores = net.fit_batched(xs, ys, epochs=EPOCHS)
     jax.block_until_ready(scores)
 
-    # Best of REPS: the measured region is short (one scanned-epoch
-    # program), so dispatch/tunnel latency and chip time-sharing dominate
-    # the tail; the max is the honest device-throughput estimate.
+    # Best of REPS: chip time-sharing can inflate the tail; the max is
+    # the honest device-throughput estimate.
     dt = math.inf
     for _ in range(REPS):
         t0 = time.perf_counter()
-        scores = net.fit_batched(xs, ys)
+        scores = net.fit_batched(xs, ys, epochs=EPOCHS)
         jax.block_until_ready(scores)
         dt = min(dt, time.perf_counter() - t0)
 
-    examples_per_sec = BATCH * MEASURE_STEPS / dt
+    examples_per_sec = BATCH * POOL_STEPS * EPOCHS / dt
     print(json.dumps({
         "metric": "lenet_mnist_train_throughput",
         "value": round(examples_per_sec, 1),

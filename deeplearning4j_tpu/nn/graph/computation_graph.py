@@ -276,10 +276,11 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
 
-    def _make_scan_fit(self):
+    def _make_scan_fit(self, epochs: int = 1):
         """Whole-epoch program: `lax.scan` of the minibatch step, keeping
         the per-step loop on device (the MultiLayerNetwork.fit_batched
-        analog for the DAG runtime)."""
+        analog for the DAG runtime). ``epochs`` > 1 nests the scan in an
+        outer pass-counting scan over the same staged pool."""
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
@@ -301,18 +302,28 @@ class ComputationGraph:
                     lr_multipliers=lr_mult, trainable=trainable)
                 return (new_params, new_state, new_opt, it + 1), score
 
-            (params, state, opt_state, _), scores = jax.lax.scan(
-                body, (params, state, opt_state, start_iteration),
-                (inputs_stack, labels_stack))
+            def one_pass(carry, _):
+                return jax.lax.scan(body, carry,
+                                    (inputs_stack, labels_stack))
+
+            carry = (params, state, opt_state, start_iteration)
+            if epochs == 1:
+                carry, scores = one_pass(carry, None)
+            else:
+                carry, scores = jax.lax.scan(one_pass, carry, None,
+                                             length=epochs)
+                scores = scores.reshape(-1)
+            params, state, opt_state, _ = carry
             return params, state, opt_state, scores
 
         return jax.jit(epoch, donate_argnums=(0, 1, 2))
 
-    def fit_batched(self, feats, labs):
+    def fit_batched(self, feats, labs, epochs: int = 1):
         """Train on a pre-staged stack of minibatches in ONE compiled
         program. ``feats``/``labs`` follow the same shapes fit() accepts
         (single array, list per input/output, or name->array dict), with
-        an extra leading [N] batches axis; returns per-step scores [N]."""
+        an extra leading [N] batches axis; returns per-step scores
+        [N * epochs] (``epochs`` repeats the staged pool in-program)."""
         if not self._initialized:
             self.init()
         tc = self.conf.training
@@ -322,12 +333,14 @@ class ComputationGraph:
                 "fit_batched supports first-order optimization only; "
                 f"optimization_algo={tc.optimization_algo!r} dispatches "
                 "to the Solver path — use fit() instead")
+        if epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {epochs}")
         inputs = self._as_input_dict(feats, self.conf.network_inputs)
         labels = self._as_input_dict(labs, self.conf.network_outputs)
-        fn = self._jit_cache.get(("scanfit",))
+        fn = self._jit_cache.get(("scanfit", epochs))
         if fn is None:
-            fn = self._make_scan_fit()
-            self._jit_cache[("scanfit",)] = fn
+            fn = self._make_scan_fit(epochs)
+            self._jit_cache[("scanfit", epochs)] = fn
         base_key = jax.random.PRNGKey(self.conf.training.seed)
         start = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.state, self.updater_state, scores = fn(

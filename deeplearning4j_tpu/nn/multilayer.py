@@ -216,12 +216,17 @@ class MultiLayerNetwork:
         return jax.jit(self._step_math(), donate_argnums=(0, 1, 2),
                        **jit_kwargs)
 
-    def _make_scan_fit(self):
+    def _make_scan_fit(self, epochs: int = 1):
         """Whole-epoch program: `lax.scan` of the minibatch step over a
         leading batches axis — the per-step loop stays ON DEVICE, so no
         host dispatch between steps (the SURVEY §3.1 design consequence:
         the reference's eager per-op/per-step JNI round-trips collapse
-        into one XLA program; this is the multi-STEP version of that)."""
+        into one XLA program; this is the multi-STEP version of that).
+        ``epochs`` > 1 nests that scan in an outer pass-counting scan:
+        the staged pool is traversed `epochs` times inside the SAME
+        program, so HBM holds one pool but the program spans the whole
+        run (the iteration counter — and with it the dropout key and LR
+        schedule position — keeps advancing across passes)."""
         step = self._step_math()
 
         def epoch(params, state, opt_state, start_iteration, xs, ys,
@@ -234,19 +239,28 @@ class MultiLayerNetwork:
                     params, state, opt, it, x, y, key, None)
                 return (params, state, opt, it + 1), score
 
-            (params, state, opt_state, _), scores = jax.lax.scan(
-                body, (params, state, opt_state, start_iteration),
-                (xs, ys))
+            def one_pass(carry, _):
+                return jax.lax.scan(body, carry, (xs, ys))
+
+            carry = (params, state, opt_state, start_iteration)
+            if epochs == 1:
+                carry, scores = one_pass(carry, None)
+            else:
+                carry, scores = jax.lax.scan(one_pass, carry, None,
+                                             length=epochs)
+                scores = scores.reshape(-1)
+            params, state, opt_state, _ = carry
             return params, state, opt_state, scores
 
         return jax.jit(epoch, donate_argnums=(0, 1, 2))
 
-    def fit_batched(self, xs, ys) -> "jnp.ndarray":
+    def fit_batched(self, xs, ys, epochs: int = 1) -> "jnp.ndarray":
         """Train on a pre-staged stack of minibatches in ONE compiled
         program: ``xs`` [N, B, ...], ``ys`` [N, B, ...] → per-step
-        scores [N]. The high-throughput path for data already on (or
-        streamable to) the device; `fit(iterator)` remains the
-        host-streaming path. Listeners fire after the program returns
+        scores [N * epochs]. The high-throughput path for data already
+        on (or streamable to) the device; `fit(iterator)` remains the
+        host-streaming path. ``epochs`` repeats the staged pool inside
+        the same program. Listeners fire after the program returns
         (scores come back as one array)."""
         if not self._initialized:
             self.init()
@@ -264,18 +278,20 @@ class MultiLayerNetwork:
             raise ValueError(
                 "fit_batched applies one update per minibatch; "
                 f"num_iterations={tc.num_iterations} requires fit()")
+        if epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {epochs}")
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
-        fn = self._jit_cache.get(("scanfit",))
+        fn = self._jit_cache.get(("scanfit", epochs))
         if fn is None:
-            fn = self._make_scan_fit()
-            self._jit_cache[("scanfit",)] = fn
+            fn = self._make_scan_fit(epochs)
+            self._jit_cache[("scanfit", epochs)] = fn
         base_key = jax.random.PRNGKey(self.conf.training.seed)
         start = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.state, self.updater_state, scores = fn(
             self.params, self.state, self.updater_state, start, xs, ys,
             base_key)
-        n = int(xs.shape[0])
+        n = int(scores.shape[0])
         if n == 0:
             return scores
         if not self.listeners:
@@ -284,8 +300,9 @@ class MultiLayerNetwork:
             self.score_value = float(scores[-1])
             return scores
         host_scores = np.asarray(scores)
+        pool = int(xs.shape[0])
         for i in range(n):
-            self._notify_iteration(float(host_scores[i]), xs[i])
+            self._notify_iteration(float(host_scores[i]), xs[i % pool])
         return scores
 
     def _notify_iteration(self, score, x) -> None:

@@ -31,8 +31,6 @@ from deeplearning4j_tpu.models.transformer import (TransformerConfig,
 from deeplearning4j_tpu.parallel.optim import (AdamState, adam_update_tree,
                                                init_adam_state)
 
-Array = jax.Array
-
 
 def fsdp_leaf_spec(shape: Tuple[int, ...], axis_size: int,
                    axis_name: str = "data") -> P:

@@ -141,14 +141,11 @@ def _block_fwd_sharded(h: Array, p: Dict[str, Array],
     k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
     v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
     if sp > 1:
+        # seq_impl validated upfront by make_parallel_train_step
         if cfg.seq_impl == "ulysses":
             a = ulysses_attention(q, k, v, "seq", causal=True)
-        elif cfg.seq_impl == "ring":
-            a = ring_attention(q, k, v, "seq", causal=True)
         else:
-            raise ValueError(
-                f"unknown seq_impl {cfg.seq_impl!r}: expected 'ring' or "
-                "'ulysses'")
+            a = ring_attention(q, k, v, "seq", causal=True)
     else:
         from deeplearning4j_tpu.nn.layers.attention import \
             dot_product_attention

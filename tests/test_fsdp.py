@@ -77,9 +77,26 @@ def test_fsdp_state_is_actually_sharded(devices8):
     # optimizer state inherits the shards (ZeRO-1 half of the win)
     mu_wq = opt.m["blocks"]["Wq"]
     assert mu_wq.addressable_shards[0].data.size == mu_wq.size // 8
-    # non-divisible leaves remain replicated, not broken
-    emb = params["embed"]                # [50, 32] -> d axis sharded too
+    # embed's vocab axis (50) is indivisible; its d axis shards instead
+    emb = params["embed"]                # [50, 32] -> d axis sharded
     assert emb.addressable_shards[0].data.size == emb.size // 8
+
+
+def test_fsdp_replicated_leaves_stay_whole(devices8):
+    """Leaves with no axis divisible by the mesh (odd-shaped norms/
+    biases) are replicated intact — every device holds the full leaf."""
+    mesh = make_mesh(MeshSpec(data=8))
+    tree = {"w": jnp.ones((16, 64)), "odd": jnp.ones((7, 3)),
+            "scalar": jnp.ones(())}
+    placed = shard_params_fsdp(tree, mesh)
+    assert placed["w"].addressable_shards[0].data.size == 16 * 64 // 8
+    for name in ("odd", "scalar"):
+        leaf = placed[name]
+        assert leaf.sharding.spec == P()
+        assert leaf.addressable_shards[0].data.size == leaf.size
+        np.testing.assert_array_equal(
+            np.asarray(leaf.addressable_shards[0].data),
+            np.asarray(tree[name]))
 
 
 def test_fsdp_loss_decreases(devices8):

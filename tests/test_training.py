@@ -231,6 +231,39 @@ def test_fit_batched_matches_per_step_fit():
     np.testing.assert_allclose(net_flat, ref_flat, rtol=1e-4, atol=1e-5)
 
 
+def test_fit_batched_epochs_matches_sequential_calls():
+    """fit_batched(xs, ys, epochs=3) — the nested-scan multi-pass
+    program — must equal three sequential fit_batched(xs, ys) calls
+    exactly (iteration counter, dropout keys, and LR schedule position
+    all advance identically across the in-program passes)."""
+    rng = np.random.default_rng(5)
+    n_steps, batch = 4, 16
+    xs = rng.random((n_steps, batch, 4), dtype=np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (n_steps, batch))]
+
+    def make_net():
+        conf = (NeuralNetConfiguration(seed=11, updater="adam",
+                                       learning_rate=0.05,
+                                       activation="tanh", dropout=0.25)
+                .list(DenseLayer(n_in=4, n_out=8),
+                      OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent")))
+        return MultiLayerNetwork(conf).init()
+
+    ref = make_net()
+    ref_scores = np.concatenate(
+        [np.asarray(ref.fit_batched(xs, ys)) for _ in range(3)])
+
+    net = make_net()
+    scores = np.asarray(net.fit_batched(xs, ys, epochs=3))
+    assert scores.shape == (3 * n_steps,)
+    np.testing.assert_allclose(scores, ref_scores, rtol=1e-5, atol=1e-6)
+    assert net.iteration_count == 3 * n_steps
+    np.testing.assert_allclose(np.asarray(net.params_flat()),
+                               np.asarray(ref.params_flat()),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_fit_batched_learns_digits():
     conf = (NeuralNetConfiguration(seed=7, updater="adam",
                                    learning_rate=5e-3)
