@@ -77,6 +77,29 @@ def skipgram_neg_impl(syn0: Array, syn1neg: Array, centers: Array,
 skipgram_neg_step = jax.jit(skipgram_neg_impl, donate_argnums=(0, 1))
 
 
+def _skipgram_neg_scan_impl(syn0: Array, syn1neg: Array, centers: Array,
+                            contexts: Array, negatives: Array, lr: Array
+                            ) -> Tuple[Array, Array, Array]:
+    """Whole-epoch skip-gram: `lax.scan` of skipgram_neg_impl over a
+    leading [N] batches axis — the per-batch loop stays on device, the
+    same dispatch-amortization move as MultiLayerNetwork.fit_batched.
+
+    centers/contexts: [N, B]; negatives: [N, B, K]; lr: [N, B].
+    Returns (syn0, syn1neg, losses [N])."""
+    def body(carry, batch):
+        s0, s1, = carry
+        c, x, neg, l = batch
+        s0, s1, loss = skipgram_neg_impl(s0, s1, c, x, neg, l)
+        return (s0, s1), loss
+
+    (syn0, syn1neg), losses = jax.lax.scan(
+        body, (syn0, syn1neg), (centers, contexts, negatives, lr))
+    return syn0, syn1neg, losses
+
+
+skipgram_neg_scan = jax.jit(_skipgram_neg_scan_impl, donate_argnums=(0, 1))
+
+
 def make_sharded_skipgram_step(mesh):
     """Data-parallel skip-gram (the reference's distributed Word2Vec role,
     spark/dl4j-spark-nlp/.../Word2Vec.java map-partitions + weight-delta

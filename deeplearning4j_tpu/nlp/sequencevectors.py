@@ -98,18 +98,21 @@ class SequenceVectors(WordVectorsMixin):
         return self._rng.random(len(ids)) < keep_p
 
     def _window_pairs(self, ids: np.ndarray):
-        """(center, context) pairs with the word2vec reduced-window trick."""
-        centers, contexts = [], []
+        """(center, context) pairs with the word2vec reduced-window
+        trick, fully vectorized (the Python double loop here was the
+        corpus-size bottleneck — pair generation is O(n*window) numpy
+        now)."""
         n = len(ids)
-        b = self._rng.integers(0, self.window, n)
-        for i in range(n):
-            w = self.window - b[i]
-            lo, hi = max(0, i - w), min(n, i + w + 1)
-            for j in range(lo, hi):
-                if j != i:
-                    centers.append(ids[i])
-                    contexts.append(ids[j])
-        return centers, contexts
+        if n == 0:
+            return (np.empty(0, np.int32),) * 2
+        w = self.window - self._rng.integers(0, self.window, n)  # [n]>=1
+        offs = np.concatenate([np.arange(-self.window, 0),
+                               np.arange(1, self.window + 1)])
+        ci = np.repeat(np.arange(n), len(offs))        # center index
+        xi = ci + np.tile(offs, n)                     # context index
+        valid = ((xi >= 0) & (xi < n)
+                 & (np.abs(xi - ci) <= np.repeat(w, len(offs))))
+        return ids[ci[valid]], ids[xi[valid]]
 
     # -- fit ---------------------------------------------------------------
     def fit(self) -> "SequenceVectors":
@@ -120,32 +123,97 @@ class SequenceVectors(WordVectorsMixin):
         step_no = 0
         # pre-collect pairs per epoch (host); batches keep a fixed shape
         for epoch in range(total_epochs):
-            centers: List[int] = []
-            contexts: List[int] = []
+            centers_l: List[np.ndarray] = []
+            contexts_l: List[np.ndarray] = []
             for seq in self._sequences():
                 ids = self._encode(seq)
                 ids = ids[self._keep_mask(ids)]
                 c, x = self._window_pairs(ids)
-                centers.extend(c)
-                contexts.extend(x)
-            n_pairs = len(centers)
+                centers_l.append(c)
+                contexts_l.append(x)
+            if not centers_l:
+                continue
+            centers_a = np.concatenate(centers_l).astype(np.int32)
+            contexts_a = np.concatenate(contexts_l).astype(np.int32)
+            n_pairs = len(centers_a)
             if n_pairs == 0:
                 continue
             order = self._rng.permutation(n_pairs)
-            centers_a = np.asarray(centers, np.int32)[order]
-            contexts_a = np.asarray(contexts, np.int32)[order]
+            centers_a = centers_a[order]
+            contexts_a = contexts_a[order]
             alpha0 = self.learning_rate
-            total_steps = total_epochs * ((n_pairs + self.batch_size - 1)
-                                          // self.batch_size)
-            for s in range(0, n_pairs, self.batch_size):
-                frac = min(1.0, step_no / max(total_steps, 1))
-                lr_now = max(self.min_learning_rate,
-                             alpha0 * (1.0 - frac))
-                self._train_batch(centers_a[s:s + self.batch_size],
-                                  contexts_a[s:s + self.batch_size], lr_now)
-                step_no += 1
+            n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
+            total_steps = total_epochs * n_batches
+            if (self.algorithm == "skipgram" and not self.use_hs
+                    and self.negative > 0 and self.mesh is None):
+                # whole-epoch scanned program (one dispatch per epoch)
+                step_no = self._fit_epoch_scanned(
+                    centers_a, contexts_a, n_batches, step_no,
+                    total_steps, alpha0)
+            else:
+                for s in range(0, n_pairs, self.batch_size):
+                    frac = min(1.0, step_no / max(total_steps, 1))
+                    lr_now = max(self.min_learning_rate,
+                                 alpha0 * (1.0 - frac))
+                    self._train_batch(
+                        centers_a[s:s + self.batch_size],
+                        contexts_a[s:s + self.batch_size], lr_now)
+                    step_no += 1
             log.info("SequenceVectors epoch %d: %d pairs", epoch, n_pairs)
         return self
+
+    # max batches per scanned program: bounds device/host staging memory
+    # at CHUNK * batch_size * (2 + negative) int32 regardless of corpus
+    # size (the per-batch path's O(batch) memory, amortized dispatch)
+    _SCAN_CHUNK = 1024
+
+    def _fit_epoch_scanned(self, centers_a: np.ndarray,
+                           contexts_a: np.ndarray, n_batches: int,
+                           step_no: int, total_steps: int,
+                           alpha0: float) -> int:
+        """Run one epoch of skip-gram/negative-sampling as a few big XLA
+        programs: the pair stream is staged in chunks of up to
+        _SCAN_CHUNK batches [N, B] and each chunk scans the batched
+        update on device (learning.skipgram_neg_scan). Padding rows
+        carry lr=0, so they are exact no-ops; partial chunks bucket N to
+        the next power of two so epoch-to-epoch pair-count jitter (the
+        reduced-window RNG) never recompiles. RNG draws happen one batch
+        at a time in stream order, so results are bit-identical to the
+        per-batch path."""
+        b = self.batch_size
+        lt = self.lookup_table
+        for start in range(0, n_batches, self._SCAN_CHUNK):
+            nb = min(self._SCAN_CHUNK, n_batches - start)
+            nb_pad = (nb if nb == self._SCAN_CHUNK
+                      else max(16, 1 << (nb - 1).bit_length()))
+            lo = start * b
+            c = centers_a[lo:lo + nb * b]
+            x = contexts_a[lo:lo + nb * b]
+            n_valid = len(c)
+            pad = nb_pad * b - n_valid
+            centers_p = np.concatenate(
+                [c, np.zeros(pad, np.int32)]).reshape(nb_pad, b)
+            contexts_p = np.concatenate(
+                [x, np.zeros(pad, np.int32)]).reshape(nb_pad, b)
+            frac = np.minimum(1.0, (step_no + np.arange(nb_pad))
+                              / max(total_steps, 1))
+            lr_rows = np.maximum(self.min_learning_rate,
+                                 alpha0 * (1.0 - frac)).astype(np.float32)
+            lr_vec = np.repeat(lr_rows[:, None], b, axis=1)
+            if pad:
+                lr_vec.reshape(-1)[n_valid:] = 0.0
+            negs = np.stack([self._sample_negatives(b)
+                             for _ in range(nb)]).astype(np.int32)
+            if nb_pad > nb:
+                negs = np.concatenate(
+                    [negs, np.zeros((nb_pad - nb, b, self.negative),
+                                    np.int32)])
+            lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_scan(
+                lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
+                jnp.asarray(contexts_p), jnp.asarray(negs),
+                jnp.asarray(lr_vec))
+            step_no += nb
+        return step_no
 
     def _pad(self, arr: np.ndarray, value=0) -> np.ndarray:
         b = self.batch_size
