@@ -39,7 +39,7 @@ class SequenceVectors(WordVectorsMixin):
                  min_word_frequency: int = 1, batch_size: int = 512,
                  subsampling: float = 0.0, seed: int = 12345,
                  elements_learning_algorithm: str = "skipgram",
-                 mesh=None):
+                 mesh=None, scan_epochs: bool = True):
         self.layer_size = layer_size
         self.window = window
         self.learning_rate = learning_rate
@@ -51,6 +51,10 @@ class SequenceVectors(WordVectorsMixin):
         self.min_word_frequency = min_word_frequency
         self.batch_size = batch_size
         self.subsampling = subsampling
+        # scanned whole-epoch programs (skip-gram/neg); False forces the
+        # per-batch dispatch path (they are numerically identical — the
+        # equivalence test in tests/test_nlp.py is the proof obligation)
+        self.scan_epochs = scan_epochs
         self.seed = seed
         self.algorithm = elements_learning_algorithm.lower()
         # device mesh with a 'data' axis → mesh-sharded pair batches (the
@@ -133,8 +137,10 @@ class SequenceVectors(WordVectorsMixin):
                 contexts_l.append(x)
             if not centers_l:
                 continue
-            centers_a = np.concatenate(centers_l).astype(np.int32)
-            contexts_a = np.concatenate(contexts_l).astype(np.int32)
+            centers_a = np.concatenate(centers_l).astype(np.int32,
+                                                         copy=False)
+            contexts_a = np.concatenate(contexts_l).astype(np.int32,
+                                                           copy=False)
             n_pairs = len(centers_a)
             if n_pairs == 0:
                 continue
@@ -144,8 +150,9 @@ class SequenceVectors(WordVectorsMixin):
             alpha0 = self.learning_rate
             n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
             total_steps = total_epochs * n_batches
-            if (self.algorithm == "skipgram" and not self.use_hs
-                    and self.negative > 0 and self.mesh is None):
+            if (self.scan_epochs and self.algorithm == "skipgram"
+                    and not self.use_hs and self.negative > 0
+                    and self.mesh is None):
                 # whole-epoch scanned program (one dispatch per epoch)
                 step_no = self._fit_epoch_scanned(
                     centers_a, contexts_a, n_batches, step_no,

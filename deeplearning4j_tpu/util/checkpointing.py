@@ -59,36 +59,65 @@ class CheckpointManager:
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep, create=True))
 
+    # -- payload plumbing (shared by net- and tree-level APIs) -------------
+    def _write_payload(self, payload: Dict, step: int) -> None:
+        if self.use_orbax:
+            self._ocp_mgr.save(step, args=ocp.args.StandardSave(payload))
+            self._ocp_mgr.wait_until_finished()
+            return
+        d = self.directory / f"step_{step}"
+        d.mkdir(parents=True, exist_ok=True)
+        flat = {}
+        exotic: Dict[str, str] = {}
+        for k, tree in payload.items():
+            leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+            for path, leaf in leaves:
+                name = k + "|" + "/".join(
+                    str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+                a = np.asarray(leaf)
+                # np.load returns raw void for ml_dtypes dtypes
+                # (bf16/fp8); persist them as same-width uints plus a
+                # dtype sidecar so the round-trip is exact.
+                if not hasattr(np, a.dtype.name):
+                    exotic[name] = a.dtype.name
+                    a = a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
+                flat[name] = a
+        np.savez(d / "arrays.npz", **flat)
+        (d / "dtypes.json").write_text(json.dumps(exotic))
+        self._retain()
+
+    def _read_payload(self, template: Dict, step: int) -> Dict:
+        if self.use_orbax:
+            return self._ocp_mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        d = self.directory / f"step_{step}"
+        data = np.load(d / "arrays.npz")
+        exotic: Dict[str, str] = {}
+        if (d / "dtypes.json").exists():
+            exotic = json.loads((d / "dtypes.json").read_text())
+        restored = {}
+        for k, tree in template.items():
+            leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            vals = []
+            for path, leaf in leaves:
+                name = k + "|" + "/".join(
+                    str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+                a = data[name]
+                if name in exotic:
+                    a = a.view(getattr(ml_dtypes, exotic[name]))
+                vals.append(jax.numpy.asarray(a))
+            restored[k] = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(tree), vals)
+        return restored
+
     # -- save --------------------------------------------------------------
     def save(self, net, step: Optional[int] = None) -> int:
         step = int(net.iteration_count if step is None else step)
         payload = {"params": net.params, "state": net.state,
                    "updater_state": net.updater_state}
-        if self.use_orbax:
-            self._ocp_mgr.save(step, args=ocp.args.StandardSave(payload))
-            self._ocp_mgr.wait_until_finished()
-        else:
-            d = self.directory / f"step_{step}"
-            d.mkdir(parents=True, exist_ok=True)
-            flat = {}
-            exotic: Dict[str, str] = {}
-            for k, tree in payload.items():
-                leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-                for path, leaf in leaves:
-                    name = k + "|" + "/".join(
-                        str(getattr(p, "key", getattr(p, "idx", p)))
-                        for p in path)
-                    a = np.asarray(leaf)
-                    # np.load returns raw void for ml_dtypes dtypes
-                    # (bf16/fp8); persist them as same-width uints plus a
-                    # dtype sidecar so the round-trip is exact.
-                    if not hasattr(np, a.dtype.name):
-                        exotic[name] = a.dtype.name
-                        a = a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
-                    flat[name] = a
-            np.savez(d / "arrays.npz", **flat)
-            (d / "dtypes.json").write_text(json.dumps(exotic))
-            self._retain()
+        self._write_payload(payload, step)
         meta = {"step": step,
                 "iteration_count": int(net.iteration_count),
                 "epoch_count": int(net.epoch_count)}
@@ -127,29 +156,7 @@ class CheckpointManager:
             return None
         template = {"params": net.params, "state": net.state,
                     "updater_state": net.updater_state}
-        if self.use_orbax:
-            restored = self._ocp_mgr.restore(
-                step, args=ocp.args.StandardRestore(template))
-        else:
-            d = self.directory / f"step_{step}"
-            data = np.load(d / "arrays.npz")
-            exotic: Dict[str, str] = {}
-            if (d / "dtypes.json").exists():
-                exotic = json.loads((d / "dtypes.json").read_text())
-            restored = {}
-            for k, tree in template.items():
-                leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
-                vals = []
-                for path, leaf in leaves:
-                    name = k + "|" + "/".join(
-                        str(getattr(p, "key", getattr(p, "idx", p)))
-                        for p in path)
-                    a = data[name]
-                    if name in exotic:
-                        a = a.view(getattr(ml_dtypes, exotic[name]))
-                    vals.append(jax.numpy.asarray(a))
-                restored[k] = jax.tree_util.tree_unflatten(
-                    jax.tree_util.tree_structure(tree), vals)
+        restored = self._read_payload(template, step)
         net.params = restored["params"]
         net.state = restored["state"]
         # Cast to the freshly-initialized skeleton's dtypes: updater state
@@ -166,6 +173,36 @@ class CheckpointManager:
             net.iteration_count = meta.get("iteration_count", step)
             net.epoch_count = meta.get("epoch_count", 0)
         return step
+
+
+    # -- arbitrary-pytree API (distributed/FSDP training states) -----------
+    def save_tree(self, tree, step: int) -> int:
+        """Checkpoint an arbitrary pytree — e.g. FSDP/composite-parallel
+        (params, AdamState) from parallel/fsdp.py or parallel/megatron.py.
+        With orbax, sharded jax.Arrays are written distributed-safe
+        (each host persists its shards; multi-host coordination via the
+        PJRT runtime)."""
+        self._write_payload({"tree": tree}, int(step))
+        return int(step)
+
+    def restore_tree(self, template, step: Optional[int] = None):
+        """Restore a pytree saved by save_tree. ``template`` supplies
+        structure, dtypes, AND shardings: restoring an FSDP state with a
+        sharded template re-places each leaf into its shards (orbax), so
+        a job can resume on a different mesh layout by passing the new
+        mesh's template. Returns None if no checkpoint exists."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        out = self._read_payload({"tree": template}, step)["tree"]
+        if not self.use_orbax:
+            # npz fallback loads host arrays; re-place onto the
+            # template's shardings
+            out = jax.tree_util.tree_map(
+                lambda t, v: (jax.device_put(v, t.sharding)
+                              if isinstance(t, jax.Array) else v),
+                template, out)
+        return out
 
 
 class CheckpointListener(IterationListener):

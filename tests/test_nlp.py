@@ -239,6 +239,23 @@ def test_vectorizers_and_inverted_index():
     assert t[0, cat_idx] > t[0, sat_idx]
 
 
+def test_scanned_word2vec_matches_per_batch():
+    """The whole-epoch scanned skip-gram program (_fit_epoch_scanned)
+    must reproduce the per-batch dispatch path exactly — same RNG
+    stream, same lr schedule, lr=0 padding no-ops (the proof obligation
+    every scanned path in the repo carries, cf. fit_batched tests)."""
+    kw = dict(sentences=_toy_corpus(10), layer_size=16, window=3,
+              negative=3, epochs=2, seed=13, min_word_frequency=2,
+              batch_size=64, learning_rate=0.05)
+    scanned = Word2Vec(**kw)
+    scanned.fit()
+    stepped = Word2Vec(scan_epochs=False, **kw)
+    stepped.fit()
+    np.testing.assert_allclose(
+        np.asarray(scanned.lookup_table.syn0),
+        np.asarray(stepped.lookup_table.syn0), rtol=0, atol=1e-7)
+
+
 def test_distributed_word2vec_matches_single(devices8):
     """Mesh-sharded skip-gram must track the single-device trainer
     (the reference's spark-vs-single equivalence pattern, SURVEY §4)."""
