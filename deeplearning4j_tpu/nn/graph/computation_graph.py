@@ -276,7 +276,7 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
 
-    def _make_scan_fit(self, epochs: int = 1):
+    def _make_scan_fit(self, epochs: int = 1, **jit_kwargs):
         """Whole-epoch program: `lax.scan` of the minibatch step, keeping
         the per-step loop on device (the MultiLayerNetwork.fit_batched
         analog for the DAG runtime). ``epochs`` > 1 nests the scan in an
@@ -316,7 +316,7 @@ class ComputationGraph:
             params, state, opt_state, _ = carry
             return params, state, opt_state, scores
 
-        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+        return jax.jit(epoch, donate_argnums=(0, 1, 2), **jit_kwargs)
 
     def fit_batched(self, feats, labs, epochs: int = 1):
         """Train on a pre-staged stack of minibatches in ONE compiled
@@ -324,6 +324,16 @@ class ComputationGraph:
         (single array, list per input/output, or name->array dict), with
         an extra leading [N] batches axis; returns per-step scores
         [N * epochs] (``epochs`` repeats the staged pool in-program)."""
+        self._validate_fit_batched(epochs)
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        labels = self._as_input_dict(labs, self.conf.network_outputs)
+        fn = self._jit_cache.get(("scanfit", epochs))
+        if fn is None:
+            fn = self._make_scan_fit(epochs)
+            self._jit_cache[("scanfit", epochs)] = fn
+        return self._run_scan_fit(fn, inputs, labels)
+
+    def _validate_fit_batched(self, epochs: int) -> None:
         if not self._initialized:
             self.init()
         tc = self.conf.training
@@ -335,12 +345,8 @@ class ComputationGraph:
                 "to the Solver path — use fit() instead")
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
-        inputs = self._as_input_dict(feats, self.conf.network_inputs)
-        labels = self._as_input_dict(labs, self.conf.network_outputs)
-        fn = self._jit_cache.get(("scanfit", epochs))
-        if fn is None:
-            fn = self._make_scan_fit(epochs)
-            self._jit_cache[("scanfit", epochs)] = fn
+
+    def _run_scan_fit(self, fn, inputs, labels):
         base_key = jax.random.PRNGKey(self.conf.training.seed)
         start = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.state, self.updater_state, scores = fn(

@@ -216,7 +216,7 @@ class MultiLayerNetwork:
         return jax.jit(self._step_math(), donate_argnums=(0, 1, 2),
                        **jit_kwargs)
 
-    def _make_scan_fit(self, epochs: int = 1):
+    def _make_scan_fit(self, epochs: int = 1, **jit_kwargs):
         """Whole-epoch program: `lax.scan` of the minibatch step over a
         leading batches axis — the per-step loop stays ON DEVICE, so no
         host dispatch between steps (the SURVEY §3.1 design consequence:
@@ -252,7 +252,7 @@ class MultiLayerNetwork:
             params, state, opt_state, _ = carry
             return params, state, opt_state, scores
 
-        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+        return jax.jit(epoch, donate_argnums=(0, 1, 2), **jit_kwargs)
 
     def fit_batched(self, xs, ys, epochs: int = 1) -> "jnp.ndarray":
         """Train on a pre-staged stack of minibatches in ONE compiled
@@ -262,6 +262,16 @@ class MultiLayerNetwork:
         host-streaming path. ``epochs`` repeats the staged pool inside
         the same program. Listeners fire after the program returns
         (scores come back as one array)."""
+        self._validate_fit_batched(epochs)
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        fn = self._jit_cache.get(("scanfit", epochs))
+        if fn is None:
+            fn = self._make_scan_fit(epochs)
+            self._jit_cache[("scanfit", epochs)] = fn
+        return self._run_scan_fit(fn, xs, ys)
+
+    def _validate_fit_batched(self, epochs: int) -> None:
         if not self._initialized:
             self.init()
         tc = self.conf.training
@@ -280,12 +290,8 @@ class MultiLayerNetwork:
                 f"num_iterations={tc.num_iterations} requires fit()")
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
-        fn = self._jit_cache.get(("scanfit", epochs))
-        if fn is None:
-            fn = self._make_scan_fit(epochs)
-            self._jit_cache[("scanfit", epochs)] = fn
+
+    def _run_scan_fit(self, fn, xs, ys) -> "jnp.ndarray":
         base_key = jax.random.PRNGKey(self.conf.training.seed)
         start = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.state, self.updater_state, scores = fn(

@@ -58,7 +58,9 @@ class ParallelWrapper:
         return NamedSharding(self.mesh, P("data", *([None] * (ndim - 1))))
 
     def _get_step(self, x, y, has_mask: bool):
-        key = ("pw", x.shape, y.shape, has_mask)
+        # mesh in the key: two wrappers over different meshes must not
+        # share compiled shardings through the model's jit cache
+        key = ("pw", self.mesh, x.shape, y.shape, has_mask)
         fn = self.model._jit_cache.get(key)
         if fn is None:
             rep = self._replicated()
@@ -93,6 +95,45 @@ class ParallelWrapper:
         m.epoch_count += 1
         if hasattr(data, "reset"):
             data.reset()
+
+    def fit_batched(self, xs, ys, epochs: int = 1):
+        """Data-parallel scanned training: the staged pool [N, B, ...] is
+        sharded over 'data' on the batch dim and the whole multi-epoch
+        run is ONE XLA program per call (MultiLayerNetwork.fit_batched
+        semantics — same math, the gradient psum rides ICI inside the
+        scan). The Spark-equivalent 'epoch wall-clock' fast path."""
+        m = self.model
+        m._validate_fit_batched(epochs)
+        if hasattr(m, "_as_input_dict"):        # ComputationGraph
+            xs = m._as_input_dict(xs, m.conf.network_inputs)
+            ys = m._as_input_dict(ys, m.conf.network_outputs)
+        else:                                   # MultiLayerNetwork
+            xs = jnp.asarray(xs)
+            ys = jnp.asarray(ys)
+        tree = jax.tree_util.tree_map
+        for leaf in jax.tree_util.tree_leaves(xs):
+            if leaf.shape[1] % self.workers:
+                raise ValueError(
+                    f"batch dim {leaf.shape[1]} must divide by workers "
+                    f"{self.workers} (GSPMD even sharding)")
+        shapes = tuple(l.shape for l in
+                       jax.tree_util.tree_leaves((xs, ys)))
+        key = ("pw-scanfit", self.mesh, epochs, shapes)
+        fn = m._jit_cache.get(key)
+        if fn is None:
+            rep = self._replicated()
+
+            def pool_shard(a):
+                return NamedSharding(
+                    self.mesh, P(None, "data", *([None] * (a.ndim - 2))))
+
+            fn = m._make_scan_fit(
+                epochs,
+                in_shardings=(rep, rep, rep, rep, tree(pool_shard, xs),
+                              tree(pool_shard, ys), rep),
+                out_shardings=(rep, rep, rep, rep))
+            m._jit_cache[key] = fn
+        return m._run_scan_fit(fn, xs, ys)
 
     def _fit_batch(self, x, y, mask=None) -> None:
         m = self.model

@@ -63,6 +63,63 @@ def test_data_parallel_uneven_batch_trimmed(devices8, rng):
     assert net.iteration_count == 1
 
 
+def test_parallel_fit_batched_matches_single_device(devices8, rng):
+    """Sharded scanned epochs (ParallelWrapper.fit_batched) == the
+    single-device scanned program, multi-pass included."""
+    n_steps, batch = 4, 16
+    xs = rng.randn(n_steps, batch, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (n_steps, batch))]
+
+    single = MultiLayerNetwork(_mlp_conf()).init()
+    s_scores = np.asarray(single.fit_batched(xs, ys, epochs=2))
+
+    sharded = MultiLayerNetwork(_mlp_conf()).init()
+    pw = ParallelWrapper(sharded, workers=8)
+    p_scores = np.asarray(pw.fit_batched(xs, ys, epochs=2))
+
+    np.testing.assert_allclose(p_scores, s_scores, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sharded.params_flat()),
+                               np.asarray(single.params_flat()),
+                               rtol=1e-4, atol=1e-5)
+    assert sharded.iteration_count == 2 * n_steps
+    with pytest.raises(ValueError):
+        pw.fit_batched(xs[:, :15], ys[:, :15])  # 15 % 8 != 0
+
+
+def test_parallel_fit_batched_computation_graph(devices8, rng):
+    """The sharded scanned path also serves the DAG runtime."""
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+
+    n_steps, batch = 3, 16
+    xs = rng.randn(n_steps, batch, 6).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (n_steps, batch))]
+
+    def make():
+        conf = (NeuralNetConfiguration(seed=9, updater="adam",
+                                       learning_rate=0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=6, n_out=10,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=10, n_out=2,
+                                              activation="softmax",
+                                              loss_function="mcxent"), "h")
+                .set_outputs("out")
+                .build())
+        return ComputationGraph(conf).init()
+
+    single = make()
+    s_scores = np.asarray(single.fit_batched(xs, ys, epochs=2))
+    sharded = make()
+    p_scores = np.asarray(
+        ParallelWrapper(sharded, workers=8).fit_batched(xs, ys, epochs=2))
+    np.testing.assert_allclose(p_scores, s_scores, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sharded.params_flat()),
+                               np.asarray(single.params_flat()),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_parallel_wrapper_iterator(devices8, rng):
     from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator)
     x, y = _data(rng, n=64)
