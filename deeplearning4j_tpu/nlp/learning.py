@@ -170,8 +170,7 @@ def cbow_neg_step(syn0: Array, syn1neg: Array, context_windows: Array,
     return syn0, syn1neg, loss
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def dm_neg_step(syn0: Array, doc_vecs: Array, syn1neg: Array,
+def dm_neg_impl(syn0: Array, doc_vecs: Array, syn1neg: Array,
                 doc_ids: Array, context_windows: Array, context_mask: Array,
                 targets: Array, negatives: Array, lr: Array
                 ) -> Tuple[Array, Array, Array, Array]:
@@ -196,8 +195,7 @@ def dm_neg_step(syn0: Array, doc_vecs: Array, syn1neg: Array,
     return syn0, doc_vecs, syn1neg, loss
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def dbow_neg_step(doc_vecs: Array, syn1neg: Array, doc_ids: Array,
+def dbow_neg_impl(doc_vecs: Array, syn1neg: Array, doc_ids: Array,
                   targets: Array, negatives: Array, lr: Array
                   ) -> Tuple[Array, Array, Array]:
     """PV-DBOW (reference: sequence/DBOW.java): the doc vector plays the
@@ -211,6 +209,42 @@ def dbow_neg_step(doc_vecs: Array, syn1neg: Array, doc_ids: Array,
     syn1neg = syn1neg.at[negatives.reshape(-1)].add(
         (-lr[:, None, None] * g_n).reshape(-1, g_n.shape[-1]))
     return doc_vecs, syn1neg, loss
+
+
+def _dbow_neg_scan_impl(doc_vecs, syn1neg, doc_ids, targets, negatives,
+                        lr):
+    """PV-DBOW epoch chunk as one scanned program (leading [N] batches
+    axis; same dispatch amortization as skipgram_neg_scan)."""
+    def body(carry, b):
+        dv, s1 = carry
+        d, t, n, l = b
+        dv, s1, loss = dbow_neg_impl(dv, s1, d, t, n, l)
+        return (dv, s1), loss
+
+    (doc_vecs, syn1neg), losses = jax.lax.scan(
+        body, (doc_vecs, syn1neg), (doc_ids, targets, negatives, lr))
+    return doc_vecs, syn1neg, losses
+
+
+dbow_neg_scan = jax.jit(_dbow_neg_scan_impl, donate_argnums=(0, 1))
+
+
+def _dm_neg_scan_impl(syn0, doc_vecs, syn1neg, doc_ids, windows, wmask,
+                      targets, negatives, lr):
+    """PV-DM epoch chunk as one scanned program."""
+    def body(carry, b):
+        s0, dv, s1 = carry
+        d, w, m, t, n, l = b
+        s0, dv, s1, loss = dm_neg_impl(s0, dv, s1, d, w, m, t, n, l)
+        return (s0, dv, s1), loss
+
+    (syn0, doc_vecs, syn1neg), losses = jax.lax.scan(
+        body, (syn0, doc_vecs, syn1neg),
+        (doc_ids, windows, wmask, targets, negatives, lr))
+    return syn0, doc_vecs, syn1neg, losses
+
+
+dm_neg_scan = jax.jit(_dm_neg_scan_impl, donate_argnums=(0, 1, 2))
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3))

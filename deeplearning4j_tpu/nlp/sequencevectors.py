@@ -174,6 +174,39 @@ class SequenceVectors(WordVectorsMixin):
     # size (the per-batch path's O(batch) memory, amortized dispatch)
     _SCAN_CHUNK = 1024
 
+    def _iter_scan_chunks(self, n_batches: int, n_items: int):
+        """Yield (sl, nb, nb_pad, n_valid) per chunk of up to _SCAN_CHUNK
+        batches. nb_pad buckets partial chunks to the next power of two
+        so per-epoch item-count jitter never recompiles the scan."""
+        b = self.batch_size
+        for start in range(0, n_batches, self._SCAN_CHUNK):
+            nb = min(self._SCAN_CHUNK, n_batches - start)
+            nb_pad = (nb if nb == self._SCAN_CHUNK
+                      else max(16, 1 << (nb - 1).bit_length()))
+            lo = start * b
+            n_valid = min(n_items - lo, nb * b)
+            yield slice(lo, lo + nb * b), nb, nb_pad, n_valid
+
+    def _stage_chunk(self, a: np.ndarray, sl: slice, nb_pad: int,
+                     n_valid: int) -> np.ndarray:
+        """Pad a chunk's rows with zeros and reshape to [nb_pad, B, ...]."""
+        b = self.batch_size
+        flat = np.concatenate(
+            [a[sl], np.zeros((nb_pad * b - n_valid,) + a.shape[1:],
+                             a.dtype)])
+        return flat.reshape((nb_pad, b) + a.shape[1:])
+
+    def _stage_negatives(self, nb: int, nb_pad: int) -> np.ndarray:
+        """Negatives drawn one batch at a time (stream-identical to the
+        per-batch path), zero-padded to the bucketed chunk size."""
+        negs = np.stack([self._sample_negatives(self.batch_size)
+                         for _ in range(nb)]).astype(np.int32)
+        if nb_pad > nb:
+            negs = np.concatenate(
+                [negs, np.zeros((nb_pad - nb, self.batch_size,
+                                 self.negative), np.int32)])
+        return negs
+
     def _fit_epoch_scanned(self, centers_a: np.ndarray,
                            contexts_a: np.ndarray, n_batches: int,
                            step_no: int, total_steps: int,
@@ -189,32 +222,17 @@ class SequenceVectors(WordVectorsMixin):
         per-batch path."""
         b = self.batch_size
         lt = self.lookup_table
-        for start in range(0, n_batches, self._SCAN_CHUNK):
-            nb = min(self._SCAN_CHUNK, n_batches - start)
-            nb_pad = (nb if nb == self._SCAN_CHUNK
-                      else max(16, 1 << (nb - 1).bit_length()))
-            lo = start * b
-            c = centers_a[lo:lo + nb * b]
-            x = contexts_a[lo:lo + nb * b]
-            n_valid = len(c)
-            pad = nb_pad * b - n_valid
-            centers_p = np.concatenate(
-                [c, np.zeros(pad, np.int32)]).reshape(nb_pad, b)
-            contexts_p = np.concatenate(
-                [x, np.zeros(pad, np.int32)]).reshape(nb_pad, b)
+        for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
+                n_batches, len(centers_a)):
+            centers_p = self._stage_chunk(centers_a, sl, nb_pad, n_valid)
+            contexts_p = self._stage_chunk(contexts_a, sl, nb_pad, n_valid)
             frac = np.minimum(1.0, (step_no + np.arange(nb_pad))
                               / max(total_steps, 1))
             lr_rows = np.maximum(self.min_learning_rate,
                                  alpha0 * (1.0 - frac)).astype(np.float32)
             lr_vec = np.repeat(lr_rows[:, None], b, axis=1)
-            if pad:
-                lr_vec.reshape(-1)[n_valid:] = 0.0
-            negs = np.stack([self._sample_negatives(b)
-                             for _ in range(nb)]).astype(np.int32)
-            if nb_pad > nb:
-                negs = np.concatenate(
-                    [negs, np.zeros((nb_pad - nb, b, self.negative),
-                                    np.int32)])
+            lr_vec.reshape(-1)[n_valid:] = 0.0
+            negs = self._stage_negatives(nb, nb_pad)
             lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_scan(
                 lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
                 jnp.asarray(contexts_p), jnp.asarray(negs),

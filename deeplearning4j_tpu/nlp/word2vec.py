@@ -158,64 +158,80 @@ class ParagraphVectors(Word2Vec):
             key, (n_docs, self.layer_size)) - 0.5) / self.layer_size
 
         lt = self.lookup_table
+        W = 2 * self.window
+        offs = np.concatenate([np.arange(-self.window, 0),
+                               np.arange(1, self.window + 1)])
         for epoch in range(self.epochs * self.iterations):
-            doc_ids: List[int] = []
-            targets: List[int] = []
-            windows: List[List[int]] = []
+            doc_l: List[np.ndarray] = []
+            tgt_l: List[np.ndarray] = []
+            win_l: List[np.ndarray] = []
+            msk_l: List[np.ndarray] = []
             for labels, toks in self._documents():
                 ids = self._encode(toks)
-                lids = [self.label_index[l] for l in labels]
                 n = len(ids)
-                for i in range(n):
-                    lo, hi = max(0, i - self.window), min(n, i + self.window
-                                                          + 1)
-                    ctx = [int(ids[j]) for j in range(lo, hi) if j != i]
-                    for lid in lids:
-                        doc_ids.append(lid)
-                        targets.append(int(ids[i]))
-                        windows.append(ctx)
-            if not targets:
+                if n == 0:
+                    continue
+                lids = [self.label_index[l] for l in labels]
+                # vectorized sliding windows: [n, 2w] context ids + mask
+                idx = np.arange(n)[:, None] + offs[None, :]
+                valid = (idx >= 0) & (idx < n)
+                win = np.where(valid, ids[np.clip(idx, 0, n - 1)], 0)
+                msk = valid.astype(np.float32)
+                for lid in lids:
+                    doc_l.append(np.full(n, lid, np.int32))
+                    tgt_l.append(ids)
+                    win_l.append(win)
+                    msk_l.append(msk)
+            if not tgt_l:
                 continue
-            W = 2 * self.window
-            n_ex = len(targets)
-            win_arr = np.zeros((n_ex, W), np.int32)
-            win_mask = np.zeros((n_ex, W), np.float32)
-            for r, ctx in enumerate(windows):
-                l = min(len(ctx), W)
-                win_arr[r, :l] = ctx[:l]
-                win_mask[r, :l] = 1.0
+            doc_a = np.concatenate(doc_l)
+            tgt_a = np.concatenate(tgt_l)
+            win_arr = np.concatenate(win_l).astype(np.int32, copy=False)
+            win_mask = np.concatenate(msk_l)
+            n_ex = len(tgt_a)
             order = self._rng.permutation(n_ex)
-            doc_a = np.asarray(doc_ids, np.int32)[order]
-            tgt_a = np.asarray(targets, np.int32)[order]
+            doc_a, tgt_a = doc_a[order], tgt_a[order]
             win_arr, win_mask = win_arr[order], win_mask[order]
             lr = self.learning_rate * (1.0 - epoch /
                                        max(self.epochs * self.iterations, 1))
             lr = max(lr, self.min_learning_rate)
-            for s in range(0, n_ex, self.batch_size):
-                nb = len(tgt_a[s:s + self.batch_size])
-                lr_vec = np.zeros(self.batch_size, np.float32)
-                lr_vec[:nb] = lr
-                negs = self._sample_negatives(nb)
-                if self.sequence_algorithm == "dbow":
-                    self.doc_vecs, lt.syn1neg, _ = learning.dbow_neg_step(
-                        self.doc_vecs, lt.syn1neg,
-                        jnp.asarray(self._pad(doc_a[s:s + self.batch_size])),
-                        jnp.asarray(self._pad(tgt_a[s:s + self.batch_size])),
-                        jnp.asarray(negs), jnp.asarray(lr_vec))
-                else:
-                    lt.syn0, self.doc_vecs, lt.syn1neg, _ = \
-                        learning.dm_neg_step(
-                            lt.syn0, self.doc_vecs, lt.syn1neg,
-                            jnp.asarray(self._pad(
-                                doc_a[s:s + self.batch_size])),
-                            jnp.asarray(self._pad_2d(
-                                win_arr[s:s + self.batch_size])),
-                            jnp.asarray(self._pad_2d(
-                                win_mask[s:s + self.batch_size])),
-                            jnp.asarray(self._pad(
-                                tgt_a[s:s + self.batch_size])),
-                            jnp.asarray(negs), jnp.asarray(lr_vec))
+            self._fit_pv_epoch_scanned(doc_a, tgt_a, win_arr, win_mask, lr)
         return self
+
+    def _fit_pv_epoch_scanned(self, doc_a, tgt_a, win_arr, win_mask,
+                              lr: float) -> None:
+        """One PV epoch as a few scanned programs, using the shared
+        chunk staging from SequenceVectors (_iter_scan_chunks /
+        _stage_chunk / _stage_negatives): padding rows carry lr=0, so
+        they are exact no-ops."""
+        lt = self.lookup_table
+        b = self.batch_size
+        n_ex = len(tgt_a)
+        n_batches = (n_ex + b - 1) // b
+        dbow = self.sequence_algorithm == "dbow"
+        for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
+                n_batches, n_ex):
+            def stage(a):
+                return self._stage_chunk(a, sl, nb_pad, n_valid)
+
+            lr_vec = np.full(nb_pad * b, lr, np.float32)
+            lr_vec[n_valid:] = 0.0
+            lr_vec = lr_vec.reshape(nb_pad, b)
+            negs = self._stage_negatives(nb, nb_pad)
+            if dbow:
+                self.doc_vecs, lt.syn1neg, _ = learning.dbow_neg_scan(
+                    self.doc_vecs, lt.syn1neg, jnp.asarray(stage(doc_a)),
+                    jnp.asarray(stage(tgt_a)), jnp.asarray(negs),
+                    jnp.asarray(lr_vec))
+            else:
+                lt.syn0, self.doc_vecs, lt.syn1neg, _ = \
+                    learning.dm_neg_scan(
+                        lt.syn0, self.doc_vecs, lt.syn1neg,
+                        jnp.asarray(stage(doc_a)),
+                        jnp.asarray(stage(win_arr)),
+                        jnp.asarray(stage(win_mask)),
+                        jnp.asarray(stage(tgt_a)), jnp.asarray(negs),
+                        jnp.asarray(lr_vec))
 
     def _pad_2d(self, arr: np.ndarray) -> np.ndarray:
         b = self.batch_size
