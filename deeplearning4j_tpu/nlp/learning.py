@@ -247,8 +247,7 @@ def _dm_neg_scan_impl(syn0, doc_vecs, syn1neg, doc_ids, windows, wmask,
 dm_neg_scan = jax.jit(_dm_neg_scan_impl, donate_argnums=(0, 1, 2))
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-def glove_step(w_main: Array, w_ctx: Array, b_main: Array, b_ctx: Array,
+def glove_impl(w_main: Array, w_ctx: Array, b_main: Array, b_ctx: Array,
                rows: Array, cols: Array, xij: Array, lr: Array,
                x_max: float = 100.0, alpha: float = 0.75
                ) -> Tuple[Array, Array, Array, Array, Array]:
@@ -268,6 +267,25 @@ def glove_step(w_main: Array, w_ctx: Array, b_main: Array, b_ctx: Array,
     b_main = b_main.at[rows].add(-lr * g)
     b_ctx = b_ctx.at[cols].add(-lr * g)
     return w_main, w_ctx, b_main, b_ctx, loss
+
+
+def _glove_scan_impl(w_main, w_ctx, b_main, b_ctx, rows, cols, xij, lr,
+                     x_max, alpha):
+    """GloVe epoch chunk as one scanned program (leading [N] batches
+    axis; padding rows carry lr=0 and xij=1 so log(xij)=0 — no-ops)."""
+    def body(carry, bt):
+        wm, wc, bm, bc = carry
+        r, c, x, l = bt
+        wm, wc, bm, bc, loss = glove_impl(wm, wc, bm, bc, r, c, x, l,
+                                          x_max, alpha)
+        return (wm, wc, bm, bc), loss
+
+    (w_main, w_ctx, b_main, b_ctx), losses = jax.lax.scan(
+        body, (w_main, w_ctx, b_main, b_ctx), (rows, cols, xij, lr))
+    return w_main, w_ctx, b_main, b_ctx, losses
+
+
+glove_scan = jax.jit(_glove_scan_impl, donate_argnums=(0, 1, 2, 3))
 
 
 @jax.jit

@@ -25,6 +25,29 @@ from deeplearning4j_tpu.nlp.word_vectors import WordVectorsMixin
 log = logging.getLogger(__name__)
 
 
+def iter_scan_chunks(batch_size: int, chunk: int, n_batches: int,
+                     n_items: int):
+    """Yield (sl, nb, nb_pad, n_valid) per chunk of up to ``chunk``
+    batches. nb_pad buckets partial chunks to the next power of two so
+    per-epoch item-count jitter never recompiles the scan program.
+    Shared by the skip-gram, ParagraphVectors, and GloVe scan paths."""
+    for start in range(0, n_batches, chunk):
+        nb = min(chunk, n_batches - start)
+        nb_pad = nb if nb == chunk else max(16, 1 << (nb - 1).bit_length())
+        lo = start * batch_size
+        n_valid = min(n_items - lo, nb * batch_size)
+        yield slice(lo, lo + nb * batch_size), nb, nb_pad, n_valid
+
+
+def stage_chunk(a: np.ndarray, sl: slice, nb_pad: int, n_valid: int,
+                batch_size: int, fill=0) -> np.ndarray:
+    """Pad a chunk's rows with ``fill`` and reshape to [nb_pad, B, ...]."""
+    flat = np.concatenate(
+        [a[sl], np.full((nb_pad * batch_size - n_valid,) + a.shape[1:],
+                        fill, a.dtype)])
+    return flat.reshape((nb_pad, batch_size) + a.shape[1:])
+
+
 class SequenceVectors(WordVectorsMixin):
     """Generic trainer over sequences of elements (words, graph-walk
     vertices, document labels...). Subclasses (Word2Vec, ParagraphVectors,
@@ -175,26 +198,12 @@ class SequenceVectors(WordVectorsMixin):
     _SCAN_CHUNK = 1024
 
     def _iter_scan_chunks(self, n_batches: int, n_items: int):
-        """Yield (sl, nb, nb_pad, n_valid) per chunk of up to _SCAN_CHUNK
-        batches. nb_pad buckets partial chunks to the next power of two
-        so per-epoch item-count jitter never recompiles the scan."""
-        b = self.batch_size
-        for start in range(0, n_batches, self._SCAN_CHUNK):
-            nb = min(self._SCAN_CHUNK, n_batches - start)
-            nb_pad = (nb if nb == self._SCAN_CHUNK
-                      else max(16, 1 << (nb - 1).bit_length()))
-            lo = start * b
-            n_valid = min(n_items - lo, nb * b)
-            yield slice(lo, lo + nb * b), nb, nb_pad, n_valid
+        return iter_scan_chunks(self.batch_size, self._SCAN_CHUNK,
+                                n_batches, n_items)
 
     def _stage_chunk(self, a: np.ndarray, sl: slice, nb_pad: int,
                      n_valid: int) -> np.ndarray:
-        """Pad a chunk's rows with zeros and reshape to [nb_pad, B, ...]."""
-        b = self.batch_size
-        flat = np.concatenate(
-            [a[sl], np.zeros((nb_pad * b - n_valid,) + a.shape[1:],
-                             a.dtype)])
-        return flat.reshape((nb_pad, b) + a.shape[1:])
+        return stage_chunk(a, sl, nb_pad, n_valid, self.batch_size)
 
     def _stage_negatives(self, nb: int, nb_pad: int) -> np.ndarray:
         """Negatives drawn one batch at a time (stream-identical to the

@@ -9,7 +9,6 @@ vectors, inferVector), models/glove/Glove.java + AbstractCoOccurrences
 """
 from __future__ import annotations
 
-from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -158,7 +157,6 @@ class ParagraphVectors(Word2Vec):
             key, (n_docs, self.layer_size)) - 0.5) / self.layer_size
 
         lt = self.lookup_table
-        W = 2 * self.window
         offs = np.concatenate([np.arange(-self.window, 0),
                                np.arange(1, self.window + 1)])
         for epoch in range(self.epochs * self.iterations):
@@ -233,13 +231,6 @@ class ParagraphVectors(Word2Vec):
                         jnp.asarray(stage(tgt_a)), jnp.asarray(negs),
                         jnp.asarray(lr_vec))
 
-    def _pad_2d(self, arr: np.ndarray) -> np.ndarray:
-        b = self.batch_size
-        if len(arr) == b:
-            return arr
-        pad = np.zeros((b - len(arr),) + arr.shape[1:], arr.dtype)
-        return np.concatenate([arr, pad])
-
     # -- queries -----------------------------------------------------------
     def doc_vector(self, label: str) -> Optional[np.ndarray]:
         idx = self.label_index.get(label)
@@ -286,8 +277,9 @@ class ParagraphVectors(Word2Vec):
 
 class Glove(WordVectorsMixin):
     """GloVe embeddings (reference: models/glove/Glove.java:
-    AbstractCoOccurrences counting + per-pair AdaGrad; here co-occurrence
-    counting host-side + batched jitted glove_step)."""
+    AbstractCoOccurrences counting + per-pair AdaGrad; here vectorized
+    host-side co-occurrence counting + scanned glove epochs
+    (learning.glove_scan))."""
 
     def __init__(self, *, sentences: Optional[Iterable[str]] = None,
                  sentence_iterator: Optional[SentenceIterator] = None,
@@ -328,45 +320,81 @@ class Glove(WordVectorsMixin):
             min_word_frequency=self.min_word_frequency,
             build_huffman=False).build_vocab(self._sequences())
         # co-occurrence counts (reference: AbstractCoOccurrences — weighted
-        # by 1/distance)
-        cooc: Dict = defaultdict(float)
-        for toks in self._sequences():
-            ids = [self.vocab.index_of(t) for t in toks]
-            ids = [i for i in ids if i >= 0]
-            for i, wi in enumerate(ids):
-                for j in range(max(0, i - self.window), i):
-                    # symmetric window, weighted by 1/distance (GloVe paper;
-                    # reference: AbstractCoOccurrences weighting)
-                    cooc[(wi, ids[j])] += 1.0 / (i - j)
-                    cooc[(ids[j], wi)] += 1.0 / (i - j)
-        if not cooc:
-            raise ValueError("empty co-occurrence matrix")
-        rows = np.array([k[0] for k in cooc], np.int32)
-        cols = np.array([k[1] for k in cooc], np.int32)
-        vals = np.array(list(cooc.values()), np.float32)
+        # by 1/distance), vectorized: per distance d the co-occurring
+        # pairs are (ids[d:], ids[:-d]) both ways with weight 1/d;
+        # aggregation by packed (row, col) key instead of a Python dict
+        V = self.vocab.num_words()
+        agg_keys = np.empty(0, np.int64)
+        agg_vals = np.empty(0, np.float64)
+        r_l: List[np.ndarray] = []
+        c_l: List[np.ndarray] = []
+        w_l: List[np.ndarray] = []
+        raw = 0
+        FLUSH = 4_000_000   # raw pairs per aggregation block: host memory
+        # stays O(FLUSH + unique pairs), not O(corpus * window)
 
-        V, D = self.vocab.num_words(), self.layer_size
+        def merge():
+            nonlocal agg_keys, agg_vals, r_l, c_l, w_l, raw
+            if not r_l:
+                return
+            keys = np.concatenate([agg_keys,
+                                   np.concatenate(r_l) * V
+                                   + np.concatenate(c_l)])
+            wts = np.concatenate([agg_vals, np.concatenate(w_l)])
+            agg_keys, inv = np.unique(keys, return_inverse=True)
+            agg_vals = np.bincount(inv, weights=wts)
+            r_l, c_l, w_l, raw = [], [], [], 0
+
+        for toks in self._sequences():
+            ids = np.asarray([self.vocab.index_of(t) for t in toks],
+                             np.int64)
+            ids = ids[ids >= 0]
+            n = len(ids)
+            for d in range(1, min(self.window, n - 1) + 1):
+                a, b = ids[d:], ids[:-d]
+                w = np.full(n - d, 1.0 / d, np.float64)
+                r_l += [a, b]
+                c_l += [b, a]
+                w_l += [w, w]
+                raw += 2 * (n - d)
+            if raw >= FLUSH:
+                merge()
+        merge()
+        if len(agg_keys) == 0:
+            raise ValueError("empty co-occurrence matrix")
+        vals = agg_vals.astype(np.float32)
+        rows = (agg_keys // V).astype(np.int32)
+        cols = (agg_keys % V).astype(np.int32)
+
+        D = self.layer_size
         key = jax.random.PRNGKey(self.seed)
         k1, k2 = jax.random.split(key)
         w_main = (jax.random.uniform(k1, (V, D)) - 0.5) / D
         w_ctx = (jax.random.uniform(k2, (V, D)) - 0.5) / D
         b_main = jnp.zeros(V)
         b_ctx = jnp.zeros(V)
+        from deeplearning4j_tpu.nlp.sequencevectors import (iter_scan_chunks,
+                                                            stage_chunk)
         n = len(rows)
+        bs = self.batch_size
+        n_batches = (n + bs - 1) // bs
         for _ in range(self.epochs):
             order = self._rng.permutation(n)
-            for s in range(0, n, self.batch_size):
-                sl = order[s:s + self.batch_size]
-                nb = len(sl)
-                pad = self.batch_size - nb
-                r = np.concatenate([rows[sl], np.zeros(pad, np.int32)])
-                c = np.concatenate([cols[sl], np.zeros(pad, np.int32)])
-                x = np.concatenate([vals[sl], np.ones(pad, np.float32)])
-                lr_vec = np.zeros(self.batch_size, np.float32)
-                lr_vec[:nb] = self.learning_rate
-                w_main, w_ctx, b_main, b_ctx, _ = learning.glove_step(
-                    w_main, w_ctx, b_main, b_ctx, jnp.asarray(r),
-                    jnp.asarray(c), jnp.asarray(x), jnp.asarray(lr_vec),
+            r_a, c_a, v_a = rows[order], cols[order], vals[order]
+            # chunks of scanned batches (shared staging helpers): padding
+            # rows carry lr=0 AND xij=1 (log 1 = 0), exact no-ops
+            for sl, nb, nb_pad, n_valid in iter_scan_chunks(
+                    bs, 1024, n_batches, n):
+                lr_vec = np.full(nb_pad * bs, self.learning_rate,
+                                 np.float32)
+                lr_vec[n_valid:] = 0.0
+                w_main, w_ctx, b_main, b_ctx, _ = learning.glove_scan(
+                    w_main, w_ctx, b_main, b_ctx,
+                    jnp.asarray(stage_chunk(r_a, sl, nb_pad, n_valid, bs)),
+                    jnp.asarray(stage_chunk(c_a, sl, nb_pad, n_valid, bs)),
+                    jnp.asarray(stage_chunk(v_a, sl, nb_pad, n_valid, bs,
+                                            fill=1.0)),
+                    jnp.asarray(lr_vec.reshape(nb_pad, bs)),
                     self.x_max, self.alpha)
         # final embedding = w_main + w_ctx (GloVe paper convention)
         lt = InMemoryLookupTable(self.vocab, D, seed=self.seed,
