@@ -212,10 +212,16 @@ def init_cache(cfg: TransformerConfig, batch: int,
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
-def _block_decode(h: Array, p: Dict[str, Array], ck: Array, cv: Array,
-                  pos: Array, cfg: TransformerConfig
-                  ) -> Tuple[Array, Array, Array]:
-    """One block, one new position: h [B, 1, D]; cache [B, S, H, Dh]."""
+def _block_decode(h: Array, p: Dict[str, Array], ck_all: Array,
+                  cv_all: Array, layer: int, pos: Array,
+                  cfg: TransformerConfig) -> Tuple[Array, Array, Array]:
+    """One block, one new position: h [B, 1, D]; stacked caches
+    [L, B, S, H, Dh]. The new K/V row is written in place at
+    (layer, :, pos) — a [1, B, 1, H, Dh] update, NOT a rewrite of the
+    layer's cache (the carry through the sampling scan aliases the
+    buffer, so per-step HBM write traffic is one position per layer;
+    restacking whole caches through a layer scan was the decode
+    bandwidth bottleneck)."""
     d = cfg.d_model
     x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
 
@@ -226,13 +232,16 @@ def _block_decode(h: Array, p: Dict[str, Array], ck: Array, cv: Array,
     k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
     v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
     z = jnp.asarray(0, pos.dtype)
-    ck = jax.lax.dynamic_update_slice(ck, k, (z, pos, z, z))
-    cv = jax.lax.dynamic_update_slice(cv, v, (z, pos, z, z))
+    lz = jnp.asarray(layer, pos.dtype)
+    ck_all = jax.lax.dynamic_update_slice(
+        ck_all, k[None].astype(ck_all.dtype), (lz, z, pos, z, z))
+    cv_all = jax.lax.dynamic_update_slice(
+        cv_all, v[None].astype(cv_all.dtype), (lz, z, pos, z, z))
     # the single query attends the filled cache prefix through the shared
     # attention core (causal with global q position = pos; the traced
     # offset takes the jnp path, same masking semantics as training)
-    a = dot_product_attention(q, ck, cv, causal=True, q_offset=pos,
-                              kv_offset=0)
+    a = dot_product_attention(q, ck_all[layer], cv_all[layer], causal=True,
+                              q_offset=pos, kv_offset=0)
     h = h + jnp.matmul(a.reshape(a.shape[0], 1, d),
                        p["Wo"].astype(h.dtype))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -240,13 +249,12 @@ def _block_decode(h: Array, p: Dict[str, Array], ck: Array, cv: Array,
         h = h + moe_mlp(x, p, cfg)
     else:
         h = h + dense_mlp(x, p)
-    return h, ck, cv
+    return h, ck_all, cv_all
 
 
-def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
-                token: Array, caches: Tuple[Array, Array], pos: Array
-                ) -> Tuple[Array, Tuple[Array, Array]]:
-    """token [B] int32 at position ``pos`` -> (logits [B, V], caches)."""
+def _decode_step_impl(cfg: TransformerConfig, params: Dict[str, Any],
+                      token: Array, caches: Tuple[Array, Array],
+                      pos: Array) -> Tuple[Array, Tuple[Array, Array]]:
     dt = cfg.activation_dtype()
     # embed + positional row at pos
     emb = params["embed"].astype(dt)[token]                      # [B, D]
@@ -254,17 +262,34 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
                                         axis=0).astype(dt)       # [1, D]
     h = (emb + posv)[:, None, :]                                 # [B, 1, D]
     ck_all, cv_all = caches
-
-    def body(h, xs):
-        p, ck, cv = xs
-        h, ck, cv = _block_decode(h, p, ck, cv, pos, cfg)
-        return h, (ck, cv)
-
-    h, (ck_all, cv_all) = lax.scan(body, h,
-                                   (params["blocks"], ck_all, cv_all))
+    for layer in range(cfg.n_layers):
+        p_l = {k: v[layer] for k, v in params["blocks"].items()}
+        h, ck_all, cv_all = _block_decode(h, p_l, ck_all, cv_all, layer,
+                                          pos, cfg)
     h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
     logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
     return logits, (ck_all, cv_all)
+
+
+@_ft.lru_cache(maxsize=64)
+def _decode_step_jit(cfg: TransformerConfig):
+    return jax.jit(_ft.partial(_decode_step_impl, cfg),
+                   donate_argnums=(2,))
+
+
+def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
+                token: Array, caches: Tuple[Array, Array], pos: Array
+                ) -> Tuple[Array, Tuple[Array, Array]]:
+    """token [B] int32 at position ``pos`` -> (logits [B, V], caches).
+
+    The layer loop is unrolled (static layer indices) so cache updates
+    stay single-position dynamic_update_slices on the stacked buffers —
+    and the step runs JITTED with the caches donated, so eager callers
+    (the rnnTimeStep-style streaming loop) get in-place cache updates
+    rather than 2L whole-cache copies. Pass the returned caches to the
+    next call; the previous caches' buffer is reused."""
+    return _decode_step_jit(cfg)(params, jnp.asarray(token),
+                                 caches, jnp.asarray(pos, jnp.int32))
 
 
 def prefill(cfg: TransformerConfig, params: Dict[str, Any],
@@ -308,8 +333,8 @@ def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
                 tok = jax.random.categorical(
                     k, logits.astype(jnp.float32) / temperature, axis=-1
                 ).astype(jnp.int32)
-            new_logits, caches = decode_step(cfg, params, tok, caches,
-                                             pos)
+            new_logits, caches = _decode_step_impl(cfg, params, tok,
+                                                   caches, pos)
             return (caches, pos + 1, new_logits), tok
 
         keys = jax.random.split(key, max_new_tokens)

@@ -24,6 +24,10 @@ from deeplearning4j_tpu.nlp.word_vectors import WordVectorsMixin
 
 log = logging.getLogger(__name__)
 
+# max batches per scanned program — bounds staging memory for all the
+# embedding scan paths (skip-gram, ParagraphVectors, GloVe)
+SCAN_CHUNK = 1024
+
 
 def iter_scan_chunks(batch_size: int, chunk: int, n_batches: int,
                      n_items: int):
@@ -195,7 +199,7 @@ class SequenceVectors(WordVectorsMixin):
     # max batches per scanned program: bounds device/host staging memory
     # at CHUNK * batch_size * (2 + negative) int32 regardless of corpus
     # size (the per-batch path's O(batch) memory, amortized dispatch)
-    _SCAN_CHUNK = 1024
+    _SCAN_CHUNK = SCAN_CHUNK
 
     def _iter_scan_chunks(self, n_batches: int, n_items: int):
         return iter_scan_chunks(self.batch_size, self._SCAN_CHUNK,

@@ -19,7 +19,10 @@ from deeplearning4j_tpu.nlp import learning
 from deeplearning4j_tpu.nlp.sentenceiterator import (SentenceIterator,
                                                      CollectionSentenceIterator,
                                                      LabelAwareIterator)
-from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.sequencevectors import (SCAN_CHUNK,
+                                                    SequenceVectors,
+                                                    iter_scan_chunks,
+                                                    stage_chunk)
 from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
                                                  TokenizerFactory)
 from deeplearning4j_tpu.nlp.vocab import VocabWord
@@ -373,8 +376,6 @@ class Glove(WordVectorsMixin):
         w_ctx = (jax.random.uniform(k2, (V, D)) - 0.5) / D
         b_main = jnp.zeros(V)
         b_ctx = jnp.zeros(V)
-        from deeplearning4j_tpu.nlp.sequencevectors import (iter_scan_chunks,
-                                                            stage_chunk)
         n = len(rows)
         bs = self.batch_size
         n_batches = (n + bs - 1) // bs
@@ -384,7 +385,7 @@ class Glove(WordVectorsMixin):
             # chunks of scanned batches (shared staging helpers): padding
             # rows carry lr=0 AND xij=1 (log 1 = 0), exact no-ops
             for sl, nb, nb_pad, n_valid in iter_scan_chunks(
-                    bs, 1024, n_batches, n):
+                    bs, SCAN_CHUNK, n_batches, n):
                 lr_vec = np.full(nb_pad * bs, self.learning_rate,
                                  np.float32)
                 lr_vec[n_valid:] = 0.0
