@@ -77,27 +77,27 @@ def skipgram_neg_impl(syn0: Array, syn1neg: Array, centers: Array,
 skipgram_neg_step = jax.jit(skipgram_neg_impl, donate_argnums=(0, 1))
 
 
-def _skipgram_neg_scan_impl(syn0: Array, syn1neg: Array, centers: Array,
-                            contexts: Array, negatives: Array, lr: Array
-                            ) -> Tuple[Array, Array, Array]:
-    """Whole-epoch skip-gram: `lax.scan` of skipgram_neg_impl over a
-    leading [N] batches axis — the per-batch loop stays on device, the
-    same dispatch-amortization move as MultiLayerNetwork.fit_batched.
+def _epoch_scan(impl, n_carry: int):
+    """Build the scanned whole-epoch form of a batched update kernel:
+    the first ``n_carry`` arguments are the embedding tables (scan
+    carry, donated — they stay in HBM across batches), the rest are
+    stacked per-batch operands with a leading [N] axis. The per-batch
+    loop stays on device — the same dispatch-amortization move as
+    MultiLayerNetwork.fit_batched. Returns (*tables, losses [N])."""
+    def scan_impl(*args):
+        carry, xs = args[:n_carry], args[n_carry:]
 
-    centers/contexts: [N, B]; negatives: [N, B, K]; lr: [N, B].
-    Returns (syn0, syn1neg, losses [N])."""
-    def body(carry, batch):
-        s0, s1, = carry
-        c, x, neg, l = batch
-        s0, s1, loss = skipgram_neg_impl(s0, s1, c, x, neg, l)
-        return (s0, s1), loss
+        def body(c, b):
+            out = impl(*c, *b)
+            return tuple(out[:-1]), out[-1]
 
-    (syn0, syn1neg), losses = jax.lax.scan(
-        body, (syn0, syn1neg), (centers, contexts, negatives, lr))
-    return syn0, syn1neg, losses
+        carry, losses = jax.lax.scan(body, tuple(carry), tuple(xs))
+        return (*carry, losses)
+
+    return jax.jit(scan_impl, donate_argnums=tuple(range(n_carry)))
 
 
-skipgram_neg_scan = jax.jit(_skipgram_neg_scan_impl, donate_argnums=(0, 1))
+skipgram_neg_scan = _epoch_scan(skipgram_neg_impl, 2)
 
 
 def make_sharded_skipgram_step(mesh):
@@ -117,8 +117,7 @@ def make_sharded_skipgram_step(mesh):
                    donate_argnums=(0, 1))
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def skipgram_hs_step(syn0: Array, syn1: Array, centers: Array,
+def skipgram_hs_impl(syn0: Array, syn1: Array, centers: Array,
                      points: Array, codes: Array, code_mask: Array,
                      lr: Array) -> Tuple[Array, Array, Array]:
     """Hierarchical-softmax skip-gram update (reference: SkipGram.java
@@ -143,8 +142,11 @@ def skipgram_hs_step(syn0: Array, syn1: Array, centers: Array,
     return syn0, syn1, loss
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def cbow_neg_step(syn0: Array, syn1neg: Array, context_windows: Array,
+skipgram_hs_step = jax.jit(skipgram_hs_impl, donate_argnums=(0, 1))
+skipgram_hs_scan = _epoch_scan(skipgram_hs_impl, 2)
+
+
+def cbow_neg_impl(syn0: Array, syn1neg: Array, context_windows: Array,
                   context_mask: Array, targets: Array, negatives: Array,
                   lr: Array) -> Tuple[Array, Array, Array]:
     """CBOW with negative sampling (reference: elements/CBOW.java):
@@ -168,6 +170,10 @@ def cbow_neg_step(syn0: Array, syn1neg: Array, context_windows: Array,
     syn1neg = syn1neg.at[negatives.reshape(-1)].add(
         (-lr[:, None, None] * g_n).reshape(-1, g_n.shape[-1]))
     return syn0, syn1neg, loss
+
+
+cbow_neg_step = jax.jit(cbow_neg_impl, donate_argnums=(0, 1))
+cbow_neg_scan = _epoch_scan(cbow_neg_impl, 2)
 
 
 def dm_neg_impl(syn0: Array, doc_vecs: Array, syn1neg: Array,
@@ -211,40 +217,8 @@ def dbow_neg_impl(doc_vecs: Array, syn1neg: Array, doc_ids: Array,
     return doc_vecs, syn1neg, loss
 
 
-def _dbow_neg_scan_impl(doc_vecs, syn1neg, doc_ids, targets, negatives,
-                        lr):
-    """PV-DBOW epoch chunk as one scanned program (leading [N] batches
-    axis; same dispatch amortization as skipgram_neg_scan)."""
-    def body(carry, b):
-        dv, s1 = carry
-        d, t, n, l = b
-        dv, s1, loss = dbow_neg_impl(dv, s1, d, t, n, l)
-        return (dv, s1), loss
-
-    (doc_vecs, syn1neg), losses = jax.lax.scan(
-        body, (doc_vecs, syn1neg), (doc_ids, targets, negatives, lr))
-    return doc_vecs, syn1neg, losses
-
-
-dbow_neg_scan = jax.jit(_dbow_neg_scan_impl, donate_argnums=(0, 1))
-
-
-def _dm_neg_scan_impl(syn0, doc_vecs, syn1neg, doc_ids, windows, wmask,
-                      targets, negatives, lr):
-    """PV-DM epoch chunk as one scanned program."""
-    def body(carry, b):
-        s0, dv, s1 = carry
-        d, w, m, t, n, l = b
-        s0, dv, s1, loss = dm_neg_impl(s0, dv, s1, d, w, m, t, n, l)
-        return (s0, dv, s1), loss
-
-    (syn0, doc_vecs, syn1neg), losses = jax.lax.scan(
-        body, (syn0, doc_vecs, syn1neg),
-        (doc_ids, windows, wmask, targets, negatives, lr))
-    return syn0, doc_vecs, syn1neg, losses
-
-
-dm_neg_scan = jax.jit(_dm_neg_scan_impl, donate_argnums=(0, 1, 2))
+dbow_neg_scan = _epoch_scan(dbow_neg_impl, 2)
+dm_neg_scan = _epoch_scan(dm_neg_impl, 3)
 
 
 def glove_impl(w_main: Array, w_ctx: Array, b_main: Array, b_ctx: Array,

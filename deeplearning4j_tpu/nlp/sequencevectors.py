@@ -177,9 +177,12 @@ class SequenceVectors(WordVectorsMixin):
             alpha0 = self.learning_rate
             n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
             total_steps = total_epochs * n_batches
-            if (self.scan_epochs and self.algorithm == "skipgram"
-                    and not self.use_hs and self.negative > 0
-                    and self.mesh is None):
+            scannable = (
+                self.scan_epochs and self.mesh is None
+                and ((self.algorithm == "skipgram"
+                      and (self.use_hs or self.negative > 0))
+                     or (self.algorithm == "cbow" and self.negative > 0)))
+            if scannable:
                 # whole-epoch scanned program (one dispatch per epoch)
                 step_no = self._fit_epoch_scanned(
                     centers_a, contexts_a, n_batches, step_no,
@@ -224,17 +227,23 @@ class SequenceVectors(WordVectorsMixin):
                            contexts_a: np.ndarray, n_batches: int,
                            step_no: int, total_steps: int,
                            alpha0: float) -> int:
-        """Run one epoch of skip-gram/negative-sampling as a few big XLA
-        programs: the pair stream is staged in chunks of up to
-        _SCAN_CHUNK batches [N, B] and each chunk scans the batched
-        update on device (learning.skipgram_neg_scan). Padding rows
-        carry lr=0, so they are exact no-ops; partial chunks bucket N to
-        the next power of two so epoch-to-epoch pair-count jitter (the
-        reduced-window RNG) never recompiles. RNG draws happen one batch
-        at a time in stream order, so results are bit-identical to the
-        per-batch path."""
+        """Run one epoch of skip-gram (negative-sampling OR hierarchical
+        softmax) or CBOW/neg as a few big XLA programs: the pair stream
+        is staged in chunks of up to _SCAN_CHUNK batches [N, B] and each
+        chunk scans the batched update on device (learning.*_scan).
+        Padding rows carry lr=0, so they are exact no-ops; partial
+        chunks bucket N to the next power of two so epoch-to-epoch
+        pair-count jitter (the reduced-window RNG) never recompiles.
+        RNG draws happen one batch at a time in stream order, so results
+        are bit-identical to the per-batch path."""
         b = self.batch_size
         lt = self.lookup_table
+        cbow = self.algorithm == "cbow"
+        if not cbow and self.use_hs:
+            # hoisted once per epoch: full Huffman tables to host
+            pts_t = np.asarray(lt.points)
+            codes_t = np.asarray(lt.codes)
+            cmask_t = np.asarray(lt.code_mask)
         for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
                 n_batches, len(centers_a)):
             centers_p = self._stage_chunk(centers_a, sl, nb_pad, n_valid)
@@ -245,11 +254,34 @@ class SequenceVectors(WordVectorsMixin):
                                  alpha0 * (1.0 - frac)).astype(np.float32)
             lr_vec = np.repeat(lr_rows[:, None], b, axis=1)
             lr_vec.reshape(-1)[n_valid:] = 0.0
-            negs = self._stage_negatives(nb, nb_pad)
-            lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_scan(
-                lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
-                jnp.asarray(contexts_p), jnp.asarray(negs),
-                jnp.asarray(lr_vec))
+            if cbow:
+                # single-word context per pair (mirrors the per-batch
+                # path: pair expansion handles window aggregation)
+                windows = contexts_p[..., None]
+                wmask = np.zeros(windows.shape, np.float32)
+                wmask.reshape(-1)[:n_valid] = 1.0
+                negs = self._stage_negatives(nb, nb_pad)
+                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_scan(
+                    lt.syn0, lt.syn1neg, jnp.asarray(windows),
+                    jnp.asarray(wmask), jnp.asarray(centers_p),
+                    jnp.asarray(negs), jnp.asarray(lr_vec))
+            elif self.use_hs:
+                # hierarchical softmax: the CONTEXT word's Huffman
+                # path/codes, the center's syn0 row (reference SkipGram
+                # HS semantics)
+                pts = pts_t[contexts_p]
+                codes = codes_t[contexts_p]
+                cmask = cmask_t[contexts_p]
+                lt.syn0, lt.syn1, _ = learning.skipgram_hs_scan(
+                    lt.syn0, lt.syn1, jnp.asarray(centers_p),
+                    jnp.asarray(pts), jnp.asarray(codes),
+                    jnp.asarray(cmask), jnp.asarray(lr_vec))
+            else:
+                negs = self._stage_negatives(nb, nb_pad)
+                lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_scan(
+                    lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
+                    jnp.asarray(contexts_p), jnp.asarray(negs),
+                    jnp.asarray(lr_vec))
             step_no += nb
         return step_no
 
@@ -289,7 +321,6 @@ class SequenceVectors(WordVectorsMixin):
                 jnp.asarray(lr_vec))
             return
         if self.use_hs:
-            points = np.asarray(lt.points)[centers_p]
             codes = np.asarray(lt.codes)[contexts_p]
             cmask = np.asarray(lt.code_mask)[contexts_p]
             # hierarchical softmax: predict context's Huffman path from

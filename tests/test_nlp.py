@@ -239,14 +239,22 @@ def test_vectorizers_and_inverted_index():
     assert t[0, cat_idx] > t[0, sat_idx]
 
 
-def test_scanned_word2vec_matches_per_batch():
-    """The whole-epoch scanned skip-gram program (_fit_epoch_scanned)
-    must reproduce the per-batch dispatch path exactly — same RNG
-    stream, same lr schedule, lr=0 padding no-ops (the proof obligation
-    every scanned path in the repo carries, cf. fit_batched tests)."""
+@pytest.mark.parametrize("mode", ["sg-neg", "sg-hs", "cbow-neg"])
+def test_scanned_word2vec_matches_per_batch(mode):
+    """The whole-epoch scanned programs (_fit_epoch_scanned) must
+    reproduce the per-batch dispatch path exactly for every algorithm
+    mode — same RNG stream, same lr schedule, lr=0 padding no-ops (the
+    proof obligation every scanned path in the repo carries, cf.
+    fit_batched tests)."""
     kw = dict(sentences=_toy_corpus(10), layer_size=16, window=3,
-              negative=3, epochs=2, seed=13, min_word_frequency=2,
+              epochs=2, seed=13, min_word_frequency=2,
               batch_size=64, learning_rate=0.05)
+    if mode == "sg-neg":
+        kw.update(negative=3)
+    elif mode == "sg-hs":
+        kw.update(negative=0, use_hierarchic_softmax=True)
+    else:
+        kw.update(negative=3, elements_learning_algorithm="cbow")
     scanned = Word2Vec(**kw)
     scanned.fit()
     stepped = Word2Vec(scan_epochs=False, **kw)
