@@ -216,28 +216,19 @@ class MultiLayerNetwork:
         return jax.jit(self._step_math(), donate_argnums=(0, 1, 2),
                        **jit_kwargs)
 
-    def _make_scan_fit(self, epochs: int = 1, **jit_kwargs):
-        """Whole-epoch program: `lax.scan` of the minibatch step over a
-        leading batches axis — the per-step loop stays ON DEVICE, so no
-        host dispatch between steps (the SURVEY §3.1 design consequence:
-        the reference's eager per-op/per-step JNI round-trips collapse
-        into one XLA program; this is the multi-STEP version of that).
-        ``epochs`` > 1 nests that scan in an outer pass-counting scan:
-        the staged pool is traversed `epochs` times inside the SAME
-        program, so HBM holds one pool but the program spans the whole
-        run (the iteration counter — and with it the dropout key and LR
-        schedule position — keeps advancing across passes)."""
-        step = self._step_math()
-
+    def _make_epoch_program(self, mb_body_factory, epochs: int,
+                            **jit_kwargs):
+        """Shared scaffolding for the scanned training programs: an
+        inner `lax.scan` walks the minibatch pool with the body built by
+        ``mb_body_factory(xs, ys, base_key)``, and ``epochs`` > 1 nests
+        that in an outer pass-counting scan — the staged pool is
+        traversed `epochs` times inside the SAME program, so HBM holds
+        one pool but the program spans the whole run (the iteration
+        counter — and with it the dropout key and LR schedule position —
+        keeps advancing across passes)."""
         def epoch(params, state, opt_state, start_iteration, xs, ys,
                   base_key):
-            def body(carry, xy):
-                params, state, opt, it = carry
-                x, y = xy
-                key = jax.random.fold_in(base_key, it)
-                params, state, opt, score = step(
-                    params, state, opt, it, x, y, key, None)
-                return (params, state, opt, it + 1), score
+            body = mb_body_factory(xs, ys, base_key)
 
             def one_pass(carry, _):
                 return jax.lax.scan(body, carry, (xs, ys))
@@ -248,11 +239,31 @@ class MultiLayerNetwork:
             else:
                 carry, scores = jax.lax.scan(one_pass, carry, None,
                                              length=epochs)
-                scores = scores.reshape(-1)
             params, state, opt_state, _ = carry
-            return params, state, opt_state, scores
+            return params, state, opt_state, scores.reshape(-1)
 
         return jax.jit(epoch, donate_argnums=(0, 1, 2), **jit_kwargs)
+
+    def _make_scan_fit(self, epochs: int = 1, **jit_kwargs):
+        """Whole-epoch program: `lax.scan` of the minibatch step over a
+        leading batches axis — the per-step loop stays ON DEVICE, so no
+        host dispatch between steps (the SURVEY §3.1 design consequence:
+        the reference's eager per-op/per-step JNI round-trips collapse
+        into one XLA program; this is the multi-STEP version of that)."""
+        step = self._step_math()
+
+        def factory(xs, ys, base_key):
+            def body(carry, xy):
+                params, state, opt, it = carry
+                x, y = xy
+                key = jax.random.fold_in(base_key, it)
+                params, state, opt, score = step(
+                    params, state, opt, it, x, y, key, None)
+                return (params, state, opt, it + 1), score
+
+            return body
+
+        return self._make_epoch_program(factory, epochs, **jit_kwargs)
 
     def fit_batched(self, xs, ys, epochs: int = 1) -> "jnp.ndarray":
         """Train on a pre-staged stack of minibatches in ONE compiled
@@ -261,17 +272,40 @@ class MultiLayerNetwork:
         on (or streamable to) the device; `fit(iterator)` remains the
         host-streaming path. ``epochs`` repeats the staged pool inside
         the same program. Listeners fire after the program returns
-        (scores come back as one array)."""
-        self._validate_fit_batched(epochs)
+        (scores come back as one array).
+
+        With backprop_type='tbptt', ``xs``/``ys`` are [N, B, T, F] with
+        T divisible by tbptt_fwd_length; each minibatch scans its time
+        chunks with carried RNN state and one update per chunk, so
+        scores (and iteration counts) are per CHUNK: [N * T/L * epochs]."""
+        self._validate_fit_batched(epochs, allow_tbptt=True)
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
-        fn = self._jit_cache.get(("scanfit", epochs))
+        if self.conf.backprop_type == "tbptt":
+            L = self.conf.tbptt_fwd_length
+            if xs.ndim != 4:
+                raise ValueError("tbptt fit_batched needs [N, B, T, F] "
+                                 f"inputs, got ndim={xs.ndim}")
+            if xs.shape[2] % L:
+                raise ValueError(
+                    f"tbptt fit_batched needs T ({xs.shape[2]}) divisible "
+                    f"by tbptt_fwd_length ({L}); use fit() for ragged "
+                    "tails")
+            cache_key = ("scanfit-tbptt", epochs)
+            maker = self._make_scan_fit_tbptt
+        else:
+            cache_key = ("scanfit", epochs)
+            maker = self._make_scan_fit
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = self._make_scan_fit(epochs)
-            self._jit_cache[("scanfit", epochs)] = fn
-        return self._run_scan_fit(fn, xs, ys)
+            fn = maker(epochs)
+            self._jit_cache[cache_key] = fn
+        chunks = (xs.shape[2] // self.conf.tbptt_fwd_length
+                  if self.conf.backprop_type == "tbptt" else 1)
+        return self._run_scan_fit(fn, xs, ys, chunks_per_batch=chunks)
 
-    def _validate_fit_batched(self, epochs: int) -> None:
+    def _validate_fit_batched(self, epochs: int,
+                              allow_tbptt: bool = False) -> None:
         if not self._initialized:
             self.init()
         tc = self.conf.training
@@ -281,9 +315,10 @@ class MultiLayerNetwork:
                 "fit_batched supports first-order optimization only; "
                 f"optimization_algo={tc.optimization_algo!r} dispatches "
                 "to the Solver path — use fit() instead")
-        if self.conf.backprop_type == "tbptt":
-            raise ValueError("fit_batched does not implement truncated "
-                             "BPTT; use fit() for backprop_type='tbptt'")
+        if self.conf.backprop_type == "tbptt" and not allow_tbptt:
+            raise ValueError("this scanned path does not implement "
+                             "truncated BPTT; use fit() or "
+                             "MultiLayerNetwork.fit_batched")
         if max(1, tc.num_iterations) != 1:
             raise ValueError(
                 "fit_batched applies one update per minibatch; "
@@ -291,7 +326,8 @@ class MultiLayerNetwork:
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
 
-    def _run_scan_fit(self, fn, xs, ys) -> "jnp.ndarray":
+    def _run_scan_fit(self, fn, xs, ys,
+                      chunks_per_batch: int = 1) -> "jnp.ndarray":
         base_key = jax.random.PRNGKey(self.conf.training.seed)
         start = jnp.asarray(self.iteration_count, jnp.int32)
         self.params, self.state, self.updater_state, scores = fn(
@@ -308,7 +344,9 @@ class MultiLayerNetwork:
         host_scores = np.asarray(scores)
         pool = int(xs.shape[0])
         for i in range(n):
-            self._notify_iteration(float(host_scores[i]), xs[i % pool])
+            # TBPTT yields chunks_per_batch scores per minibatch
+            self._notify_iteration(float(host_scores[i]),
+                                   xs[(i // chunks_per_batch) % pool])
         return scores
 
     def _notify_iteration(self, score, x) -> None:
@@ -418,9 +456,11 @@ class MultiLayerNetwork:
                                  self.score_value)
             self.iteration_count += 1
 
-    def _make_tbptt_step(self):
-        """Jitted TBPTT chunk step, cached per (batch, features) shape —
-        the compiled program is reused across minibatches and chunks."""
+    def _tbptt_chunk_math(self):
+        """The pure TBPTT chunk update: one forward over a time chunk
+        with carried (stop-gradient) RNN state, one optimizer step.
+        Shared by the per-chunk jitted path (_make_tbptt_step) and the
+        scanned fit_batched path (_make_scan_fit_tbptt)."""
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
@@ -458,7 +498,54 @@ class MultiLayerNetwork:
                 lr_multipliers=lr_mult, trainable=trainable)
             return new_params, new_state, new_opt, new_carries, score
 
-        return jax.jit(chunk_step)
+        return chunk_step
+
+    def _make_tbptt_step(self):
+        """Jitted TBPTT chunk step, cached per (batch, features) shape —
+        the compiled program is reused across minibatches and chunks."""
+        return jax.jit(self._tbptt_chunk_math())
+
+    def _make_scan_fit_tbptt(self, epochs: int = 1, **jit_kwargs):
+        """Whole-run TBPTT program: for each staged minibatch, an inner
+        `lax.scan` walks the time chunks (carried RNN state reset per
+        minibatch, parameters updated per chunk — iteration semantics of
+        _fit_tbptt), an outer scan walks the minibatch pool, and the
+        `epochs` scan repeats the pool — all inside ONE compiled
+        program, the TBPTT counterpart of _make_scan_fit."""
+        chunk_step = self._tbptt_chunk_math()
+        L = self.conf.tbptt_fwd_length
+
+        def factory(xs, ys, base_key):
+            b, t = xs.shape[1], xs.shape[2]
+            s = t // L
+            carries0 = self._init_carries(b)
+
+            def to_chunks(a):
+                # [B, T, ...] -> [S, B, L, ...]
+                a = a.reshape((b, s, L) + a.shape[2:])
+                return jnp.moveaxis(a, 1, 0)
+
+            def mb_body(carry, xy):
+                params, state, opt, it = carry
+                x, y = xy
+
+                def chunk_body(c2, xyc):
+                    params, state, opt, it, carries = c2
+                    xc, yc = xyc
+                    key = jax.random.fold_in(base_key, it)
+                    params, state, opt, carries, score = chunk_step(
+                        params, state, opt, it, xc, yc, carries, key,
+                        None)
+                    return (params, state, opt, it + 1, carries), score
+
+                (params, state, opt, it, _), scores = jax.lax.scan(
+                    chunk_body, (params, state, opt, it, carries0),
+                    (to_chunks(x), to_chunks(y)))
+                return (params, state, opt, it), scores
+
+            return mb_body
+
+        return self._make_epoch_program(factory, epochs, **jit_kwargs)
 
     def _init_carries(self, batch: int) -> Dict[str, Any]:
         carries = {}

@@ -264,6 +264,43 @@ def test_fit_batched_epochs_matches_sequential_calls():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_fit_batched_tbptt_matches_per_chunk_fit():
+    """Scanned TBPTT (fit_batched on a tbptt config: inner chunk scan
+    with carried RNN state, one update per chunk) == per-minibatch
+    fit(), which dispatches the host-loop _fit_tbptt path."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+
+    rng = np.random.default_rng(2)
+    n_steps, batch, T, F = 3, 8, 8, 5
+    xs = rng.random((n_steps, batch, T, F), dtype=np.float32)
+    ys = np.eye(F, dtype=np.float32)[
+        rng.integers(0, F, (n_steps, batch, T))]
+
+    def make_net():
+        conf = (NeuralNetConfiguration(seed=21, updater="rmsprop",
+                                       learning_rate=0.05)
+                .list(GravesLSTM(n_out=12, activation="tanh"),
+                      RnnOutputLayer(n_out=F, activation="softmax",
+                                     loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(F)))
+        conf.backprop_type_tbptt(4, 4)       # T=8 -> 2 chunks/minibatch
+        return MultiLayerNetwork(conf).init()
+
+    ref = make_net()
+    for i in range(n_steps):
+        ref.fit(xs[i], ys[i])
+
+    net = make_net()
+    scores = np.asarray(net.fit_batched(xs, ys))
+    assert scores.shape == (n_steps * 2,)    # one score per chunk
+    assert net.iteration_count == ref.iteration_count == n_steps * 2
+    np.testing.assert_allclose(np.asarray(net.params_flat()),
+                               np.asarray(ref.params_flat()),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_fit_batched_learns_digits():
     conf = (NeuralNetConfiguration(seed=7, updater="adam",
                                    learning_rate=5e-3)
