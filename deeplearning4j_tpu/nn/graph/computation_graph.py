@@ -343,6 +343,10 @@ class ComputationGraph:
                 "fit_batched supports first-order optimization only; "
                 f"optimization_algo={tc.optimization_algo!r} dispatches "
                 "to the Solver path — use fit() instead")
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError(
+                "ComputationGraph.fit_batched does not implement "
+                "truncated BPTT; use fit() for backprop_type='tbptt'")
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
 

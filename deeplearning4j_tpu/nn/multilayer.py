@@ -344,19 +344,24 @@ class MultiLayerNetwork:
         host_scores = np.asarray(scores)
         pool = int(xs.shape[0])
         for i in range(n):
-            # TBPTT yields chunks_per_batch scores per minibatch
+            # TBPTT yields chunks_per_batch scores per minibatch; batch/
+            # input telemetry fires once per minibatch (its first chunk)
             self._notify_iteration(float(host_scores[i]),
-                                   xs[(i // chunks_per_batch) % pool])
+                                   xs[(i // chunks_per_batch) % pool],
+                                   record=(i % chunks_per_batch == 0))
         return scores
 
-    def _notify_iteration(self, score, x) -> None:
+    def _notify_iteration(self, score, x, record: bool = True) -> None:
         """Fire per-iteration listener hooks and advance iteration_count
-        (reference: BaseOptimizer notifies listeners each iteration)."""
+        (reference: BaseOptimizer notifies listeners each iteration).
+        ``record`` gates the batch/input telemetry hooks — TBPTT fires
+        iteration_done per chunk but counts each minibatch's examples
+        once."""
         self.score_value = score
         for l in self.listeners:
-            if hasattr(l, "record_batch"):
+            if record and hasattr(l, "record_batch"):
                 l.record_batch(int(x.shape[0]))
-            if hasattr(l, "record_input"):
+            if record and hasattr(l, "record_input"):
                 l.record_input(x)
             l.iteration_done(self, self.iteration_count, score)
         self.iteration_count += 1
@@ -450,11 +455,10 @@ class MultiLayerNetwork:
              score) = chunk_step(self.params, self.state,
                                  self.updater_state, self.iteration_count,
                                  xs, ys, carries, key, m)
-            self.score_value = score
-            for l in self.listeners:
-                l.iteration_done(self, self.iteration_count,
-                                 self.score_value)
-            self.iteration_count += 1
+            # batch/input telemetry once per minibatch (first chunk),
+            # iteration_done per chunk — same contract as the scanned
+            # TBPTT path (_run_scan_fit)
+            self._notify_iteration(float(score), x, record=(c == 0))
 
     def _tbptt_chunk_math(self):
         """The pure TBPTT chunk update: one forward over a time chunk
