@@ -13,7 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Batch 4096: TPU-right sizing — the MXU wants large batched matmuls. One
 MNIST epoch (15 x 4096 = 61,440 examples) is staged in HBM once and the
 measured program runs EPOCHS passes over it via the nested-scan path
-(fit_batched(..., epochs=N)): ~480 optimizer steps in one XLA program,
+(fit_batched(..., epochs=N)): ~960 optimizer steps in one XLA program,
 so the per-dispatch tunnel latency (~250 ms against ~2 ms/step of
 compute) amortizes the way it does in a real multi-epoch run. (The CPU
 reference estimate is per-example throughput, which for the reference's
@@ -33,7 +33,7 @@ import numpy as np
 REFERENCE_CPU_EXAMPLES_PER_SEC = 2500.0
 BATCH = 4096
 POOL_STEPS = 15          # one staged MNIST epoch: 15 x 4096 = 61,440
-EPOCHS = 32              # in-program passes over the pool
+EPOCHS = 64              # in-program passes over the pool
 REPS = 4
 
 

@@ -276,17 +276,40 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2), **jit_kwargs)
 
+    def _make_epoch_program(self, mb_body_factory, epochs: int,
+                            **jit_kwargs):
+        """Shared scanned-program scaffolding (cf. the MLN twin): inner
+        scan over the minibatch pool with the body from
+        ``mb_body_factory(inputs_stack, labels_stack, base_key)``,
+        optional outer epochs scan."""
+        def epoch(params, state, opt_state, start_iteration, inputs_stack,
+                  labels_stack, base_key):
+            body = mb_body_factory(inputs_stack, labels_stack, base_key)
+
+            def one_pass(carry, _):
+                return jax.lax.scan(body, carry,
+                                    (inputs_stack, labels_stack))
+
+            carry = (params, state, opt_state, start_iteration)
+            if epochs == 1:
+                carry, scores = one_pass(carry, None)
+            else:
+                carry, scores = jax.lax.scan(one_pass, carry, None,
+                                             length=epochs)
+            params, state, opt_state, _ = carry
+            return params, state, opt_state, scores.reshape(-1)
+
+        return jax.jit(epoch, donate_argnums=(0, 1, 2), **jit_kwargs)
+
     def _make_scan_fit(self, epochs: int = 1, **jit_kwargs):
         """Whole-epoch program: `lax.scan` of the minibatch step, keeping
         the per-step loop on device (the MultiLayerNetwork.fit_batched
-        analog for the DAG runtime). ``epochs`` > 1 nests the scan in an
-        outer pass-counting scan over the same staged pool."""
+        analog for the DAG runtime)."""
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
 
-        def epoch(params, state, opt_state, start_iteration, inputs_stack,
-                  labels_stack, base_key):
+        def factory(inputs_stack, labels_stack, base_key):
             def body(carry, il):
                 params, state, opt, it = carry
                 inputs, labels = il
@@ -302,38 +325,56 @@ class ComputationGraph:
                     lr_multipliers=lr_mult, trainable=trainable)
                 return (new_params, new_state, new_opt, it + 1), score
 
-            def one_pass(carry, _):
-                return jax.lax.scan(body, carry,
-                                    (inputs_stack, labels_stack))
+            return body
 
-            carry = (params, state, opt_state, start_iteration)
-            if epochs == 1:
-                carry, scores = one_pass(carry, None)
-            else:
-                carry, scores = jax.lax.scan(one_pass, carry, None,
-                                             length=epochs)
-                scores = scores.reshape(-1)
-            params, state, opt_state, _ = carry
-            return params, state, opt_state, scores
-
-        return jax.jit(epoch, donate_argnums=(0, 1, 2), **jit_kwargs)
+        return self._make_epoch_program(factory, epochs, **jit_kwargs)
 
     def fit_batched(self, feats, labs, epochs: int = 1):
         """Train on a pre-staged stack of minibatches in ONE compiled
         program. ``feats``/``labs`` follow the same shapes fit() accepts
         (single array, list per input/output, or name->array dict), with
         an extra leading [N] batches axis; returns per-step scores
-        [N * epochs] (``epochs`` repeats the staged pool in-program)."""
-        self._validate_fit_batched(epochs)
+        [N * epochs] (``epochs`` repeats the staged pool in-program).
+
+        With backprop_type='tbptt' and temporal labels ([N, B, T, C]
+        everywhere), each minibatch scans its time chunks with carried
+        RNN state and one update per chunk, so scores (and iteration
+        counts) are per CHUNK: [N * T/L * epochs]. Non-temporal labels
+        fall through to standard BPTT, matching fit()."""
+        self._validate_fit_batched(epochs, allow_tbptt=True)
         inputs = self._as_input_dict(feats, self.conf.network_inputs)
         labels = self._as_input_dict(labs, self.conf.network_outputs)
-        fn = self._jit_cache.get(("scanfit", epochs))
+        use_tbptt = (self.conf.backprop_type == "tbptt"
+                     and all(v.ndim == 4 for v in labels.values()))
+        if use_tbptt:
+            L = self.conf.tbptt_fwd_length
+            t_in = next(iter(inputs.values())).shape[2]
+            for k, v in list(inputs.items()) + list(labels.items()):
+                if v.ndim != 4:
+                    raise ValueError(
+                        f"tbptt fit_batched needs [N, B, T, F] arrays; "
+                        f"{k!r} has ndim={v.ndim}")
+                if v.shape[2] != t_in:
+                    raise ValueError(
+                        f"tbptt fit_batched needs one sequence length; "
+                        f"{k!r} has T={v.shape[2]} vs {t_in}")
+            if t_in % L:
+                raise ValueError(
+                    f"tbptt fit_batched needs T ({t_in}) divisible by "
+                    f"tbptt_fwd_length ({L}); use fit() for ragged tails")
+            cache_key = ("scanfit-tbptt", epochs)
+            maker = self._make_scan_fit_tbptt
+        else:
+            cache_key = ("scanfit", epochs)
+            maker = self._make_scan_fit
+        fn = self._jit_cache.get(cache_key)
         if fn is None:
-            fn = self._make_scan_fit(epochs)
-            self._jit_cache[("scanfit", epochs)] = fn
+            fn = maker(epochs)
+            self._jit_cache[cache_key] = fn
         return self._run_scan_fit(fn, inputs, labels)
 
-    def _validate_fit_batched(self, epochs: int) -> None:
+    def _validate_fit_batched(self, epochs: int,
+                              allow_tbptt: bool = False) -> None:
         if not self._initialized:
             self.init()
         tc = self.conf.training
@@ -343,10 +384,10 @@ class ComputationGraph:
                 "fit_batched supports first-order optimization only; "
                 f"optimization_algo={tc.optimization_algo!r} dispatches "
                 "to the Solver path — use fit() instead")
-        if self.conf.backprop_type == "tbptt":
+        if self.conf.backprop_type == "tbptt" and not allow_tbptt:
             raise ValueError(
-                "ComputationGraph.fit_batched does not implement "
-                "truncated BPTT; use fit() for backprop_type='tbptt'")
+                "this scanned path does not implement truncated BPTT; "
+                "use fit() or ComputationGraph.fit_batched")
         if epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {epochs}")
 
@@ -546,9 +587,10 @@ class ComputationGraph:
                 carries[name] = v.initial_carry(batch, self.dtype)
         return carries
 
-    def _make_tbptt_step(self):
-        """Jitted TBPTT chunk step over the DAG (reference:
-        ComputationGraph.doTruncatedBPTT:2042)."""
+    def _tbptt_chunk_math(self):
+        """The pure TBPTT chunk update over the DAG (reference:
+        ComputationGraph.doTruncatedBPTT:2042) — shared by the per-chunk
+        jitted path and the scanned fit_batched path."""
         tc = self.conf.training
         lr_mult = self._lr_multipliers()
         trainable = self._trainable()
@@ -577,7 +619,54 @@ class ComputationGraph:
                 lr_multipliers=lr_mult, trainable=trainable)
             return new_params, new_state, new_opt, new_carries, score
 
-        return jax.jit(chunk_step)
+        return chunk_step
+
+    def _make_tbptt_step(self):
+        """Jitted TBPTT chunk step over the DAG."""
+        return jax.jit(self._tbptt_chunk_math())
+
+    def _make_scan_fit_tbptt(self, epochs: int = 1, **jit_kwargs):
+        """Whole-run TBPTT program over the DAG: inner scan over each
+        minibatch's time chunks (carried RNN state reset per minibatch,
+        one update per chunk), outer scans over the pool and epochs —
+        the ComputationGraph counterpart of
+        MultiLayerNetwork._make_scan_fit_tbptt."""
+        chunk_step = self._tbptt_chunk_math()
+        L = self.conf.tbptt_fwd_length
+
+        def factory(inputs_stack, labels_stack, base_key):
+            first = next(iter(inputs_stack.values()))
+            b, t = first.shape[1], first.shape[2]
+            s = t // L
+            carries0 = self._init_carries(b)
+
+            def to_chunks(d):
+                # each [B, T, ...] -> [S, B, L, ...]
+                return {k: jnp.moveaxis(
+                    v.reshape((b, s, L) + v.shape[2:]), 1, 0)
+                    for k, v in d.items()}
+
+            def mb_body(carry, xy):
+                params, state, opt, it = carry
+                inputs, labels = xy
+
+                def chunk_body(c2, xyc):
+                    params, state, opt, it, carries = c2
+                    xc, yc = xyc
+                    key = jax.random.fold_in(base_key, it)
+                    params, state, opt, carries, score = chunk_step(
+                        params, state, opt, it, xc, yc, carries, key,
+                        None)
+                    return (params, state, opt, it + 1, carries), score
+
+                (params, state, opt, it, _), scores = jax.lax.scan(
+                    chunk_body, (params, state, opt, it, carries0),
+                    (to_chunks(inputs), to_chunks(labels)))
+                return (params, state, opt, it), scores
+
+            return mb_body
+
+        return self._make_epoch_program(factory, epochs, **jit_kwargs)
 
     def _fit_tbptt(self, inputs: Dict[str, Array],
                    labels: Dict[str, Array], masks=None) -> None:

@@ -281,11 +281,18 @@ class MultiLayerNetwork:
         self._validate_fit_batched(epochs, allow_tbptt=True)
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
-        if self.conf.backprop_type == "tbptt":
+        # tbptt needs temporal labels; non-temporal targets fall through
+        # to standard BPTT, matching fit()'s dispatch
+        use_tbptt = (self.conf.backprop_type == "tbptt" and ys.ndim == 4)
+        if use_tbptt:
             L = self.conf.tbptt_fwd_length
             if xs.ndim != 4:
                 raise ValueError("tbptt fit_batched needs [N, B, T, F] "
                                  f"inputs, got ndim={xs.ndim}")
+            if xs.shape[2] != ys.shape[2]:
+                raise ValueError(
+                    f"tbptt fit_batched needs one sequence length; "
+                    f"inputs T={xs.shape[2]} vs labels T={ys.shape[2]}")
             if xs.shape[2] % L:
                 raise ValueError(
                     f"tbptt fit_batched needs T ({xs.shape[2]}) divisible "
@@ -301,7 +308,7 @@ class MultiLayerNetwork:
             fn = maker(epochs)
             self._jit_cache[cache_key] = fn
         chunks = (xs.shape[2] // self.conf.tbptt_fwd_length
-                  if self.conf.backprop_type == "tbptt" else 1)
+                  if use_tbptt else 1)
         return self._run_scan_fit(fn, xs, ys, chunks_per_batch=chunks)
 
     def _validate_fit_batched(self, epochs: int,
@@ -457,8 +464,9 @@ class MultiLayerNetwork:
                                  xs, ys, carries, key, m)
             # batch/input telemetry once per minibatch (first chunk),
             # iteration_done per chunk — same contract as the scanned
-            # TBPTT path (_run_scan_fit)
-            self._notify_iteration(float(score), x, record=(c == 0))
+            # TBPTT path (_run_scan_fit). score stays a device array:
+            # forcing it would serialize the chunk pipeline.
+            self._notify_iteration(score, x, record=(c == 0))
 
     def _tbptt_chunk_math(self):
         """The pure TBPTT chunk update: one forward over a time chunk

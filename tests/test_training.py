@@ -301,6 +301,51 @@ def test_fit_batched_tbptt_matches_per_chunk_fit():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_graph_fit_batched_tbptt_matches_per_chunk_fit():
+    """ComputationGraph scanned TBPTT == per-minibatch fit() (the
+    doTruncatedBPTT analog), same contract as the MLN twin."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+
+    rng = np.random.default_rng(4)
+    n_steps, batch, T, F = 3, 8, 8, 5
+    xs = rng.random((n_steps, batch, T, F), dtype=np.float32)
+    ys = np.eye(F, dtype=np.float32)[
+        rng.integers(0, F, (n_steps, batch, T))]
+
+    def make_net():
+        conf = (NeuralNetConfiguration(seed=31, updater="rmsprop",
+                                       learning_rate=0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_out=12,
+                                              activation="tanh"), "in")
+                .add_layer("out", RnnOutputLayer(n_out=F,
+                                                 activation="softmax",
+                                                 loss_function="mcxent"),
+                           "lstm")
+                .set_outputs("out")
+                .set_input_types(**{"in": InputType.recurrent(F)})
+                .backprop_type_tbptt(4, 4)
+                .build())
+        return ComputationGraph(conf).init()
+
+    ref = make_net()
+    for i in range(n_steps):
+        ref.fit(xs[i], ys[i])
+
+    net = make_net()
+    scores = np.asarray(net.fit_batched(xs, ys))
+    assert scores.shape == (n_steps * 2,)
+    assert net.iteration_count == ref.iteration_count == n_steps * 2
+    np.testing.assert_allclose(np.asarray(net.params_flat()),
+                               np.asarray(ref.params_flat()),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_fit_batched_learns_digits():
     conf = (NeuralNetConfiguration(seed=7, updater="adam",
                                    learning_rate=5e-3)
