@@ -128,6 +128,16 @@ class SequenceVectors(WordVectorsMixin):
                             + self.subsampling / freqs)
         return self._rng.random(len(ids)) < keep_p
 
+    def _reduced_windows(self, n: int):
+        """The word2vec reduced-window draw: per-position effective
+        window sizes w [n] (>=1) and the symmetric offset vector
+        [-window..-1, 1..window]. One definition keeps _window_pairs and
+        _window_rows on the same RNG stream structurally."""
+        w = self.window - self._rng.integers(0, self.window, n)
+        offs = np.concatenate([np.arange(-self.window, 0),
+                               np.arange(1, self.window + 1)])
+        return w, offs
+
     def _window_pairs(self, ids: np.ndarray):
         """(center, context) pairs with the word2vec reduced-window
         trick, fully vectorized (the Python double loop here was the
@@ -136,14 +146,31 @@ class SequenceVectors(WordVectorsMixin):
         n = len(ids)
         if n == 0:
             return (np.empty(0, np.int32),) * 2
-        w = self.window - self._rng.integers(0, self.window, n)  # [n]>=1
-        offs = np.concatenate([np.arange(-self.window, 0),
-                               np.arange(1, self.window + 1)])
+        w, offs = self._reduced_windows(n)
         ci = np.repeat(np.arange(n), len(offs))        # center index
         xi = ci + np.tile(offs, n)                     # context index
         valid = ((xi >= 0) & (xi < n)
                  & (np.abs(xi - ci) <= np.repeat(w, len(offs))))
         return ids[ci[valid]], ids[xi[valid]]
+
+    def _window_rows(self, ids: np.ndarray):
+        """Per-CENTER training rows for CBOW (reference CBOW.java: the
+        mean of the whole reduced window predicts the center): targets
+        [n], context windows [n, 2w] (0-padded), validity mask [n, 2w].
+        Same reduced-window draw as _window_pairs."""
+        n = len(ids)
+        if n == 0:
+            z = np.empty((0, 2 * self.window))
+            return (np.empty(0, np.int32), z.astype(np.int32),
+                    z.astype(np.float32))
+        w, offs = self._reduced_windows(n)
+        idx = np.arange(n)[:, None] + offs[None, :]
+        valid = ((idx >= 0) & (idx < n)
+                 & (np.abs(offs)[None, :] <= w[:, None]))
+        win = np.where(valid, ids[np.clip(idx, 0, n - 1)], 0)
+        return (ids.astype(np.int32, copy=False),
+                win.astype(np.int32, copy=False),
+                valid.astype(np.float32))
 
     # -- fit ---------------------------------------------------------------
     def fit(self) -> "SequenceVectors":
@@ -154,6 +181,10 @@ class SequenceVectors(WordVectorsMixin):
         step_no = 0
         # pre-collect pairs per epoch (host); batches keep a fixed shape
         for epoch in range(total_epochs):
+            if self.algorithm == "cbow":
+                step_no = self._fit_cbow_epoch(step_no, total_epochs,
+                                               epoch)
+                continue
             centers_l: List[np.ndarray] = []
             contexts_l: List[np.ndarray] = []
             for seq in self._sequences():
@@ -177,11 +208,9 @@ class SequenceVectors(WordVectorsMixin):
             alpha0 = self.learning_rate
             n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
             total_steps = total_epochs * n_batches
-            scannable = (
-                self.scan_epochs and self.mesh is None
-                and ((self.algorithm == "skipgram"
-                      and (self.use_hs or self.negative > 0))
-                     or (self.algorithm == "cbow" and self.negative > 0)))
+            scannable = (self.scan_epochs and self.mesh is None
+                         and self.algorithm == "skipgram"
+                         and (self.use_hs or self.negative > 0))
             if scannable:
                 # whole-epoch scanned program (one dispatch per epoch)
                 step_no = self._fit_epoch_scanned(
@@ -199,6 +228,77 @@ class SequenceVectors(WordVectorsMixin):
             log.info("SequenceVectors epoch %d: %d pairs", epoch, n_pairs)
         return self
 
+    def _fit_cbow_epoch(self, step_no: int, total_epochs: int,
+                        epoch: int) -> int:
+        """One CBOW epoch (reference CBOW.java: mean over the reduced
+        window + negative sampling predicts the center). Scanned chunks
+        when eligible, per-batch dispatch otherwise — both bit-identical
+        (the equivalence test's obligation)."""
+        if self.negative <= 0:
+            raise ValueError("cbow requires negative sampling "
+                             "(negative > 0)")
+        tgt_l: List[np.ndarray] = []
+        win_l: List[np.ndarray] = []
+        msk_l: List[np.ndarray] = []
+        for seq in self._sequences():
+            ids = self._encode(seq)
+            ids = ids[self._keep_mask(ids)]
+            if len(ids) == 0:
+                continue
+            t, w_arr, m = self._window_rows(ids)
+            tgt_l.append(t)
+            win_l.append(w_arr)
+            msk_l.append(m)
+        if not tgt_l:
+            return step_no
+        tgt = np.concatenate(tgt_l)
+        win = np.concatenate(win_l)
+        msk = np.concatenate(msk_l)
+        n_ex = len(tgt)
+        order = self._rng.permutation(n_ex)
+        tgt, win, msk = tgt[order], win[order], msk[order]
+        b = self.batch_size
+        n_batches = (n_ex + b - 1) // b
+        total_steps = total_epochs * n_batches
+        alpha0 = self.learning_rate
+        lt = self.lookup_table
+
+        def lr_at(step):
+            frac = min(1.0, step / max(total_steps, 1))
+            return max(self.min_learning_rate, alpha0 * (1.0 - frac))
+
+        if self.scan_epochs and self.mesh is None:
+            for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
+                    n_batches, n_ex):
+                windows = self._stage_chunk(win, sl, nb_pad, n_valid)
+                wmask = self._stage_chunk(msk, sl, nb_pad, n_valid)
+                targets = self._stage_chunk(tgt, sl, nb_pad, n_valid)
+                lr_vec = self._chunk_lr(step_no, nb_pad, total_steps,
+                                        alpha0, n_valid)
+                negs = self._stage_negatives(nb, nb_pad)
+                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_scan(
+                    lt.syn0, lt.syn1neg, jnp.asarray(windows),
+                    jnp.asarray(wmask), jnp.asarray(targets),
+                    jnp.asarray(negs), jnp.asarray(lr_vec))
+                step_no += nb
+        else:
+            for s in range(0, n_ex, b):
+                nb = len(tgt[s:s + b])
+                lr_vec = np.zeros(b, np.float32)
+                lr_vec[:nb] = lr_at(step_no)
+                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_step(
+                    lt.syn0, lt.syn1neg,
+                    jnp.asarray(self._pad(win[s:s + b])),
+                    jnp.asarray(self._pad(msk[s:s + b])),
+                    jnp.asarray(self._pad(tgt[s:s + b])),
+                    jnp.asarray(self._sample_negatives(nb)),
+                    jnp.asarray(lr_vec))
+                step_no += 1
+        log.info("SequenceVectors cbow epoch %d: %d examples", epoch,
+                 n_ex)
+        return step_no
+
+
     # max batches per scanned program: bounds device/host staging memory
     # at CHUNK * batch_size * (2 + negative) int32 regardless of corpus
     # size (the per-batch path's O(batch) memory, amortized dispatch)
@@ -211,6 +311,20 @@ class SequenceVectors(WordVectorsMixin):
     def _stage_chunk(self, a: np.ndarray, sl: slice, nb_pad: int,
                      n_valid: int) -> np.ndarray:
         return stage_chunk(a, sl, nb_pad, n_valid, self.batch_size)
+
+    def _chunk_lr(self, step_no: int, nb_pad: int, total_steps: int,
+                  alpha0: float, n_valid: int) -> np.ndarray:
+        """Per-row lr schedule for one scanned chunk [nb_pad, B]: linear
+        decay by global step with the min-lr floor, zeros on padding
+        rows — the ONE definition both the skip-gram and CBOW scanned
+        paths share with the per-batch schedule."""
+        frac = np.minimum(1.0, (step_no + np.arange(nb_pad))
+                          / max(total_steps, 1))
+        lr_rows = np.maximum(self.min_learning_rate,
+                             alpha0 * (1.0 - frac)).astype(np.float32)
+        lr_vec = np.repeat(lr_rows[:, None], self.batch_size, axis=1)
+        lr_vec.reshape(-1)[n_valid:] = 0.0
+        return lr_vec
 
     def _stage_negatives(self, nb: int, nb_pad: int) -> np.ndarray:
         """Negatives drawn one batch at a time (stream-identical to the
@@ -238,8 +352,7 @@ class SequenceVectors(WordVectorsMixin):
         are bit-identical to the per-batch path."""
         b = self.batch_size
         lt = self.lookup_table
-        cbow = self.algorithm == "cbow"
-        if not cbow and self.use_hs:
+        if self.use_hs:
             # hoisted once per epoch: full Huffman tables to host
             pts_t = np.asarray(lt.points)
             codes_t = np.asarray(lt.codes)
@@ -248,24 +361,9 @@ class SequenceVectors(WordVectorsMixin):
                 n_batches, len(centers_a)):
             centers_p = self._stage_chunk(centers_a, sl, nb_pad, n_valid)
             contexts_p = self._stage_chunk(contexts_a, sl, nb_pad, n_valid)
-            frac = np.minimum(1.0, (step_no + np.arange(nb_pad))
-                              / max(total_steps, 1))
-            lr_rows = np.maximum(self.min_learning_rate,
-                                 alpha0 * (1.0 - frac)).astype(np.float32)
-            lr_vec = np.repeat(lr_rows[:, None], b, axis=1)
-            lr_vec.reshape(-1)[n_valid:] = 0.0
-            if cbow:
-                # single-word context per pair (mirrors the per-batch
-                # path: pair expansion handles window aggregation)
-                windows = contexts_p[..., None]
-                wmask = np.zeros(windows.shape, np.float32)
-                wmask.reshape(-1)[:n_valid] = 1.0
-                negs = self._stage_negatives(nb, nb_pad)
-                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_scan(
-                    lt.syn0, lt.syn1neg, jnp.asarray(windows),
-                    jnp.asarray(wmask), jnp.asarray(centers_p),
-                    jnp.asarray(negs), jnp.asarray(lr_vec))
-            elif self.use_hs:
+            lr_vec = self._chunk_lr(step_no, nb_pad, total_steps,
+                                    alpha0, n_valid)
+            if self.use_hs:
                 # hierarchical softmax: the CONTEXT word's Huffman
                 # path/codes, the center's syn0 row (reference SkipGram
                 # HS semantics)
@@ -306,20 +404,6 @@ class SequenceVectors(WordVectorsMixin):
         lr_vec[:n] = lr
         centers_p = self._pad(centers)
         contexts_p = self._pad(contexts)
-        if self.algorithm == "cbow":
-            # re-interpret: for CBOW each (center, context-window) comes
-            # from _window_pairs' center with its window; approximate with
-            # single-word context (matches reference CBOW with window
-            # aggregation handled by pair expansion)
-            windows = contexts_p[:, None]
-            wmask = np.zeros_like(windows, np.float32)
-            wmask[:n] = 1.0
-            lt.syn0, lt.syn1neg, _ = learning.cbow_neg_step(
-                lt.syn0, lt.syn1neg, jnp.asarray(windows),
-                jnp.asarray(wmask), jnp.asarray(centers_p),
-                jnp.asarray(self._sample_negatives(n)),
-                jnp.asarray(lr_vec))
-            return
         if self.use_hs:
             codes = np.asarray(lt.codes)[contexts_p]
             cmask = np.asarray(lt.code_mask)[contexts_p]
