@@ -176,6 +176,43 @@ cbow_neg_step = jax.jit(cbow_neg_impl, donate_argnums=(0, 1))
 cbow_neg_scan = _epoch_scan(cbow_neg_impl, 2)
 
 
+def cbow_hs_impl(syn0: Array, syn1: Array, context_windows: Array,
+                 context_mask: Array, points: Array, codes: Array,
+                 code_mask: Array, lr: Array
+                 ) -> Tuple[Array, Array, Array]:
+    """CBOW with hierarchical softmax (reference: CBOW.java useHS): the
+    mean of the window's context vectors predicts the CENTER word's
+    Huffman path.
+
+    context_windows/context_mask: [B, W]; points/codes/code_mask:
+    [B, L] (the center word's tree path); lr: [B].
+    """
+    ctx = syn0[context_windows]                               # [B, W, D]
+    denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+    mean_ctx = (ctx * context_mask[:, :, None]).sum(1) / denom  # [B, D]
+    nodes = syn1[points]                                      # [B, L, D]
+    dots = jnp.einsum("bd,bld->bl", mean_ctx, nodes)
+    labels = 1.0 - codes
+    sig = jax.nn.sigmoid(dots)
+    loss = jnp.mean(jnp.sum(
+        code_mask * (jax.nn.softplus(dots) - labels * dots), axis=-1))
+    g = (sig - labels) * code_mask                            # [B, L]
+    g_mean = jnp.einsum("bl,bld->bd", g, nodes)               # [B, D]
+    g_nodes = g[:, :, None] * mean_ctx[:, None, :]            # [B, L, D]
+    g_ctx_rows = (g_mean[:, None, :] * context_mask[:, :, None]) / \
+        denom[:, :, None]                                     # [B, W, D]
+    syn0 = syn0.at[context_windows.reshape(-1)].add(
+        (-lr[:, None, None] * g_ctx_rows).reshape(-1,
+                                                  g_ctx_rows.shape[-1]))
+    syn1 = syn1.at[points.reshape(-1)].add(
+        (-lr[:, None, None] * g_nodes).reshape(-1, g_nodes.shape[-1]))
+    return syn0, syn1, loss
+
+
+cbow_hs_step = jax.jit(cbow_hs_impl, donate_argnums=(0, 1))
+cbow_hs_scan = _epoch_scan(cbow_hs_impl, 2)
+
+
 def dm_neg_impl(syn0: Array, doc_vecs: Array, syn1neg: Array,
                 doc_ids: Array, context_windows: Array, context_mask: Array,
                 targets: Array, negatives: Array, lr: Array

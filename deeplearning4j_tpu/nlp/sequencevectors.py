@@ -218,9 +218,7 @@ class SequenceVectors(WordVectorsMixin):
                     total_steps, alpha0)
             else:
                 for s in range(0, n_pairs, self.batch_size):
-                    frac = min(1.0, step_no / max(total_steps, 1))
-                    lr_now = max(self.min_learning_rate,
-                                 alpha0 * (1.0 - frac))
+                    lr_now = self._lr_at(step_no, total_steps, alpha0)
                     self._train_batch(
                         centers_a[s:s + self.batch_size],
                         contexts_a[s:s + self.batch_size], lr_now)
@@ -228,15 +226,23 @@ class SequenceVectors(WordVectorsMixin):
             log.info("SequenceVectors epoch %d: %d pairs", epoch, n_pairs)
         return self
 
+    def _lr_at(self, step: int, total_steps: int, alpha0: float) -> float:
+        """The word2vec linear lr decay with the min-lr floor — the one
+        scalar definition; _chunk_lr vectorizes it for scanned chunks."""
+        frac = min(1.0, step / max(total_steps, 1))
+        return max(self.min_learning_rate, alpha0 * (1.0 - frac))
+
     def _fit_cbow_epoch(self, step_no: int, total_epochs: int,
                         epoch: int) -> int:
-        """One CBOW epoch (reference CBOW.java: mean over the reduced
-        window + negative sampling predicts the center). Scanned chunks
-        when eligible, per-batch dispatch otherwise — both bit-identical
+        """One CBOW epoch (reference CBOW.java): the mean over the
+        reduced window predicts the center, through negative sampling
+        or — when use_hs — the center's Huffman path (HS takes
+        precedence, as in the skip-gram dispatch). Scanned chunks when
+        eligible, per-batch dispatch otherwise — both bit-identical
         (the equivalence test's obligation)."""
-        if self.negative <= 0:
+        if self.negative <= 0 and not self.use_hs:
             raise ValueError("cbow requires negative sampling "
-                             "(negative > 0)")
+                             "(negative > 0) or hierarchical softmax")
         tgt_l: List[np.ndarray] = []
         win_l: List[np.ndarray] = []
         msk_l: List[np.ndarray] = []
@@ -262,10 +268,10 @@ class SequenceVectors(WordVectorsMixin):
         total_steps = total_epochs * n_batches
         alpha0 = self.learning_rate
         lt = self.lookup_table
-
-        def lr_at(step):
-            frac = min(1.0, step / max(total_steps, 1))
-            return max(self.min_learning_rate, alpha0 * (1.0 - frac))
+        if self.use_hs:
+            pts_t = np.asarray(lt.points)
+            codes_t = np.asarray(lt.codes)
+            cmask_t = np.asarray(lt.code_mask)
 
         if self.scan_epochs and self.mesh is None:
             for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
@@ -275,24 +281,41 @@ class SequenceVectors(WordVectorsMixin):
                 targets = self._stage_chunk(tgt, sl, nb_pad, n_valid)
                 lr_vec = self._chunk_lr(step_no, nb_pad, total_steps,
                                         alpha0, n_valid)
-                negs = self._stage_negatives(nb, nb_pad)
-                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_scan(
-                    lt.syn0, lt.syn1neg, jnp.asarray(windows),
-                    jnp.asarray(wmask), jnp.asarray(targets),
-                    jnp.asarray(negs), jnp.asarray(lr_vec))
+                if self.use_hs:
+                    lt.syn0, lt.syn1, _ = learning.cbow_hs_scan(
+                        lt.syn0, lt.syn1, jnp.asarray(windows),
+                        jnp.asarray(wmask), jnp.asarray(pts_t[targets]),
+                        jnp.asarray(codes_t[targets]),
+                        jnp.asarray(cmask_t[targets]),
+                        jnp.asarray(lr_vec))
+                else:
+                    negs = self._stage_negatives(nb, nb_pad)
+                    lt.syn0, lt.syn1neg, _ = learning.cbow_neg_scan(
+                        lt.syn0, lt.syn1neg, jnp.asarray(windows),
+                        jnp.asarray(wmask), jnp.asarray(targets),
+                        jnp.asarray(negs), jnp.asarray(lr_vec))
                 step_no += nb
         else:
             for s in range(0, n_ex, b):
                 nb = len(tgt[s:s + b])
                 lr_vec = np.zeros(b, np.float32)
-                lr_vec[:nb] = lr_at(step_no)
-                lt.syn0, lt.syn1neg, _ = learning.cbow_neg_step(
-                    lt.syn0, lt.syn1neg,
-                    jnp.asarray(self._pad(win[s:s + b])),
-                    jnp.asarray(self._pad(msk[s:s + b])),
-                    jnp.asarray(self._pad(tgt[s:s + b])),
-                    jnp.asarray(self._sample_negatives(nb)),
-                    jnp.asarray(lr_vec))
+                lr_vec[:nb] = self._lr_at(step_no, total_steps, alpha0)
+                win_b = jnp.asarray(self._pad(win[s:s + b]))
+                msk_b = jnp.asarray(self._pad(msk[s:s + b]))
+                tgt_b = self._pad(tgt[s:s + b])
+                if self.use_hs:
+                    lt.syn0, lt.syn1, _ = learning.cbow_hs_step(
+                        lt.syn0, lt.syn1, win_b, msk_b,
+                        jnp.asarray(pts_t[tgt_b]),
+                        jnp.asarray(codes_t[tgt_b]),
+                        jnp.asarray(cmask_t[tgt_b]),
+                        jnp.asarray(lr_vec))
+                else:
+                    lt.syn0, lt.syn1neg, _ = learning.cbow_neg_step(
+                        lt.syn0, lt.syn1neg, win_b, msk_b,
+                        jnp.asarray(tgt_b),
+                        jnp.asarray(self._sample_negatives(nb)),
+                        jnp.asarray(lr_vec))
                 step_no += 1
         log.info("SequenceVectors cbow epoch %d: %d examples", epoch,
                  n_ex)
@@ -341,10 +364,11 @@ class SequenceVectors(WordVectorsMixin):
                            contexts_a: np.ndarray, n_batches: int,
                            step_no: int, total_steps: int,
                            alpha0: float) -> int:
-        """Run one epoch of skip-gram (negative-sampling OR hierarchical
-        softmax) or CBOW/neg as a few big XLA programs: the pair stream
-        is staged in chunks of up to _SCAN_CHUNK batches [N, B] and each
-        chunk scans the batched update on device (learning.*_scan).
+        """Run one skip-gram epoch (negative-sampling OR hierarchical
+        softmax; CBOW lives in _fit_cbow_epoch) as a few big XLA
+        programs: the pair stream is staged in chunks of up to
+        _SCAN_CHUNK batches [N, B] and each chunk scans the batched
+        update on device (learning.*_scan).
         Padding rows carry lr=0, so they are exact no-ops; partial
         chunks bucket N to the next power of two so epoch-to-epoch
         pair-count jitter (the reduced-window RNG) never recompiles.

@@ -819,6 +819,41 @@ class MultiLayerNetwork:
         ev = self._run_evaluation(iterator, Evaluation())
         return ev
 
+    def output_batched(self, xs) -> Array:
+        """Scanned inference over a pre-staged pool: ``xs``
+        [N, B, ...] -> activations [N, B, ...]. One compiled program for
+        the whole pool (the inference face of fit_batched: per-batch
+        dispatch stays on device), bounded memory — only the outputs are
+        kept, not the pool's activations."""
+        if not self._initialized:
+            self.init()
+        xs = jnp.asarray(xs)
+        fn = self._jit_cache.get(("output-scan",))
+        if fn is None:
+            def _scan_out(params, state, xs):
+                def body(_, x):
+                    h, _, _, _ = self._forward(params, state, x,
+                                               train=False, key=None,
+                                               mask=None)
+                    return None, h
+
+                return jax.lax.scan(body, None, xs)[1]
+
+            fn = jax.jit(_scan_out)
+            self._jit_cache[("output-scan",)] = fn
+        return fn(self.params, self.state, xs)
+
+    def evaluate_batched(self, xs, ys):
+        """Evaluation over a pre-staged pool [N, B, ...] — scanned
+        forward, then one host-side metrics pass."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        out = np.asarray(self.output_batched(xs))
+        ys = np.asarray(ys)
+        ev = Evaluation()
+        ev.eval(ys.reshape(-1, ys.shape[-1]),
+                out.reshape(-1, out.shape[-1]))
+        return ev
+
     # --------------------------------------------------------- rnn inference
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None

@@ -239,7 +239,8 @@ def test_vectorizers_and_inverted_index():
     assert t[0, cat_idx] > t[0, sat_idx]
 
 
-@pytest.mark.parametrize("mode", ["sg-neg", "sg-hs", "cbow-neg"])
+@pytest.mark.parametrize("mode", ["sg-neg", "sg-hs", "cbow-neg",
+                                  "cbow-hs"])
 def test_scanned_word2vec_matches_per_batch(mode):
     """The whole-epoch scanned programs (_fit_epoch_scanned) must
     reproduce the per-batch dispatch path exactly for every algorithm
@@ -253,8 +254,11 @@ def test_scanned_word2vec_matches_per_batch(mode):
         kw.update(negative=3)
     elif mode == "sg-hs":
         kw.update(negative=0, use_hierarchic_softmax=True)
-    else:
+    elif mode == "cbow-neg":
         kw.update(negative=3, elements_learning_algorithm="cbow")
+    else:
+        kw.update(negative=0, use_hierarchic_softmax=True,
+                  elements_learning_algorithm="cbow")
     scanned = Word2Vec(**kw)
     scanned.fit()
     stepped = Word2Vec(scan_epochs=False, **kw)

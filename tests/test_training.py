@@ -346,6 +346,32 @@ def test_graph_fit_batched_tbptt_matches_per_chunk_fit():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_output_and_evaluate_batched_match_per_batch():
+    """Scanned inference (output_batched/evaluate_batched) == per-batch
+    output()/evaluate() over the same pool."""
+    conf = (NeuralNetConfiguration(seed=3, updater="adam",
+                                   learning_rate=0.05, activation="tanh")
+            .list(DenseLayer(n_in=4, n_out=8),
+                  OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent")))
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    xs = rng.random((5, 16, 4), dtype=np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (5, 16))]
+    net.fit_batched(xs, ys, epochs=2)
+
+    pooled = np.asarray(net.output_batched(xs))
+    per_batch = np.stack([np.asarray(net.output(xs[i]))
+                          for i in range(5)])
+    np.testing.assert_allclose(pooled, per_batch, rtol=1e-5, atol=1e-6)
+
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    ev = net.evaluate_batched(xs, ys)
+    ref = Evaluation()
+    ref.eval(ys.reshape(-1, 3), per_batch.reshape(-1, 3))
+    assert abs(ev.accuracy() - ref.accuracy()) < 1e-9
+
+
 def test_fit_batched_learns_digits():
     conf = (NeuralNetConfiguration(seed=7, updater="adam",
                                    learning_rate=5e-3)
