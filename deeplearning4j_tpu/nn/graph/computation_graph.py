@@ -758,6 +758,48 @@ class ComputationGraph:
             self._jit_cache[("output", train)] = fn
         return fn(self.params, self.state, inputs)
 
+    def output_batched(self, feats) -> List[Array]:
+        """Scanned inference over a pre-staged pool: inputs with a
+        leading [N] batches axis -> per-output activations [N, B, ...]
+        in one compiled program (the DAG twin of
+        MultiLayerNetwork.output_batched)."""
+        if not self._initialized:
+            self.init()
+        inputs = self._as_input_dict(feats, self.conf.network_inputs)
+        fn = self._jit_cache.get(("output-scan",))
+        if fn is None:
+            def _scan_out(params, state, inputs):
+                def body(_, x):
+                    values, _ = self._forward(params, state, x,
+                                              train=False, key=None)
+                    return None, [values[n]
+                                  for n in self.conf.network_outputs]
+
+                return jax.lax.scan(body, None, inputs)[1]
+
+            fn = jax.jit(_scan_out)
+            self._jit_cache[("output-scan",)] = fn
+        return fn(self.params, self.state, inputs)
+
+    def evaluate_batched(self, feats, labs):
+        """Evaluation over a pre-staged pool — scanned forward on the
+        FIRST output (the reference's evaluate semantics), one host-side
+        metrics pass."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        out = np.asarray(self.output_batched(feats)[0])
+        # labels stay on host: pick output 0's array without the
+        # _as_input_dict device round-trip
+        if isinstance(labs, dict):
+            ys = np.asarray(labs[self.conf.network_outputs[0]])
+        elif isinstance(labs, (list, tuple)):
+            ys = np.asarray(labs[0])
+        else:
+            ys = np.asarray(labs)
+        ev = Evaluation()
+        ev.eval(ys.reshape(-1, ys.shape[-1]),
+                out.reshape(-1, out.shape[-1]))
+        return ev
+
     def feed_forward(self, data, train: bool = False) -> Dict[str, Array]:
         inputs = self._as_input_dict(data, self.conf.network_inputs)
         values, _ = self._forward(self.params, self.state, inputs,

@@ -372,6 +372,36 @@ def test_output_and_evaluate_batched_match_per_batch():
     assert abs(ev.accuracy() - ref.accuracy()) < 1e-9
 
 
+def test_graph_output_and_evaluate_batched():
+    """DAG twin of the scanned inference path."""
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+
+    conf = (NeuralNetConfiguration(seed=9, updater="adam",
+                                   learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=6, n_out=10,
+                                       activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=10, n_out=2,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "h")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    xs = rng.random((4, 16, 6), dtype=np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 16))]
+    net.fit_batched(xs, ys)
+
+    pooled = np.asarray(net.output_batched(xs)[0])
+    per_batch = np.stack([np.asarray(net.output(xs[i])[0])
+                          for i in range(4)])
+    np.testing.assert_allclose(pooled, per_batch, rtol=1e-5, atol=1e-6)
+    ev = net.evaluate_batched(xs, ys)
+    assert 0.0 <= ev.accuracy() <= 1.0
+
+
 def test_fit_batched_learns_digits():
     conf = (NeuralNetConfiguration(seed=7, updater="adam",
                                    learning_rate=5e-3)
