@@ -830,18 +830,22 @@ class MultiLayerNetwork:
         xs = jnp.asarray(xs)
         fn = self._jit_cache.get(("output-scan",))
         if fn is None:
-            def _scan_out(params, state, xs):
-                def body(_, x):
-                    h, _, _, _ = self._forward(params, state, x,
-                                               train=False, key=None,
-                                               mask=None)
-                    return None, h
-
-                return jax.lax.scan(body, None, xs)[1]
-
-            fn = jax.jit(_scan_out)
+            fn = self._make_scan_out()
             self._jit_cache[("output-scan",)] = fn
         return fn(self.params, self.state, xs)
+
+    def _make_scan_out(self, **jit_kwargs):
+        """The scanned-inference program (shared by output_batched and
+        ParallelWrapper.output_batched, which adds shardings)."""
+        def _scan_out(params, state, xs):
+            def body(_, x):
+                h, _, _, _ = self._forward(params, state, x, train=False,
+                                           key=None, mask=None)
+                return None, h
+
+            return jax.lax.scan(body, None, xs)[1]
+
+        return jax.jit(_scan_out, **jit_kwargs)
 
     def evaluate_batched(self, xs, ys):
         """Evaluation over a pre-staged pool [N, B, ...] — scanned

@@ -135,6 +135,34 @@ class ParallelWrapper:
             m._jit_cache[key] = fn
         return m._run_scan_fit(fn, xs, ys)
 
+    def output_batched(self, xs):
+        """Data-parallel scanned inference: the staged pool [N, B, ...]
+        shards over 'data' on the batch dim; one compiled program per
+        pool (the inference face of fit_batched). MultiLayerNetwork
+        pools only (the DAG runtime has its own output_batched)."""
+        m = self.model
+        if not hasattr(m, "_make_scan_out"):
+            raise ValueError(
+                "ParallelWrapper.output_batched supports "
+                "MultiLayerNetwork pools; use "
+                "ComputationGraph.output_batched for the DAG runtime")
+        if not m._initialized:
+            m.init()
+        xs = jnp.asarray(xs)
+        if xs.shape[1] % self.workers:
+            raise ValueError(
+                f"batch dim {xs.shape[1]} must divide by workers "
+                f"{self.workers} (GSPMD even sharding)")
+        key = ("pw-output-scan", self.mesh, xs.shape)
+        fn = m._jit_cache.get(key)
+        if fn is None:
+            rep = self._replicated()
+            pool = NamedSharding(
+                self.mesh, P(None, "data", *([None] * (xs.ndim - 2))))
+            fn = m._make_scan_out(in_shardings=(rep, rep, pool))
+            m._jit_cache[key] = fn
+        return fn(m.params, m.state, xs)
+
     def _fit_batch(self, x, y, mask=None) -> None:
         m = self.model
         n = x.shape[0]

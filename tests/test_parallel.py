@@ -120,6 +120,18 @@ def test_parallel_fit_batched_computation_graph(devices8, rng):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_parallel_output_batched_matches_single(devices8, rng):
+    """Sharded scanned inference == single-device scanned inference."""
+    xs = rng.randn(3, 16, 6).astype(np.float32)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    single = np.asarray(net.output_batched(xs))
+    pw = ParallelWrapper(net, workers=8)
+    sharded = np.asarray(pw.output_batched(xs))
+    np.testing.assert_allclose(sharded, single, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        pw.output_batched(xs[:, :15])
+
+
 def test_parallel_wrapper_iterator(devices8, rng):
     from deeplearning4j_tpu.datasets.iterators import (BaseDatasetIterator)
     x, y = _data(rng, n=64)
