@@ -77,13 +77,14 @@ def skipgram_neg_impl(syn0: Array, syn1neg: Array, centers: Array,
 skipgram_neg_step = jax.jit(skipgram_neg_impl, donate_argnums=(0, 1))
 
 
-def _epoch_scan(impl, n_carry: int):
+def _epoch_scan(impl, n_carry: int, **jit_kwargs):
     """Build the scanned whole-epoch form of a batched update kernel:
     the first ``n_carry`` arguments are the embedding tables (scan
     carry, donated — they stay in HBM across batches), the rest are
     stacked per-batch operands with a leading [N] axis. The per-batch
     loop stays on device — the same dispatch-amortization move as
-    MultiLayerNetwork.fit_batched. Returns (*tables, losses [N])."""
+    MultiLayerNetwork.fit_batched. Returns (*tables, losses [N]).
+    ``jit_kwargs`` lets mesh callers add in/out shardings."""
     def scan_impl(*args):
         carry, xs = args[:n_carry], args[n_carry:]
 
@@ -94,7 +95,8 @@ def _epoch_scan(impl, n_carry: int):
         carry, losses = jax.lax.scan(body, tuple(carry), tuple(xs))
         return (*carry, losses)
 
-    return jax.jit(scan_impl, donate_argnums=tuple(range(n_carry)))
+    return jax.jit(scan_impl, donate_argnums=tuple(range(n_carry)),
+                   **jit_kwargs)
 
 
 skipgram_neg_scan = _epoch_scan(skipgram_neg_impl, 2)
@@ -115,6 +117,20 @@ def make_sharded_skipgram_step(mesh):
                    in_shardings=(rep, rep, row, row, mat, row),
                    out_shardings=(rep, rep, rep),
                    donate_argnums=(0, 1))
+
+
+def make_sharded_skipgram_scan(mesh):
+    """Scanned whole-chunk form of the sharded skip-gram step: the
+    stacked [N, B] pair batches shard over 'data' on the batch dim and
+    the per-batch loop scans on device with the per-batch allreduce
+    inside the program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(None, "data"))
+    mat = NamedSharding(mesh, P(None, "data", None))
+    return _epoch_scan(skipgram_neg_impl, 2,
+                       in_shardings=(rep, rep, row, row, mat, row),
+                       out_shardings=(rep, rep, rep))
 
 
 def skipgram_hs_impl(syn0: Array, syn1: Array, centers: Array,

@@ -87,7 +87,14 @@ class SequenceVectors(WordVectorsMixin):
         # device mesh with a 'data' axis → mesh-sharded pair batches (the
         # distributed Word2Vec mode; see make_sharded_skipgram_step)
         self.mesh = mesh
-        self._sharded_step = None
+        # sharded step/scan built eagerly (jit wrapping is lazy; nothing
+        # compiles until first call)
+        if mesh is not None:
+            self._sharded_step = learning.make_sharded_skipgram_step(mesh)
+            self._sharded_scan = learning.make_sharded_skipgram_scan(mesh)
+        else:
+            self._sharded_step = None
+            self._sharded_scan = None
         if mesh is not None and self.algorithm != "skipgram":
             raise ValueError("mesh-distributed training currently covers "
                              "the skipgram algorithm")
@@ -208,9 +215,11 @@ class SequenceVectors(WordVectorsMixin):
             alpha0 = self.learning_rate
             n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
             total_steps = total_epochs * n_batches
-            scannable = (self.scan_epochs and self.mesh is None
-                         and self.algorithm == "skipgram"
-                         and (self.use_hs or self.negative > 0))
+            # scanned when there's something to train (hs or neg) and
+            # the mode has a scan kernel (mesh covers neg only)
+            scannable = (self.scan_epochs and self.algorithm == "skipgram"
+                         and (self.use_hs or self.negative > 0)
+                         and (self.mesh is None or not self.use_hs))
             if scannable:
                 # whole-epoch scanned program (one dispatch per epoch)
                 step_no = self._fit_epoch_scanned(
@@ -400,7 +409,9 @@ class SequenceVectors(WordVectorsMixin):
                     jnp.asarray(cmask), jnp.asarray(lr_vec))
             else:
                 negs = self._stage_negatives(nb, nb_pad)
-                lt.syn0, lt.syn1neg, _ = learning.skipgram_neg_scan(
+                scan_fn = (self._sharded_scan if self.mesh is not None
+                           else learning.skipgram_neg_scan)
+                lt.syn0, lt.syn1neg, _ = scan_fn(
                     lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
                     jnp.asarray(contexts_p), jnp.asarray(negs),
                     jnp.asarray(lr_vec))
@@ -441,9 +452,6 @@ class SequenceVectors(WordVectorsMixin):
                 jnp.asarray(lr_vec))
             return
         if self.mesh is not None:
-            if self._sharded_step is None:
-                self._sharded_step = learning.make_sharded_skipgram_step(
-                    self.mesh)
             step = self._sharded_step
         else:
             step = learning.skipgram_neg_step
