@@ -315,6 +315,22 @@ def _glove_scan_impl(w_main, w_ctx, b_main, b_ctx, rows, cols, xij, lr,
 glove_scan = jax.jit(_glove_scan_impl, donate_argnums=(0, 1, 2, 3))
 
 
+def make_sharded_glove_scan(mesh):
+    """Data-parallel GloVe (the reference's distributed GloVe role,
+    spark/dl4j-spark-nlp GlovePerformer): co-occurrence pair batches
+    shard over 'data', embedding/bias tables stay replicated, GSPMD
+    allreduces the per-shard scatter-add deltas inside the scanned
+    program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(_glove_scan_impl,
+                   in_shardings=(rep, rep, rep, rep, row, row, row, row,
+                                 None, None),
+                   out_shardings=(rep,) * 5,
+                   donate_argnums=(0, 1, 2, 3))
+
+
 @jax.jit
 def dbow_infer_step(doc_vec: Array, syn1neg: Array, targets: Array,
                     negatives: Array, lr: Array) -> Tuple[Array, Array]:

@@ -88,7 +88,8 @@ class SequenceVectors(WordVectorsMixin):
         # distributed Word2Vec mode; see make_sharded_skipgram_step)
         self.mesh = mesh
         # sharded step/scan built eagerly (jit wrapping is lazy; nothing
-        # compiles until first call)
+        # compiles until first call); _sharded_fns() rebuilds on demand
+        # if a mesh is assigned after construction
         if mesh is not None:
             self._sharded_step = learning.make_sharded_skipgram_step(mesh)
             self._sharded_scan = learning.make_sharded_skipgram_scan(mesh)
@@ -98,9 +99,23 @@ class SequenceVectors(WordVectorsMixin):
         if mesh is not None and self.algorithm != "skipgram":
             raise ValueError("mesh-distributed training currently covers "
                              "the skipgram algorithm")
+        if mesh is not None and self.use_hs:
+            raise ValueError("mesh-distributed training currently covers "
+                             "skipgram with negative sampling, not "
+                             "hierarchical softmax")
         self.vocab: Optional[AbstractCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.default_rng(seed)
+
+    def _sharded_fns(self):
+        """(step, scan) for the current mesh — rebuilt on demand when a
+        mesh was assigned after construction."""
+        if self._sharded_step is None:
+            self._sharded_step = learning.make_sharded_skipgram_step(
+                self.mesh)
+            self._sharded_scan = learning.make_sharded_skipgram_scan(
+                self.mesh)
+        return self._sharded_step, self._sharded_scan
 
     # -- corpus access (subclasses override) -------------------------------
     def _sequences(self) -> Iterable[List[str]]:
@@ -409,7 +424,8 @@ class SequenceVectors(WordVectorsMixin):
                     jnp.asarray(cmask), jnp.asarray(lr_vec))
             else:
                 negs = self._stage_negatives(nb, nb_pad)
-                scan_fn = (self._sharded_scan if self.mesh is not None
+                scan_fn = (self._sharded_fns()[1]
+                           if self.mesh is not None
                            else learning.skipgram_neg_scan)
                 lt.syn0, lt.syn1neg, _ = scan_fn(
                     lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
@@ -452,7 +468,7 @@ class SequenceVectors(WordVectorsMixin):
                 jnp.asarray(lr_vec))
             return
         if self.mesh is not None:
-            step = self._sharded_step
+            step = self._sharded_fns()[0]
         else:
             step = learning.skipgram_neg_step
         lt.syn0, lt.syn1neg, _ = step(

@@ -290,9 +290,14 @@ class Glove(WordVectorsMixin):
                  layer_size: int = 50, window: int = 5, epochs: int = 5,
                  learning_rate: float = 0.05, min_word_frequency: int = 1,
                  x_max: float = 100.0, alpha: float = 0.75,
-                 batch_size: int = 1024, seed: int = 12345):
+                 batch_size: int = 1024, seed: int = 12345, mesh=None):
         if sentence_iterator is None and sentences is not None:
             sentence_iterator = CollectionSentenceIterator(sentences)
+        # mesh with a 'data' axis → pair batches shard over it (the
+        # reference's distributed GloVe, spark-nlp GlovePerformer)
+        self.mesh = mesh
+        self._glove_scan = (learning.make_sharded_glove_scan(mesh)
+                            if mesh is not None else learning.glove_scan)
         self.sentence_iterator = sentence_iterator
         self.tokenizer_factory = tokenizer_factory or \
             DefaultTokenizerFactory()
@@ -389,7 +394,7 @@ class Glove(WordVectorsMixin):
                 lr_vec = np.full(nb_pad * bs, self.learning_rate,
                                  np.float32)
                 lr_vec[n_valid:] = 0.0
-                w_main, w_ctx, b_main, b_ctx, _ = learning.glove_scan(
+                w_main, w_ctx, b_main, b_ctx, _ = self._glove_scan(
                     w_main, w_ctx, b_main, b_ctx,
                     jnp.asarray(stage_chunk(r_a, sl, nb_pad, n_valid, bs)),
                     jnp.asarray(stage_chunk(c_a, sl, nb_pad, n_valid, bs)),

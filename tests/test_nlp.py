@@ -268,6 +268,22 @@ def test_scanned_word2vec_matches_per_batch(mode):
         np.asarray(stepped.lookup_table.syn0), rtol=0, atol=1e-7)
 
 
+def test_distributed_glove_matches_single(devices8):
+    """Mesh-sharded GloVe == single-device GloVe (the spark-nlp
+    GlovePerformer analog, same spark-vs-single proof pattern)."""
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    kw = dict(sentences=_toy_corpus(8), layer_size=16, window=3,
+              epochs=3, seed=13, min_word_frequency=2, batch_size=64,
+              learning_rate=0.05)
+    single = Glove(**kw)
+    single.fit()
+    dist = Glove(mesh=data_parallel_mesh(8), **kw)
+    dist.fit()
+    np.testing.assert_allclose(
+        np.asarray(single.lookup_table.syn0),
+        np.asarray(dist.lookup_table.syn0), rtol=1e-4, atol=1e-5)
+
+
 def test_distributed_word2vec_matches_single(devices8):
     """Mesh-sharded skip-gram must track the single-device trainer
     (the reference's spark-vs-single equivalence pattern, SURVEY §4)."""
