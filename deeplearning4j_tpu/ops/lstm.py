@@ -207,6 +207,19 @@ def fused_lstm_available(x: Array, hdim: int, mask, gate_activation: str,
     bsz = x.shape[0]
     if hdim % 128 != 0 or bsz % 8 != 0:
         return False
+    # VMEM budget: the kernel pins the RW block [H, 4H], the step's x
+    # block [B, 4H], f32 gates [B, 4H], and h/c [B, H] in VMEM, and the
+    # autodiff pass roughly 2.5x's the footprint. Estimate and reject
+    # what would overflow the 16MB scoped-vmem limit at compile time
+    # (calibrated on v5e: B=512,H=256 bf16 fits, B=768,H=256 does not) —
+    # oversize configs take the lax.scan path instead of crashing
+    # compilation.
+    itemsize = jnp.dtype(x.dtype).itemsize
+    vmem_est = (4 * hdim * hdim * itemsize          # RW block
+                + bsz * 4 * hdim * (4 + itemsize)   # f32 gates + x block
+                + 2 * bsz * hdim * 4)               # h/c carries
+    if vmem_est > 6_400_000:
+        return False
     if env == "interpret":
         return True
     return jax.default_backend() == "tpu"
