@@ -3,6 +3,7 @@ the multi-chip sharding suite — runs without TPU hardware (the 'fake backend'
 CI strategy, SURVEY.md §4: the reference's test-nd4j-native profile analog).
 """
 import os
+import sys
 
 # The environment pre-sets JAX_PLATFORMS=axon (the tunneled TPU backend) and a
 # sitecustomize module imports jax + registers the axon PJRT plugin at
@@ -10,17 +11,15 @@ import os
 # late; tests must (a) drop the axon backend factory so jax never dials the
 # TPU tunnel, and (b) override the already-read platform config. Tests must
 # never claim the single TPU tunnel — it hangs the suite waiting on a grant.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# The one canonical implementation of that recipe lives next to the driver
+# entry point (which needs it for the same reason the suite does).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
+
+_force_virtual_cpu_mesh(8)
 
 import jax  # noqa: E402
-
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
