@@ -61,13 +61,33 @@ def main() -> None:
     jax.block_until_ready(scores)
 
     # Best of REPS: chip time-sharing can inflate the tail; the max is
-    # the honest device-throughput estimate.
+    # the honest device-throughput estimate. The timed region ends with
+    # a forced HOST READ of the last per-step score — on the axon
+    # backend block_until_ready can return before the program finishes
+    # (round-1 finding, memory: axon-tpu-quirks), so a device->host
+    # transfer is the only trustworthy fence.
     dt = math.inf
+    last_score = float("nan")
     for _ in range(REPS):
         t0 = time.perf_counter()
         scores = net.fit_batched(xs, ys, epochs=EPOCHS)
-        jax.block_until_ready(scores)
+        last_score = float(np.asarray(scores[-1]))
         dt = min(dt, time.perf_counter() - t0)
+    if last_score != last_score:
+        raise RuntimeError("NaN training score in bench run")
+
+    # MFU from XLA's own cost model — un-gameable, needs no reference
+    # estimate (util/flops.py). XLA counts a lax.scan body ONCE
+    # regardless of trip count (verified: 1-step and 15-step pools cost
+    # the same), so cost a single-step program and scale by the step
+    # count explicitly. None on backends with no cost model / unknown
+    # peak (e.g. CPU smoke runs).
+    from deeplearning4j_tpu.util.flops import mfu
+    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1)
+    step_flops = cost.get("flops")
+    flops = (float(step_flops) * POOL_STEPS * EPOCHS
+             if step_flops and step_flops > 0 else None)
+    mfu_val = mfu(flops, dt)
 
     examples_per_sec = BATCH * POOL_STEPS * EPOCHS / dt
     print(json.dumps({
@@ -77,6 +97,9 @@ def main() -> None:
         "vs_baseline": round(examples_per_sec
                              / REFERENCE_CPU_EXAMPLES_PER_SEC, 3),
         "batch": BATCH,
+        "program_tflops": (round(flops / 1e12, 3)
+                           if flops is not None else None),
+        "mfu": round(mfu_val, 4) if mfu_val is not None else None,
     }))
 
 

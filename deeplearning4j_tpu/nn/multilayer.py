@@ -278,9 +278,30 @@ class MultiLayerNetwork:
         T divisible by tbptt_fwd_length; each minibatch scans its time
         chunks with carried RNN state and one update per chunk, so
         scores (and iteration counts) are per CHUNK: [N * T/L * epochs]."""
-        self._validate_fit_batched(epochs, allow_tbptt=True)
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
+        fn, chunks = self._scan_fit_fn(xs, ys, epochs)
+        return self._run_scan_fit(fn, xs, ys, chunks_per_batch=chunks)
+
+    def fit_batched_cost(self, xs, ys, epochs: int = 1) -> dict:
+        """XLA cost analysis ({'flops', 'bytes accessed', ...}) for the
+        exact program `fit_batched(xs, ys, epochs)` runs at these shapes.
+        Lower+compile only — no execution, parameters untouched. Feeds
+        MFU reporting (util/flops.py); the reference's PerformanceListener
+        reports examples/sec only."""
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        fn, _ = self._scan_fit_fn(xs, ys, epochs)
+        from deeplearning4j_tpu.util.flops import cost_analysis
+        base_key = jax.random.PRNGKey(self.conf.training.seed)
+        start = jnp.asarray(self.iteration_count, jnp.int32)
+        return cost_analysis(fn, self.params, self.state,
+                             self.updater_state, start, xs, ys, base_key)
+
+    def _scan_fit_fn(self, xs, ys, epochs: int):
+        """Dispatch + cache for the scanned-fit program; returns
+        (jitted_fn, chunks_per_batch)."""
+        self._validate_fit_batched(epochs, allow_tbptt=True)
         # tbptt needs temporal labels; non-temporal targets fall through
         # to standard BPTT, matching fit()'s dispatch
         use_tbptt = (self.conf.backprop_type == "tbptt" and ys.ndim == 4)
@@ -309,7 +330,7 @@ class MultiLayerNetwork:
             self._jit_cache[cache_key] = fn
         chunks = (xs.shape[2] // self.conf.tbptt_fwd_length
                   if use_tbptt else 1)
-        return self._run_scan_fit(fn, xs, ys, chunks_per_batch=chunks)
+        return fn, chunks
 
     def _validate_fit_batched(self, epochs: int,
                               allow_tbptt: bool = False) -> None:

@@ -27,7 +27,7 @@ def test_dryrun_multichip_subprocess_ambient_env():
         [sys.executable, "-c",
          "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"],
         capture_output=True, text=True, timeout=900, env=env,
-        cwd=__file__.rsplit("/", 2)[0])
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 0, proc.stderr[-2000:]
     # all four composite-parallel configs must report OK
     assert proc.stdout.count("OK") >= 4, proc.stdout
