@@ -272,24 +272,30 @@ def _decode_step_impl(cfg: TransformerConfig, params: Dict[str, Any],
 
 
 @_ft.lru_cache(maxsize=64)
-def _decode_step_jit(cfg: TransformerConfig):
-    return jax.jit(_ft.partial(_decode_step_impl, cfg),
-                   donate_argnums=(2,))
+def _decode_step_jit(cfg: TransformerConfig, donate: bool = True):
+    kwargs = {"donate_argnums": (2,)} if donate else {}
+    return jax.jit(_ft.partial(_decode_step_impl, cfg), **kwargs)
 
 
 def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
-                token: Array, caches: Tuple[Array, Array], pos: Array
+                token: Array, caches: Tuple[Array, Array], pos: Array,
+                donate: bool = True
                 ) -> Tuple[Array, Tuple[Array, Array]]:
     """token [B] int32 at position ``pos`` -> (logits [B, V], caches).
 
     The layer loop is unrolled (static layer indices) so cache updates
     stay single-position dynamic_update_slices on the stacked buffers —
-    and the step runs JITTED with the caches donated, so eager callers
-    (the rnnTimeStep-style streaming loop) get in-place cache updates
-    rather than 2L whole-cache copies. Pass the returned caches to the
-    next call; the previous caches' buffer is reused."""
-    return _decode_step_jit(cfg)(params, jnp.asarray(token),
-                                 caches, jnp.asarray(pos, jnp.int32))
+    and by default the step runs JITTED with the caches DONATED, so
+    eager callers (the rnnTimeStep-style streaming loop) get in-place
+    cache updates rather than 2L whole-cache copies. Donation
+    INVALIDATES the passed-in cache buffers: pass the returned caches
+    to the next call and never reuse the old ones. Branching decode
+    (several continuations from one prefill cache) must call with
+    ``donate=False``, which keeps the input caches intact at the cost
+    of a cache copy per step."""
+    return _decode_step_jit(cfg, donate)(params, jnp.asarray(token),
+                                         caches,
+                                         jnp.asarray(pos, jnp.int32))
 
 
 def prefill(cfg: TransformerConfig, params: Dict[str, Any],

@@ -87,6 +87,14 @@ class SequenceVectors(WordVectorsMixin):
         # device mesh with a 'data' axis → mesh-sharded pair batches (the
         # distributed Word2Vec mode; see make_sharded_skipgram_step)
         self.mesh = mesh
+        # unsupported mesh combinations fail before any construction work
+        if mesh is not None and self.algorithm != "skipgram":
+            raise ValueError("mesh-distributed training currently covers "
+                             "the skipgram algorithm")
+        if mesh is not None and self.use_hs:
+            raise ValueError("mesh-distributed training currently covers "
+                             "skipgram with negative sampling, not "
+                             "hierarchical softmax")
         # sharded step/scan built eagerly (jit wrapping is lazy; nothing
         # compiles until first call); _sharded_fns() rebuilds on demand
         # if a mesh is assigned after construction
@@ -96,13 +104,6 @@ class SequenceVectors(WordVectorsMixin):
         else:
             self._sharded_step = None
             self._sharded_scan = None
-        if mesh is not None and self.algorithm != "skipgram":
-            raise ValueError("mesh-distributed training currently covers "
-                             "the skipgram algorithm")
-        if mesh is not None and self.use_hs:
-            raise ValueError("mesh-distributed training currently covers "
-                             "skipgram with negative sampling, not "
-                             "hierarchical softmax")
         self.vocab: Optional[AbstractCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._rng = np.random.default_rng(seed)

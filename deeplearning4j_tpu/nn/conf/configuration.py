@@ -214,6 +214,19 @@ class MultiLayerConfiguration:
             raise ValueError("JSON does not encode a MultiLayerConfiguration")
         return obj
 
+    def to_yaml(self) -> str:
+        """Reference: MultiLayerConfiguration.java:79 (toYaml)."""
+        from deeplearning4j_tpu.nn.conf.serde import to_yaml
+        return to_yaml(self)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn.conf.serde import from_yaml
+        obj = from_yaml(s)
+        if not isinstance(obj, MultiLayerConfiguration):
+            raise ValueError("YAML does not encode a MultiLayerConfiguration")
+        return obj
+
 
 @register
 @dataclass
@@ -247,6 +260,21 @@ class ComputationGraphConfiguration:
         if not isinstance(obj, ComputationGraphConfiguration):
             raise ValueError(
                 "JSON does not encode a ComputationGraphConfiguration")
+        return obj
+
+    def to_yaml(self) -> str:
+        """Reference: ComputationGraphConfiguration toYaml (same dual
+        format contract as MultiLayerConfiguration.java:79)."""
+        from deeplearning4j_tpu.nn.conf.serde import to_yaml
+        return to_yaml(self)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.nn.conf.serde import from_yaml
+        obj = from_yaml(s)
+        if not isinstance(obj, ComputationGraphConfiguration):
+            raise ValueError(
+                "YAML does not encode a ComputationGraphConfiguration")
         return obj
 
     def topological_order(self) -> List[str]:

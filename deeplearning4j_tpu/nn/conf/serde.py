@@ -66,3 +66,22 @@ def from_dict(data: Any) -> Any:
     if isinstance(data, list):
         return [from_dict(v) for v in data]
     return data
+
+
+def to_yaml(obj: Any) -> str:
+    """YAML face of the registry — the reference's config DSL is
+    dual-format (reference: MultiLayerConfiguration.java:79 `toYaml` /
+    :108-126 both formats share one object mapper pipeline); here both
+    formats share to_dict/from_dict, so the @class-tagged document is
+    identical modulo syntax."""
+    import yaml
+
+    return yaml.safe_dump(to_dict(obj), sort_keys=False,
+                          default_flow_style=False)
+
+
+def from_yaml(s: str) -> Any:
+    """Inverse of :func:`to_yaml`."""
+    import yaml
+
+    return from_dict(yaml.safe_load(s))

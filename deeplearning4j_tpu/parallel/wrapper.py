@@ -111,7 +111,7 @@ class ParallelWrapper:
             xs = jnp.asarray(xs)
             ys = jnp.asarray(ys)
         tree = jax.tree_util.tree_map
-        for leaf in jax.tree_util.tree_leaves(xs):
+        for leaf in jax.tree_util.tree_leaves((xs, ys)):
             if leaf.shape[1] % self.workers:
                 raise ValueError(
                     f"batch dim {leaf.shape[1]} must divide by workers "

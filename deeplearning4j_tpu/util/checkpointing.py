@@ -197,11 +197,16 @@ class CheckpointManager:
         out = self._read_payload({"tree": template}, step)["tree"]
         if not self.use_orbax:
             # npz fallback loads host arrays; re-place onto the
-            # template's shardings
-            out = jax.tree_util.tree_map(
-                lambda t, v: (jax.device_put(v, t.sharding)
-                              if isinstance(t, jax.Array) else v),
-                template, out)
+            # template's shardings. Abstract templates (jax.eval_shape
+            # ShapeDtypeStructs carrying .sharding — the orbax path
+            # accepts them) are honored the same way as concrete arrays.
+            def _replace(t, v):
+                sharding = getattr(t, "sharding", None)
+                if isinstance(t, jax.Array) or sharding is not None:
+                    return jax.device_put(v, sharding)
+                return v
+
+            out = jax.tree_util.tree_map(_replace, template, out)
         return out
 
 

@@ -167,6 +167,28 @@ def test_kv_cache_decode_matches_full_forward():
                                atol=2e-4)
 
 
+def test_decode_step_branching_without_donation():
+    """donate=False keeps the input caches valid — several continuations
+    can branch from one prefill cache (the advisor's branching-decode
+    scenario; the default donating path invalidates its input)."""
+    from deeplearning4j_tpu.models.transformer import (decode_step,
+                                                       init_cache, prefill)
+    cfg = TransformerConfig(vocab_size=50, d_model=32, n_heads=4,
+                            n_layers=2, max_len=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 50)
+    _, caches = prefill(cfg, params, prompt)
+    pos = jnp.asarray(6, jnp.int32)
+    tok_a = jnp.asarray([1, 2], jnp.int32)
+    tok_b = jnp.asarray([3, 4], jnp.int32)
+    la, _ = decode_step(cfg, params, tok_a, caches, pos, donate=False)
+    # caches must still be alive and reusable for a second branch
+    lb, _ = decode_step(cfg, params, tok_b, caches, pos, donate=False)
+    assert np.isfinite(np.asarray(la)).all()
+    assert np.isfinite(np.asarray(lb)).all()
+    assert not np.allclose(np.asarray(la), np.asarray(lb))
+
+
 def test_generate_greedy_and_sampled():
     from deeplearning4j_tpu.models.transformer import TransformerLM
     cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,

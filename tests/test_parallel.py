@@ -84,6 +84,10 @@ def test_parallel_fit_batched_matches_single_device(devices8, rng):
     assert sharded.iteration_count == 2 * n_steps
     with pytest.raises(ValueError):
         pw.fit_batched(xs[:, :15], ys[:, :15])  # 15 % 8 != 0
+    with pytest.raises(ValueError):
+        # label-side mismatch must fail the same clean way (advisor r1:
+        # only xs leaves were checked; ys surfaced as a GSPMD error)
+        pw.fit_batched(xs, ys[:, :15])
 
 
 def test_parallel_fit_batched_computation_graph(devices8, rng):
