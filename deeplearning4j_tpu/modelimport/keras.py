@@ -31,6 +31,8 @@ from deeplearning4j_tpu.nn.conf.configuration import (
     NeuralNetConfiguration, MultiLayerConfiguration,
     ComputationGraphConfiguration)
 from deeplearning4j_tpu.nn.conf import inputs as it
+from deeplearning4j_tpu.nn.conf.preprocessors import \
+    CnnToFeedForwardPreProcessor
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
                                                       EmbeddingLayer)
@@ -304,25 +306,46 @@ def _short(weight_name: str) -> str:
     return base.split(":")[0]
 
 
+def _find(short: Dict[str, np.ndarray], *names: str):
+    """Resolve a canonical weight name against both modern
+    ('kernel', 'W') and Keras-1 flat ('dense_1_W') naming: exact key
+    first, then '<layer>_<name>' suffix match."""
+    for n in names:
+        if n in short:
+            return short[n]
+    for n in names:
+        for k, v in short.items():
+            if k.endswith("_" + n):
+                return v
+    return None
+
+
 def convert_weights(framework_layer: Layer, kweights: Dict[str, np.ndarray],
-                    dim_ordering: str = "tf"
+                    dim_ordering: str = "tf", keras_major: int = 2
                     ) -> Tuple[Dict[str, np.ndarray],
                                Dict[str, np.ndarray]]:
     """Map a Keras layer's weight dict onto (params, state) for the
     corresponding framework layer. Handles Keras-1 per-gate LSTM weights,
-    Theano OIHW kernels, and BN running stats."""
+    Theano OIHW kernels, and BN running stats.
+
+    Kernel layout depends on BOTH the ordering and the Keras era
+    (reference: KerasLayer.java keras_version dispatch +
+    KerasConvolution weight layout handling): Keras-1 'th' stored
+    Theano OIHW kernels, but Keras-2 ``channels_first`` models still
+    store HWIO — for those only the activation layout differs, and
+    transposing the kernel would corrupt it."""
     short = {_short(k): v for k, v in kweights.items()}
     params: Dict[str, np.ndarray] = {}
     state: Dict[str, np.ndarray] = {}
 
     if isinstance(framework_layer, BatchNormalization):
-        params["gamma"] = short.get("gamma")
-        params["beta"] = short.get("beta")
-        state["mean"] = short.get("moving_mean", short.get("running_mean"))
-        var = short.get("moving_variance")
-        if var is None and "running_std" in short:
+        params["gamma"] = _find(short, "gamma")
+        params["beta"] = _find(short, "beta")
+        state["mean"] = _find(short, "moving_mean", "running_mean")
+        var = _find(short, "moving_variance")
+        if var is None:
             # Keras 1 stored std for some backends; DL4J treats it as var
-            var = short["running_std"]
+            var = _find(short, "running_std")
         state["var"] = var
         return ({k: v for k, v in params.items() if v is not None},
                 {k: v for k, v in state.items() if v is not None})
@@ -350,30 +373,30 @@ def convert_weights(framework_layer: Layer, kweights: Dict[str, np.ndarray],
         return params, state
 
     if isinstance(framework_layer, (ConvolutionLayer,)):
-        w = short.get("kernel", short.get("W"))
+        w = _find(short, "kernel", "W")
         if w is None:
             raise InvalidKerasConfigurationException(
                 f"Conv weights missing; have {list(short)}")
-        if w.ndim == 4 and dim_ordering == "th":
+        if w.ndim == 4 and dim_ordering == "th" and keras_major < 2:
             w = np.transpose(w, (2, 3, 1, 0))  # OIHW → HWIO
         if isinstance(framework_layer, Convolution1DLayer) and w.ndim == 3:
             # Keras Conv1D kernel [k, in, out] → our [1, k, in, out]
             w = w[None, :, :, :]
         params["W"] = w
-        b = short.get("bias", short.get("b"))
+        b = _find(short, "bias", "b")
         if b is not None:
             params["b"] = b
         return params, state
 
     if isinstance(framework_layer, EmbeddingLayer):
-        emb = short.get("embeddings", short.get("W"))
+        emb = _find(short, "embeddings", "W")
         params["W"] = emb
         params["b"] = np.zeros(emb.shape[1], emb.dtype)
         return params, state
 
     if isinstance(framework_layer, DenseLayer):  # includes OutputLayer
-        params["W"] = short.get("kernel", short.get("W"))
-        b = short.get("bias", short.get("b"))
+        params["W"] = _find(short, "kernel", "W")
+        b = _find(short, "bias", "b")
         if b is not None:
             params["b"] = b
         return params, state
@@ -424,6 +447,10 @@ class KerasSequentialModel:
         self.keras_names: List[str] = []
         self.dim_ordering = "tf"
         self.input_type = None
+        # dense layers whose preceding (dropped) Flatten declared
+        # channels_first: Keras-2's Flatten already transposed to HWC
+        # order there, so the th dense-row permutation must NOT apply
+        self.hwc_flatten_dense: set = set()
         self._build()
 
     def _loss(self) -> Optional[str]:
@@ -438,21 +465,43 @@ class KerasSequentialModel:
         return map_loss(loss) if loss else None
 
     def _build(self) -> None:
+        # dim ordering first, from ANY layer that declares it: the input
+        # shape is usually on an InputLayer that precedes the conv layer
+        # carrying data_format, and NCHW shapes must not be read as NHWC
+        # (reference: KerasModel resolves dimOrdering across all layers
+        # before building input types)
+        for lc in self.layer_configs:
+            cfg = _cfg(lc)
+            if "dim_ordering" in cfg or "data_format" in cfg:
+                self.dim_ordering = _dim_ordering(cfg)
+                break
+        pending_hwc_flatten = False
         for lc in self.layer_configs:
             cname = lc["class_name"]
             cfg = _cfg(lc)
             shape = cfg.get("batch_input_shape")
-            if "dim_ordering" in cfg or "data_format" in cfg:
-                self.dim_ordering = _dim_ordering(cfg)
             if shape is not None and self.input_type is None:
                 self.input_type = _input_type_from_shape(
                     shape, self.dim_ordering)
             mapped = map_keras_layer(cname, lc)
             if mapped is None:
+                if cname == "Flatten" and ("data_format" in cfg
+                                           or "dim_ordering" in cfg) \
+                        and _dim_ordering(cfg) == "th":
+                    pending_hwc_flatten = True
                 continue
+            name = (cfg.get("name") or lc.get("name")
+                    or f"layer_{len(self.layers)}")
+            if pending_hwc_flatten:
+                if isinstance(mapped, DenseLayer):
+                    self.hwc_flatten_dense.add(name)
+                    pending_hwc_flatten = False
+                elif isinstance(mapped, (DropoutLayer, ActivationLayer)):
+                    pass  # order-preserving: Dense may still follow
+                else:
+                    pending_hwc_flatten = False
             self.layers.append(mapped)
-            self.keras_names.append(cfg.get("name") or lc.get("name")
-                                    or f"layer_{len(self.layers)}")
+            self.keras_names.append(name)
         loss = self._loss()
         if loss and self.layers and \
                 type(self.layers[-1]) in (DenseLayer,):
@@ -494,6 +543,7 @@ class KerasModel:
         self.builder = NeuralNetConfiguration(seed=12345).graph_builder()
         self.keras_layer_names: List[str] = []
         self._skipped: Dict[str, str] = {}  # skipped layer → its input
+        self.hwc_flatten_dense: set = set()
         self._build()
 
     @staticmethod
@@ -515,13 +565,20 @@ class KerasModel:
 
     def _build(self) -> None:
         input_types = {}
+        # dim ordering first, from any layer declaring it (input shapes
+        # usually precede the conv layer carrying data_format)
+        for lc in self.layer_configs:
+            cfg = _cfg(lc)
+            if "dim_ordering" in cfg or "data_format" in cfg:
+                self.dim_ordering = _dim_ordering(cfg)
+                break
+        hwc_flattens: set = set()
         for lc in self.layer_configs:
             cname = lc["class_name"]
             cfg = _cfg(lc)
             name = lc.get("name") or cfg.get("name")
-            if "dim_ordering" in cfg or "data_format" in cfg:
-                self.dim_ordering = _dim_ordering(cfg)
-            inbound = [self._resolve(n) for n in self._inbound(lc)]
+            raw_inbound = self._inbound(lc)
+            inbound = [self._resolve(n) for n in raw_inbound]
             if cname == "InputLayer":
                 shape = cfg.get("batch_input_shape")
                 if shape is not None:
@@ -536,7 +593,18 @@ class KerasModel:
             if mapped is None:
                 # structural layer: route around it
                 self._skipped[name] = inbound[0]
+                if cname == "Flatten" and ("data_format" in cfg
+                                           or "dim_ordering" in cfg) \
+                        and _dim_ordering(cfg) == "th":
+                    hwc_flattens.add(name)
                 continue
+            hwc_upstream = any(n in hwc_flattens for n in raw_inbound)
+            if isinstance(mapped, DenseLayer) and hwc_upstream:
+                self.hwc_flatten_dense.add(name)
+            elif isinstance(mapped, (DropoutLayer, ActivationLayer)) \
+                    and hwc_upstream:
+                # order-preserving: downstream Dense is still HWC-ordered
+                hwc_flattens.add(name)
             self.builder.add_layer(name, mapped, *inbound)
             self.keras_layer_names.append(name)
         self.builder.add_inputs(*self.input_names)
@@ -602,13 +670,58 @@ def _find_layer_group(root, keras_name: str):
     return None
 
 
+def keras_major_version(archive: Hdf5Archive) -> int:
+    """1 or 2 from the file's keras_version attribute (reference:
+    KerasModelUtils.determineKerasMajorVersion). Keras 2 always writes
+    the attribute; a file without one is Keras-1-era."""
+    v = archive.read_attribute_as_string("keras_version")
+    if not v:
+        return 1
+    try:
+        return int(str(v).split(".")[0])
+    except ValueError:
+        return 2
+
+
+def _chw_to_hwc_rows(W: np.ndarray, h: int, w: int, c: int) -> np.ndarray:
+    """Permute Dense rows from Keras channels-first flatten order (C,H,W)
+    to this framework's NHWC flatten order (H,W,C). The reference is
+    NCHW-native and permutes for 'tf' models instead (its
+    CnnToFeedForwardPreProcessor carries the Keras dim ordering); here
+    the mirror image applies to 'th'/channels_first models."""
+    hh, ww, cc = np.meshgrid(np.arange(h), np.arange(w), np.arange(c),
+                             indexing="ij")
+    perm = (cc * h * w + hh * w + ww).reshape(-1)
+    return W[perm]
+
+
+def _dense_flatten_fix(net, layer_index: int, pname: str,
+                       params: Dict[str, np.ndarray]) -> None:
+    """Apply the th-flatten row permutation when this Dense consumes a
+    flattened conv map (detected via the auto-inserted cnn→ff
+    preprocessor: index-keyed on a MultiLayerConfiguration, vertex-name-
+    keyed on a ComputationGraph)."""
+    pre = getattr(net.conf, "input_preprocessors", {}).get(str(layer_index))
+    if pre is None:
+        pre = getattr(net, "_preprocessors", {}).get(pname)
+    if isinstance(pre, CnnToFeedForwardPreProcessor) and "W" in params:
+        h, w, c = pre.height, pre.width, pre.channels
+        if params["W"].shape[0] == h * w * c:
+            params["W"] = _chw_to_hwc_rows(params["W"], h, w, c)
+
+
 def copy_weights_to_network(archive: Hdf5Archive, net,
                             layers: List[Layer], keras_names: List[str],
-                            dim_ordering: str = "tf") -> None:
+                            dim_ordering: str = "tf",
+                            hwc_flatten_dense: frozenset = frozenset()
+                            ) -> None:
     """Copy HDF5 weights into an initialized network by Keras layer name
-    (reference: KerasModel.copyWeightsToModel / helpers.KerasModelUtils)."""
+    (reference: KerasModel.copyWeightsToModel / helpers.KerasModelUtils).
+    ``hwc_flatten_dense``: dense layers Keras already reordered to HWC
+    via Flatten(channels_first) — exempt from the th row permutation."""
+    keras_major = keras_major_version(archive)
     root = _weight_root(archive)
-    for layer, kname in zip(layers, keras_names):
+    for i, (layer, kname) in enumerate(zip(layers, keras_names)):
         group = _find_layer_group(root, kname)
         if group is None:
             if layer.init_params.__func__ is Layer.init_params:
@@ -618,7 +731,11 @@ def copy_weights_to_network(archive: Hdf5Archive, net,
         kweights = archive.layer_weights(group)
         if not kweights:
             continue
-        params, state = convert_weights(layer, kweights, dim_ordering)
+        params, state = convert_weights(layer, kweights, dim_ordering,
+                                        keras_major)
+        if dim_ordering == "th" and isinstance(layer, DenseLayer) \
+                and kname not in hwc_flatten_dense:
+            _dense_flatten_fix(net, i, layer.name or kname, params)
         pname = layer.name or kname
         tgt = net.params.get(pname)
         if tgt is None:
@@ -652,7 +769,8 @@ def import_keras_sequential_model_and_weights(
         conf = km.multi_layer_configuration()
         net = MultiLayerNetwork(conf).init()
         copy_weights_to_network(archive, net, net.layers, km.keras_names,
-                                km.dim_ordering)
+                                km.dim_ordering,
+                                frozenset(km.hwc_flatten_dense))
         return net
 
 
@@ -673,7 +791,8 @@ def import_keras_model_and_weights(path: str,
         net = ComputationGraph(conf).init()
         layers = [conf.vertices[n].vertex for n in km.keras_layer_names]
         copy_weights_to_network(archive, net, layers, km.keras_layer_names,
-                                km.dim_ordering)
+                                km.dim_ordering,
+                                frozenset(km.hwc_flatten_dense))
         return net
 
 
@@ -713,12 +832,14 @@ def import_keras_model_and_weights_separate(json_path: str, h5_path: str):
             km = KerasSequentialModel(mc)
             net = MultiLayerNetwork(km.multi_layer_configuration()).init()
             copy_weights_to_network(archive, net, net.layers,
-                                    km.keras_names, km.dim_ordering)
+                                    km.keras_names, km.dim_ordering,
+                                    frozenset(km.hwc_flatten_dense))
             return net
         kg = KerasModel(mc)
         conf = kg.computation_graph_configuration()
         netg = ComputationGraph(conf).init()
         layers = [conf.vertices[n].vertex for n in kg.keras_layer_names]
         copy_weights_to_network(archive, netg, layers, kg.keras_layer_names,
-                                kg.dim_ordering)
+                                kg.dim_ordering,
+                                frozenset(kg.hwc_flatten_dense))
         return netg

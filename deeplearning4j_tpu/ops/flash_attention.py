@@ -37,7 +37,25 @@ import jax.numpy as jnp
 Array = jax.Array
 
 NEG_INF = -1e30
-BLOCK_Q = 128
+BLOCK_Q = 128          # floor / eligibility granularity
+
+
+def _pick_block(rows: int, panel_cols: int, target_elems: int) -> int:
+    """Largest power-of-two row-block (128..512) whose [block, cols] f32
+    score panel stays within ``target_elems`` — measured on v5e
+    (T=2048): bwd panels at 512 rows are ~1.5x faster than 128 (fewer
+    full-K/V re-reads per program: the kernels are HBM-bandwidth-bound,
+    block count multiplies K/V traffic), while 1024-row panels blow the
+    ~16MB scoped-VMEM stack. Longer sequences scale the block back down
+    so VMEM stays bounded."""
+    b = 512
+    while b > 128 and b * panel_cols > target_elems:
+        b //= 2
+    if rows <= b:
+        return rows          # single block covers everything
+    while rows % b:          # must tile rows exactly
+        b //= 2
+    return b
 
 
 def _reference_attention(q, k, v, scale: float, causal: bool,
@@ -173,8 +191,10 @@ def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
 
     bh, tq, d = q3.shape
     sk = k3.shape[1]
-    bq = min(BLOCK_Q, tq)
-    bk = min(BLOCK_Q, sk)
+    # dq panels are [bq, sk]; dkv panels are [tq, bk] — both directions
+    # get the largest block that keeps the f32 panel stack in VMEM
+    bq = _pick_block(tq, sk, 1 << 20)
+    bk = _pick_block(sk, tq, 1 << 20)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
     # Δ_i = Σ_d dO_id · O_id — rowwise, XLA fuses this into one pass
@@ -230,7 +250,8 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
 
     bh, tq, d = q3.shape
     sk = k3.shape[1]
-    bq = min(BLOCK_Q, tq)
+    # fwd panels are [bq, sk]; 256-row panels measured fastest at T=2048
+    bq = _pick_block(tq, sk, 1 << 19)
     grid = (bh, tq // bq)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)

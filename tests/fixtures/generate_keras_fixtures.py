@@ -1,0 +1,198 @@
+"""Generate INDEPENDENT Keras import fixtures with real tf_keras.
+
+VERDICT r1 #4: round-1 Keras-import goldens were self-authored (written
+with h5py and verified against NumPy by the same author) — a systematic
+layout misunderstanding would be invisible. These fixtures are produced
+by GENUINE Keras (tf_keras, the Keras-2 lineage TensorFlow ships): the
+HDF5 files come from `model.save(...)` and the golden outputs from
+`model.predict(...)` — no code from this repository touches either.
+
+Run offline (TF is not a runtime dependency of the framework):
+    python tests/fixtures/generate_keras_fixtures.py
+and check in the resulting .h5/.npz pairs (a few hundred KB).
+
+The Keras-1 Theano fixture cannot be produced by modern Keras; its
+model_config is hand-authored to the documented Keras-1 disk layout,
+but its GOLDEN still comes from real Keras: a tf_keras channels_first
+model is built with the same (OIHW→HWIO transposed) weights and
+predicts the golden — so our importer's th path is checked against
+Keras's own arithmetic, not ours.
+"""
+import json
+import os
+
+import numpy as np
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import tf_keras as keras  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RNG = np.random.default_rng(20260730)
+
+
+def _save(model, name, x):
+    """Save model h5 + (input, keras-predicted golden) npz."""
+    h5 = os.path.join(HERE, f"{name}.h5")
+    model.save(h5, save_format="h5")
+    y = model.predict(x, verbose=0)
+    np.savez(os.path.join(HERE, f"{name}_golden.npz"), x=x, y=y)
+    print(f"{name}: x{ x.shape } -> y{ y.shape }")
+
+
+def mlp():
+    m = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        keras.layers.Dense(12, activation="tanh"),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    _save(m, "real_mlp", RNG.normal(size=(7, 8)).astype(np.float32))
+
+
+def cnn_tf():
+    m = keras.Sequential([
+        keras.layers.Conv2D(6, (3, 3), activation="relu",
+                            input_shape=(12, 12, 2)),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Conv2D(4, (3, 3), padding="same", activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(9, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="adam")
+    _save(m, "real_cnn", RNG.normal(size=(5, 12, 12, 2)).astype(np.float32))
+
+
+def cnn_channels_first():
+    """Keras-2 channels_first: NCHW activations, HWIO kernels — the
+    combination that must NOT get a kernel transpose."""
+    m = keras.Sequential([
+        keras.layers.Conv2D(5, (3, 3), activation="relu",
+                            data_format="channels_first",
+                            input_shape=(2, 10, 10)),
+        keras.layers.MaxPooling2D((2, 2), data_format="channels_first"),
+        # the realistic Keras-2 pairing: Flatten(channels_first)
+        # transposes to HWC before flattening (weight portability), so
+        # the dense weights are HWC-ordered — NO import permutation.
+        # The Dropout in between checks the exemption survives
+        # order-preserving layers (inactive at inference).
+        keras.layers.Flatten(data_format="channels_first"),
+        keras.layers.Dropout(0.25),
+        keras.layers.Dense(7, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    _save(m, "real_cnn_chfirst",
+          RNG.normal(size=(4, 2, 10, 10)).astype(np.float32))
+
+
+def lstm():
+    m = keras.Sequential([
+        keras.layers.LSTM(10, return_sequences=True,
+                          input_shape=(6, 4)),
+        keras.layers.LSTM(8, return_sequences=True),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="rmsprop")
+    _save(m, "real_lstm", RNG.normal(size=(3, 6, 4)).astype(np.float32))
+
+
+def functional_merge():
+    a = keras.Input(shape=(6,), name="in_a")
+    b = keras.Input(shape=(6,), name="in_b")
+    ha = keras.layers.Dense(10, activation="relu", name="da")(a)
+    hb = keras.layers.Dense(10, activation="relu", name="db")(b)
+    merged = keras.layers.Concatenate(name="cat")([ha, hb])
+    added = keras.layers.Add(name="add")([ha, hb])
+    m1 = keras.layers.Dense(4, activation="linear", name="head1")(merged)
+    m2 = keras.layers.Dense(4, activation="linear", name="head2")(added)
+    out = keras.layers.Add(name="out")([m1, m2])
+    m = keras.Model([a, b], out)
+    m.compile(loss="mse", optimizer="sgd")
+    h5 = os.path.join(HERE, "real_functional.h5")
+    m.save(h5, save_format="h5")
+    xa = RNG.normal(size=(6, 6)).astype(np.float32)
+    xb = RNG.normal(size=(6, 6)).astype(np.float32)
+    y = m.predict([xa, xb], verbose=0)
+    np.savez(os.path.join(HERE, "real_functional_golden.npz"),
+             xa=xa, xb=xb, y=y)
+    print(f"real_functional: -> y{y.shape}")
+
+
+def keras1_theano_th():
+    """Hand-authored Keras-1 'th' HDF5 (documented layout: list-form
+    Sequential config, nb_filter/nb_row/nb_col/dim_ordering fields,
+    <name>_W/<name>_b weight names, OIHW kernels, NO keras_version
+    attribute — pre-1.0.8 files did not write one); golden predicted by
+    real Keras via the equivalent channels_first model."""
+    import h5py
+
+    kh = kw = 3
+    cin, cout = 2, 4
+    W_oihw = RNG.normal(size=(cout, cin, kh, kw)).astype(np.float32) * 0.4
+    b1 = RNG.normal(size=(cout,)).astype(np.float32) * 0.1
+    dense_in = cout * 6 * 6
+    W2 = RNG.normal(size=(dense_in, 5)).astype(np.float32) * 0.2
+    b2 = RNG.normal(size=(5,)).astype(np.float32) * 0.1
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Convolution2D", "config": {
+                "name": "convolution2d_1", "nb_filter": cout,
+                "nb_row": kh, "nb_col": kw, "subsample": [1, 1],
+                "border_mode": "valid", "dim_ordering": "th",
+                "activation": "relu",
+                "batch_input_shape": [None, cin, 8, 8]}},
+            {"class_name": "Flatten",
+             "config": {"name": "flatten_1"}},
+            {"class_name": "Dense", "config": {
+                "name": "dense_1", "output_dim": 5,
+                "activation": "softmax"}},
+        ],
+    }
+    h5path = os.path.join(HERE, "real_keras1_th.h5")
+    with h5py.File(h5path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        f.attrs["backend"] = "theano"
+        g = f.create_group("model_weights")
+        gc = g.create_group("convolution2d_1")
+        gc.attrs["weight_names"] = np.array(
+            [b"convolution2d_1_W", b"convolution2d_1_b"])
+        gc.create_dataset("convolution2d_1_W", data=W_oihw)
+        gc.create_dataset("convolution2d_1_b", data=b1)
+        gd = g.create_group("dense_1")
+        gd.attrs["weight_names"] = np.array(
+            [b"dense_1_W", b"dense_1_b"])
+        gd.create_dataset("dense_1_W", data=W2)
+        gd.create_dataset("dense_1_b", data=b2)
+
+    # golden from REAL keras: channels_first model, HWIO kernel. Keras-1
+    # th flattened the raw NCHW tensor (C,H,W row-major) — tf_keras's
+    # DEFAULT Flatten reshapes raw (no transpose; only
+    # data_format="channels_first" triggers the to-HWC transpose), so a
+    # plain Flatten reproduces Keras-1 ordering and W2 applies verbatim
+    m = keras.Sequential([
+        keras.layers.Conv2D(cout, (kh, kw), activation="relu",
+                            data_format="channels_first",
+                            input_shape=(cin, 8, 8)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    W_hwio = np.transpose(W_oihw, (2, 3, 1, 0))
+    m.layers[0].set_weights([W_hwio, b1])
+    m.layers[2].set_weights([W2, b2])
+    x_nchw = RNG.normal(size=(4, cin, 8, 8)).astype(np.float32)
+    y = m.predict(x_nchw, verbose=0)
+    np.savez(os.path.join(HERE, "real_keras1_th_golden.npz"),
+             x=x_nchw, y=y)
+    print(f"real_keras1_th: x{x_nchw.shape} -> y{y.shape}")
+
+
+if __name__ == "__main__":
+    mlp()
+    cnn_tf()
+    cnn_channels_first()
+    lstm()
+    functional_merge()
+    keras1_theano_th()
