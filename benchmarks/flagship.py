@@ -1,0 +1,214 @@
+"""Flagship benchmark harness: throughput + MFU on the real chip.
+
+`python benchmarks/flagship.py [--config transformer|vgg16|lstm|all]`
+
+Extends bench.py (the driver's one-line LeNet benchmark) to the
+flagship configs from BASELINE.md, printing one JSON line per config
+with examples-or-tokens/sec AND model-FLOPs utilization. Methodology
+(memory: axon-tpu-quirks / VERDICT r1 weak #2):
+
+- the measured region is a scanned multi-step program (per-dispatch
+  tunnel latency ~100ms amortized across N in-program steps),
+- every timed region ends with a forced host read (block_until_ready
+  can return early on this backend),
+- MFU uses analytic model FLOPs for the transformer (XLA cost analysis
+  counts remat recompute, and counts scan bodies once) and XLA
+  per-step cost for the CNNs; causal attention is counted at T²/2
+  (the model only needs the lower triangle).
+
+Practical context recorded in BASELINE.md: this chip sustains
+~140 TF/s bf16 on large serial matmuls and ~134 GB/s effective HBM
+bandwidth through the axon tunnel — d_model=512-class training is
+bandwidth-bound here, so MFU-vs-197TF-nominal understates how close
+the programs run to this device's envelope.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _host_read(x) -> float:
+    import jax
+    import jax.numpy as jnp
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def _peak() -> float | None:
+    from deeplearning4j_tpu.util.flops import chip_peak_flops
+    return chip_peak_flops()
+
+
+def bench_transformer(steps: int = 10, reps: int = 3) -> dict:
+    """TransformerLM 12L/512d/8H, T=2048, B=16, bf16, flash attention,
+    blockwise remat, Adam — `steps` optimizer steps per compiled
+    program."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params, loss_fn)
+
+    B, T, L, D, H, V = 16, 2048, 12, 512, 8, 256
+    cfg = TransformerConfig(vocab_size=V, d_model=D, n_heads=H,
+                            n_layers=L, max_len=T, dtype="bfloat16",
+                            remat=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    v0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
+                       jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    def adam_step(p, m, v, t, y):
+        g = jax.grad(lambda pp: loss_fn(cfg, pp, t, y))(p)
+        m = jax.tree_util.tree_map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+        v = jax.tree_util.tree_map(
+            lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
+        p = jax.tree_util.tree_map(
+            lambda a, mm, vv: a - 1e-3 * mm / (jnp.sqrt(vv) + 1e-8),
+            p, m, v)
+        return p, m, v
+
+    def run(p, m, v, t, y):
+        def body(c, _):
+            return adam_step(*c, t, y), ()
+        c, _ = jax.lax.scan(body, (p, m, v), None, length=steps)
+        return c
+
+    f = jax.jit(run, donate_argnums=(0, 1, 2))
+    p, m, v = f(params, m0, v0, toks, tgts)
+    _host_read(p)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        p, m, v = f(p, m, v, toks, tgts)
+        _host_read(p)
+        best = min(best, time.perf_counter() - t0)
+
+    tok_s = B * T * steps / best
+    # analytic model FLOPs/token (train = 3x fwd; causal attn at T²/2):
+    # matmul params/layer = 4D² (QKVO) + 2·D·4D (MLP) = 12D²
+    p_mat = L * 12 * D * D + D * V
+    attn = 2 * L * T * D          # 4·T·D per layer × T²/2 causal factor
+    flops_tok = 3 * (2 * p_mat + attn)
+    mfu = None
+    peak = _peak()
+    if peak:
+        mfu = tok_s * flops_tok / peak
+    return {"config": "transformer_lm_12L512d_T2048", "value": round(tok_s),
+            "unit": "tokens/sec/chip", "ms_per_step": round(
+                best / steps * 1e3, 1),
+            "model_flops_per_token": flops_tok,
+            "mfu": round(mfu, 4) if mfu else None}
+
+
+def bench_vgg16(reps: int = 3) -> dict:
+    """VGG16-CIFAR train (batch 512), multi-epoch scanned program —
+    BASELINE.md's 'VGG16 via Keras import' throughput config."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.modelimport.trained_models import vgg16
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    BATCH, POOL, EPOCHS = 512, 4, 12
+    conf = vgg16(num_classes=10, include_top=False, height=32, width=32,
+                 dtype="bfloat16")
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    conf.layers.append(DenseLayer(name="fc", n_out=512, activation="relu"))
+    conf.layers.append(OutputLayer(name="out", n_out=10,
+                                   activation="softmax",
+                                   loss_function="mcxent"))
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.random((POOL, BATCH, 32, 32, 3),
+                                dtype=np.float32))
+    ys = jax.nn.one_hot(jnp.asarray(rng.integers(0, 10, (POOL, BATCH))), 10)
+    scores = net.fit_batched(xs, ys, epochs=EPOCHS)
+    _host_read(scores)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        scores = net.fit_batched(xs, ys, epochs=EPOCHS)
+        last = float(np.asarray(scores[-1]))
+        best = min(best, time.perf_counter() - t0)
+    if last != last:
+        raise RuntimeError("NaN score in vgg16 bench")
+    ex_s = BATCH * POOL * EPOCHS / best
+    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1)
+    step_flops = cost.get("flops")
+    mfu = None
+    peak = _peak()
+    if step_flops and peak:
+        mfu = step_flops * POOL * EPOCHS / best / peak
+    return {"config": "vgg16_cifar_train_b512", "value": round(ex_s),
+            "unit": "examples/sec/chip",
+            "mfu": round(mfu, 4) if mfu else None}
+
+
+def bench_lstm(reps: int = 3) -> dict:
+    """GravesLSTM char-RNN (2x200, T=64, batch 1024) scanned multi-pass
+    train — BASELINE.md config 3."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    V, BATCH, T, POOL, EPOCHS = 80, 1024, 64, 4, 12
+    conf = char_rnn_lstm(vocab_size=V, hidden=200, layers=2,
+                         dtype="bfloat16")
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (POOL, BATCH, T))
+    xs = jax.nn.one_hot(jnp.asarray(ids), V)
+    ys = jax.nn.one_hot(jnp.asarray(np.roll(ids, -1, axis=2)), V)
+    scores = net.fit_batched(xs, ys, epochs=EPOCHS)
+    _host_read(scores)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        scores = net.fit_batched(xs, ys, epochs=EPOCHS)
+        last = float(np.asarray(scores[-1]))
+        best = min(best, time.perf_counter() - t0)
+    if last != last:
+        raise RuntimeError("NaN score in lstm bench")
+    chars_s = BATCH * T * POOL * EPOCHS / best
+    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1)
+    step_flops = cost.get("flops")
+    mfu = None
+    peak = _peak()
+    if step_flops and peak:
+        mfu = step_flops * POOL * EPOCHS / best / peak
+    return {"config": "graves_lstm_charrnn_2x200_T64", "value": round(
+        chars_s), "unit": "chars/sec/chip",
+        "mfu": round(mfu, 4) if mfu else None}
+
+
+BENCHES = {"transformer": bench_transformer, "vgg16": bench_vgg16,
+           "lstm": bench_lstm}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all",
+                    choices=[*BENCHES, "all"])
+    args = ap.parse_args()
+    names = list(BENCHES) if args.config == "all" else [args.config]
+    for n in names:
+        try:
+            print(json.dumps(BENCHES[n]()), flush=True)
+        except Exception as e:  # keep going; partial results still land
+            print(json.dumps({"config": n, "error":
+                              f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
