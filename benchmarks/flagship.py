@@ -163,7 +163,7 @@ def bench_lstm(reps: int = 3) -> dict:
 
     V, BATCH, T, POOL, EPOCHS = 80, 1024, 64, 4, 12
     conf = char_rnn_lstm(vocab_size=V, hidden=200, layers=2,
-                         dtype="bfloat16")
+                         tbptt_length=T, dtype="bfloat16")
     net = MultiLayerNetwork(conf).init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, V, (POOL, BATCH, T))

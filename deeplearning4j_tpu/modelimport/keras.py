@@ -490,16 +490,29 @@ class KerasSequentialModel:
                         and _dim_ordering(cfg) == "th":
                     pending_hwc_flatten = True
                 continue
+            # Fallback numbering is 0-based over *mapped* layers
+            # (layer_0 for the first unnamed mapped layer). Real Keras
+            # files always carry names; this only affects synthetic
+            # configs, and the round-2 renumbering is intentional.
             name = (cfg.get("name") or lc.get("name")
                     or f"layer_{len(self.layers)}")
             if pending_hwc_flatten:
                 if isinstance(mapped, DenseLayer):
                     self.hwc_flatten_dense.add(name)
                     pending_hwc_flatten = False
-                elif isinstance(mapped, (DropoutLayer, ActivationLayer)):
-                    pass  # order-preserving: Dense may still follow
+                elif isinstance(mapped, (DropoutLayer, ActivationLayer,
+                                         BatchNormalization)):
+                    pass  # elementwise/order-preserving: Dense may follow
                 else:
-                    pending_hwc_flatten = False
+                    # A layer that may reorder or reshape features between
+                    # the channels_first Flatten and the Dense would make
+                    # the CHW→HWC dense-row permutation silently wrong —
+                    # fail loudly instead (advisor round-2 finding).
+                    raise UnsupportedKerasConfigurationException(
+                        f"layer '{name}' ({cname}) between a "
+                        "channels_first Flatten and its Dense consumer; "
+                        "cannot prove the flattened feature order is "
+                        "preserved")
             self.layers.append(mapped)
             self.keras_names.append(name)
         loss = self._loss()

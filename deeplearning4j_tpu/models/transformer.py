@@ -51,6 +51,16 @@ class TransformerConfig:
     # activations are NOT kept through the scan, trading recompute FLOPs
     # for HBM — the long-context lever when T*L activations outgrow HBM
     remat: bool = False
+    # what the checkpoint keeps when remat=True:
+    #   'full'  — keep only the block input, recompute everything (max
+    #             HBM savings; backward re-runs the whole block, so
+    #             train cost ≈ 4x fwd instead of 3x)
+    #   'dots'  — jax.checkpoint_policies.dots_with_no_batch_dims_saveable:
+    #             keep matmul outputs, recompute the cheap elementwise
+    #             tail (gelu/LN) only — nearly full-speed backward at a
+    #             fraction of full-activation HBM (the measured MFU
+    #             sweet spot for flagship-class configs, BASELINE.md r3)
+    remat_policy: str = "full"
     # sequence-parallel attention strategy when the mesh's 'seq' axis > 1:
     # 'ring' (parallel/ring.py: K/V ppermute ring) or 'ulysses'
     # (parallel/ulysses.py: all_to_all head resharding; needs
@@ -190,7 +200,9 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     if cfg.remat:
         # prevent_cse=False: under lax.scan the loop structure already
         # prevents the CSE the default barrier guards against
-        body = jax.checkpoint(body, prevent_cse=False)
+        pol = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+               if cfg.remat_policy == "dots" else None)
+        body = jax.checkpoint(body, prevent_cse=False, policy=pol)
     h, _ = lax.scan(body, h, params["blocks"])
     h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
     return jnp.matmul(h, params["Wout"].astype(h.dtype))

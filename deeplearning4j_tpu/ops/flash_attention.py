@@ -93,9 +93,34 @@ def _masked_scores(q, k, scale, causal, qi_base, ki_base):
     return jnp.where(valid, s, NEG_INF), valid
 
 
+def _inner_block(n: int, cap: int = 512) -> int:
+    """Largest power-of-two (<= cap) dividing n — the k-loop tile."""
+    b = cap
+    while n % b:
+        b //= 2
+    return b
+
+
+def _n_kblocks_needed(causal: bool, skip: bool, qend_g, ko, sk: int,
+                      bk: int):
+    """How many leading k-blocks of bk cols this q-block must process.
+    With ``skip`` (causal, offsets statically known with
+    kv_offset <= q_offset, so no row can be fully masked) blocks past
+    the causal diagonal are exact no-ops: all their entries are masked
+    and exp(NEG_INF - finite_m) underflows to 0. Without it every block
+    is processed (masked entries then reproduce the reference's
+    uniform-softmax fully-masked-row semantics exactly)."""
+    nb = sk // bk
+    if not (causal and skip):
+        return nb
+    return jnp.minimum(nb, (qend_g - ko) // bk + 1)
+
+
 def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                  logl_ref, *, scale: float, causal: bool):
-    """One (batch-head, q-block) program: full-K online attention.
+                  logl_ref, *, scale: float, causal: bool, skip: bool):
+    """One (batch-head, q-block) program: online softmax over k-blocks,
+    skipping blocks past the causal diagonal when ``skip`` (2x on the
+    dominant causal-training cost — round-3 MFU push).
 
     qo_ref/ko_ref: [1,1] SMEM global position offsets (sequence-parallel
     callers pass non-zero offsets, attention.py q_offset/kv_offset).
@@ -103,18 +128,33 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
     import jax.experimental.pallas as pl
 
     q = q_ref[0]                      # [BQ, D]
-    k = k_ref[0]                      # [S, D]
-    v = v_ref[0]                      # [S, D]
-    s, _ = _masked_scores(q, k, scale, causal,
-                          pl.program_id(1) * q.shape[0] + qo_ref[0, 0],
-                          ko_ref[0, 0])                 # [BQ, S]
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) / l
-    o_ref[0] = o.astype(o_ref.dtype)
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    bk = _inner_block(sk)
+    qi_base = pl.program_id(1) * bq + qo_ref[0, 0]
+    ko = ko_ref[0, 0]
+    nb = _n_kblocks_needed(causal, skip, qi_base + bq - 1, ko, sk, bk)
+
+    def body(j, carry):
+        m, l, acc = carry             # [BQ,1], [BQ,1], [BQ,D] f32
+        kj = k_ref[0, pl.ds(j * bk, bk), :]
+        vj = v_ref[0, pl.ds(j * bk, bk), :]
+        s, _ = _masked_scores(q, kj, scale, causal, qi_base,
+                              j * bk + ko)              # [BQ, BK]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
     # Softmax statistics saved for the Pallas backward, as SEPARATE
     # [BQ, 1] columns (trailing singleton keeps TPU block tiling happy).
     # m and log(l) must not be pre-summed into one logsumexp: for a
@@ -127,60 +167,112 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
 
 def _flash_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
                      logl_ref, delta_ref, dq_ref, *, scale: float,
-                     causal: bool):
+                     causal: bool, skip: bool):
     """One (batch-head, q-block) program of the backward: recompute this
     block's probabilities from the saved softmax statistics, then
-    dS = P ∘ (dO Vᵀ − Δ), dQ = dS K · scale."""
+    dS = P ∘ (dO Vᵀ − Δ), dQ = dS K · scale. k-blocks past the causal
+    diagonal are skipped under ``skip`` (their dS is exactly 0: masked
+    entries' p underflows, valid-mask zeroes the rest)."""
     import jax.experimental.pallas as pl
 
     q = q_ref[0]                      # [BQ, D]
-    k = k_ref[0]                      # [S, D]
-    v = v_ref[0]                      # [S, D]
     do = do_ref[0]                    # [BQ, D]
-    s, valid = _masked_scores(q, k, scale, causal,
-                              pl.program_id(1) * q.shape[0] + qo_ref[0, 0],
-                              ko_ref[0, 0])             # [BQ, S]
-    p = jnp.exp((s - m_ref[0]) - logl_ref[0])           # [BQ, S]
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)             # [BQ, S]
-    ds = p * (dp - delta_ref[0])                        # [BQ, S]
-    if valid is not None:
-        ds = jnp.where(valid, ds, 0.0)
-    dq = jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    m, logl, delta = m_ref[0], logl_ref[0], delta_ref[0]
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    bk = _inner_block(sk)
+    qi_base = pl.program_id(1) * bq + qo_ref[0, 0]
+    ko = ko_ref[0, 0]
+    nb = _n_kblocks_needed(causal, skip, qi_base + bq - 1, ko, sk, bk)
+
+    def body(j, dq):
+        kj = k_ref[0, pl.ds(j * bk, bk), :]
+        vj = v_ref[0, pl.ds(j * bk, bk), :]
+        s, valid = _masked_scores(q, kj, scale, causal, qi_base,
+                                  j * bk + ko)          # [BQ, BK]
+        p = jnp.exp((s - m) - logl)
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BQ, BK]
+        ds = p * (dp - delta)
+        if valid is not None:
+            ds = jnp.where(valid, ds, 0.0)
+        return dq + jax.lax.dot_general(
+            ds.astype(kj.dtype), kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nb, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
                       logl_ref, delta_ref, dk_ref, dv_ref, *,
-                      scale: float, causal: bool):
-    """One (batch-head, k-block) program of the backward: full Q rows vs
-    this key block; dV = Pᵀ dO, dK = dSᵀ Q · scale."""
+                      scale: float, causal: bool, skip: bool):
+    """One (batch-head, k-block) program of the backward: Q rows vs this
+    key block in q-tiles; dV = Pᵀ dO, dK = dSᵀ Q · scale. Under ``skip``
+    q-tiles strictly above the causal diagonal contribute exactly 0
+    (p underflows / valid-mask) and the loop starts at the diagonal.
+    Without ``skip`` every tile runs — fully-masked rows carry p = 1/S
+    into dV (the reference's uniform-softmax gradient)."""
     import jax.experimental.pallas as pl
 
-    q = q_ref[0]                      # [T, D]
     k = k_ref[0]                      # [BK, D]
     v = v_ref[0]                      # [BK, D]
-    do = do_ref[0]                    # [T, D]
-    s, valid = _masked_scores(q, k, scale, causal, qo_ref[0, 0],
-                              pl.program_id(1) * k.shape[0] + ko_ref[0, 0])
-    p = jnp.exp((s - m_ref[0]) - logl_ref[0])           # [T, BK]
-    dv = jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)             # [BK, D]
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)             # [T, BK]
-    ds = p * (dp - delta_ref[0])                        # [T, BK]
-    if valid is not None:
-        ds = jnp.where(valid, ds, 0.0)
-    dk = jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    tq, d = q_ref.shape[1], q_ref.shape[2]
+    bko = k.shape[0]
+    bqi = _inner_block(tq)
+    qo = qo_ref[0, 0]
+    ki_base = pl.program_id(1) * bko + ko_ref[0, 0]
+    nqb = tq // bqi
+    if causal and skip:
+        # first q-tile whose LAST row reaches this k-block's first col:
+        # i*bqi + bqi - 1 + qo >= ki_base
+        # =>  i >= ceil((ki_base - qo - bqi + 1) / bqi)
+        start = jnp.maximum(0, -(-(ki_base - qo - (bqi - 1)) // bqi))
+    else:
+        start = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qi = q_ref[0, pl.ds(i * bqi, bqi), :]
+        doi = do_ref[0, pl.ds(i * bqi, bqi), :]
+        mi = m_ref[0, pl.ds(i * bqi, bqi), :]
+        logli = logl_ref[0, pl.ds(i * bqi, bqi), :]
+        deltai = delta_ref[0, pl.ds(i * bqi, bqi), :]
+        s, valid = _masked_scores(qi, k, scale, causal,
+                                  i * bqi + qo, ki_base)   # [BQI, BK]
+        p = jnp.exp((s - mi) - logli)
+        dv = dv + jax.lax.dot_general(
+            p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+        dp = jax.lax.dot_general(
+            doi, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BQI, BK]
+        ds = p * (dp - deltai)
+        if valid is not None:
+            ds = jnp.where(valid, ds, 0.0)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(qi.dtype), qi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bko, d), jnp.float32)
+    dv0 = jnp.zeros((bko, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _can_skip(q_offset, kv_offset) -> bool:
+    """Causal diagonal-block skipping is exact only when no row can be
+    fully masked, i.e. every query has at least its own position among
+    the keys — statically known offsets with kv_offset <= q_offset
+    (the self-attention/training case; blockwise callers with future
+    kv blocks keep the conservative full loop so fully-masked rows
+    reproduce the reference's uniform softmax exactly)."""
+    return (isinstance(q_offset, int) and isinstance(kv_offset, int)
+            and kv_offset <= q_offset)
 
 
 def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
@@ -189,6 +281,7 @@ def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    skip = _can_skip(q_offset, kv_offset)
     bh, tq, d = q3.shape
     sk = k3.shape[1]
     # dq panels are [bq, sk]; dkv panels are [tq, bk] — both directions
@@ -204,7 +297,8 @@ def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
     smem = functools.partial(pl.BlockSpec, (1, 1), lambda b, i: (0, 0),
                              memory_space=pltpu.SMEM)
     dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          skip=skip),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
         grid=(bh, tq // bq),
         in_specs=[
@@ -222,7 +316,8 @@ def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
     )(qo, ko, q3, k3, v3, g, m, logl, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          skip=skip),
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
         grid=(bh, sk // bk),
@@ -255,7 +350,8 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
     grid = (bh, tq // bq)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               skip=_can_skip(q_offset, kv_offset))
     return pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),

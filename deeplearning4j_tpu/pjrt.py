@@ -118,6 +118,11 @@ def get_bridge() -> Optional[ctypes.CDLL]:
             c_ptr, ctypes.POINTER(c_int), ctypes.POINTER(c_int)]
         lib.dl4j_pjrt_client_create.restype = c_ptr
         lib.dl4j_pjrt_client_create.argtypes = [c_ptr, c_char_p, c_int]
+        lib.dl4j_pjrt_client_create_opts.restype = c_ptr
+        lib.dl4j_pjrt_client_create_opts.argtypes = [
+            c_ptr, ctypes.POINTER(c_char_p), ctypes.POINTER(c_char_p),
+            ctypes.POINTER(c_ll), ctypes.POINTER(c_int), c_int,
+            c_char_p, c_int]
         lib.dl4j_pjrt_client_destroy.restype = c_int
         lib.dl4j_pjrt_client_destroy.argtypes = [c_ptr, c_ptr]
         lib.dl4j_pjrt_platform_name.restype = c_int
@@ -339,7 +344,12 @@ class PjrtAsyncExecutor:
 class PjrtRuntime:
     """One loaded plugin + one client (the `Nd4jBackend` analog)."""
 
-    def __init__(self, plugin_path: Optional[str] = None):
+    def __init__(self, plugin_path: Optional[str] = None,
+                 create_options: Optional[dict] = None):
+        """`create_options`: PJRT_NamedValue key/values for
+        PJRT_Client_Create — str → kString, bool → kBool, int → kInt64.
+        Real plugins (libtpu, the axon tunnel) need session/topology
+        options here; the stub ignores them."""
         lib = get_bridge()
         if lib is None:
             raise PjrtError("native PJRT bridge unavailable (build failed)")
@@ -353,7 +363,25 @@ class PjrtRuntime:
         if not self._api:
             raise PjrtError(f"plugin load failed: "
                             f"{err.value.decode(errors='replace')}")
-        self._client = lib.dl4j_pjrt_client_create(self._api, err, _ERRLEN)
+        if create_options:
+            n = len(create_options)
+            keys = (ctypes.c_char_p * n)()
+            svals = (ctypes.c_char_p * n)()
+            ivals = (ctypes.c_longlong * n)()
+            kinds = (ctypes.c_int * n)()
+            for i, (k, v) in enumerate(create_options.items()):
+                keys[i] = str(k).encode()
+                if isinstance(v, bool):
+                    kinds[i], ivals[i], svals[i] = 2, int(v), b""
+                elif isinstance(v, int):
+                    kinds[i], ivals[i], svals[i] = 1, v, b""
+                else:
+                    kinds[i], ivals[i], svals[i] = 0, 0, str(v).encode()
+            self._client = lib.dl4j_pjrt_client_create_opts(
+                self._api, keys, svals, ivals, kinds, n, err, _ERRLEN)
+        else:
+            self._client = lib.dl4j_pjrt_client_create(self._api, err,
+                                                       _ERRLEN)
         if not self._client:
             raise PjrtError(f"client create failed: "
                             f"{err.value.decode(errors='replace')}")

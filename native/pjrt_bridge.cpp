@@ -145,6 +145,52 @@ void* dl4j_pjrt_client_create(const void* api_p, char* err, int errlen) {
   return args.client;
 }
 
+// Client creation with PJRT_NamedValue create_options. Real plugins
+// (libtpu, the axon tunnel plugin) require session/topology options at
+// client creation; the parallel arrays encode n options of kind 0
+// (string: str_vals[i]) or kind 1 (int64: int_vals[i]). Role parity:
+// ND4J backends pass CudaEnvironment-style config into libnd4j at
+// backend init (SURVEY §2.9 row 1).
+void* dl4j_pjrt_client_create_opts(const void* api_p, const char** keys,
+                                   const char** str_vals,
+                                   const long long* int_vals,
+                                   const int* kinds, int n, char* err,
+                                   int errlen) {
+  const PJRT_Api* api = static_cast<const PJRT_Api*>(api_p);
+  std::vector<PJRT_NamedValue> opts(static_cast<size_t>(n));
+  for (int i = 0; i < n; ++i) {
+    PJRT_NamedValue& v = opts[static_cast<size_t>(i)];
+    std::memset(&v, 0, sizeof(v));
+    v.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    v.name = keys[i];
+    v.name_size = std::strlen(keys[i]);
+    if (kinds[i] == 0) {
+      v.type = PJRT_NamedValue_kString;
+      v.string_value = str_vals[i];
+      v.value_size = std::strlen(str_vals[i]);
+    } else if (kinds[i] == 2) {
+      v.type = PJRT_NamedValue_kBool;
+      v.bool_value = int_vals[i] != 0;
+      v.value_size = 1;
+    } else {
+      v.type = PJRT_NamedValue_kInt64;
+      v.int64_value = static_cast<int64_t>(int_vals[i]);
+      v.value_size = 1;
+    }
+  }
+  PJRT_Client_Create_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  args.create_options = opts.empty() ? nullptr : opts.data();
+  args.num_options = opts.size();
+  PJRT_Error* e = api->PJRT_Client_Create(&args);
+  if (e != nullptr) {
+    consume_error(api, e, err, errlen);
+    return nullptr;
+  }
+  return args.client;
+}
+
 int dl4j_pjrt_client_destroy(const void* api_p, void* client) {
   const PJRT_Api* api = static_cast<const PJRT_Api*>(api_p);
   PJRT_Client_Destroy_Args args;
