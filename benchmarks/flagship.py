@@ -43,20 +43,24 @@ def _peak() -> float | None:
     return chip_peak_flops()
 
 
-def bench_transformer(steps: int = 10, reps: int = 3) -> dict:
+def bench_transformer(steps: int = 10, reps: int = 3, *,
+                      batch: int = 16, remat: bool = True,
+                      remat_policy: str = "full") -> dict:
     """TransformerLM 12L/512d/8H, T=2048, B=16, bf16, flash attention,
     blockwise remat, Adam — `steps` optimizer steps per compiled
-    program."""
+    program. The keyword knobs exist for benchmarks/remat_sweep.py so
+    the sweep and the flagship row share ONE harness (same warmup,
+    donation, host-read fence, best-of-reps timing)."""
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        init_params, loss_fn)
 
-    B, T, L, D, H, V = 16, 2048, 12, 512, 8, 256
+    B, T, L, D, H, V = batch, 2048, 12, 512, 8, 256
     cfg = TransformerConfig(vocab_size=V, d_model=D, n_heads=H,
                             n_layers=L, max_len=T, dtype="bfloat16",
-                            remat=True)
+                            remat=remat, remat_policy=remat_policy)
     params = init_params(cfg, jax.random.PRNGKey(0))
     m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
     v0 = jax.tree_util.tree_map(jnp.zeros_like, params)

@@ -1,77 +1,34 @@
-"""One-off sweep: transformer flagship config under remat variants.
+"""Sweep: flagship transformer config under remat variants.
 
-Measures tokens/sec for (remat, remat_policy, batch) combinations to
-pick the production default recorded in BASELINE.md. Methodology as
-benchmarks/flagship.py (scanned multi-step program, forced host read).
+Thin wrapper over benchmarks/flagship.py's bench_transformer — ONE
+harness (same warmup, donation, host-read fence, best-of-reps timing)
+so sweep numbers stay comparable to the flagship row they justify.
+Measured history (BASELINE.md round 3): 'full' > 'dots' > 'mlp' at
+B=16 (saving attention residuals costs more HBM than recomputing the
+forward); remat=False fails to compile at this config.
 """
 from __future__ import annotations
 
 import json
-import time
+import os
+import sys
 
-import numpy as np
-
-
-def run(remat: bool, policy: str, batch: int, steps: int = 10,
-        reps: int = 3) -> dict:
-    import jax
-    import jax.numpy as jnp
-
-    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
-                                                       init_params, loss_fn)
-
-    B, T, L, D, H, V = batch, 2048, 12, 512, 8, 256
-    cfg = TransformerConfig(vocab_size=V, d_model=D, n_heads=H,
-                            n_layers=L, max_len=T, dtype="bfloat16",
-                            remat=remat, remat_policy=policy)
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
-    v0 = jax.tree_util.tree_map(jnp.zeros_like, params)
-    toks = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
-                       jnp.int32)
-    tgts = jnp.roll(toks, -1, axis=1)
-
-    def adam_step(p, m, v, t, y):
-        g = jax.grad(lambda pp: loss_fn(cfg, pp, t, y))(p)
-        m = jax.tree_util.tree_map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
-        v = jax.tree_util.tree_map(
-            lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
-        p = jax.tree_util.tree_map(
-            lambda a, mm, vv: a - 1e-3 * mm / (jnp.sqrt(vv) + 1e-8),
-            p, m, v)
-        return p, m, v
-
-    def runf(p, m, v, t, y):
-        def body(c, _):
-            return adam_step(*c, t, y), ()
-        c, _ = jax.lax.scan(body, (p, m, v), None, length=steps)
-        return c
-
-    f = jax.jit(runf, donate_argnums=(0, 1, 2))
-    p, m, v = f(params, m0, v0, toks, tgts)
-    float(jnp.sum(jax.tree_util.tree_leaves(p)[0]).astype(jnp.float32))
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        p, m, v = f(p, m, v, toks, tgts)
-        float(jnp.sum(jax.tree_util.tree_leaves(p)[0]).astype(jnp.float32))
-        best = min(best, time.perf_counter() - t0)
-    tok_s = B * T * steps / best
-    return {"remat": remat, "policy": policy, "batch": batch,
-            "tok_s": round(tok_s), "ms_per_step": round(
-                best / steps * 1e3, 1)}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from flagship import bench_transformer  # noqa: E402
 
 
 def main() -> None:
     for remat, policy, batch in [
-        (True, "full", 16),    # round-2 production default
+        (True, "full", 16),    # production default
         (True, "dots", 16),
+        (True, "mlp", 16),
         (False, "full", 16),
-        (False, "full", 32),
-        (True, "dots", 32),
     ]:
         try:
-            print(json.dumps(run(remat, policy, batch)), flush=True)
+            r = bench_transformer(remat=remat, remat_policy=policy,
+                                  batch=batch)
+            r.update({"remat": remat, "policy": policy, "batch": batch})
+            print(json.dumps(r), flush=True)
         except Exception as e:
             print(json.dumps({"remat": remat, "policy": policy,
                               "batch": batch, "error":

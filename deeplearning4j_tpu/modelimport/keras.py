@@ -614,10 +614,21 @@ class KerasModel:
             hwc_upstream = any(n in hwc_flattens for n in raw_inbound)
             if isinstance(mapped, DenseLayer) and hwc_upstream:
                 self.hwc_flatten_dense.add(name)
-            elif isinstance(mapped, (DropoutLayer, ActivationLayer)) \
+            elif isinstance(mapped, (DropoutLayer, ActivationLayer,
+                                     BatchNormalization)) \
                     and hwc_upstream:
-                # order-preserving: downstream Dense is still HWC-ordered
+                # elementwise/order-preserving: downstream Dense is
+                # still HWC-ordered
                 hwc_flattens.add(name)
+            elif hwc_upstream:
+                # same contract as the Sequential builder: a layer that
+                # may reorder features between the channels_first
+                # Flatten and its Dense consumer makes the CHW→HWC
+                # dense-row permutation unprovable — fail loudly
+                raise UnsupportedKerasConfigurationException(
+                    f"layer '{name}' ({cname}) between a channels_first "
+                    "Flatten and its Dense consumer; cannot prove the "
+                    "flattened feature order is preserved")
             self.builder.add_layer(name, mapped, *inbound)
             self.keras_layer_names.append(name)
         self.builder.add_inputs(*self.input_names)

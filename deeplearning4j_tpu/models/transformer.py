@@ -53,13 +53,20 @@ class TransformerConfig:
     remat: bool = False
     # what the checkpoint keeps when remat=True:
     #   'full'  — keep only the block input, recompute everything (max
-    #             HBM savings; backward re-runs the whole block, so
-    #             train cost ≈ 4x fwd instead of 3x)
+    #             HBM savings; backward re-runs the whole block —
+    #             including the flash-attention forward kernel, the
+    #             single most expensive recompute)
     #   'dots'  — jax.checkpoint_policies.dots_with_no_batch_dims_saveable:
-    #             keep matmul outputs, recompute the cheap elementwise
-    #             tail (gelu/LN) only — nearly full-speed backward at a
-    #             fraction of full-activation HBM (the measured MFU
-    #             sweet spot for flagship-class configs, BASELINE.md r3)
+    #             keep matmul outputs, recompute elementwise tails; the
+    #             flash kernel is a custom_vjp the policy cannot see
+    #             inside, so its forward still re-runs (measured ~2%)
+    #   'mlp'   — checkpoint ONLY the MLP (its [B,T,4D] intermediate is
+    #             the memory hog; its recompute is cheap MXU work) and
+    #             keep every attention residual — the backward never
+    #             re-runs the VPU-bound attention kernel. The measured
+    #             throughput sweet spot when activations fit
+    #             (BASELINE.md r3); 'full' remains the long-context
+    #             fallback
     remat_policy: str = "full"
     # sequence-parallel attention strategy when the mesh's 'seq' axis > 1:
     # 'ring' (parallel/ring.py: K/V ppermute ring) or 'ulysses'
@@ -160,10 +167,14 @@ def moe_mlp(h: Array, p: Dict[str, Array], cfg: TransformerConfig) -> Array:
 
 
 def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
-                  mask: Optional[Array] = None, return_kv: bool = False):
+                  mask: Optional[Array] = None, return_kv: bool = False,
+                  remat_mlp: bool = False):
     """One pre-LN transformer block on [B, T, D] (full, unsharded).
     ``return_kv`` additionally returns the block's K/V heads — the
-    batched cache-prefill path for decoding."""
+    batched cache-prefill path for decoding. ``remat_mlp`` checkpoints
+    just the MLP branch (the remat_policy='mlp' mode: the [B,T,4D]
+    intermediate is recomputed in backward, attention residuals are
+    kept)."""
     d = cfg.d_model
     x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
 
@@ -178,9 +189,12 @@ def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
                        p["Wo"].astype(h.dtype))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
     if cfg.n_experts > 0:
-        h = h + moe_mlp(x, p, cfg)
+        mlp = lambda xx, pp: moe_mlp(xx, pp, cfg)  # noqa: E731
     else:
-        h = h + dense_mlp(x, p)
+        mlp = dense_mlp
+    if remat_mlp:
+        mlp = jax.checkpoint(mlp, prevent_cse=False)
+    h = h + mlp(x, p)
     if return_kv:
         return h, (k, v)
     return h
@@ -194,10 +208,15 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     h = (params["embed"].astype(dt)[tokens]
          + params["pos"].astype(dt)[:t][None])
 
-    def body(h, p):
-        return block_forward(h, p, cfg), None
+    if cfg.remat and cfg.remat_policy not in ("full", "dots", "mlp"):
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}: "
+                         "expected 'full', 'dots' or 'mlp'")
+    remat_mlp = cfg.remat and cfg.remat_policy == "mlp"
 
-    if cfg.remat:
+    def body(h, p):
+        return block_forward(h, p, cfg, remat_mlp=remat_mlp), None
+
+    if cfg.remat and not remat_mlp:
         # prevent_cse=False: under lax.scan the loop structure already
         # prevents the CSE the default barrier guards against
         pol = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable

@@ -134,64 +134,105 @@ class SequenceVectors(WordVectorsMixin):
             self.vocab, self.layer_size, seed=self.seed,
             use_hs=self.use_hs, use_neg=self.negative > 0)
         self.lookup_table.reset_weights()
+        # vocab changed: encoded-corpus, frequency and pooled-negative
+        # caches are stale (the pool indexes the OLD unigram table)
+        self._corpus_cache = None
+        self._freq_cache = None
+        self._neg_pool = None
+        self._neg_cursor = 0
 
     # -- training pair generation (host-side, IO/string bound) ------------
     def _encode(self, seq: Sequence[str]) -> np.ndarray:
         idx = [self.vocab.index_of(w) for w in seq]
         return np.array([i for i in idx if i >= 0], dtype=np.int32)
 
-    def _keep_mask(self, ids: np.ndarray) -> np.ndarray:
-        """Frequent-word subsampling (word2vec's t-threshold)."""
-        if self.subsampling <= 0:
-            return np.ones(len(ids), bool)
-        total = self.vocab.total_word_count
-        freqs = np.array([self.vocab.word_at_index(int(i)).element_frequency
-                          for i in ids]) / total
-        keep_p = np.minimum(1.0, np.sqrt(self.subsampling / freqs)
-                            + self.subsampling / freqs)
-        return self._rng.random(len(ids)) < keep_p
-
     def _reduced_windows(self, n: int):
         """The word2vec reduced-window draw: per-position effective
         window sizes w [n] (>=1) and the symmetric offset vector
-        [-window..-1, 1..window]. One definition keeps _window_pairs and
-        _window_rows on the same RNG stream structurally."""
+        [-window..-1, 1..window]. One definition keeps the pair and
+        CBOW-row staging on the same RNG stream structurally."""
         w = self.window - self._rng.integers(0, self.window, n)
         offs = np.concatenate([np.arange(-self.window, 0),
                                np.arange(1, self.window + 1)])
         return w, offs
 
-    def _window_pairs(self, ids: np.ndarray):
-        """(center, context) pairs with the word2vec reduced-window
-        trick, fully vectorized (the Python double loop here was the
-        corpus-size bottleneck — pair generation is O(n*window) numpy
-        now)."""
-        n = len(ids)
+    # -- whole-corpus staging (round-3: the profiled epoch bottleneck was
+    # host work — re-tokenizing, per-token vocab attribute chases, and
+    # 60k-call-per-epoch pair generation; one pass of numpy over the
+    # cached encoded corpus replaces all of it) -------------------------
+    def _encoded_corpus(self):
+        """Tokenize + encode the corpus ONCE per vocab (the reference
+        re-tokenizes every epoch, SequenceVectors.java; epochs after the
+        first reuse the flat int corpus). Returns (flat ids [N] int32,
+        per-sentence lengths [S])."""
+        if getattr(self, "_corpus_cache", None) is None:
+            seqs = [self._encode(s) for s in self._sequences()]
+            lens = np.array([len(s) for s in seqs], np.int64)
+            flat = (np.concatenate(seqs).astype(np.int32, copy=False)
+                    if seqs else np.empty(0, np.int32))
+            self._corpus_cache = (flat, lens)
+        return self._corpus_cache
+
+    def _freq_arr(self) -> np.ndarray:
+        """Per-index corpus frequencies as one array (vectorized
+        subsampling; cached alongside the corpus)."""
+        if getattr(self, "_freq_cache", None) is None:
+            nw = self.vocab.num_words
+            self._freq_cache = np.array(
+                [self.vocab.word_at_index(i).element_frequency
+                 for i in range(nw)], np.float64)
+        return self._freq_cache
+
+    def _subsampled_corpus(self):
+        """One epoch's subsampled view of the cached corpus: flat kept
+        ids + their sentence ids (same keep probabilities as the
+        reference's per-sentence subsampling, drawn corpus-wide)."""
+        flat, lens = self._encoded_corpus()
+        sid = np.repeat(np.arange(len(lens)), lens)
+        if self.subsampling > 0 and len(flat):
+            freqs = self._freq_arr()[flat] / self.vocab.total_word_count
+            keep_p = np.minimum(1.0, np.sqrt(self.subsampling / freqs)
+                                + self.subsampling / freqs)
+            keep = self._rng.random(len(flat)) < keep_p
+            flat, sid = flat[keep], sid[keep]
+        return flat, sid
+
+    def _corpus_window_pairs(self):
+        """All (center, context) pairs for one epoch in one numpy pass,
+        sentence boundaries respected via sentence ids, token-major
+        pair order (same as the reference's per-sentence loop)."""
+        flat, sid = self._subsampled_corpus()
+        n = len(flat)
         if n == 0:
             return (np.empty(0, np.int32),) * 2
         w, offs = self._reduced_windows(n)
-        ci = np.repeat(np.arange(n), len(offs))        # center index
-        xi = ci + np.tile(offs, n)                     # context index
-        valid = ((xi >= 0) & (xi < n)
-                 & (np.abs(xi - ci) <= np.repeat(w, len(offs))))
-        return ids[ci[valid]], ids[xi[valid]]
+        k = len(offs)
+        offs_t = np.tile(offs, n)
+        ci = np.repeat(np.arange(n), k)
+        xi = ci + offs_t
+        inb = (xi >= 0) & (xi < n)
+        valid = (inb & (sid[np.clip(xi, 0, n - 1)] == sid[ci])
+                 & (np.abs(offs_t) <= np.repeat(w, k)))
+        return flat[ci[valid]], flat[xi[valid]]
 
-    def _window_rows(self, ids: np.ndarray):
-        """Per-CENTER training rows for CBOW (reference CBOW.java: the
-        mean of the whole reduced window predicts the center): targets
-        [n], context windows [n, 2w] (0-padded), validity mask [n, 2w].
-        Same reduced-window draw as _window_pairs."""
-        n = len(ids)
+    def _corpus_window_rows(self):
+        """All CBOW training rows for one epoch in one numpy pass
+        (targets [n], windows [n, 2w], mask [n, 2w]) — the corpus-wide
+        per-center form."""
+        flat, sid = self._subsampled_corpus()
+        n = len(flat)
         if n == 0:
             z = np.empty((0, 2 * self.window))
             return (np.empty(0, np.int32), z.astype(np.int32),
                     z.astype(np.float32))
         w, offs = self._reduced_windows(n)
         idx = np.arange(n)[:, None] + offs[None, :]
-        valid = ((idx >= 0) & (idx < n)
+        inb = (idx >= 0) & (idx < n)
+        cidx = np.clip(idx, 0, n - 1)
+        valid = (inb & (sid[cidx] == sid[:, None])
                  & (np.abs(offs)[None, :] <= w[:, None]))
-        win = np.where(valid, ids[np.clip(idx, 0, n - 1)], 0)
-        return (ids.astype(np.int32, copy=False),
+        win = np.where(valid, flat[cidx], 0)
+        return (flat.astype(np.int32, copy=False),
                 win.astype(np.int32, copy=False),
                 valid.astype(np.float32))
 
@@ -208,20 +249,7 @@ class SequenceVectors(WordVectorsMixin):
                 step_no = self._fit_cbow_epoch(step_no, total_epochs,
                                                epoch)
                 continue
-            centers_l: List[np.ndarray] = []
-            contexts_l: List[np.ndarray] = []
-            for seq in self._sequences():
-                ids = self._encode(seq)
-                ids = ids[self._keep_mask(ids)]
-                c, x = self._window_pairs(ids)
-                centers_l.append(c)
-                contexts_l.append(x)
-            if not centers_l:
-                continue
-            centers_a = np.concatenate(centers_l).astype(np.int32,
-                                                         copy=False)
-            contexts_a = np.concatenate(contexts_l).astype(np.int32,
-                                                           copy=False)
+            centers_a, contexts_a = self._corpus_window_pairs()
             n_pairs = len(centers_a)
             if n_pairs == 0:
                 continue
@@ -268,24 +296,10 @@ class SequenceVectors(WordVectorsMixin):
         if self.negative <= 0 and not self.use_hs:
             raise ValueError("cbow requires negative sampling "
                              "(negative > 0) or hierarchical softmax")
-        tgt_l: List[np.ndarray] = []
-        win_l: List[np.ndarray] = []
-        msk_l: List[np.ndarray] = []
-        for seq in self._sequences():
-            ids = self._encode(seq)
-            ids = ids[self._keep_mask(ids)]
-            if len(ids) == 0:
-                continue
-            t, w_arr, m = self._window_rows(ids)
-            tgt_l.append(t)
-            win_l.append(w_arr)
-            msk_l.append(m)
-        if not tgt_l:
-            return step_no
-        tgt = np.concatenate(tgt_l)
-        win = np.concatenate(win_l)
-        msk = np.concatenate(msk_l)
+        tgt, win, msk = self._corpus_window_rows()
         n_ex = len(tgt)
+        if n_ex == 0:
+            return step_no
         order = self._rng.permutation(n_ex)
         tgt, win, msk = tgt[order], win[order], msk[order]
         b = self.batch_size
@@ -375,8 +389,10 @@ class SequenceVectors(WordVectorsMixin):
         return lr_vec
 
     def _stage_negatives(self, nb: int, nb_pad: int) -> np.ndarray:
-        """Negatives drawn one batch at a time (stream-identical to the
-        per-batch path), zero-padded to the bucketed chunk size."""
+        """Negatives for one scanned chunk, zero-padded to the bucketed
+        chunk size. Consumes the same pooled stream as the per-batch
+        path (_sample_negatives), so the scanned/stepped equivalence
+        holds by construction."""
         negs = np.stack([self._sample_negatives(self.batch_size)
                          for _ in range(nb)]).astype(np.int32)
         if nb_pad > nb:
@@ -442,11 +458,28 @@ class SequenceVectors(WordVectorsMixin):
         pad_shape = (b - len(arr),) + arr.shape[1:]
         return np.concatenate([arr, np.full(pad_shape, value, arr.dtype)])
 
+    # one rng call refills this many batches of negatives at once — the
+    # per-batch draw + unigram-table gather was a profiled host cost
+    _NEG_POOL_BATCHES = 512
+
     def _sample_negatives(self, n: int) -> np.ndarray:
-        table = self.lookup_table.neg_table
-        picks = self._rng.integers(0, len(table),
-                                   (self.batch_size, self.negative))
-        return table[picks].astype(np.int32)
+        """Next (batch_size, negative) block of negative samples. Drawn
+        from a pooled pre-gathered buffer (one rng call + one table
+        gather per _NEG_POOL_BATCHES batches); both the scanned and the
+        stepped training paths consume this same stream, so their
+        bit-level equivalence is preserved by construction."""
+        pool = getattr(self, "_neg_pool", None)
+        if pool is None or self._neg_cursor >= len(pool):
+            table = self.lookup_table.neg_table
+            picks = self._rng.integers(
+                0, len(table),
+                (self._NEG_POOL_BATCHES, self.batch_size, self.negative))
+            self._neg_pool = table[picks].astype(np.int32)
+            self._neg_cursor = 0
+            pool = self._neg_pool
+        row = pool[self._neg_cursor]
+        self._neg_cursor += 1
+        return row
 
     def _train_batch(self, centers: np.ndarray, contexts: np.ndarray,
                      lr: float) -> None:

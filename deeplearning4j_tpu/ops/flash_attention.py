@@ -10,20 +10,31 @@ none, SURVEY.md §5.7), so it gets the same treatment: a Pallas kernel
 when available, with the jnp reference path as fallback — selection at
 call time, zero API change (`dot_product_attention` dispatches).
 
-Kernel shape strategy: grid over (batch*heads, q-blocks); each program
-holds one q block plus the full K/V rows for its batch-head in VMEM
-(T*Dh*4B each — fits VMEM for T ≲ 8k per chip). Longer sequences ride
-sequence parallelism instead: parallel/ring.py shards T across the mesh
-and calls this kernel on local blocks.
+Kernel shape strategy (round-3 redesign): ONE program per batch-head —
+grid (B*H,) — holding that head's full Q/K/V rows in VMEM and looping
+over [bq, bk] score tiles inside the program. The round-2 layout
+(grid (B*H, q-blocks), full K/V per program) re-read K/V from HBM once
+per q-block and was measured HBM-bound on exactly that traffic; one
+program per head reads each operand once. Position offsets are Python
+ints on this path (attention.py falls back to jnp for traced offsets),
+so the causal tile structure is resolved at trace time: tiles past the
+causal diagonal are skipped outright when offsets prove no row can be
+fully masked (kv_offset <= q_offset), and diagonal-straddling tiles
+run a masked body while fully-valid tiles skip the iota/compare/select
+arithmetic entirely. Loops are lax.fori_loop (Mosaic reuses the tile
+stack across iterations; a fully unrolled Python loop was measured to
+blow the 16MB scoped-VMEM budget). Fits VMEM for T ≲ 8k per chip;
+longer sequences ride sequence parallelism instead (parallel/ring.py
+shards T across the mesh and calls this kernel on local blocks).
 
 Backward pass: Pallas kernels too (Dao et al.'s two-kernel split). The
-forward additionally emits the per-row logsumexp; the backward
-recomputes probabilities blockwise from (q, k, lse) in VMEM — never
-materializing [T,S] in HBM in either direction — with one kernel
-gridded over q-blocks producing dQ and one over k-blocks producing
-dK/dV. Shapes the kernels can't tile (kv length not block-divisible)
-fall back to a jnp-recompute VJP, same dispatch philosophy as the
-forward.
+forward additionally emits the per-row running max and log-normalizer;
+the backward recomputes probabilities tile-by-tile from (q, k, stats)
+in VMEM — never materializing [T,S] in HBM in either direction — with
+one kernel producing dQ (tiles up to the diagonal) and one producing
+dK/dV (tiles from the diagonal down). Shapes the kernels can't tile
+(kv length not block-divisible) fall back to a jnp-recompute VJP, same
+dispatch philosophy as the forward.
 """
 from __future__ import annotations
 
@@ -40,22 +51,19 @@ NEG_INF = -1e30
 BLOCK_Q = 128          # floor / eligibility granularity
 
 
-def _pick_block(rows: int, panel_cols: int, target_elems: int) -> int:
-    """Largest power-of-two row-block (128..512) whose [block, cols] f32
-    score panel stays within ``target_elems`` — measured on v5e
-    (T=2048): bwd panels at 512 rows are ~1.5x faster than 128 (fewer
-    full-K/V re-reads per program: the kernels are HBM-bandwidth-bound,
-    block count multiplies K/V traffic), while 1024-row panels blow the
-    ~16MB scoped-VMEM stack. Longer sequences scale the block back down
-    so VMEM stays bounded."""
-    b = 512
-    while b > 128 and b * panel_cols > target_elems:
+def _inner_block(n: int, cap: int = 512) -> int:
+    """Score-tile edge: the largest power-of-two (<= cap) dividing n,
+    or n itself when it fits in one tile. 512-edge tiles measured
+    fastest on v5e at T=2048 (bigger tiles amortize per-tile loop
+    overhead; 1024+ blows the panel VMEM budget at long T). Small or
+    odd extents (short sequences, cross-attention kv lengths) become a
+    single tile rather than degrading to sub-sublane slivers."""
+    if n <= cap:
+        return n
+    b = cap
+    while n % b and b > 8:
         b //= 2
-    if rows <= b:
-        return rows          # single block covers everything
-    while rows % b:          # must tile rows exactly
-        b //= 2
-    return b
+    return b if n % b == 0 else n
 
 
 def _reference_attention(q, k, v, scale: float, causal: bool,
@@ -72,20 +80,20 @@ def _reference_attention(q, k, v, scale: float, causal: bool,
     return jnp.einsum("bts,bsd->btd", p.astype(q.dtype), v)
 
 
-def _masked_scores(q, k, scale, causal, qi_base, ki_base):
-    """Scaled (and causally masked) score block — the one definition
-    shared by the forward and both backward kernels so their masking
-    can never drift apart. Returns (scores, valid) where valid is the
-    boolean keep-mask (None when not causal): the backward must zero
-    dS at masked positions, because in the reference formulation the
-    mask's where() makes masked scores constants that carry no
-    gradient — p=0 handles that for ordinary rows, but a fully-masked
-    row has uniform nonzero p and still must not push gradient into
-    q/k."""
+def _masked_scores(q, k, scale, masked, qi_base, ki_base):
+    """Scaled score tile; causal mask applied only when ``masked`` —
+    the one definition shared by the forward and both backward kernels
+    so their masking can never drift apart. Returns (scores, valid)
+    where valid is the boolean keep-mask (None when unmasked): the
+    backward must zero dS at masked positions, because in the
+    reference formulation the mask's where() makes masked scores
+    constants that carry no gradient — p=0 handles that for ordinary
+    rows, but a fully-masked row has uniform nonzero p and still must
+    not push gradient into q/k."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    if not causal:
+    if not masked:
         return s, None
     qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi_base
     ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki_base
@@ -93,285 +101,232 @@ def _masked_scores(q, k, scale, causal, qi_base, ki_base):
     return jnp.where(valid, s, NEG_INF), valid
 
 
-def _inner_block(n: int, cap: int = 512) -> int:
-    """Largest power-of-two (<= cap) dividing n — the k-loop tile."""
-    b = cap
-    while n % b:
-        b //= 2
-    return b
-
-
-def _n_kblocks_needed(causal: bool, skip: bool, qend_g, ko, sk: int,
-                      bk: int):
-    """How many leading k-blocks of bk cols this q-block must process.
-    With ``skip`` (causal, offsets statically known with
-    kv_offset <= q_offset, so no row can be fully masked) blocks past
-    the causal diagonal are exact no-ops: all their entries are masked
-    and exp(NEG_INF - finite_m) underflows to 0. Without it every block
-    is processed (masked entries then reproduce the reference's
-    uniform-softmax fully-masked-row semantics exactly)."""
-    nb = sk // bk
-    if not (causal and skip):
-        return nb
-    return jnp.minimum(nb, (qend_g - ko) // bk + 1)
-
-
-def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                  logl_ref, *, scale: float, causal: bool, skip: bool):
-    """One (batch-head, q-block) program: online softmax over k-blocks,
-    skipping blocks past the causal diagonal when ``skip`` (2x on the
-    dominant causal-training cost — round-3 MFU push).
-
-    qo_ref/ko_ref: [1,1] SMEM global position offsets (sequence-parallel
-    callers pass non-zero offsets, attention.py q_offset/kv_offset).
-    """
-    import jax.experimental.pallas as pl
-
-    q = q_ref[0]                      # [BQ, D]
-    bq, d = q.shape
-    sk = k_ref.shape[1]
-    bk = _inner_block(sk)
-    qi_base = pl.program_id(1) * bq + qo_ref[0, 0]
-    ko = ko_ref[0, 0]
-    nb = _n_kblocks_needed(causal, skip, qi_base + bq - 1, ko, sk, bk)
-
-    def body(j, carry):
-        m, l, acc = carry             # [BQ,1], [BQ,1], [BQ,D] f32
-        kj = k_ref[0, pl.ds(j * bk, bk), :]
-        vj = v_ref[0, pl.ds(j * bk, bk), :]
-        s, _ = _masked_scores(q, kj, scale, causal, qi_base,
-                              j * bk + ko)              # [BQ, BK]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
-
-    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # Softmax statistics saved for the Pallas backward, as SEPARATE
-    # [BQ, 1] columns (trailing singleton keeps TPU block tiling happy).
-    # m and log(l) must not be pre-summed into one logsumexp: for a
-    # fully-masked row m is -1e30 and log(l)=log(S) would be absorbed
-    # by f32 rounding, making the backward reconstruct p=1 instead of
-    # the forward's uniform 1/S. exp((s - m) - log l) is exact.
-    m_ref[0] = m
-    logl_ref[0] = jnp.log(l)
-
-
-def _flash_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
-                     logl_ref, delta_ref, dq_ref, *, scale: float,
-                     causal: bool, skip: bool):
-    """One (batch-head, q-block) program of the backward: recompute this
-    block's probabilities from the saved softmax statistics, then
-    dS = P ∘ (dO Vᵀ − Δ), dQ = dS K · scale. k-blocks past the causal
-    diagonal are skipped under ``skip`` (their dS is exactly 0: masked
-    entries' p underflows, valid-mask zeroes the rest)."""
-    import jax.experimental.pallas as pl
-
-    q = q_ref[0]                      # [BQ, D]
-    do = do_ref[0]                    # [BQ, D]
-    m, logl, delta = m_ref[0], logl_ref[0], delta_ref[0]
-    bq, d = q.shape
-    sk = k_ref.shape[1]
-    bk = _inner_block(sk)
-    qi_base = pl.program_id(1) * bq + qo_ref[0, 0]
-    ko = ko_ref[0, 0]
-    nb = _n_kblocks_needed(causal, skip, qi_base + bq - 1, ko, sk, bk)
-
-    def body(j, dq):
-        kj = k_ref[0, pl.ds(j * bk, bk), :]
-        vj = v_ref[0, pl.ds(j * bk, bk), :]
-        s, valid = _masked_scores(q, kj, scale, causal, qi_base,
-                                  j * bk + ko)          # [BQ, BK]
-        p = jnp.exp((s - m) - logl)
-        dp = jax.lax.dot_general(
-            do, vj, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [BQ, BK]
-        ds = p * (dp - delta)
-        if valid is not None:
-            ds = jnp.where(valid, ds, 0.0)
-        return dq + jax.lax.dot_general(
-            ds.astype(kj.dtype), kj, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, nb, body,
-                           jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _flash_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, m_ref,
-                      logl_ref, delta_ref, dk_ref, dv_ref, *,
-                      scale: float, causal: bool, skip: bool):
-    """One (batch-head, k-block) program of the backward: Q rows vs this
-    key block in q-tiles; dV = Pᵀ dO, dK = dSᵀ Q · scale. Under ``skip``
-    q-tiles strictly above the causal diagonal contribute exactly 0
-    (p underflows / valid-mask) and the loop starts at the diagonal.
-    Without ``skip`` every tile runs — fully-masked rows carry p = 1/S
-    into dV (the reference's uniform-softmax gradient)."""
-    import jax.experimental.pallas as pl
-
-    k = k_ref[0]                      # [BK, D]
-    v = v_ref[0]                      # [BK, D]
-    tq, d = q_ref.shape[1], q_ref.shape[2]
-    bko = k.shape[0]
-    bqi = _inner_block(tq)
-    qo = qo_ref[0, 0]
-    ki_base = pl.program_id(1) * bko + ko_ref[0, 0]
-    nqb = tq // bqi
-    if causal and skip:
-        # first q-tile whose LAST row reaches this k-block's first col:
-        # i*bqi + bqi - 1 + qo >= ki_base
-        # =>  i >= ceil((ki_base - qo - bqi + 1) / bqi)
-        start = jnp.maximum(0, -(-(ki_base - qo - (bqi - 1)) // bqi))
+def _qtile_bounds(causal: bool, skip_safe: bool, q0, bq: int, qo: int,
+                  ko: int, nkb: int, bk: int):
+    """Per-q-tile k-bounds (nb_full, nb), traced in the tile index:
+    k-tiles [0, nb_full) are fully below the causal diagonal (unmasked
+    body), [nb_full, nb) straddle or cross it (masked body), tiles >=
+    nb are skipped. Skipping past the diagonal is exact only when
+    ``skip_safe`` (kv_offset <= q_offset: every query sees at least its
+    own position, so no row can be fully masked); otherwise every tile
+    is processed so fully-masked rows reproduce the reference's
+    uniform-softmax semantics exactly."""
+    if not causal:
+        return nkb, nkb
+    qstart_g = q0 + qo
+    if skip_safe:
+        nb = jnp.minimum(nkb, jnp.maximum(
+            0, (qstart_g + bq - 1 - ko) // bk + 1))
     else:
-        start = 0
-
-    def body(i, carry):
-        dk, dv = carry
-        qi = q_ref[0, pl.ds(i * bqi, bqi), :]
-        doi = do_ref[0, pl.ds(i * bqi, bqi), :]
-        mi = m_ref[0, pl.ds(i * bqi, bqi), :]
-        logli = logl_ref[0, pl.ds(i * bqi, bqi), :]
-        deltai = delta_ref[0, pl.ds(i * bqi, bqi), :]
-        s, valid = _masked_scores(qi, k, scale, causal,
-                                  i * bqi + qo, ki_base)   # [BQI, BK]
-        p = jnp.exp((s - mi) - logli)
-        dv = dv + jax.lax.dot_general(
-            p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [BK, D]
-        dp = jax.lax.dot_general(
-            doi, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [BQI, BK]
-        ds = p * (dp - deltai)
-        if valid is not None:
-            ds = jnp.where(valid, ds, 0.0)
-        dk = dk + jax.lax.dot_general(
-            ds.astype(qi.dtype), qi, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk, dv
-
-    dk0 = jnp.zeros((bko, d), jnp.float32)
-    dv0 = jnp.zeros((bko, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        nb = nkb
+    nb_full = jnp.minimum(nb, jnp.maximum(
+        0, (qstart_g - ko - bk + 1) // bk + 1))
+    return nb_full, nb
 
 
-def _can_skip(q_offset, kv_offset) -> bool:
-    """Causal diagonal-block skipping is exact only when no row can be
-    fully masked, i.e. every query has at least its own position among
-    the keys — statically known offsets with kv_offset <= q_offset
-    (the self-attention/training case; blockwise callers with future
-    kv blocks keep the conservative full loop so fully-masked rows
-    reproduce the reference's uniform softmax exactly)."""
-    return (isinstance(q_offset, int) and isinstance(kv_offset, int)
-            and kv_offset <= q_offset)
-
-
-def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
-                    kv_offset, interpret):
-    """Pallas backward: dQ gridded over q-blocks, dK/dV over k-blocks."""
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, logl_ref, *,
+                      scale: float, causal: bool, qo: int, ko: int,
+                      bq: int, bk: int):
+    """One batch-head per program: online softmax over [bq, bk] score
+    tiles, K/V resident in VMEM (read from HBM once per head)."""
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    skip = _can_skip(q_offset, kv_offset)
-    bh, tq, d = q3.shape
-    sk = k3.shape[1]
-    # dq panels are [bq, sk]; dkv panels are [tq, bk] — both directions
-    # get the largest block that keeps the f32 panel stack in VMEM
-    bq = _pick_block(tq, sk, 1 << 20)
-    bk = _pick_block(sk, tq, 1 << 20)
-    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
-    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
-    # Δ_i = Σ_d dO_id · O_id — rowwise, XLA fuses this into one pass
-    delta = jnp.sum(g.astype(jnp.float32) * o3.astype(jnp.float32), -1,
-                    keepdims=True)                       # [BH, T, 1]
+    tq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    nkb = sk // bk
+    skip_safe = causal and ko <= qo
 
-    smem = functools.partial(pl.BlockSpec, (1, 1), lambda b, i: (0, 0),
-                             memory_space=pltpu.SMEM)
-    dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
-                          skip=skip),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
-        grid=(bh, tq // bq),
-        in_specs=[
-            smem(), smem(),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        interpret=interpret,
-    )(qo, ko, q3, k3, v3, g, m, logl, delta)
+    def q_tile(i, _):
+        q = q_ref[0, pl.ds(i * bq, bq), :]
+        nb_full, nb = _qtile_bounds(causal, skip_safe, i * bq, bq, qo,
+                                    ko, nkb, bk)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
-                          skip=skip),
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
-        grid=(bh, sk // bk),
-        in_specs=[
-            smem(), smem(),
-            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, i: (b, i, 0))],
-        interpret=interpret,
-    )(qo, ko, q3, k3, v3, g, m, logl, delta)
-    return dq, dk, dv
+        def make_body(masked: bool):
+            def body(j, carry):
+                m, l, acc = carry     # [BQ,1], [BQ,1], [BQ,D] f32
+                kj = k_ref[0, pl.ds(j * bk, bk), :]
+                vj = v_ref[0, pl.ds(j * bk, bk), :]
+                s, _ = _masked_scores(q, kj, scale, masked,
+                                      i * bq + qo, j * bk + ko)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1,
+                                               keepdims=True))
+                p = jnp.exp(s - m_new)
+                corr = jnp.exp(m - m_new)
+                l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+                acc = acc * corr + jax.lax.dot_general(
+                    p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l, acc
+            return body
+
+        init = (jnp.full((bq, 1), -jnp.inf, jnp.float32),
+                jnp.zeros((bq, 1), jnp.float32),
+                jnp.zeros((bq, d), jnp.float32))
+        carry = jax.lax.fori_loop(0, nb_full, make_body(False), init)
+        m, l, acc = jax.lax.fori_loop(nb_full, nb, make_body(causal),
+                                      carry)
+        o_ref[0, pl.ds(i * bq, bq), :] = (acc / l).astype(o_ref.dtype)
+        # Softmax statistics saved for the Pallas backward, as SEPARATE
+        # [BQ, 1] columns (trailing singleton keeps TPU block tiling
+        # happy). m and log(l) must not be pre-summed into one
+        # logsumexp when rows can be fully masked: there m is -1e30 and
+        # log(l)=log(S) would be absorbed by f32 rounding, making the
+        # backward reconstruct p=1 instead of the forward's uniform
+        # 1/S. exp((s - m) - log l) is exact.
+        m_ref[0, pl.ds(i * bq, bq), :] = m
+        logl_ref[0, pl.ds(i * bq, bq), :] = jnp.log(l)
+        return ()
+
+    jax.lax.fori_loop(0, tq // bq, q_tile, ())
+
+
+def _flash_dqkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, logl_ref,
+                       delta_ref, dq_ref, dk_ref, dv_ref, dq_acc, *,
+                       scale: float, causal: bool, qo: int, ko: int,
+                       bq: int, bk: int):
+    """One batch-head per program, ALL THREE gradients in one pass:
+    looping k-blocks outer / q-tiles inner, each tile's probability and
+    dS panels are computed ONCE and feed dV += Pᵀ dO, dK += dSᵀ Q and
+    dQ[i] += dS K (accumulated across the outer loop in a VMEM scratch,
+    written out at the end). The panel recompute (exp) is the
+    VPU-bound cost of the backward — the separate-dQ variant paid it
+    twice. Under causal+skip-safe offsets, q-tiles strictly above the
+    diagonal contribute exactly 0 and the loop starts at the diagonal;
+    without it every tile runs — fully-masked rows carry p = 1/S into
+    dV (the reference's uniform-softmax gradient)."""
+    import jax.experimental.pallas as pl
+
+    tq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    nqb = tq // bq
+    skip_safe = causal and ko <= qo
+
+    dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def k_tile(jk, _):
+        k = k_ref[0, pl.ds(jk * bk, bk), :]
+        v = v_ref[0, pl.ds(jk * bk, bk), :]
+        ki0 = jk * bk + ko
+        if skip_safe:
+            # first q-tile whose LAST row reaches this k-block's first
+            # col: i*bq + bq - 1 + qo >= ki0
+            start = jnp.maximum(0, -(-(ki0 - qo - (bq - 1)) // bq))
+        else:
+            start = 0
+        if causal:
+            # first q-tile FULLY below the diagonal (first row >= this
+            # k-block's last col) — masked/unmasked phase split
+            full_start = jnp.clip(-(-(ki0 + bk - 1 - qo) // bq),
+                                  start, nqb)
+        else:
+            full_start = start
+
+        def make_body(masked: bool):
+            def body(i, carry):
+                dk, dv = carry
+                qi = q_ref[0, pl.ds(i * bq, bq), :]
+                doi = do_ref[0, pl.ds(i * bq, bq), :]
+                mi = m_ref[0, pl.ds(i * bq, bq), :]
+                logli = logl_ref[0, pl.ds(i * bq, bq), :]
+                deltai = delta_ref[0, pl.ds(i * bq, bq), :]
+                s, valid = _masked_scores(qi, k, scale, masked,
+                                          i * bq + qo, ki0)
+                p = jnp.exp(s - (mi + logli)) if skip_safe \
+                    else jnp.exp((s - mi) - logli)
+                dv = dv + jax.lax.dot_general(
+                    p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dp = jax.lax.dot_general(
+                    doi, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                ds = p * (dp - deltai)
+                if valid is not None:
+                    ds = jnp.where(valid, ds, 0.0)
+                dsq = ds.astype(qi.dtype)
+                dk = dk + jax.lax.dot_general(
+                    dsq, qi, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dq_acc[pl.ds(i * bq, bq), :] += jax.lax.dot_general(
+                    dsq, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return dk, dv
+            return body
+
+        init = (jnp.zeros((bk, d), jnp.float32),
+                jnp.zeros((bk, d), jnp.float32))
+        carry = jax.lax.fori_loop(start, full_start, make_body(causal),
+                                  init)
+        dk, dv = jax.lax.fori_loop(full_start, nqb, make_body(False),
+                                   carry)
+        dk_ref[0, pl.ds(jk * bk, bk), :] = \
+            (dk * scale).astype(dk_ref.dtype)
+        dv_ref[0, pl.ds(jk * bk, bk), :] = dv.astype(dv_ref.dtype)
+        return ()
+
+    jax.lax.fori_loop(0, sk // bk, k_tile, ())
+    dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_forward(q3, k3, v3, scale: float, causal: bool,
-                   q_offset, kv_offset, interpret: bool):
+                   q_offset: int, kv_offset: int, interpret: bool):
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     bh, tq, d = q3.shape
     sk = k3.shape[1]
-    # fwd panels are [bq, sk]; 256-row panels measured fastest at T=2048
-    bq = _pick_block(tq, sk, 1 << 19)
-    grid = (bh, tq // bq)
-    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
-    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               skip=_can_skip(q_offset, kv_offset))
+    bq = _inner_block(tq)
+    bk = _inner_block(sk)
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        qo=int(q_offset), ko=int(kv_offset), bq=bq, bk=bk)
+    full = pl.BlockSpec((1, tq, d), lambda b: (b, 0, 0))
+    kvspec = pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0))
+    col = pl.BlockSpec((1, tq, 1), lambda b: (b, 0, 0))
     return pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
                    jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
                    jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0))],
+        grid=(bh,),
+        in_specs=[full, kvspec, kvspec],
+        out_specs=[full, col, col],
         interpret=interpret,
-    )(qo, ko, q3, k3, v3)
+    )(q3, k3, v3)
+
+
+def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
+                    kv_offset, interpret):
+    """Pallas backward: ONE program per batch-head producing dQ, dK and
+    dV together (shared probability panels)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q3.shape
+    sk = k3.shape[1]
+    bq = _inner_block(tq)
+    # 256-col k-tiles: the fused three-gradient kernel's panel stack
+    # (s/p/dp/ds + dq scratch) must fit the 16MB scoped-VMEM budget
+    bk = _inner_block(sk, 256)
+    # Δ_i = Σ_d dO_id · O_id — rowwise, XLA fuses this into one pass
+    delta = jnp.sum(g.astype(jnp.float32) * o3.astype(jnp.float32), -1,
+                    keepdims=True)                       # [BH, T, 1]
+
+    full = pl.BlockSpec((1, tq, d), lambda b: (b, 0, 0))
+    kvspec = pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0))
+    col = pl.BlockSpec((1, tq, 1), lambda b: (b, 0, 0))
+    statics = dict(scale=scale, causal=causal, qo=int(q_offset),
+                   ko=int(kv_offset), bq=bq, bk=bk)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_dqkv_kernel, **statics),
+        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
+        grid=(bh,),
+        in_specs=[full, kvspec, kvspec, full, col, col, col],
+        out_specs=[full, kvspec, kvspec],
+        scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, g, m, logl, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -443,5 +398,6 @@ def flash_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     def to3(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
     out3 = _flash_attention3(to3(q), to3(k), to3(v), float(scale),
-                             bool(causal), q_offset, kv_offset, interpret)
+                             bool(causal), int(q_offset), int(kv_offset),
+                             interpret)
     return jnp.transpose(out3.reshape(b, h, tq, d), (0, 2, 1, 3))

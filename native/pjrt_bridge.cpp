@@ -315,6 +315,18 @@ int dl4j_pjrt_executable_destroy(const void* api_p, void* lexec) {
 
 // Synchronous H2D: copy a dense row-major host array to device
 // `device_ordinal`'s default memory. Returns a PJRT_Buffer*.
+// element byte size for the PJRT_Buffer_Type enum values the host API
+// uses (pjrt.py _DTYPE_TO_PJRT)
+static int64_t dl4j_dtype_size(int dtype) {
+  switch (dtype) {
+    case 1: case 2: case 6: return 1;            // PRED, S8, U8
+    case 3: case 7: case 10: return 2;           // S16, U16, F16
+    case 4: case 8: case 11: return 4;           // S32, U32, F32
+    case 5: case 9: case 12: return 8;           // S64, U64, F64
+    default: return 4;
+  }
+}
+
 void* dl4j_pjrt_h2d(const void* api_p, void* client, const void* data,
                     int dtype, const int64_t* dims, int ndims,
                     int device_ordinal, char* err, int errlen) {
@@ -342,7 +354,20 @@ void* dl4j_pjrt_h2d(const void* api_p, void* client, const void* data,
   args.type = static_cast<PJRT_Buffer_Type>(dtype);
   args.dims = dims;
   args.num_dims = static_cast<size_t>(ndims);
-  // dense major-to-minor layout: leave byte_strides empty
+  // EXPLICIT C-order (row-major) byte strides. Leaving byte_strides
+  // empty means "the plugin's default dense layout", and the real TPU
+  // plugin's default for rank>=3 buffers is NOT row-major (observed: a
+  // clean axis permutation on the (2,3,4) roundtrip) — the host side
+  // of this bridge always speaks C-contiguous numpy.
+  std::vector<int64_t> strides(static_cast<size_t>(ndims));
+  int64_t esize = dl4j_dtype_size(dtype);
+  int64_t acc = esize;
+  for (int i = ndims - 1; i >= 0; --i) {
+    strides[static_cast<size_t>(i)] = acc;
+    acc *= dims[i];
+  }
+  args.byte_strides = strides.empty() ? nullptr : strides.data();
+  args.num_byte_strides = strides.size();
   args.host_buffer_semantics =
       PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
   args.device = dev_args.addressable_devices[device_ordinal];
@@ -376,10 +401,38 @@ long long dl4j_pjrt_buffer_size(const void* api_p, void* buf) {
 long long dl4j_pjrt_d2h(const void* api_p, void* buf, void* dst,
                         size_t dst_size, char* err, int errlen) {
   const PJRT_Api* api = static_cast<const PJRT_Api*>(api_p);
+  // EXPLICIT C-order host layout (same reason as the h2d strides: the
+  // real plugin's default layout for rank>=3 is a permuted order)
+  PJRT_Buffer_Dimensions_Args dim_args;
+  std::memset(&dim_args, 0, sizeof(dim_args));
+  dim_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  dim_args.buffer = static_cast<PJRT_Buffer*>(buf);
+  PJRT_Error* de = api->PJRT_Buffer_Dimensions(&dim_args);
+  if (de != nullptr) {
+    consume_error(api, de, err, errlen);
+    return -1;
+  }
+  // row-major == minor_to_major [ndims-1, ..., 0], no tiles. Tiled is
+  // the layout kind every PJRT plugin accepts on the ToHostBuffer path
+  // (jaxlib's ToLiteral always passes Tiled; the axon plugin rejects
+  // Strides outright).
+  std::vector<int64_t> m2m(dim_args.num_dims);
+  for (size_t i = 0; i < dim_args.num_dims; ++i) {
+    m2m[i] = static_cast<int64_t>(dim_args.num_dims - 1 - i);
+  }
+  PJRT_Buffer_MemoryLayout layout;
+  std::memset(&layout, 0, sizeof(layout));
+  layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  layout.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  layout.tiled.minor_to_major = m2m.empty() ? nullptr : m2m.data();
+  layout.tiled.minor_to_major_size = m2m.size();
+
   PJRT_Buffer_ToHostBuffer_Args args;
   std::memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
   args.src = static_cast<PJRT_Buffer*>(buf);
+  args.host_layout = &layout;
   args.dst = dst;
   args.dst_size = dst_size;
   PJRT_Error* e = api->PJRT_Buffer_ToHostBuffer(&args);

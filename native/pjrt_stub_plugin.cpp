@@ -170,8 +170,21 @@ PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
 PJRT_Error* BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
   if (args->num_byte_strides != 0) {
-    return make_error(PJRT_Error_Code_UNIMPLEMENTED,
-                      "stub supports dense layouts only");
+    // dense C-order strides describe the same layout as "no strides";
+    // the bridge always passes them explicitly (real plugins default
+    // rank>=3 buffers to a permuted order otherwise)
+    if (args->num_byte_strides != args->num_dims) {
+      return make_error(PJRT_Error_Code_INVALID_ARGUMENT,
+                        "byte_strides size must match num_dims");
+    }
+    int64_t acc = static_cast<int64_t>(dtype_size(args->type));
+    for (size_t i = args->num_dims; i > 0; --i) {
+      if (args->byte_strides[i - 1] != acc) {
+        return make_error(PJRT_Error_Code_UNIMPLEMENTED,
+                          "stub supports dense C-order layouts only");
+      }
+      acc *= args->dims[i - 1];
+    }
   }
   size_t elems = 1;
   for (size_t i = 0; i < args->num_dims; ++i) {
