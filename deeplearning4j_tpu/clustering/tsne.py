@@ -42,6 +42,10 @@ Array = jax.Array
 # entropy term NaN).
 _SELF_D2 = 1e30
 
+# adaptive-gain ceiling (both descent paths) — see the scanned-path
+# comment for the f32-at-scale rationale
+_MAX_GAIN = 4.0
+
 
 def _binary_search_perplexity(d2: np.ndarray, perplexity: float
                               ) -> np.ndarray:
@@ -246,8 +250,13 @@ def _make_sparse_tsne_program(n_real: int, block: int, lr: float,
             grad = 4.0 * (attr - rep / jnp.maximum(z, 1e-12))
             mom = jnp.where(it < switch_iter, momentum, final_momentum)
             same_sign = (grad > 0) == (inc > 0)
-            gain = jnp.maximum(jnp.where(same_sign, gain * 0.8,
-                                         gain + 0.2), 0.01)
+            # gains clamped to [0.01, _MAX_GAIN]: the reference scheme
+            # (unbounded, vdM) runs in double precision; in f32 at
+            # N>=50k an oscillating coordinate accumulates gain ~50 and
+            # the momentum-0.8 phase resonates into overflow (measured
+            # round 3) — the cap bounds lr*gain amplification
+            gain = jnp.clip(jnp.where(same_sign, gain * 0.8,
+                                      gain + 0.2), 0.01, _MAX_GAIN)
             inc = mom * inc - lr * gain * grad
             Y = Y + inc
             mean = (jnp.sum(Y[:n_real], axis=0, keepdims=True)
@@ -342,7 +351,7 @@ class Tsne:
             # adaptive gains (same scheme as the reference / original impl)
             same_sign = (grad > 0) == (inc > 0)
             gain = jnp.where(same_sign, gain * 0.8, gain + 0.2)
-            gain = jnp.maximum(gain, 0.01)
+            gain = jnp.clip(gain, 0.01, _MAX_GAIN)  # see scanned path
             inc = mom * inc - self.learning_rate * gain * grad
             Y = Y + inc
             Y = Y - jnp.mean(Y, axis=0, keepdims=True)

@@ -218,8 +218,12 @@ def map_keras_layer(class_name: str, layer: Dict) -> Optional[Layer]:
         return GlobalPoolingLayer(name=name, pooling_type=ptype)
 
     if class_name == "BatchNormalization":
+        # activation explicitly identity: Keras BN has no fused
+        # activation, and leaving it unset would inherit the config
+        # DSL's DL4J-style 'sigmoid' default (round-3 bug: every
+        # imported BN silently sigmoided its output)
         return BatchNormalization(
-            name=name,
+            name=name, activation="identity",
             decay=float(_k1(cfg, "momentum", "momentum", 0.99)),
             eps=float(cfg.get("epsilon", 1e-3)))
 
@@ -599,6 +603,16 @@ class KerasModel:
                         shape, self.dim_ordering)
                 continue
             if cname in _MERGE_CLASSES:
+                if any(n in hwc_flattens for n in raw_inbound):
+                    # a merge after a channels_first Flatten recombines
+                    # features — the CHW→HWC dense-row permutation for
+                    # any downstream Dense becomes unprovable (same
+                    # contract as the layer-between guard below)
+                    raise UnsupportedKerasConfigurationException(
+                        f"merge '{name}' ({cname}) consumes a "
+                        "channels_first Flatten output; cannot prove "
+                        "the flattened feature order for downstream "
+                        "Dense layers")
                 self.builder.add_vertex(name, map_merge_vertex(cname, lc),
                                         *inbound)
                 continue

@@ -90,6 +90,12 @@ def pos_tag_word(word: str, *, sentence_initial: bool = False) -> str:
         return "NNP"
     for suffix, tag in _SUFFIX_RULES:
         if low.endswith(suffix) and len(low) > len(suffix) + 1:
+            # participle suffixes only fire when what's left is a
+            # plausible verb stem (contains a vowel): "testing" -> VBG
+            # but "string"/"king" stay nouns (stems "str"/"k")
+            if tag in ("VBG", "VBD", "VBN") and not any(
+                    c in "aeiouy" for c in low[:-len(suffix)]):
+                continue
             return tag
     return "NN"
 
@@ -101,20 +107,27 @@ def pos_tag(tokens: Sequence[str]) -> List[Tuple[str, str]]:
 
 
 class PosTaggedTokenizerFactory(TokenizerFactory):
-    """Tokenize then keep only tokens whose POS tag is in the allow-list
-    — exact set membership, matching the reference PosUimaTokenizer's
-    `allowedPosTags` semantics (list "NN" and "NNS" separately, as its
-    users do). Wraps any base TokenizerFactory; tags with the rule
-    tagger above."""
+    """Tokenize then filter by POS allow-list — the reference
+    PosUimaTokenizer's EXACT semantics (PosUimaTokenizerFactoryTest):
+    tokens whose tag is NOT allowed become the literal string "NONE"
+    (position-preserving, so windowed models keep distances) unless
+    ``strip_nones`` — then they are dropped. Exact set membership (list
+    "NN" and "NNS" separately, as its users do). Wraps any base
+    TokenizerFactory; tags with the rule tagger above."""
 
     def __init__(self, base: TokenizerFactory,
                  allowed_pos_tags: Sequence[str],
+                 strip_nones: bool = False,
                  preprocessor=None):
         super().__init__(preprocessor)
         self.base = base
         self.allowed = set(allowed_pos_tags)
+        self.strip_nones = strip_nones
 
     def create(self, text: str) -> Tokenizer:
         toks = self.base.create(text).get_tokens()
-        kept = [t for t, tag in pos_tag(toks) if tag in self.allowed]
-        return Tokenizer(kept, self._pre)
+        out = [(t if tag in self.allowed else "NONE")
+               for t, tag in pos_tag(toks)]
+        if self.strip_nones:
+            out = [t for t in out if t != "NONE"]
+        return Tokenizer(out, self._pre)

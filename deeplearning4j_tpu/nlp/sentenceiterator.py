@@ -8,6 +8,7 @@ SentencePreProcessor; documentiterator/: LabelAwareIterator, LabelsSource).
 from __future__ import annotations
 
 import io
+import re
 import os
 from typing import Iterable, Iterator, List, Optional
 
@@ -251,3 +252,62 @@ class AggregatingSentenceIterator(SentenceIterator):
         if not self.has_next():
             raise StopIteration
         return self._apply(self._its[self._idx].next_sentence())
+
+
+_ABBREVIATIONS = frozenset((
+    "mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs", "etc",
+    "e.g", "i.e", "fig", "inc", "ltd", "co", "corp", "no", "vol",
+))
+
+_SENT_BOUNDARY = re.compile(r"(?<=[.!?])\s+")
+
+
+class SegmentingSentenceIterator(SentenceIterator):
+    """Sentence segmentation over raw text — the UimaSentenceIterator
+    capability analog (reference: deeplearning4j-nlp-uima
+    UimaSentenceIterator, which runs the UIMA SentenceAnnotator over a
+    document stream; SURVEY §2.5 UIMA row). The UIMA middleware is a
+    deliberate non-port; the CAPABILITY — turning documents into
+    sentences for the text pipeline — is this regex segmenter:
+    terminator + whitespace boundaries with a closed abbreviation list
+    (won't split after "Dr.", "e.g.", single initials, or decimal
+    numbers)."""
+
+    def __init__(self, documents):
+        super().__init__()
+        self.documents = list(documents)
+        self._sents: List[str] = []
+        self.reset()
+
+    @staticmethod
+    def segment(text: str) -> List[str]:
+        parts = _SENT_BOUNDARY.split(text.strip())
+        out: List[str] = []
+        buf = ""
+        for part in parts:
+            buf = (buf + " " + part).strip() if buf else part
+            last = buf.rstrip(".!?").rsplit(None, 1)
+            word = last[-1].lower() if last else ""
+            # don't end a sentence on an abbreviation or single initial
+            if buf.endswith(".") and (word in _ABBREVIATIONS
+                                      or len(word) == 1):
+                continue
+            if buf:
+                out.append(buf)
+                buf = ""
+        if buf:
+            out.append(buf)
+        return out
+
+    def reset(self) -> None:
+        self._sents = [s for doc in self.documents
+                       for s in self.segment(doc)]
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._sents)
+
+    def next_sentence(self) -> str:
+        s = self._sents[self._i]
+        self._i += 1
+        return self._apply(s)

@@ -197,28 +197,40 @@ class SequenceVectors(WordVectorsMixin):
             flat, sid = flat[keep], sid[keep]
         return flat, sid
 
+    # centers per staging chunk: bounds the O(chunk * 2*window) index
+    # intermediates (the all-at-once form built five corpus x 2w arrays
+    # — multi-GB at 10M+ tokens)
+    _STAGE_CHUNK = 1 << 20
+
     def _corpus_window_pairs(self):
-        """All (center, context) pairs for one epoch in one numpy pass,
-        sentence boundaries respected via sentence ids, token-major
-        pair order (same as the reference's per-sentence loop)."""
+        """All (center, context) pairs for one epoch, vectorized numpy
+        over center-chunks of the flat corpus; sentence boundaries
+        respected via sentence ids, token-major pair order (same as the
+        reference's per-sentence loop)."""
         flat, sid = self._subsampled_corpus()
         n = len(flat)
         if n == 0:
             return (np.empty(0, np.int32),) * 2
         w, offs = self._reduced_windows(n)
         k = len(offs)
-        offs_t = np.tile(offs, n)
-        ci = np.repeat(np.arange(n), k)
-        xi = ci + offs_t
-        inb = (xi >= 0) & (xi < n)
-        valid = (inb & (sid[np.clip(xi, 0, n - 1)] == sid[ci])
-                 & (np.abs(offs_t) <= np.repeat(w, k)))
-        return flat[ci[valid]], flat[xi[valid]]
+        cs, xs = [], []
+        for lo in range(0, n, self._STAGE_CHUNK):
+            hi = min(lo + self._STAGE_CHUNK, n)
+            ci = np.repeat(np.arange(lo, hi, dtype=np.int64), k)
+            off_t = np.tile(offs, hi - lo)
+            xi = ci + off_t
+            valid = ((xi >= 0) & (xi < n)
+                     & (np.abs(off_t) <= np.repeat(w[lo:hi], k)))
+            xi_c = np.clip(xi, 0, n - 1)
+            valid &= sid[xi_c] == sid[ci]
+            cs.append(flat[ci[valid]])
+            xs.append(flat[xi[valid]])
+        return (np.concatenate(cs).astype(np.int32, copy=False),
+                np.concatenate(xs).astype(np.int32, copy=False))
 
     def _corpus_window_rows(self):
-        """All CBOW training rows for one epoch in one numpy pass
-        (targets [n], windows [n, 2w], mask [n, 2w]) — the corpus-wide
-        per-center form."""
+        """All CBOW training rows for one epoch (targets [n], windows
+        [n, 2w], mask [n, 2w]) — chunked like _corpus_window_pairs."""
         flat, sid = self._subsampled_corpus()
         n = len(flat)
         if n == 0:
@@ -226,15 +238,19 @@ class SequenceVectors(WordVectorsMixin):
             return (np.empty(0, np.int32), z.astype(np.int32),
                     z.astype(np.float32))
         w, offs = self._reduced_windows(n)
-        idx = np.arange(n)[:, None] + offs[None, :]
-        inb = (idx >= 0) & (idx < n)
-        cidx = np.clip(idx, 0, n - 1)
-        valid = (inb & (sid[cidx] == sid[:, None])
-                 & (np.abs(offs)[None, :] <= w[:, None]))
-        win = np.where(valid, flat[cidx], 0)
+        wins, masks = [], []
+        for lo in range(0, n, self._STAGE_CHUNK):
+            hi = min(lo + self._STAGE_CHUNK, n)
+            idx = np.arange(lo, hi, dtype=np.int64)[:, None] + offs[None]
+            inb = (idx >= 0) & (idx < n)
+            cidx = np.clip(idx, 0, n - 1)
+            valid = (inb & (sid[cidx] == sid[lo:hi, None])
+                     & (np.abs(offs)[None, :] <= w[lo:hi, None]))
+            wins.append(np.where(valid, flat[cidx], 0))
+            masks.append(valid)
         return (flat.astype(np.int32, copy=False),
-                win.astype(np.int32, copy=False),
-                valid.astype(np.float32))
+                np.concatenate(wins).astype(np.int32, copy=False),
+                np.concatenate(masks).astype(np.float32))
 
     # -- fit ---------------------------------------------------------------
     def fit(self) -> "SequenceVectors":

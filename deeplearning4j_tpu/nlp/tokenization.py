@@ -34,6 +34,38 @@ class LowCasePreProcessor(TokenPreProcess):
         return token.lower()
 
 
+class StemmingPreprocessor(TokenPreProcess):
+    """Lowercase + punctuation strip + suffix stem (reference:
+    deeplearning4j-nlp-uima StemmingPreprocessor — CommonPreprocessor
+    normalization then a Porter-class stem; its own test pins
+    preProcess("TESTING.") == "test"). This is a compact Porter step-1
+    family (plural/participle suffixes with the vowel-in-stem guard),
+    which covers the embedding-pipeline use; it is not a full 5-step
+    Porter implementation."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    @staticmethod
+    def _has_vowel(s: str) -> bool:
+        return any(c in "aeiouy" for c in s)
+
+    def pre_process(self, token: str) -> str:
+        t = self._PUNCT.sub("", token.lower())
+        if t.endswith("sses"):
+            t = t[:-2]
+        elif t.endswith("ies"):
+            t = t[:-2]
+        elif t.endswith("s") and not t.endswith("ss"):
+            t = t[:-1]
+        for suf in ("ing", "ed"):
+            if t.endswith(suf) and self._has_vowel(t[:-len(suf)]):
+                t = t[:-len(suf)]
+                # restore 'e' for doubled-consonant-free CVCe stems is
+                # out of scope for the compact stemmer
+                break
+        return t
+
+
 class EndingPreProcessor(TokenPreProcess):
     """Crude stemmer for plurals/verb endings (reference:
     preprocessor/EndingPreProcessor.java)."""

@@ -470,3 +470,47 @@ def test_text_cnn_zoo_builder_with_sentence_iterator():
     b = next(iter(it))
     preds = np.asarray(net.output(b.features[..., 0])).argmax(1)
     assert (preds == b.labels.argmax(1)).mean() > 0.9
+
+
+def test_pos_uima_factory_parity():
+    """Reference parity: PosUimaTokenizerFactoryTest.testCreate1/2 —
+    allowed ["NN"] on "some test string" gives ["NONE","test","string"]
+    and, with strip_nones, ["test","string"]."""
+    from deeplearning4j_tpu.nlp.pos import PosTaggedTokenizerFactory
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+    f = PosTaggedTokenizerFactory(DefaultTokenizerFactory(), ["NN"])
+    assert f.create("some test string").get_tokens() == \
+        ["NONE", "test", "string"]
+    f2 = PosTaggedTokenizerFactory(DefaultTokenizerFactory(), ["NN"],
+                                   strip_nones=True)
+    assert f2.create("some test string").get_tokens() == ["test", "string"]
+
+
+def test_stemming_preprocessor_parity():
+    """Reference parity: StemmingPreprocessorTest —
+    preProcess("TESTING.") == "test"."""
+    from deeplearning4j_tpu.nlp.tokenization import StemmingPreprocessor
+
+    p = StemmingPreprocessor()
+    assert p.pre_process("TESTING.") == "test"
+    assert p.pre_process("classes") == "class"
+    assert p.pre_process("dogs") == "dog"
+    assert p.pre_process("Jumped!") == "jump"
+
+
+def test_segmenting_sentence_iterator():
+    """UimaSentenceIterator capability analog: multi-sentence documents
+    split at terminators, abbreviation-safe."""
+    from deeplearning4j_tpu.nlp.sentenceiterator import \
+        SegmentingSentenceIterator
+
+    doc = ("Dr. Smith went to Washington. He arrived at 3.30 p.m? "
+           "No one noticed! It was e.g. a quiet day.")
+    sents = SegmentingSentenceIterator.segment(doc)
+    assert sents[0] == "Dr. Smith went to Washington."
+    assert any(s.startswith("No one noticed") for s in sents)
+    it = SegmentingSentenceIterator([doc, "Single sentence here."])
+    all_s = list(it)
+    assert "Single sentence here." in all_s
+    assert len(all_s) >= 4
