@@ -1,6 +1,7 @@
 """Flagship benchmark harness: throughput + MFU on the real chip.
 
-`python benchmarks/flagship.py [--config transformer|vgg16|lstm|all]`
+`python benchmarks/flagship.py
+    [--config transformer|transformer_1024|vgg16|lstm|all]`
 
 Extends bench.py (the driver's one-line LeNet benchmark) to the
 flagship configs from BASELINE.md, printing one JSON line per config
@@ -16,11 +17,13 @@ with examples-or-tokens/sec AND model-FLOPs utilization. Methodology
   per-step cost for the CNNs; causal attention is counted at T²/2
   (the model only needs the lower triangle).
 
-Practical context recorded in BASELINE.md: this chip sustains
-~140 TF/s bf16 on large serial matmuls and ~134 GB/s effective HBM
-bandwidth through the axon tunnel — d_model=512-class training is
-bandwidth-bound here, so MFU-vs-197TF-nominal understates how close
-the programs run to this device's envelope.
+Practical context recorded in BASELINE.md (round-3 measured
+envelope): D=512 square matmul chains sustain ~17 TF/s on this chip
+(latency/bandwidth-bound shape), MLP-shaped 512->2048 matmuls
+~98 TF/s, vs 197 TF/s nominal — so the d=512 flagship config's MFU is
+bounded by its shapes, not the framework: the same training code at
+d_model=1024 (head_dim 128) measures 49.4% MFU (the transformer_1024
+config below).
 """
 from __future__ import annotations
 
@@ -44,7 +47,8 @@ def _peak() -> float | None:
 
 
 def bench_transformer(steps: int = 10, reps: int = 3, *,
-                      batch: int = 16, remat: bool = True,
+                      batch: int = 16, d_model: int = 512,
+                      remat: bool = True,
                       remat_policy: str = "full") -> dict:
     """TransformerLM 12L/512d/8H, T=2048, B=16, bf16, flash attention,
     blockwise remat, Adam — `steps` optimizer steps per compiled
@@ -57,7 +61,7 @@ def bench_transformer(steps: int = 10, reps: int = 3, *,
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        init_params, loss_fn)
 
-    B, T, L, D, H, V = batch, 2048, 12, 512, 8, 256
+    B, T, L, D, H, V = batch, 2048, 12, d_model, 8, 256
     cfg = TransformerConfig(vocab_size=V, d_model=D, n_heads=H,
                             n_layers=L, max_len=T, dtype="bfloat16",
                             remat=remat, remat_policy=remat_policy)
@@ -104,7 +108,7 @@ def bench_transformer(steps: int = 10, reps: int = 3, *,
     peak = _peak()
     if peak:
         mfu = tok_s * flops_tok / peak
-    return {"config": "transformer_lm_12L512d_T2048", "value": round(tok_s),
+    return {"config": f"transformer_lm_12L{D}d_T2048", "value": round(tok_s),
             "unit": "tokens/sec/chip", "ms_per_step": round(
                 best / steps * 1e3, 1),
             "model_flops_per_token": flops_tok,
@@ -195,8 +199,16 @@ def bench_lstm(reps: int = 3) -> dict:
         "mfu": round(mfu, 4) if mfu else None}
 
 
-BENCHES = {"transformer": bench_transformer, "vgg16": bench_vgg16,
-           "lstm": bench_lstm}
+def bench_transformer_1024() -> dict:
+    """d_model=1024 / head_dim 128 variant (B=8): the MXU-native shape
+    that demonstrates the framework's MFU ceiling — measured 49.4%
+    round 3 (BASELINE.md) vs the flagship d=512 config's 27%."""
+    return bench_transformer(batch=8, d_model=1024)
+
+
+BENCHES = {"transformer": bench_transformer,
+           "transformer_1024": bench_transformer_1024,
+           "vgg16": bench_vgg16, "lstm": bench_lstm}
 
 
 def main() -> None:
