@@ -122,3 +122,36 @@ def test_real_vgg16_trained_weights_predict():
     # real accuracy on real data, through our forward pass
     acc = float((pred == g["labels"]).mean())
     assert acc >= 0.8, acc
+
+
+@pytest.mark.slow
+def test_full_resnet50_import_matches_keras():
+    """The BASELINE north-star model end-to-end: the FULL
+    tf_keras.applications.ResNet50 (177 layers: strided convs,
+    ZeroPadding, BatchNorm, Add shortcuts with projection branches,
+    GlobalAveragePooling) built in-process, saved to HDF5, imported
+    through the functional path, predictions compared to Keras's own.
+    Generated at test time (no fixture checked in: the h5 is ~100MB),
+    skipped where tf_keras is unavailable. Reference:
+    KerasModelImport.java:101 + BASELINE.md config 2."""
+    keras = pytest.importorskip("tf_keras")
+    import tempfile
+
+    m = keras.applications.ResNet50(weights=None, input_shape=(64, 64, 3),
+                                    classes=7)
+    h5 = tempfile.mktemp(suffix=".h5")
+    try:
+        m.save(h5, save_format="h5")
+        x = np.random.default_rng(0).normal(
+            size=(2, 64, 64, 3)).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        net = import_keras_model_and_weights(h5)
+        input_name = m.layers[0].name
+        out = net.output({input_name: x})
+        if isinstance(out, dict):
+            out = list(out.values())
+        got = np.asarray(out[0])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    finally:
+        if os.path.exists(h5):
+            os.remove(h5)
