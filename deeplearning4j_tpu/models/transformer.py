@@ -236,9 +236,12 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
 
 def init_cache(cfg: TransformerConfig, batch: int,
                max_len: Optional[int] = None) -> Tuple[Array, Array]:
-    """Stacked per-layer KV caches [L, B, S, H, Dh] (k, v)."""
+    """Stacked per-layer KV caches [L, B, S, D] (k, v) — heads kept
+    FLATTENED in the cache (D = H*Dh): the minor-most dims are then
+    (S-tile, D=lane-full), a clean 2D tiling for the per-position
+    dynamic_update_slice; views reshape to heads at the attention."""
     s = max_len or cfg.max_len
-    shape = (cfg.n_layers, batch, s, cfg.n_heads, cfg.d_head)
+    shape = (cfg.n_layers, batch, s, cfg.d_model)
     dt = cfg.activation_dtype()
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
@@ -247,12 +250,14 @@ def _block_decode(h: Array, p: Dict[str, Array], ck_all: Array,
                   cv_all: Array, layer: int, pos: Array,
                   cfg: TransformerConfig) -> Tuple[Array, Array, Array]:
     """One block, one new position: h [B, 1, D]; stacked caches
-    [L, B, S, H, Dh]. The new K/V row is written in place at
-    (layer, :, pos) — a [1, B, 1, H, Dh] update, NOT a rewrite of the
-    layer's cache (the carry through the sampling scan aliases the
-    buffer, so per-step HBM write traffic is one position per layer;
-    restacking whole caches through a layer scan was the decode
-    bandwidth bottleneck)."""
+    [L, B, S, D] (heads FLATTENED — see init_cache). The new K/V row
+    is written in place at (layer, :, pos) — a [1, B, 1, D] update,
+    NOT a rewrite of the layer's cache (the carry through the sampling
+    scan aliases the buffer, so per-step HBM write traffic is one
+    position per layer; restacking whole caches through a layer scan
+    was the decode bandwidth bottleneck, and the old per-head 5-D
+    layout hit a 369 ms/step XLA tiling pathology at
+    (S=2048, B=64/96) — BASELINE.md round-3 notes)."""
     d = cfg.d_model
     x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
 
@@ -260,19 +265,24 @@ def _block_decode(h: Array, p: Dict[str, Array], ck_all: Array,
         return y.reshape(y.shape[0], 1, cfg.n_heads, cfg.d_head)
 
     q = heads(jnp.matmul(x, p["Wq"].astype(x.dtype)))
-    k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
-    v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
+    k = jnp.matmul(x, p["Wk"].astype(x.dtype))        # [B, 1, D] flat
+    v = jnp.matmul(x, p["Wv"].astype(x.dtype))
     z = jnp.asarray(0, pos.dtype)
     lz = jnp.asarray(layer, pos.dtype)
     ck_all = jax.lax.dynamic_update_slice(
-        ck_all, k[None].astype(ck_all.dtype), (lz, z, pos, z, z))
+        ck_all, k[None].astype(ck_all.dtype), (lz, z, pos, z))
     cv_all = jax.lax.dynamic_update_slice(
-        cv_all, v[None].astype(cv_all.dtype), (lz, z, pos, z, z))
+        cv_all, v[None].astype(cv_all.dtype), (lz, z, pos, z))
     # the single query attends the filled cache prefix through the shared
     # attention core (causal with global q position = pos; the traced
     # offset takes the jnp path, same masking semantics as training)
-    a = dot_product_attention(q, ck_all[layer], cv_all[layer], causal=True,
-                              q_offset=pos, kv_offset=0)
+    b_sz, s_len = ck_all.shape[1], ck_all.shape[2]
+
+    def cache_heads(c):
+        return c[layer].reshape(b_sz, s_len, cfg.n_heads, cfg.d_head)
+
+    a = dot_product_attention(q, cache_heads(ck_all), cache_heads(cv_all),
+                              causal=True, q_offset=pos, kv_offset=0)
     h = h + jnp.matmul(a.reshape(a.shape[0], 1, d),
                        p["Wo"].astype(h.dtype))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -344,8 +354,9 @@ def prefill(cfg: TransformerConfig, params: Dict[str, Any],
 
     h, (ks, vs) = lax.scan(body, h, params["blocks"])  # [L, B, T0, H, Dh]
     ck, cv = init_cache(cfg, b)
-    ck = ck.at[:, :, :t0].set(ks.astype(ck.dtype))
-    cv = cv.at[:, :, :t0].set(vs.astype(cv.dtype))
+    lf = (cfg.n_layers, b, t0, cfg.d_model)            # flatten heads
+    ck = ck.at[:, :, :t0].set(ks.reshape(lf).astype(ck.dtype))
+    cv = cv.at[:, :, :t0].set(vs.reshape(lf).astype(cv.dtype))
     h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
     last_logits = jnp.matmul(h[:, -1], params["Wout"].astype(h.dtype))
     return last_logits, (ck, cv)
