@@ -140,6 +140,7 @@ class SequenceVectors(WordVectorsMixin):
         self._freq_cache = None
         self._neg_pool = None
         self._neg_cursor = 0
+        self._pv_staging = None   # ParagraphVectors' staged windows
 
     # -- training pair generation (host-side, IO/string bound) ------------
     def _encode(self, seq: Sequence[str]) -> np.ndarray:

@@ -162,7 +162,13 @@ class ParagraphVectors(Word2Vec):
         lt = self.lookup_table
         offs = np.concatenate([np.arange(-self.window, 0),
                                np.arange(1, self.window + 1)])
-        for epoch in range(self.epochs * self.iterations):
+        # PV staging is DETERMINISTIC (no reduced-window / subsampling
+        # draws) — tokenize, encode and window the corpus ONCE and
+        # reuse across epochs (only the shuffle re-draws); round-3:
+        # per-epoch re-tokenization was the profiled epoch cost, same
+        # as the skip-gram staging fix in sequencevectors.py
+        staged = getattr(self, "_pv_staging", None)
+        if staged is None:
             doc_l: List[np.ndarray] = []
             tgt_l: List[np.ndarray] = []
             win_l: List[np.ndarray] = []
@@ -183,12 +189,18 @@ class ParagraphVectors(Word2Vec):
                     tgt_l.append(ids)
                     win_l.append(win)
                     msk_l.append(msk)
-            if not tgt_l:
+            if tgt_l:
+                staged = (np.concatenate(doc_l), np.concatenate(tgt_l),
+                          np.concatenate(win_l).astype(np.int32,
+                                                       copy=False),
+                          np.concatenate(msk_l))
+            else:
+                staged = ()
+            self._pv_staging = staged
+        for epoch in range(self.epochs * self.iterations):
+            if not staged:
                 continue
-            doc_a = np.concatenate(doc_l)
-            tgt_a = np.concatenate(tgt_l)
-            win_arr = np.concatenate(win_l).astype(np.int32, copy=False)
-            win_mask = np.concatenate(msk_l)
+            doc_a, tgt_a, win_arr, win_mask = staged
             n_ex = len(tgt_a)
             order = self._rng.permutation(n_ex)
             doc_a, tgt_a = doc_a[order], tgt_a[order]
