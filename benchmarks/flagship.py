@@ -199,6 +199,47 @@ def bench_lstm(reps: int = 3) -> dict:
         "mfu": round(mfu, 4) if mfu else None}
 
 
+def bench_decode(reps: int = 3) -> dict:
+    """KV-cache decode (12L/512d, max_len 2048, B=64): marginal
+    ms/token from the difference of two compiled generate lengths
+    (subtracting prefill + dispatch), forced host read. Round-3: the
+    flattened-head cache layout fixed a 369 ms/token tiling pathology
+    at exactly this shape (BASELINE.md)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params,
+                                                       generate)
+    cfg = TransformerConfig(vocab_size=256, d_model=512, n_heads=8,
+                            n_layers=12, max_len=2048, dtype="bfloat16")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    B = 64
+    prompt = jnp.zeros((B, 64), jnp.int32)
+
+    def timed(new):
+        out = generate(cfg, params, prompt, max_new_tokens=new,
+                       key=jax.random.PRNGKey(1))
+        _host_read(out)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            out = generate(cfg, params, prompt, max_new_tokens=new,
+                           key=jax.random.PRNGKey(1))
+            _host_read(out)
+            best = min(best, _t.perf_counter() - t0)
+        return best
+
+    short, long_ = 16, 128
+    ms_tok = (timed(long_) - timed(short)) / (long_ - short) * 1e3
+    return {"config": "kv_decode_12L512d_S2048_B64",
+            "value": round(B / (ms_tok / 1e3)),
+            "unit": "tokens/sec/chip",
+            "marginal_ms_per_step": round(ms_tok, 2)}
+
+
 def bench_transformer_1024() -> dict:
     """d_model=1024 / head_dim 128 variant (B=8): the MXU-native shape
     that demonstrates the framework's MFU ceiling — measured 49.4%
@@ -208,7 +249,8 @@ def bench_transformer_1024() -> dict:
 
 BENCHES = {"transformer": bench_transformer,
            "transformer_1024": bench_transformer_1024,
-           "vgg16": bench_vgg16, "lstm": bench_lstm}
+           "vgg16": bench_vgg16, "lstm": bench_lstm,
+           "decode": bench_decode}
 
 
 def main() -> None:
