@@ -23,18 +23,29 @@ fully masked (kv_offset <= q_offset), and diagonal-straddling tiles
 run a masked body while fully-valid tiles skip the iota/compare/select
 arithmetic entirely. Loops are lax.fori_loop (Mosaic reuses the tile
 stack across iterations; a fully unrolled Python loop was measured to
-blow the 16MB scoped-VMEM budget). Fits VMEM for T ≲ 8k per chip;
-longer sequences ride sequence parallelism instead (parallel/ring.py
-shards T across the mesh and calls this kernel on local blocks).
+blow the 16MB scoped-VMEM budget). See the measured support matrix at
+the end of this docstring for the per-direction sequence-length
+limits on this backend.
 
-Backward pass: Pallas kernels too (Dao et al.'s two-kernel split). The
-forward additionally emits the per-row running max and log-normalizer;
-the backward recomputes probabilities tile-by-tile from (q, k, stats)
-in VMEM — never materializing [T,S] in HBM in either direction — with
-one kernel producing dQ (tiles up to the diagonal) and one producing
-dK/dV (tiles from the diagonal down). Shapes the kernels can't tile
-(kv length not block-divisible) fall back to a jnp-recompute VJP, same
-dispatch philosophy as the forward.
+Backward pass: ONE fused Pallas kernel producing dQ, dK and dV from
+shared probability panels (the separate-dQ variant paid the VPU-bound
+panel recompute twice). The forward additionally emits the per-row
+running max and log-normalizer; the backward recomputes probabilities
+tile-by-tile from (q, k, stats) in VMEM — never materializing [T,S] in
+HBM in either direction. Shapes the kernels can't tile (kv length not
+block-divisible) fall back to a jnp-recompute VJP.
+
+Measured single-chip support matrix (v5e via the axon tunnel, r3):
+forward compiles and runs to T=16384 (bh-chunked 2-D grids — larger
+grids crash the terminal compile helper, see _MAX_2D_GRID_*); the
+fused backward to T=4096 (q-chunked past _BWD_Q_CHUNK, k-superblocks
+capped at 2); FULL train-step programs (scan + remat + several kernel
+instantiations) compile to T=2048 on this backend — the helper dies
+without a diagnostic on long-T programs containing several pallas
+custom-calls. Longer-context training is sequence parallelism's job
+(parallel/ring.py, parallel/ulysses.py shard T so local blocks stay
+in the supported range), which is the documented first-class
+long-context mechanism (SURVEY §5.7).
 """
 from __future__ import annotations
 
@@ -127,19 +138,24 @@ def _qtile_bounds(causal: bool, skip_safe: bool, q0, bq: int, qo: int,
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, logl_ref, *,
                       scale: float, causal: bool, qo: int, ko: int,
                       bq: int, bk: int):
-    """One batch-head per program: online softmax over [bq, bk] score
-    tiles, K/V resident in VMEM (read from HBM once per head)."""
+    """One (batch-head, q-superblock) program: online softmax over
+    [bq, bk] score tiles. K/V stay VMEM-resident across a head's
+    q-superblocks (their block index is constant in the superblock
+    grid dim, so Mosaic does not re-DMA them); the superblock bounds
+    per-program VMEM so long sequences (T > 2048) still fit."""
     import jax.experimental.pallas as pl
 
-    tq, d = q_ref.shape[1], q_ref.shape[2]
+    qsb, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
     nkb = sk // bk
     skip_safe = causal and ko <= qo
+    q_base = pl.program_id(1) * qsb
 
     def q_tile(i, _):
         q = q_ref[0, pl.ds(i * bq, bq), :]
-        nb_full, nb = _qtile_bounds(causal, skip_safe, i * bq, bq, qo,
-                                    ko, nkb, bk)
+        nb_full, nb = _qtile_bounds(causal, skip_safe,
+                                    q_base + i * bq, bq, qo, ko, nkb,
+                                    bk)
 
         def make_body(masked: bool):
             def body(j, carry):
@@ -147,7 +163,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, logl_ref, *,
                 kj = k_ref[0, pl.ds(j * bk, bk), :]
                 vj = v_ref[0, pl.ds(j * bk, bk), :]
                 s, _ = _masked_scores(q, kj, scale, masked,
-                                      i * bq + qo, j * bk + ko)
+                                      q_base + i * bq + qo,
+                                      j * bk + ko)
                 m_new = jnp.maximum(m, jnp.max(s, axis=-1,
                                                keepdims=True))
                 p = jnp.exp(s - m_new)
@@ -167,17 +184,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, logl_ref, *,
                                       carry)
         o_ref[0, pl.ds(i * bq, bq), :] = (acc / l).astype(o_ref.dtype)
         # Softmax statistics saved for the Pallas backward, as SEPARATE
-        # [BQ, 1] columns (trailing singleton keeps TPU block tiling
-        # happy). m and log(l) must not be pre-summed into one
-        # logsumexp when rows can be fully masked: there m is -1e30 and
-        # log(l)=log(S) would be absorbed by f32 rounding, making the
-        # backward reconstruct p=1 instead of the forward's uniform
-        # 1/S. exp((s - m) - log l) is exact.
+        # [T, 1] columns (the trailing singleton lane-pads 1 -> 128 in
+        # VMEM — tolerable at the supported backward range T <= 4096;
+        # a lane-major repacking was tried and crashed the Mosaic
+        # lowering, so the column form stays). m and log(l) must not be
+        # pre-summed into one logsumexp when rows can be fully masked:
+        # there m is -1e30 and log(l)=log(S) would be absorbed by f32
+        # rounding, making the backward reconstruct p=1 instead of the
+        # forward's uniform 1/S. exp((s - m) - log l) is exact.
         m_ref[0, pl.ds(i * bq, bq), :] = m
         logl_ref[0, pl.ds(i * bq, bq), :] = jnp.log(l)
         return ()
 
-    jax.lax.fori_loop(0, tq // bq, q_tile, ())
+    jax.lax.fori_loop(0, qsb // bq, q_tile, ())
 
 
 def _flash_dqkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, logl_ref,
@@ -197,16 +216,21 @@ def _flash_dqkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, logl_ref,
     import jax.experimental.pallas as pl
 
     tq, d = q_ref.shape[1], q_ref.shape[2]
-    sk = k_ref.shape[1]
+    ksb = k_ref.shape[1]           # this program's k-superblock extent
     nqb = tq // bq
     skip_safe = causal and ko <= qo
+    k_base = pl.program_id(1) * ksb
 
-    dq_acc[...] = jnp.zeros_like(dq_acc)
+    # the dq accumulator persists across the k-superblock grid dim:
+    # zero it on the first superblock only
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def k_tile(jk, _):
         k = k_ref[0, pl.ds(jk * bk, bk), :]
         v = v_ref[0, pl.ds(jk * bk, bk), :]
-        ki0 = jk * bk + ko
+        ki0 = k_base + jk * bk + ko
         if skip_safe:
             # first q-tile whose LAST row reaches this k-block's first
             # col: i*bq + bq - 1 + qo >= ki0
@@ -263,8 +287,30 @@ def _flash_dqkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, logl_ref,
         dv_ref[0, pl.ds(jk * bk, bk), :] = dv.astype(dv_ref.dtype)
         return ()
 
-    jax.lax.fori_loop(0, sk // bk, k_tile, ())
+    jax.lax.fori_loop(0, ksb // bk, k_tile, ())
+    # written every superblock; only the final state leaves VMEM (the
+    # dq block index is constant in the superblock grid dim)
     dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+# max programs per pallas_call when the grid has REAL superblocks
+# (nsb > 1): larger such grids were observed to crash the terminal
+# compile helper on this backend without a diagnostic (fwd: (32,4) and
+# (64,2) crash; the scratch-carrying fused backward fails earlier, at
+# (32,2)). Grids with nsb == 1 are exempt from the cap — they are the
+# T<=2048 hot path and are empirically safe at least to (128, 1)
+# (the flagship training config, measured all round).
+_MAX_2D_GRID_FWD = 96
+_MAX_2D_GRID_BWD = 32
+
+
+def _bh_chunks(bh: int, nsb: int, cap: int):
+    """Slice extents over the batch-head axis keeping the 2-D grid
+    (chunk, nsb) within ``cap`` programs."""
+    if nsb <= 1:
+        return [(0, bh)]
+    step = max(1, cap // nsb)
+    return [(lo, min(step, bh - lo)) for lo in range(0, bh, step)]
 
 
 def _flash_forward(q3, k3, v3, scale: float, causal: bool,
@@ -275,22 +321,37 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
     sk = k3.shape[1]
     bq = _inner_block(tq)
     bk = _inner_block(sk)
+    # q-superblock: bounds per-program VMEM (full-T q/o blocks blow the
+    # 16MB budget past T=2048); K/V block indices are constant in this
+    # grid dim, so they stay VMEM-resident across a head's superblocks
+    qsb = _inner_block(tq, 2048)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
         qo=int(q_offset), ko=int(kv_offset), bq=bq, bk=bk)
-    full = pl.BlockSpec((1, tq, d), lambda b: (b, 0, 0))
-    kvspec = pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0))
-    col = pl.BlockSpec((1, tq, 1), lambda b: (b, 0, 0))
-    return pl.pallas_call(
-        kernel,
-        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
-        grid=(bh,),
-        in_specs=[full, kvspec, kvspec],
-        out_specs=[full, col, col],
-        interpret=interpret,
-    )(q3, k3, v3)
+    qspec = pl.BlockSpec((1, qsb, d), lambda b, i: (b, i, 0))
+    kvspec = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+    stat_spec = pl.BlockSpec((1, qsb, 1), lambda b, i: (b, i, 0))
+
+    def call(qc, kc, vc):
+        c = qc.shape[0]
+        return pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((c, tq, d), q3.dtype),
+                       jax.ShapeDtypeStruct((c, tq, 1), jnp.float32),
+                       jax.ShapeDtypeStruct((c, tq, 1), jnp.float32)],
+            grid=(c, tq // qsb),
+            in_specs=[qspec, kvspec, kvspec],
+            out_specs=[qspec, stat_spec, stat_spec],
+            interpret=interpret,
+        )(qc, kc, vc)
+
+    chunks = _bh_chunks(bh, tq // qsb, _MAX_2D_GRID_FWD)
+    if len(chunks) == 1:
+        return call(q3, k3, v3)
+    outs = [call(q3[lo:lo + n], k3[lo:lo + n], v3[lo:lo + n])
+            for lo, n in chunks]
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+                 for i in range(3))
 
 
 def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
@@ -306,27 +367,46 @@ def _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal, q_offset,
     # 256-col k-tiles: the fused three-gradient kernel's panel stack
     # (s/p/dp/ds + dq scratch) must fit the 16MB scoped-VMEM budget
     bk = _inner_block(sk, 256)
+    # k-superblock grid dim (long-T VMEM bound, mirroring the forward's
+    # q-superblocks); q/do/stats blocks stay VMEM-resident across it
+    # and the dq scratch accumulates through it. At most TWO
+    # superblocks — backward grids with a superblock dim >= 4 crash the
+    # terminal compile helper on this backend (no diagnostic) — and
+    # ksb must be a multiple of bk (the kernel loops ksb // bk tiles;
+    # a non-multiple would silently skip the tail k-rows)
+    ksb = sk // 2 if (sk % (2 * bk) == 0 and sk // 2 >= 2048) else sk
     # Δ_i = Σ_d dO_id · O_id — rowwise, XLA fuses this into one pass
     delta = jnp.sum(g.astype(jnp.float32) * o3.astype(jnp.float32), -1,
                     keepdims=True)                       # [BH, T, 1]
 
-    full = pl.BlockSpec((1, tq, d), lambda b: (b, 0, 0))
-    kvspec = pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0))
-    col = pl.BlockSpec((1, tq, 1), lambda b: (b, 0, 0))
     statics = dict(scale=scale, causal=causal, qo=int(q_offset),
                    ko=int(kv_offset), bq=bq, bk=bk)
-    dq, dk, dv = pl.pallas_call(
-        functools.partial(_flash_dqkv_kernel, **statics),
-        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
-        grid=(bh,),
-        in_specs=[full, kvspec, kvspec, full, col, col, col],
-        out_specs=[full, kvspec, kvspec],
-        scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
-        interpret=interpret,
-    )(q3, k3, v3, g, m, logl, delta)
-    return dq, dk, dv
+    full = pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0))
+    kspec = pl.BlockSpec((1, ksb, d), lambda b, j: (b, j, 0))
+    col = pl.BlockSpec((1, tq, 1), lambda b, j: (b, 0, 0))
+
+    def call(args):
+        c = args[0].shape[0]
+        return pl.pallas_call(
+            functools.partial(_flash_dqkv_kernel, **statics),
+            out_shape=[jax.ShapeDtypeStruct((c, tq, d), q3.dtype),
+                       jax.ShapeDtypeStruct((c, sk, d), k3.dtype),
+                       jax.ShapeDtypeStruct((c, sk, d), v3.dtype)],
+            grid=(c, sk // ksb),
+            in_specs=[full, kspec, kspec, full, col, col, col],
+            out_specs=[full, kspec, kspec],
+            scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
+            interpret=interpret,
+        )(*args)
+
+    operands = (q3, k3, v3, g, m, logl, delta)
+    chunks = _bh_chunks(bh, sk // ksb, _MAX_2D_GRID_BWD)
+    if len(chunks) == 1:
+        return call(operands)
+    outs = [call(tuple(a[lo:lo + n] for a in operands))
+            for lo, n in chunks]
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+                 for i in range(3))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -343,10 +423,34 @@ def _fwd(q3, k3, v3, scale, causal, q_offset, kv_offset, interpret):
     return out, (q3, k3, v3, out, m, logl)
 
 
+# q-extent per fused-backward call: the kernel holds full-T q/do and
+# the three [T, 1] stat columns (lane-padded 128x) in VMEM — past this
+# the 16MB budget blows, so longer sequences split over q at the host
+# level (dK/dV are linear in the q chunks and sum; dQ concatenates)
+_BWD_Q_CHUNK = 4096
+
+
 def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
     q3, k3, v3, o3, m, logl = res
     sk = k3.shape[1]
     if sk % min(BLOCK_Q, sk) == 0:
+        tq = q3.shape[1]
+        if tq > _BWD_Q_CHUNK and tq % _BWD_Q_CHUNK == 0:
+            dqs = []
+            dk = dv = None
+            for lo in range(0, tq, _BWD_Q_CHUNK):
+                sl = slice(lo, lo + _BWD_Q_CHUNK)
+                dq_c, dk_c, dv_c = _flash_backward(
+                    q3[:, sl], k3, v3, o3[:, sl], m[:, sl],
+                    logl[:, sl], g[:, sl], scale, causal,
+                    q_offset + lo, kv_offset, interpret)
+                dqs.append(dq_c)
+                dk = dk_c.astype(jnp.float32) if dk is None \
+                    else dk + dk_c.astype(jnp.float32)
+                dv = dv_c.astype(jnp.float32) if dv is None \
+                    else dv + dv_c.astype(jnp.float32)
+            return (jnp.concatenate(dqs, axis=1),
+                    dk.astype(k3.dtype), dv.astype(v3.dtype))
         return _flash_backward(q3, k3, v3, o3, m, logl, g, scale, causal,
                                q_offset, kv_offset, interpret)
     # kv length doesn't tile: jnp-recompute fallback
