@@ -125,3 +125,47 @@ def test_gradients_with_fully_masked_rows():
     for g1, g2 in zip(got, want):
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_multi_superblock_and_chunked_backward_paths():
+    """Exercise the long-context structures at SMALL T by shrinking the
+    internal tile caps: multiple q/k-superblocks per head, batch-head
+    chunked calls, and the q-chunked host-split backward — the paths
+    real CPU tests never reach (they all fit one superblock) and that
+    only long-T chip runs would otherwise cover (round-3)."""
+    import importlib
+
+    fa = importlib.import_module("deeplearning4j_tpu.ops.flash_attention")
+    orig_inner = fa._inner_block
+    orig_chunk = fa._BWD_Q_CHUNK
+
+    def small_inner(n, cap=512):
+        # superblock cap 128, tile cap 64 -> nsb up to 4 at T=512
+        return orig_inner(n, 128 if cap == 2048 else 64)
+
+    fa._inner_block = small_inner
+    fa._BWD_Q_CHUNK = 256
+    try:
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 512, 2, 32),
+                              jnp.float32)
+        got = fa.flash_attention(q, q, q, causal=True)
+        q3 = jnp.moveaxis(q, 2, 1).reshape(2, 512, 32)
+        want = fa._reference_attention(q3, q3, q3, 32 ** -0.5, True, 0, 0)
+        want = jnp.moveaxis(want.reshape(1, 2, 512, 32), 1, 2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+        g1 = jax.grad(lambda x: jnp.sum(
+            fa.flash_attention(x, x, x, causal=True)))(q)
+
+        def ref_loss(x):
+            x3 = jnp.moveaxis(x, 2, 1).reshape(2, 512, 32)
+            return jnp.sum(fa._reference_attention(
+                x3, x3, x3, 32 ** -0.5, True, 0, 0))
+
+        g2 = jax.grad(ref_loss)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-5)
+    finally:
+        fa._inner_block = orig_inner
+        fa._BWD_Q_CHUNK = orig_chunk
