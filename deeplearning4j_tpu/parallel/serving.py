@@ -1,0 +1,176 @@
+"""Tensor+data-parallel KV-cache generation — sharded serving.
+
+NET-NEW vs the reference (its serving story is single-process
+`MultiLayerNetwork.output`/`rnnTimeStep`; SURVEY §5.7-5.8): the flagship
+transformer's autoregressive decode runs SPMD over a `('data',
+'model')` mesh. Megatron-style tensor parallelism splits the attention
+heads and MLP hidden dim over 'model' (reusing parallel/megatron.py's
+param_specs/shard_params layout, pipe=1), the batch splits over 'data',
+and each device holds only its head-shard of the KV cache —
+[L, B/dp, S, D/tp] in the flattened-head layout models/transformer.py
+uses (round-3 decode tiling fix). Per decode step the only collective
+is the attention/MLP output psum over 'model' (g-sync), after which
+every model-rank holds identical full logits and samples the same
+token from the same per-step key — no gather of the cache, ever.
+
+Greedy (temperature <= 0) parallel decode equals single-chip
+`models/transformer.generate` token-for-token (the equivalence test's
+obligation, tests/test_parallel_serving.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.nn.layers.attention import (dot_product_attention,
+                                                    layer_norm)
+from deeplearning4j_tpu.parallel.megatron import (_g_sync, param_specs,
+                                                  shard_params)
+
+Array = jax.Array
+
+
+def _local_block_prefill(h, p, cfg: TransformerConfig, tp: int):
+    """TP block forward over the full prompt, returning the block's
+    LOCAL k/v rows (flattened local heads) for the cache."""
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+
+    def heads(y):
+        return y.reshape(y.shape[0], y.shape[1], h_loc, cfg.d_head)
+
+    q = heads(jnp.matmul(x, p["Wq"].astype(x.dtype)))
+    k = heads(jnp.matmul(x, p["Wk"].astype(x.dtype)))
+    v = heads(jnp.matmul(x, p["Wv"].astype(x.dtype)))
+    a = dot_product_attention(q, k, v, causal=True)
+    a = a.reshape(a.shape[0], a.shape[1], h_loc * cfg.d_head)
+    h = h + g_model(jnp.matmul(a, p["Wo"].astype(a.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    z = jax.nn.gelu(jnp.matmul(x, p["W1"].astype(x.dtype))
+                    + p["b1"].astype(x.dtype))
+    m = g_model(jnp.matmul(z, p["W2"].astype(z.dtype)))
+    h = h + m + p["b2"].astype(h.dtype)
+    kf = k.reshape(k.shape[0], k.shape[1], h_loc * cfg.d_head)
+    vf = v.reshape(v.shape[0], v.shape[1], h_loc * cfg.d_head)
+    return h, (kf, vf)
+
+
+def _local_block_decode(h, p, ck_all, cv_all, layer: int, pos,
+                        cfg: TransformerConfig, tp: int):
+    """One TP block, one new position, local-head cache update +
+    attention over the local cache shard."""
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    q = jnp.matmul(x, p["Wq"].astype(x.dtype)) \
+        .reshape(x.shape[0], 1, h_loc, cfg.d_head)
+    k = jnp.matmul(x, p["Wk"].astype(x.dtype))      # [B, 1, D_loc]
+    v = jnp.matmul(x, p["Wv"].astype(x.dtype))
+    z = jnp.asarray(0, pos.dtype)
+    lz = jnp.asarray(layer, pos.dtype)
+    ck_all = lax.dynamic_update_slice(
+        ck_all, k[None].astype(ck_all.dtype), (lz, z, pos, z))
+    cv_all = lax.dynamic_update_slice(
+        cv_all, v[None].astype(cv_all.dtype), (lz, z, pos, z))
+    b, s = ck_all.shape[1], ck_all.shape[2]
+
+    def cache_heads(c):
+        return c[layer].reshape(b, s, h_loc, cfg.d_head)
+
+    a = dot_product_attention(q, cache_heads(ck_all),
+                              cache_heads(cv_all), causal=True,
+                              q_offset=pos, kv_offset=0)
+    h = h + g_model(jnp.matmul(a.reshape(a.shape[0], 1, d_loc),
+                               p["Wo"].astype(h.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    z2 = jax.nn.gelu(jnp.matmul(x, p["W1"].astype(x.dtype))
+                     + p["b1"].astype(x.dtype))
+    m = g_model(jnp.matmul(z2, p["W2"].astype(z2.dtype)))
+    h = h + m + p["b2"].astype(h.dtype)
+    return h, ck_all, cv_all
+
+
+def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
+                           max_new_tokens: int,
+                           temperature: float = 0.0):
+    """Compiled sharded generate: (params, prompt [B, T0], key) ->
+    [B, T0 + max_new_tokens]. Params must be placed with
+    `shard_serving_params`; batch shards over 'data', heads/MLP over
+    'model'. MoE configs are out of scope (serving covers the dense
+    flagship)."""
+    if cfg.n_experts > 0:
+        raise ValueError("parallel serving covers dense configs; "
+                         "route MoE through the training mesh")
+    tp = mesh.shape["model"]
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
+                         f"model axis {tp}")
+    specs = param_specs(cfg)
+
+    def run(params, prompt, key):
+        dt = cfg.activation_dtype()
+        b, t0 = prompt.shape
+        h = (params["embed"].astype(dt)[prompt]
+             + params["pos"].astype(dt)[:t0][None])
+
+        def pf_body(h, p):
+            return _local_block_prefill(h, p, cfg, tp)
+
+        h, (ks, vs) = lax.scan(pf_body, h, params["blocks"])
+        d_loc = (cfg.n_heads // tp) * cfg.d_head
+        ck = jnp.zeros((cfg.n_layers, b, cfg.max_len, d_loc), dt)
+        cv = jnp.zeros_like(ck)
+        ck = ck.at[:, :, :t0].set(ks.astype(dt))
+        cv = cv.at[:, :, :t0].set(vs.astype(dt))
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(h[:, -1], params["Wout"].astype(h.dtype))
+        pos0 = jnp.asarray(t0, jnp.int32)
+
+        def sample(carry, k_step):
+            ck, cv, pos, logits = carry
+            if temperature <= 0:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                tok = jax.random.categorical(
+                    k_step, logits.astype(jnp.float32) / temperature,
+                    axis=-1).astype(jnp.int32)
+            emb = params["embed"].astype(dt)[tok]
+            posv = lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                            axis=0).astype(dt)
+            hh = (emb + posv)[:, None, :]
+            for layer in range(cfg.n_layers):
+                p_l = {kk: vv[layer]
+                       for kk, vv in params["blocks"].items()}
+                hh, ck, cv = _local_block_decode(hh, p_l, ck, cv,
+                                                 layer, pos, cfg, tp)
+            hh = layer_norm(hh, params["lnfg"], params["lnfb"],
+                            cfg.eps)
+            new_logits = jnp.matmul(hh[:, 0],
+                                    params["Wout"].astype(hh.dtype))
+            return (ck, cv, pos + 1, new_logits), tok
+
+        keys = jax.random.split(key, max_new_tokens)
+        _, toks = lax.scan(sample, (ck, cv, pos0, logits), keys)
+        return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)],
+                               axis=1)
+
+    sharded = shard_map(run, mesh=mesh,
+                        in_specs=(specs, P("data", None), P()),
+                        out_specs=P("data", None), check_rep=False)
+    return jax.jit(sharded)
+
+
+def shard_serving_params(params, cfg: TransformerConfig, mesh: Mesh):
+    """Place params for serving — same megatron layout (pipe=1 on a
+    serving mesh, so the stacked [L, ...] blocks stay whole per
+    device while heads/MLP split over 'model')."""
+    return shard_params(params, cfg, mesh)
