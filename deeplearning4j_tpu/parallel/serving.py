@@ -39,7 +39,14 @@ Array = jax.Array
 
 def _local_block_prefill(h, p, cfg: TransformerConfig, tp: int):
     """TP block forward over the full prompt, returning the block's
-    LOCAL k/v rows (flattened local heads) for the cache."""
+    LOCAL k/v rows (flattened local heads) for the cache.
+
+    NOTE: this and _local_block_decode deliberately mirror
+    models/transformer.block_forward/_block_decode and
+    megatron._block_fwd_sharded with local head counts + the 'model'
+    output psum; any change to the block math must land in all of
+    them — tests/test_parallel_serving.py's token-for-token greedy
+    equivalence is the guard that catches drift."""
     g_model = _g_sync("model")
     h_loc = cfg.n_heads // tp
     x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
@@ -114,11 +121,25 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
     if cfg.n_heads % tp:
         raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
                          f"model axis {tp}")
+    for ax in ("pipe", "seq", "expert"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"serving mesh uses only ('data', 'model'); axis "
+                f"'{ax}'={mesh.shape[ax]} would silently shard the "
+                "stacked layers with no schedule to reassemble them")
     specs = param_specs(cfg)
 
     def run(params, prompt, key):
         dt = cfg.activation_dtype()
         b, t0 = prompt.shape
+        if t0 + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"generation length {t0 + max_new_tokens} exceeds "
+                f"max_len={cfg.max_len}")
+        # independent sampling noise per data shard (greedy ignores
+        # the key; without the fold, equal prompts on different data
+        # ranks would sample identical continuations)
+        key = jax.random.fold_in(key, lax.axis_index("data"))
         h = (params["embed"].astype(dt)[prompt]
              + params["pos"].astype(dt)[:t0][None])
 

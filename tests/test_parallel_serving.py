@@ -50,3 +50,22 @@ def test_tp_generate_sampled_is_valid(mesh):
     np.testing.assert_array_equal(a, b)
     assert a.shape == (4, 20)
     assert (a >= 0).all() and (a < 32).all()
+    # identical prompts on DIFFERENT data shards must not sample
+    # identical continuations (per-shard key fold; rows 0-1 live on
+    # data rank 0, rows 2-3 on rank 1)
+    assert not np.array_equal(a[:2, 8:], a[2:, 8:])
+
+
+def test_tp_generate_rejects_bad_meshes_and_lengths(devices8):
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=16)
+    with pytest.raises(ValueError, match="pipe"):
+        make_parallel_generate(cfg, make_mesh(MeshSpec(pipe=2, model=2,
+                                                       data=2)),
+                               max_new_tokens=4)
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    pgen = make_parallel_generate(cfg, mesh, max_new_tokens=12)
+    params = shard_serving_params(init_params(cfg, jax.random.PRNGKey(0)),
+                                  cfg, mesh)
+    with pytest.raises(ValueError, match="exceeds"):
+        pgen(params, jnp.zeros((4, 8), jnp.int32), jax.random.PRNGKey(1))
