@@ -46,15 +46,19 @@ def _peak() -> float | None:
     return chip_peak_flops()
 
 
-def bench_transformer(steps: int = 10, reps: int = 3, *,
+def bench_transformer(steps: int = 20, reps: int = 3, *,
                       batch: int = 16, d_model: int = 512,
                       remat: bool = True,
                       remat_policy: str = "full") -> dict:
     """TransformerLM 12L/512d/8H, T=2048, B=16, bf16, flash attention,
     blockwise remat, Adam — `steps` optimizer steps per compiled
-    program. The keyword knobs exist for benchmarks/remat_sweep.py so
-    the sweep and the flagship row share ONE harness (same warmup,
-    donation, host-read fence, best-of-reps timing)."""
+    program (20 default: the ~300 ms tunnel dispatch is ~2% of a
+    10-step program and halves again at 20 — the same amortization a
+    real multi-epoch run gets; bench.py's LeNet line runs 960-step
+    programs for the same reason). The keyword knobs exist for
+    benchmarks/remat_sweep.py so the sweep and the flagship row share
+    ONE harness (same warmup, donation, host-read fence, best-of-reps
+    timing)."""
     import jax
     import jax.numpy as jnp
 
