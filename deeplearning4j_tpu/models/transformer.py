@@ -73,6 +73,14 @@ class TransformerConfig:
     # (parallel/ulysses.py: all_to_all head resharding; needs
     # n_heads/tp % sp == 0)
     seq_impl: str = "ring"
+    # vocab chunk size for the streaming cross-entropy (0 = dense path).
+    # At real-LM vocabularies the [B, T, V] f32 logits of the dense
+    # loss are the memory wall (4.3 GB at V=32k/B=16/T=2048, and the
+    # dense backward holds logits + log_softmax residuals — ~3x that);
+    # with xent_chunk=C the loss scans V/C output-projection panels
+    # with an online logsumexp and never materializes more than
+    # [B*T, C] — see chunked_cross_entropy
+    xent_chunk: int = 0
 
     @property
     def d_head(self) -> int:
@@ -200,9 +208,12 @@ def block_forward(h: Array, p: Dict[str, Array], cfg: TransformerConfig,
     return h
 
 
-def forward(cfg: TransformerConfig, params: Dict[str, Any],
-            tokens: Array) -> Array:
-    """tokens [B, T] int32 -> logits [B, T, V]."""
+def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any],
+                   tokens: Array) -> Array:
+    """tokens [B, T] int32 -> final-LN hidden states [B, T, D] (the
+    pre-output-projection activations; loss_fn consumes these directly
+    so the chunked cross-entropy can fuse the D->V projection into its
+    vocab-panel scan)."""
     dt = cfg.activation_dtype()
     t = tokens.shape[1]
     h = (params["embed"].astype(dt)[tokens]
@@ -223,7 +234,13 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
                if cfg.remat_policy == "dots" else None)
         body = jax.checkpoint(body, prevent_cse=False, policy=pol)
     h, _ = lax.scan(body, h, params["blocks"])
-    h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+    return layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: Array) -> Array:
+    """tokens [B, T] int32 -> logits [B, T, V]."""
+    h = forward_hidden(cfg, params, tokens)
     return jnp.matmul(h, params["Wout"].astype(h.dtype))
 
 
@@ -408,8 +425,61 @@ def generate(cfg: TransformerConfig, params: Dict[str, Any], prompt: Array,
     return run(params, prompt, key)
 
 
+def chunked_cross_entropy(h: Array, wout: Array, targets: Array,
+                          chunk: int) -> Array:
+    """Streaming softmax cross-entropy: mean NLL of ``targets`` under
+    ``softmax(h @ wout)`` WITHOUT materializing the [B, T, V] logits.
+
+    The vocab axis is split into V/chunk panels and scanned with an
+    online logsumexp (running max ``m``, rescaled sum ``s`` — the same
+    streaming-softmax recurrence flash attention uses along T, applied
+    along V), picking up the target logit from whichever panel contains
+    it. Live memory is one [B*T, chunk] f32 panel; the scan body is
+    jax.checkpoint'ed so reverse-mode recomputes each panel instead of
+    saving all of them (which would rebuild the full logits tensor as
+    residuals). Role analog: the reference's output-layer score path
+    (BaseOutputLayer.java computeScore) materializes full preOutput —
+    affordable at its vocabularies, not at a 32k-vocab LM batch.
+    """
+    d, v = wout.shape
+    if v % chunk != 0:
+        raise ValueError(f"vocab {v} not divisible by xent_chunk {chunk}")
+    n_chunks = v // chunk
+    x = h.reshape(-1, d)
+    y = targets.reshape(-1).astype(jnp.int32)
+    n = x.shape[0]
+    # [D, V] -> [nC, D, C] panel stack (panel i holds cols [i*C, (i+1)*C))
+    wc = jnp.moveaxis(wout.reshape(d, n_chunks, chunk), 1, 0)
+
+    def body(carry, inp):
+        m, s, tl = carry
+        w_i, c0 = inp
+        # match the dense path's arithmetic: matmul in the activation
+        # dtype (bf16 on TPU, f32 accumulation on the MXU), then f32
+        logits = jnp.matmul(x, w_i.astype(x.dtype)).astype(jnp.float32)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
+        local = y - c0
+        hit = (local >= 0) & (local < chunk)
+        g = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        return (m_new, s, jnp.where(hit, g, tl)), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    offsets = (jnp.arange(n_chunks, dtype=jnp.int32) * chunk)
+    (m, s, tl), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), init, (wc, offsets))
+    return jnp.mean(m + jnp.log(s) - tl)
+
+
 def loss_fn(cfg: TransformerConfig, params: Dict[str, Any], tokens: Array,
             targets: Array) -> Array:
+    if cfg.xent_chunk > 0 and cfg.vocab_size > cfg.xent_chunk:
+        h = forward_hidden(cfg, params, tokens)
+        return chunked_cross_entropy(h, params["Wout"], targets,
+                                     cfg.xent_chunk)
     logits = forward(cfg, params, tokens).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32),

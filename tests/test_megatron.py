@@ -145,6 +145,46 @@ def test_transformer_remat_same_loss_and_grads():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_chunked_cross_entropy_matches_dense():
+    """xent_chunk streaming loss == dense log_softmax loss in value AND
+    grads (the real-vocab flagship path: never materializes [B,T,V])."""
+    from deeplearning4j_tpu.models.transformer import (chunked_cross_entropy,
+                                                       loss_fn)
+
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                max_len=32)
+    cfg_d = TransformerConfig(**base)
+    cfg_c = TransformerConfig(**base, xent_chunk=16)
+    params = init_params(cfg_d, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    tgt = jnp.roll(tok, -1, axis=1)
+    l1, g1 = jax.value_and_grad(
+        lambda p: loss_fn(cfg_d, p, tok, tgt))(params)
+    l2, g2 = jax.value_and_grad(
+        lambda p: loss_fn(cfg_c, p, tok, tgt))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # direct function check with adversarial logit magnitudes (the
+    # online-logsumexp rescale must not overflow where a naive
+    # sum-of-exp would)
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 16)) * 30.0
+    w = jax.random.normal(jax.random.PRNGKey(3), (16, 48))
+    y = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, 48)
+    dense = -jnp.take_along_axis(
+        jax.nn.log_softmax(jnp.matmul(h, w), axis=-1),
+        y[..., None], axis=-1).mean()
+    for c in (8, 16, 48):
+        np.testing.assert_allclose(
+            float(chunked_cross_entropy(h, w, y, c)), float(dense),
+            rtol=1e-5)
+    with pytest.raises(ValueError):
+        chunked_cross_entropy(h, w, y, 13)
+
+
 def test_kv_cache_decode_matches_full_forward():
     """Cached decode logits at each position == full-sequence forward
     logits (the correctness contract of the KV cache)."""
