@@ -119,15 +119,18 @@ def flagship_lines(which: str) -> None:
     """Append flagship-config JSON lines after the LeNet line so the
     driver-captured BENCH_r{N}.json records them round-over-round
     (VERDICT r2 weak #8). BENCH_FLAGSHIP=0 disables; =1/transformer
-    (default) runs the transformer only (bounded added wall-clock);
-    =all runs transformer+vgg16+lstm."""
+    (default) runs the transformer family — d512, the d1024
+    MFU-ceiling proof point, the V=32768 real-vocab row, and both
+    KV-cache decode regimes (short-prefix + full-cache roofline probe;
+    VERDICT r3 #2/#9); =all additionally runs vgg16+lstm."""
     import os
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "benchmarks"))
     import flagship
     names = (list(flagship.BENCHES) if which == "all"
-             else ["transformer"])
+             else ["transformer", "transformer_1024",
+                   "transformer_32kvocab", "decode", "decode_long"])
     for n in names:
         try:
             print(json.dumps(flagship.BENCHES[n]()), flush=True)

@@ -88,14 +88,12 @@ def _local_block_decode(h, p, ck_all, cv_all, layer: int, pos,
         ck_all, k[None].astype(ck_all.dtype), (lz, z, pos, z))
     cv_all = lax.dynamic_update_slice(
         cv_all, v[None].astype(cv_all.dtype), (lz, z, pos, z))
-    b, s = ck_all.shape[1], ck_all.shape[2]
-
-    def cache_heads(c):
-        return c[layer].reshape(b, s, h_loc, cfg.d_head)
-
-    a = dot_product_attention(q, cache_heads(ck_all),
-                              cache_heads(cv_all), causal=True,
-                              q_offset=pos, kv_offset=0)
+    # same split-K decode path as _block_decode (stacked local cache +
+    # layer plane selected in the kernel's BlockSpec — prefix-bounded
+    # HBM reads; jnp reference semantics off-TPU)
+    from deeplearning4j_tpu.ops.flash_decode import decode_attention
+    a = decode_attention(q[:, 0], ck_all, cv_all, pos,
+                         n_heads=h_loc, layer=layer)    # [B, h_loc, Dh]
     h = h + g_model(jnp.matmul(a.reshape(a.shape[0], 1, d_loc),
                                p["Wo"].astype(h.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
