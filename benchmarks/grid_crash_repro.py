@@ -1,0 +1,93 @@
+"""Minimal repro for the terminal-compile-helper grid-size crash
+(VERDICT r3 #7).
+
+The flash-attention kernels cap 2-D superblock grids at
+_MAX_2D_GRID_FWD=96 / _MAX_2D_GRID_BWD=32 programs because larger
+grids kill this backend's remote compile. TWO observed signatures of
+the same boundary:
+
+  * round 3 (original): diagnostic-free helper death —
+    `JaxRuntimeError: INTERNAL: http://127.0.0.1:<port>/remote_compile:
+    HTTP 500: tpu_compile_helper subprocess exit code 1` with no
+    Mosaic/XLA message in the body.
+  * round 4 (current toolchain, re-measured by this script): the SAME
+    (32, 4) grid now fails with a spurious scoped-vmem STACK OOM:
+    `Ran out of memory in memory space vmem while allocating on stack
+    ... It should not be possible to run out of scoped vmem` —
+    spurious because the per-program VMEM footprint is IDENTICAL under
+    the cap (bh-chunking changes only the grid's first extent), and
+    the capped (24, 4) chunks of the very same shape compile and run
+    (verified r4). The accounting scales with grid programs — the
+    known XLA bug class its own message cites
+    (go/compile-time-vmem-oom#kernel-vmem-stack-oom).
+
+This script deliberately compiles a (32, 4)-superblock forward —
+the smallest observed-crashing configuration — with the cap lifted,
+and reports whether the boundary still holds. Run it after any
+jax/libtpu/terminal bump:
+
+  * "CRASH REPRODUCED" -> the caps are still needed; nothing to do
+    (matches_known_signature tells you which of the two signatures
+    appeared).
+  * "NO CRASH" -> the toolchain moved the boundary; the caps can be
+    raised (re-sweep with DL4JTPU_MAX_GRID overrides and update
+    ops/flash_attention.py).
+
+Chip-only (the crash is in the terminal's AOT helper); harmless to the
+terminal — the helper is a per-request subprocess. Not collected by
+pytest (benchmarks/ is outside tests/).
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site \
+           python benchmarks/grid_crash_repro.py
+"""
+import json
+import os
+import sys
+
+os.environ["DL4JTPU_MAX_GRID"] = "100000"   # lift the cap: repro mode
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> int:
+    from deeplearning4j_tpu.ops.flash_attention import _flash_forward
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"repro": "grid_crash", "skipped":
+                          "needs the real TPU backend"}))
+        return 0
+    # bh=32, T=8192 -> qsb=2048 -> grid (32, 4) = 128 programs with a
+    # real superblock dim: the smallest observed-crashing fwd grid
+    bh, t, d = 32, 8192, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (bh, t, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (bh, t, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (bh, t, d), jnp.bfloat16)
+    try:
+        out, _, _ = jax.jit(lambda a, b, c: _flash_forward(
+            a, b, c, 0.125, True, 0, 0, False))(q, k, v)
+        float(jnp.sum(out.astype(jnp.float32)))
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"
+        if "It should not be possible to run out of scoped vmem" in msg:
+            sig = "spurious_vmem_stack_oom"        # r4 toolchain
+        elif "tpu_compile_helper subprocess exit code" in msg and \
+                "Mosaic" not in msg and "Scoped allocation" not in msg:
+            sig = "diagnostic_free_helper_death"   # r3 original
+        else:
+            sig = "UNKNOWN - inspect; may be a genuine kernel error"
+        print(json.dumps({
+            "repro": "grid_crash", "result": "CRASH REPRODUCED",
+            "matches_known_signature": sig,
+            "error": msg[:300]}))
+        return 0
+    print(json.dumps({
+        "repro": "grid_crash", "result": "NO CRASH",
+        "note": "toolchain boundary moved - re-sweep and raise the "
+                "caps in ops/flash_attention.py"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

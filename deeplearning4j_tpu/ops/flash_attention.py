@@ -300,8 +300,40 @@ def _flash_dqkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, logl_ref,
 # (32,2)). Grids with nsb == 1 are exempt from the cap — they are the
 # T<=2048 hot path and are empirically safe at least to (128, 1)
 # (the flagship training config, measured all round).
-_MAX_2D_GRID_FWD = 96
-_MAX_2D_GRID_BWD = 32
+#
+# CRASH SIGNATURES (so a toolchain bump that moves the boundary is
+# recognizable) — r3: `HTTP 500: tpu_compile_helper subprocess exit
+# code 1` with NO Mosaic/XLA diagnostic; r4 toolchain: a SPURIOUS
+# scoped-vmem stack OOM ("It should not be possible to run out of
+# scoped vmem") on grids whose per-program footprint is identical to
+# capped chunks that compile fine — the accounting scales with grid
+# program count. `benchmarks/grid_crash_repro.py` is the checked-in
+# minimal repro with both signatures classified: run it after any
+# jax/libtpu bump — if it stops crashing, the caps can be raised; if
+# smaller grids start crashing, lower them via the env overrides below
+# (DL4JTPU_MAX_GRID sets both; _FWD/_BWD variants take precedence).
+# The assumed caps are logged once at first kernel build so a
+# mis-chunking run is diagnosable from its log.
+_MAX_2D_GRID_FWD = int(os.environ.get(
+    "DL4JTPU_MAX_GRID_FWD", os.environ.get("DL4JTPU_MAX_GRID", "96")))
+_MAX_2D_GRID_BWD = int(os.environ.get(
+    "DL4JTPU_MAX_GRID_BWD", os.environ.get("DL4JTPU_MAX_GRID", "32")))
+
+_caps_logged = False
+
+
+def _log_caps_once():
+    global _caps_logged
+    if _caps_logged:
+        return
+    _caps_logged = True
+    import logging
+    logging.getLogger(__name__).info(
+        "flash-attention 2-D grid caps: fwd=%d bwd=%d (empirical "
+        "tpu_compile_helper crash boundaries on this backend; override "
+        "DL4JTPU_MAX_GRID[_FWD|_BWD]; repro: "
+        "benchmarks/grid_crash_repro.py)",
+        _MAX_2D_GRID_FWD, _MAX_2D_GRID_BWD)
 
 
 def _bh_chunks(bh: int, nsb: int, cap: int):
@@ -317,6 +349,7 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
                    q_offset: int, kv_offset: int, interpret: bool):
     import jax.experimental.pallas as pl
 
+    _log_caps_once()
     bh, tq, d = q3.shape
     sk = k3.shape[1]
     bq = _inner_block(tq)
@@ -426,8 +459,13 @@ def _fwd(q3, k3, v3, scale, causal, q_offset, kv_offset, interpret):
 # q-extent per fused-backward call: the kernel holds full-T q/do and
 # the three [T, 1] stat columns (lane-padded 128x) in VMEM — past this
 # the 16MB budget blows, so longer sequences split over q at the host
-# level (dK/dV are linear in the q chunks and sum; dQ concatenates)
-_BWD_Q_CHUNK = 4096
+# level (dK/dV are linear in the q chunks and sum; dQ concatenates).
+# Env-overridable for A/B runs; do NOT lower it chasing speed —
+# benchmarks/headpack_experiment.py's end-to-end A/B measured chunk
+# 512 COSTS 16% on the flagship step (4x K/V re-reads); the default
+# is the measured optimum and the override exists for re-sweeps after
+# toolchain bumps
+_BWD_Q_CHUNK = int(os.environ.get("DL4JTPU_BWD_Q_CHUNK", "4096"))
 
 
 def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
