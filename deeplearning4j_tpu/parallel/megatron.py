@@ -356,9 +356,13 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
-    """Place a host/replicated param pytree onto the mesh per param_specs."""
-    specs = param_specs(cfg)
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh,
+                 specs=None):
+    """Place a host/replicated param pytree onto the mesh per
+    param_specs (or caller-supplied ``specs`` — e.g. the serving
+    layout's MoE overrides)."""
+    if specs is None:
+        specs = param_specs(cfg)
     return jax.tree_util.tree_map(
         lambda p, sp_: jax.device_put(p, NamedSharding(mesh, sp_)),
         params, specs)

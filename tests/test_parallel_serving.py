@@ -36,6 +36,28 @@ def test_tp_generate_matches_single_chip(mesh):
     np.testing.assert_array_equal(got, want)
 
 
+def test_moe_tp_generate_matches_single_chip(mesh):
+    """MoE serving (experts replicated, FFN hidden sharded over
+    'model', GLOBAL capacity-drop decisions) == single-chip MoE
+    generate token-for-token. capacity_factor chosen so the cap BINDS
+    (B=4 tokens/step, E=2, cap=int(0.6*4/2)=1): the global-position
+    drop logic is exercised, not just the no-drop happy path."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64, n_experts=2,
+                            capacity_factor=0.6)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    key = jax.random.PRNGKey(2)
+    want = np.asarray(generate(cfg, params, prompt, max_new_tokens=16,
+                               key=key, temperature=0.0))
+    pgen = make_parallel_generate(cfg, mesh, max_new_tokens=16,
+                                  temperature=0.0)
+    got = np.asarray(pgen(shard_serving_params(params, cfg, mesh),
+                          prompt, key))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_tp_generate_sampled_is_valid(mesh):
     """Sampled decode: valid tokens, deterministic for a fixed key."""
     cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
@@ -54,6 +76,28 @@ def test_tp_generate_sampled_is_valid(mesh):
     # identical continuations (per-shard key fold; rows 0-1 live on
     # data rank 0, rows 2-3 on rank 1)
     assert not np.array_equal(a[:2, 8:], a[2:, 8:])
+
+
+@pytest.mark.slow
+def test_flagship_geometry_serving_smoke(mesh):
+    """Serving at the FLAGSHIP geometry (12L/512d/8H, max_len=2048) on
+    the CPU mesh — tiny-shape tests can miss shape-dependent sharding
+    bugs (VERDICT r3 #8); this pins the real layer count, width and
+    cache length end-to-end with check_rep ON, and cross-checks the
+    first greedy tokens against single-chip generate."""
+    cfg = TransformerConfig(vocab_size=256, d_model=512, n_heads=8,
+                            n_layers=12, max_len=2048)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    key = jax.random.PRNGKey(2)
+    want = np.asarray(generate(cfg, params, prompt, max_new_tokens=4,
+                               key=key, temperature=0.0))
+    pgen = make_parallel_generate(cfg, mesh, max_new_tokens=4,
+                                  temperature=0.0)
+    got = np.asarray(pgen(shard_serving_params(params, cfg, mesh),
+                          prompt, key))
+    np.testing.assert_array_equal(got, want)
 
 
 def test_tp_generate_rejects_bad_meshes_and_lengths(devices8):
