@@ -284,13 +284,21 @@ class SegmentingSentenceIterator(SentenceIterator):
         parts = _SENT_BOUNDARY.split(text.strip())
         out: List[str] = []
         buf = ""
-        for part in parts:
+        for idx, part in enumerate(parts):
             buf = (buf + " " + part).strip() if buf else part
             last = buf.rstrip(".!?").rsplit(None, 1)
-            word = last[-1].lower() if last else ""
-            # don't end a sentence on an abbreviation or single initial
-            if buf.endswith(".") and (word in _ABBREVIATIONS
-                                      or len(word) == 1):
+            word = last[-1] if last else ""
+            nxt = parts[idx + 1].lstrip() if idx + 1 < len(parts) else ""
+            # don't end a sentence on an abbreviation or a person
+            # initial — but only treat a single letter as an initial
+            # when it is UPPERCASE and the next fragment starts with a
+            # capitalized token ("J. Smith"); a bare len==1 test also
+            # merged real one-letter sentence endings ("... vitamin c.
+            # then we left" — advisor r3)
+            initial = (len(word) == 1 and word.isupper()
+                       and nxt[:1].isupper())
+            if buf.endswith(".") and (word.lower() in _ABBREVIATIONS
+                                      or initial):
                 continue
             if buf:
                 out.append(buf)

@@ -372,7 +372,7 @@ class SequenceVectors(WordVectorsMixin):
                     lt.syn0, lt.syn1neg, _ = learning.cbow_neg_step(
                         lt.syn0, lt.syn1neg, win_b, msk_b,
                         jnp.asarray(tgt_b),
-                        jnp.asarray(self._sample_negatives(nb)),
+                        jnp.asarray(self._sample_negatives()),
                         jnp.asarray(lr_vec))
                 step_no += 1
         log.info("SequenceVectors cbow epoch %d: %d examples", epoch,
@@ -412,7 +412,7 @@ class SequenceVectors(WordVectorsMixin):
         chunk size. Consumes the same pooled stream as the per-batch
         path (_sample_negatives), so the scanned/stepped equivalence
         holds by construction."""
-        negs = np.stack([self._sample_negatives(self.batch_size)
+        negs = np.stack([self._sample_negatives()
                          for _ in range(nb)]).astype(np.int32)
         if nb_pad > nb:
             negs = np.concatenate(
@@ -481,12 +481,15 @@ class SequenceVectors(WordVectorsMixin):
     # per-batch draw + unigram-table gather was a profiled host cost
     _NEG_POOL_BATCHES = 512
 
-    def _sample_negatives(self, n: int) -> np.ndarray:
+    def _sample_negatives(self) -> np.ndarray:
         """Next (batch_size, negative) block of negative samples. Drawn
         from a pooled pre-gathered buffer (one rng call + one table
         gather per _NEG_POOL_BATCHES batches); both the scanned and the
         stepped training paths consume this same stream, so their
-        bit-level equivalence is preserved by construction."""
+        bit-level equivalence is preserved by construction. Always a
+        FULL (batch_size, negative) row — partial final batches are
+        padded upstream, and the old ``n`` parameter was ignored
+        anyway (advisor r3), so it is gone."""
         pool = getattr(self, "_neg_pool", None)
         if pool is None or self._neg_cursor >= len(pool):
             table = self.lookup_table.neg_table
@@ -527,4 +530,4 @@ class SequenceVectors(WordVectorsMixin):
         lt.syn0, lt.syn1neg, _ = step(
             lt.syn0, lt.syn1neg, jnp.asarray(centers_p),
             jnp.asarray(contexts_p),
-            jnp.asarray(self._sample_negatives(n)), jnp.asarray(lr_vec))
+            jnp.asarray(self._sample_negatives()), jnp.asarray(lr_vec))

@@ -471,13 +471,28 @@ _BWD_Q_CHUNK = int(os.environ.get("DL4JTPU_BWD_Q_CHUNK", "4096"))
 def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
     q3, k3, v3, o3, m, logl = res
     sk = k3.shape[1]
-    if sk % min(BLOCK_Q, sk) == 0:
-        tq = q3.shape[1]
-        if tq > _BWD_Q_CHUNK and tq % _BWD_Q_CHUNK == 0:
+    tq = q3.shape[1]
+    # kv must tile AND long-tq must be chunkable: a tq like 6144 that
+    # exceeds _BWD_Q_CHUNK without dividing by it must NOT run the
+    # full-T fused kernel the module docstring says blows VMEM
+    # (advisor r3). The chunk is the largest BLOCK_Q-multiple divisor
+    # of tq <= _BWD_Q_CHUNK (6144 -> 3072), so such shapes stay on the
+    # fused path; only a truly undividable tq falls back to the
+    # jnp-recompute VJP (which materializes [B*H, tq, sk] f32 — fine
+    # at the short lengths that can actually reach it).
+    chunk = tq
+    if tq > _BWD_Q_CHUNK:
+        chunk = 0
+        for c in range(_BWD_Q_CHUNK, 0, -BLOCK_Q):
+            if tq % c == 0:
+                chunk = c
+                break
+    if sk % min(BLOCK_Q, sk) == 0 and chunk:
+        if tq > chunk:
             dqs = []
             dk = dv = None
-            for lo in range(0, tq, _BWD_Q_CHUNK):
-                sl = slice(lo, lo + _BWD_Q_CHUNK)
+            for lo in range(0, tq, chunk):
+                sl = slice(lo, lo + chunk)
                 dq_c, dk_c, dv_c = _flash_backward(
                     q3[:, sl], k3, v3, o3[:, sl], m[:, sl],
                     logl[:, sl], g[:, sl], scale, causal,
@@ -520,6 +535,12 @@ def flash_attention_available(q: Array, k: Array,
         return False
     tq = q.shape[1]
     if tq % min(BLOCK_Q, tq) != 0 or tq < 8:
+        return False
+    # kv extents with no power-of-two tile (e.g. cross-attention
+    # S=2500) would become ONE untiled panel, silently bypassing the
+    # VMEM bounds the tile caps enforce (advisor r3) — jnp path instead
+    sk = k.shape[1]
+    if sk > 512 and _inner_block(sk) == sk:
         return False
     if env == "interpret":
         return True

@@ -148,7 +148,9 @@ void* dl4j_pjrt_client_create(const void* api_p, char* err, int errlen) {
 // Client creation with PJRT_NamedValue create_options. Real plugins
 // (libtpu, the axon tunnel plugin) require session/topology options at
 // client creation; the parallel arrays encode n options of kind 0
-// (string: str_vals[i]) or kind 1 (int64: int_vals[i]). Role parity:
+// (string: str_vals[i]), kind 1 (int64: int_vals[i]) or kind 2
+// (bool: int_vals[i] != 0) — keep this list in sync with the switch
+// below and pjrt.py's marshalling. Role parity:
 // ND4J backends pass CudaEnvironment-style config into libnd4j at
 // backend init (SURVEY §2.9 row 1).
 void* dl4j_pjrt_client_create_opts(const void* api_p, const char** keys,

@@ -97,6 +97,12 @@ def test_eligibility_rules():
     os.environ["DL4JTPU_FLASH"] = "interpret"
     q_small = _rand((1, 5, 2, 16), 0)
     assert not flash_attention_available(q_small, q_small, None)
+    # kv extents with no power-of-two tile (cross-attention S=2500)
+    # must take the jnp path — an untiled single panel would bypass
+    # the VMEM bounds the tile caps enforce (advisor r3)
+    q_ok = _rand((1, 128, 2, 16), 0)
+    k_odd = _rand((1, 2500, 2, 16), 1)
+    assert not flash_attention_available(q_ok, k_odd, None)
 
 
 def test_gradients_with_fully_masked_rows():
@@ -165,6 +171,23 @@ def test_multi_superblock_and_chunked_backward_paths():
 
         g2 = jax.grad(ref_loss)(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-5)
+        # tq > _BWD_Q_CHUNK with tq NOT a multiple of it (384 % 256):
+        # the backward must pick the largest dividing chunk (128) and
+        # stay on the fused path, not run full-T or fall to jnp
+        # (advisor r3 / r4 review)
+        q_nd = jax.random.normal(jax.random.PRNGKey(1), (1, 384, 2, 32),
+                                 jnp.float32)
+        g3 = jax.grad(lambda x: jnp.sum(
+            fa.flash_attention(x, x, x, causal=True)))(q_nd)
+
+        def ref_loss_nd(x):
+            x3 = jnp.moveaxis(x, 2, 1).reshape(2, 384, 32)
+            return jnp.sum(fa._reference_attention(
+                x3, x3, x3, 32 ** -0.5, True, 0, 0))
+
+        g4 = jax.grad(ref_loss_nd)(q_nd)
+        np.testing.assert_allclose(np.asarray(g3), np.asarray(g4),
                                    rtol=2e-4, atol=2e-5)
     finally:
         fa._inner_block = orig_inner
