@@ -389,20 +389,25 @@ def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
         last_logits, caches = prefill(cfg, params, prompt)
         pos = jnp.asarray(prompt.shape[1], jnp.int32)
 
-        def sample(carry, k):
+        def sample(carry, i):
             caches, pos, logits = carry
             if temperature <= 0:
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
+                # per-step key FOLDED inside the body rather than a
+                # pre-split key array scanned as xs: greedy then
+                # traces zero threefry work and the scan xs stay a
+                # plain int32 arange
                 tok = jax.random.categorical(
-                    k, logits.astype(jnp.float32) / temperature, axis=-1
+                    jax.random.fold_in(key, i),
+                    logits.astype(jnp.float32) / temperature, axis=-1
                 ).astype(jnp.int32)
             new_logits, caches = _decode_step_impl(cfg, params, tok,
                                                    caches, pos)
             return (caches, pos + 1, new_logits), tok
 
-        keys = jax.random.split(key, max_new_tokens)
-        _, toks = lax.scan(sample, (caches, pos, last_logits), keys)
+        _, toks = lax.scan(sample, (caches, pos, last_logits),
+                           jnp.arange(max_new_tokens, dtype=jnp.int32))
         return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)], axis=1)
 
     return jax.jit(run)

@@ -483,7 +483,11 @@ def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
     chunk = tq
     if tq > _BWD_Q_CHUNK:
         chunk = 0
-        for c in range(_BWD_Q_CHUNK, 0, -BLOCK_Q):
+        # start from the largest BLOCK_Q multiple <= the cap: an env
+        # override like 4000 must not make the search walk values that
+        # are never BLOCK_Q-aligned and land on a tiny divisor
+        start = max(BLOCK_Q, (_BWD_Q_CHUNK // BLOCK_Q) * BLOCK_Q)
+        for c in range(start, 0, -BLOCK_Q):
             if tq % c == 0:
                 chunk = c
                 break

@@ -219,13 +219,17 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
         logits = jnp.matmul(h[:, -1], params["Wout"].astype(h.dtype))
         pos0 = jnp.asarray(t0, jnp.int32)
 
-        def sample(carry, k_step):
+        def sample(carry, i):
             ck, cv, pos, logits = carry
             if temperature <= 0:
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
+                # per-step fold, not pre-split xs — same rationale as
+                # models/transformer._generate_jit (greedy traces no
+                # threefry work)
                 tok = jax.random.categorical(
-                    k_step, logits.astype(jnp.float32) / temperature,
+                    jax.random.fold_in(key, i),
+                    logits.astype(jnp.float32) / temperature,
                     axis=-1).astype(jnp.int32)
             emb = params["embed"].astype(dt)[tok]
             posv = lax.dynamic_slice_in_dim(params["pos"], pos, 1,
@@ -243,8 +247,8 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
                                     params["Wout"].astype(hh.dtype))
             return (ck, cv, pos + 1, new_logits), tok
 
-        keys = jax.random.split(key, max_new_tokens)
-        _, toks = lax.scan(sample, (ck, cv, pos0, logits), keys)
+        _, toks = lax.scan(sample, (ck, cv, pos0, logits),
+                           jnp.arange(max_new_tokens, dtype=jnp.int32))
         return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)],
                                axis=1)
 

@@ -30,6 +30,7 @@ import ctypes
 import hashlib
 import logging
 import os
+import re
 import subprocess
 import threading
 from pathlib import Path
@@ -255,13 +256,38 @@ class PjrtBuffer:
             pass
 
 
+def _main_arity(stablehlo) -> Optional[int]:
+    """Number of parameters of the module's public @main, parsed from
+    MLIR text (None for bytecode or unparsable input — the guard is
+    best-effort)."""
+    if isinstance(stablehlo, bytes):
+        try:
+            stablehlo = stablehlo.decode()
+        except UnicodeDecodeError:
+            return None
+    m = re.search(r"func\.func public @main\((.*?)\)\s*->", stablehlo,
+                  re.DOTALL)
+    if not m:
+        return None
+    sig = m.group(1)
+    return len(re.findall(r"%arg\d+\s*:", sig))
+
+
 class PjrtExecutable:
     """A compiled program loaded on the client's devices."""
 
-    def __init__(self, runtime: "PjrtRuntime", handle: int):
+    def __init__(self, runtime: "PjrtRuntime", handle: int,
+                 expected_args: Optional[int] = None):
         self._rt = runtime
         self._handle = handle
         self._cache_owned = False  # set by PjrtRuntime.compile_cached
+        # entry-point arity parsed from the module at compile time:
+        # feeding the wrong operand count doesn't error on all
+        # backends — the axon terminal was observed to CRASH its
+        # backend connection on a one-extra-operand execute (jax.jit
+        # had pruned an unused arg from the frozen module;
+        # benchmarks/bridge_bisect.py is the investigation record)
+        self._expected_args = expected_args
 
     @property
     def num_outputs(self) -> int:
@@ -270,6 +296,12 @@ class PjrtExecutable:
 
     def execute(self, inputs: Sequence[PjrtBuffer],
                 max_outputs: int = 8) -> List[PjrtBuffer]:
+        if (self._expected_args is not None
+                and len(inputs) != self._expected_args):
+            raise PjrtError(
+                f"executable takes {self._expected_args} operands, got "
+                f"{len(inputs)} — check for jax.jit-pruned unused args "
+                "(freeze with keep_unused=True, or drop the extras)")
         lib, api = self._rt._lib, self._rt._api
         in_arr = (ctypes.c_void_p * len(inputs))(
             *[b._handle for b in inputs])
@@ -312,6 +344,12 @@ class PjrtAsyncExecutor:
 
     def submit(self, exe: PjrtExecutable,
                inputs: Sequence[PjrtBuffer]) -> int:
+        if (exe._expected_args is not None
+                and len(inputs) != exe._expected_args):
+            raise PjrtError(
+                f"executable takes {exe._expected_args} operands, got "
+                f"{len(inputs)} — check for jax.jit-pruned unused args "
+                "(freeze with keep_unused=True, or drop the extras)")
         in_arr = (ctypes.c_void_p * len(inputs))(
             *[b._handle for b in inputs])
         ticket = self._rt._lib.dl4j_async_submit(
@@ -420,7 +458,8 @@ class PjrtRuntime:
         if not h:
             raise PjrtError(f"compile failed: "
                             f"{err.value.decode(errors='replace')}")
-        return PjrtExecutable(self, h)
+        return PjrtExecutable(self, h,
+                              expected_args=_main_arity(stablehlo))
 
     def compile_cached(self, stablehlo: str,
                        key: Optional[str] = None) -> "PjrtExecutable":
@@ -446,7 +485,8 @@ class PjrtRuntime:
         if not h:
             raise PjrtError(f"compile failed: "
                             f"{err.value.decode(errors='replace')}")
-        exe = PjrtExecutable(self, h)
+        exe = PjrtExecutable(self, h,
+                             expected_args=_main_arity(stablehlo))
         exe._cache_owned = True
         exe.cache_hit = bool(hit.value)
         return exe

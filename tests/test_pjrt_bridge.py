@@ -171,12 +171,21 @@ def test_async_executor_fifo(runtime):
 
 
 def test_async_executor_error_path(runtime):
-    """A failing execution surfaces its error at wait() and doesn't
-    poison the queue."""
+    """Wrong operand arity is rejected SYNCHRONOUSLY at submit (the r4
+    guard — a mismatched execute crashed the axon terminal's backend
+    connection instead of erroring, benchmarks/bridge_bisect.py), and
+    a failing NATIVE execution still surfaces its error at wait()
+    without poisoning the queue (covered by disabling the Python-side
+    guard, as happens for bytecode modules whose arity can't be
+    parsed)."""
     exe = runtime.compile(_STABLEHLO_ADD)
+    assert exe._expected_args == 2
     b = runtime.to_device(np.arange(8, dtype=np.float32))
     with runtime.async_executor() as ex:
-        bad = ex.submit(exe, [b])  # wrong arity
+        with pytest.raises(pjrt.PjrtError, match="takes 2 operands"):
+            ex.submit(exe, [b])            # wrong arity: sync reject
+        exe._expected_args = None          # unparsable-arity scenario
+        bad = ex.submit(exe, [b])          # reaches the native path
         good_b2 = runtime.to_device(np.arange(8, dtype=np.float32))
         good = ex.submit(exe, [b, good_b2])
         with pytest.raises(pjrt.PjrtError):
