@@ -10,6 +10,10 @@ from deeplearning4j_tpu.modelimport.keras import (
     UnsupportedKerasConfigurationException,
 )
 from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.labels import (ImageNetLabels,
+                                                   decode_predictions,
+                                                   get_predicted_classes,
+                                                   top_k)
 from deeplearning4j_tpu.modelimport.trained_models import (vgg16,
                                                            vgg16_preprocess,
                                                            load_vgg16,
@@ -25,4 +29,6 @@ __all__ = [
     "InvalidKerasConfigurationException",
     "UnsupportedKerasConfigurationException",
     "vgg16", "vgg16_preprocess", "load_vgg16", "resnet50",
+    "ImageNetLabels", "decode_predictions", "get_predicted_classes",
+    "top_k",
 ]

@@ -1,0 +1,166 @@
+"""ImageNet label table + prediction decoding.
+
+Parity with the reference's `Utils/ImageNetLabels.java` (reference:
+deeplearning4j-modelimport/.../trainedmodels/Utils/ImageNetLabels.java)
+and `TrainedModels.decodePredictions` (TrainedModels.java:128-160).
+The reference fetches Keras's `imagenet_class_index.json` from S3 at
+first use and keeps `label = entry[1]` per class index; this analog
+resolves the same JSON through a local-first chain (zero-egress
+containers cannot download, and even online the file should be
+cached):
+
+1. an explicit ``path=`` argument,
+2. ``$DL4JTPU_IMAGENET_INDEX``,
+3. Keras's own cache (``~/.keras/models/imagenet_class_index.json`` —
+   present on any machine that ever ran
+   ``keras...decode_predictions``),
+4. this framework's cache dir (``~/.dl4j_tpu/imagenet_class_index.json``
+   — the reference's ``~/.dl4j/trainedmodels`` analog),
+5. download from the reference's URL (``ImageNetLabels.jsonUrl``) into
+   cache 4 — raising a clear error when the network is unreachable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# the exact URL the reference hardcodes (ImageNetLabels.java:17)
+JSON_URL = ("https://s3.amazonaws.com/deep-learning-models/"
+            "image-models/imagenet_class_index.json")
+_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".dl4j_tpu")
+
+
+class ImageNetLabels:
+    """Lazy ImageNet class-index table (1000 entries); mirrors the
+    reference's static getLabels()/getLabel(n) surface plus the wnid
+    (synset id) the Keras JSON also carries."""
+
+    _labels: Optional[List[str]] = None
+    _wnids: Optional[List[str]] = None
+
+    @classmethod
+    def _candidate_paths(cls, path: Optional[str]) -> List[str]:
+        cands = []
+        if path:
+            cands.append(path)
+        env = os.environ.get("DL4JTPU_IMAGENET_INDEX")
+        if env:
+            cands.append(env)
+        home = os.path.expanduser("~")
+        cands.append(os.path.join(home, ".keras", "models",
+                                  "imagenet_class_index.json"))
+        cands.append(os.path.join(_CACHE_DIR,
+                                  "imagenet_class_index.json"))
+        return cands
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> List[str]:
+        """Resolve and parse the class-index JSON (see module doc for
+        the chain). Idempotent; pass ``path`` to force a re-load.
+        An EXPLICITLY named source (path= or the env var) that does
+        not exist raises instead of silently falling through to a
+        cache that may hold a different table."""
+        if cls._labels is not None and path is None:
+            return cls._labels
+        for name, explicit in (("path argument", path),
+                               ("$DL4JTPU_IMAGENET_INDEX",
+                                os.environ.get(
+                                    "DL4JTPU_IMAGENET_INDEX"))):
+            if explicit and not os.path.exists(explicit):
+                raise FileNotFoundError(
+                    f"{name} names {explicit!r}, which does not exist "
+                    "(refusing to fall back to a cached table that "
+                    "may differ)")
+        tried = []
+        for cand in cls._candidate_paths(path):
+            if os.path.exists(cand):
+                with open(cand) as f:
+                    return cls._parse(json.load(f))
+            tried.append(cand)
+        # last resort: the reference's download (ImageNetLabels.java)
+        try:
+            from urllib.request import urlopen
+            with urlopen(JSON_URL, timeout=20) as r:
+                data = json.load(r)
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            with open(os.path.join(_CACHE_DIR,
+                                   "imagenet_class_index.json"),
+                      "w") as f:
+                json.dump(data, f)
+            return cls._parse(data)
+        except Exception as e:
+            raise FileNotFoundError(
+                "imagenet_class_index.json not found locally and the "
+                f"download failed ({type(e).__name__}: {e}). Looked "
+                f"in: {tried}. Provide the standard Keras class-index "
+                "JSON via path=, $DL4JTPU_IMAGENET_INDEX, or place it "
+                f"in {_CACHE_DIR}/ (source URL: {JSON_URL})."
+            ) from e
+
+    @classmethod
+    def _parse(cls, data: dict) -> List[str]:
+        n = len(data)
+        labels = [""] * n
+        wnids = [""] * n
+        for k, (wnid, label) in data.items():
+            labels[int(k)] = label       # reference: jsonMap.get(i)[1]
+            wnids[int(k)] = wnid
+        cls._labels, cls._wnids = labels, wnids
+        return labels
+
+    @classmethod
+    def get_labels(cls) -> List[str]:
+        return cls.load()
+
+    @classmethod
+    def get_label(cls, n: int) -> str:
+        return cls.load()[n]
+
+    @classmethod
+    def get_wnid(cls, n: int) -> str:
+        cls.load()
+        return cls._wnids[n]
+
+
+def get_predicted_classes(predictions) -> np.ndarray:
+    """Argmax class index per row — the reference's
+    `getPredictedClasses`-style API (BaseOutputLayer semantics applied
+    to zoo predictions). predictions: [batch, n_classes]."""
+    return np.argmax(np.asarray(predictions), axis=-1)
+
+
+def top_k(predictions, k: int = 5,
+          labels: Optional[Sequence[str]] = None
+          ) -> List[List[Tuple[int, str, float]]]:
+    """Per batch row, the top-k (class_index, label, probability)
+    tuples, descending. ``labels`` defaults to the ImageNet table."""
+    p = np.asarray(predictions, dtype=np.float64)
+    if labels is None:
+        labels = ImageNetLabels.get_labels()
+    out = []
+    for row in p:
+        idx = np.argsort(-row)[:k]
+        out.append([(int(i), labels[int(i)], float(row[i]))
+                    for i in idx])
+    return out
+
+
+def decode_predictions(predictions, top: int = 5,
+                       labels: Optional[Sequence[str]] = None) -> str:
+    """The reference's TrainedModels.decodePredictions string format:
+    per batch row, the top-k matches as '<percent>%, <label>' lines
+    (TrainedModels.java:128 — "%3f%%, " + label)."""
+    p = np.asarray(predictions)
+    desc = ""
+    multi = p.shape[0] > 1
+    for batch, picks in enumerate(top_k(p, k=top, labels=labels)):
+        desc += "Predictions for batch "
+        if multi:
+            desc += str(batch)
+        desc += " :"
+        for i, label, prob in picks:
+            desc += "\n\t" + "%3f" % (prob * 100) + "%, " + label
+    return desc

@@ -1,0 +1,97 @@
+"""ImageNetLabels + prediction decoding (reference:
+Utils/ImageNetLabels.java, TrainedModels.decodePredictions) — the
+zoo's predicted-classes API, tested fully offline via a synthetic
+class-index fixture (the real JSON's schema, 6 classes)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.labels import (ImageNetLabels,
+                                                   decode_predictions,
+                                                   get_predicted_classes,
+                                                   top_k)
+
+INDEX = {str(i): [f"n{i:08d}", name] for i, name in enumerate(
+    ["tench", "goldfish", "great_white_shark", "tiger_shark",
+     "hammerhead", "electric_ray"])}
+
+
+@pytest.fixture()
+def index_file(tmp_path, monkeypatch):
+    p = tmp_path / "imagenet_class_index.json"
+    p.write_text(json.dumps(INDEX))
+    # isolate from any real ~/.keras cache and force a re-load
+    monkeypatch.setattr(ImageNetLabels, "_labels", None)
+    monkeypatch.setattr(ImageNetLabels, "_wnids", None)
+    yield str(p)
+    ImageNetLabels._labels = None
+    ImageNetLabels._wnids = None
+
+
+def test_load_parses_keras_schema_in_index_order(index_file):
+    labels = ImageNetLabels.load(index_file)
+    assert labels[0] == "tench" and labels[5] == "electric_ray"
+    assert ImageNetLabels.get_label(1) == "goldfish"
+    assert ImageNetLabels.get_wnid(2) == "n00000002"
+
+
+def test_env_var_resolution(index_file, monkeypatch):
+    monkeypatch.setenv("DL4JTPU_IMAGENET_INDEX", index_file)
+    assert ImageNetLabels.load()[3] == "tiger_shark"
+
+
+def test_explicit_missing_source_raises_not_falls_through(tmp_path,
+                                                          monkeypatch):
+    """A typo'd path=/env var must error, not silently use a cache
+    holding a possibly different table (r4 review finding)."""
+    monkeypatch.setattr(ImageNetLabels, "_labels", None)
+    monkeypatch.setattr(ImageNetLabels, "_wnids", None)
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        ImageNetLabels.load(str(tmp_path / "nope.json"))
+    monkeypatch.setenv("DL4JTPU_IMAGENET_INDEX",
+                       str(tmp_path / "unmounted.json"))
+    with pytest.raises(FileNotFoundError, match="DL4JTPU"):
+        ImageNetLabels.load()
+
+
+def test_missing_everywhere_is_a_clear_error(tmp_path, monkeypatch):
+    monkeypatch.setattr(ImageNetLabels, "_labels", None)
+    monkeypatch.setattr(ImageNetLabels, "_wnids", None)
+    monkeypatch.delenv("DL4JTPU_IMAGENET_INDEX", raising=False)
+    # point HOME somewhere empty so neither cache path exists, and
+    # break the download URL without touching the network
+    monkeypatch.setenv("HOME", str(tmp_path))
+    import deeplearning4j_tpu.modelimport.labels as L
+    monkeypatch.setattr(L, "JSON_URL", "file:///nonexistent.json")
+    monkeypatch.setattr(L, "_CACHE_DIR", str(tmp_path / ".dl4j_tpu"))
+    with pytest.raises(FileNotFoundError, match="DL4JTPU_IMAGENET"):
+        ImageNetLabels.load()
+
+
+def test_predicted_classes_and_topk(index_file):
+    ImageNetLabels.load(index_file)
+    preds = np.array([[0.1, 0.6, 0.05, 0.05, 0.1, 0.1],
+                      [0.7, 0.1, 0.05, 0.05, 0.05, 0.05]])
+    np.testing.assert_array_equal(get_predicted_classes(preds), [1, 0])
+    picks = top_k(preds, k=2)
+    assert picks[0][0] == (1, "goldfish", pytest.approx(0.6))
+    assert picks[1][0][1] == "tench"
+
+
+def test_decode_predictions_reference_format(index_file):
+    """Pin the reference's exact string shape: 'Predictions for batch
+    [n] :' then tab-indented '%3f%, label' lines, batch index printed
+    only for multi-row inputs (TrainedModels.java:143-147)."""
+    ImageNetLabels.load(index_file)
+    one = decode_predictions(np.array([[0.0, 0.25, 0.75, 0.0, 0.0,
+                                        0.0]]), top=2)
+    # single-batch: the reference emits "batch " + " :" (double space)
+    assert one.startswith("Predictions for batch  :")
+    lines = one.splitlines()
+    assert lines[1] == "\t75.000000%, great_white_shark"
+    assert lines[2] == "\t25.000000%, goldfish"
+    two = decode_predictions(np.eye(6)[:2], top=1)
+    assert "Predictions for batch 0 :" in two
+    assert "Predictions for batch 1 :" in two
+    assert "\t100.000000%, tench" in two
