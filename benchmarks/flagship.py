@@ -197,7 +197,12 @@ def bench_lstm(reps: int = 3) -> dict:
     if last != last:
         raise RuntimeError("NaN score in lstm bench")
     chars_s = BATCH * T * POOL * EPOCHS / best
-    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1)
+    # cost on the UNFUSED schedule (see fit_batched_cost docstring):
+    # the wavefront moves layer 2's hoisted [B*T] input projection
+    # into the scan body, which XLA's cost model counts once instead
+    # of T times; model FLOPs are schedule-independent
+    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1,
+                                lstm_wavefront=False)
     step_flops = cost.get("flops")
     mfu = None
     peak = _peak()

@@ -62,9 +62,10 @@ class ImageNetLabels:
         the chain). Idempotent; pass ``path`` to force a re-load.
         An EXPLICITLY named source (path= or the env var) that does
         not exist raises instead of silently falling through to a
-        cache that may hold a different table."""
-        if cls._labels is not None and path is None:
-            return cls._labels
+        cache that may hold a different table — validated BEFORE the
+        in-memory cache short-circuit, so setting a bad env var after
+        a successful load still errors instead of silently serving
+        the previously cached table."""
         for name, explicit in (("path argument", path),
                                ("$DL4JTPU_IMAGENET_INDEX",
                                 os.environ.get(
@@ -74,6 +75,8 @@ class ImageNetLabels:
                     f"{name} names {explicit!r}, which does not exist "
                     "(refusing to fall back to a cached table that "
                     "may differ)")
+        if cls._labels is not None and path is None:
+            return cls._labels
         tried = []
         for cand in cls._candidate_paths(path):
             if os.path.exists(cand):
@@ -137,7 +140,9 @@ def top_k(predictions, k: int = 5,
           ) -> List[List[Tuple[int, str, float]]]:
     """Per batch row, the top-k (class_index, label, probability)
     tuples, descending. ``labels`` defaults to the ImageNet table."""
-    p = np.asarray(predictions, dtype=np.float64)
+    # a single unbatched [n_classes] vector is a batch of one (the
+    # reference's INDArray contract is 2-D; r4 review)
+    p = np.atleast_2d(np.asarray(predictions, dtype=np.float64))
     if labels is None:
         labels = ImageNetLabels.get_labels()
     out = []
@@ -153,7 +158,7 @@ def decode_predictions(predictions, top: int = 5,
     """The reference's TrainedModels.decodePredictions string format:
     per batch row, the top-k matches as '<percent>%, <label>' lines
     (TrainedModels.java:128 — "%3f%%, " + label)."""
-    p = np.asarray(predictions)
+    p = np.atleast_2d(np.asarray(predictions))
     desc = ""
     multi = p.shape[0] > 1
     for batch, picks in enumerate(top_k(p, k=top, labels=labels)):
