@@ -34,7 +34,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig, chunked_cross_entropy)
 from deeplearning4j_tpu.nn.layers.attention import layer_norm
 from deeplearning4j_tpu.parallel.optim import (AdamState,  # noqa: F401
                                                adam_update_tree,
@@ -313,12 +314,22 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
         out = _pipeline_apply(params["blocks"], h_mb, cfg, mesh)
         hf = out.reshape(b_loc, tl, cfg.d_model)
         hf = layer_norm(hf, params["lnfg"], params["lnfb"], cfg.eps)
-        logits = jnp.matmul(hf, params["Wout"].astype(hf.dtype))
-        logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(
-            logp, targets_loc[..., None].astype(jnp.int32), axis=-1)[..., 0]
-        local_sum = jnp.sum(nll)
+        if cfg.xent_chunk > 0 and cfg.vocab_size > cfg.xent_chunk:
+            # streaming vocab-panel loss on the LOCAL tokens (Wout is
+            # replicated; each shard scans its own panels) — the same
+            # real-vocab memory wall the single-chip loss_fn dodges,
+            # models/transformer.chunked_cross_entropy
+            local_sum = chunked_cross_entropy(
+                hf, params["Wout"], targets_loc,
+                cfg.xent_chunk) * (b_loc * tl)
+        else:
+            logits = jnp.matmul(hf, params["Wout"].astype(hf.dtype))
+            logits = logits.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, targets_loc[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            local_sum = jnp.sum(nll)
         if s > 1:
             is_last = (lax.axis_index("pipe") == s - 1)
             local_sum = jnp.where(is_last, local_sum, 0.0)

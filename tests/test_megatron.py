@@ -145,6 +145,38 @@ def test_transformer_remat_same_loss_and_grads():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_parallel_training_chunked_xent_matches_single_device(devices8):
+    """xent_chunk flows through the megatron sharded step: parallel
+    training with the streaming vocab-panel loss == the dense-loss
+    parallel path AND the single-device chunked loss_fn (the
+    real-vocab flagship on a mesh)."""
+    from deeplearning4j_tpu.models.transformer import loss_fn
+
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                max_len=32)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64)
+    tgts = jnp.roll(toks, -1, axis=1)
+    spec = MeshSpec(data=2, model=2, seq=2)
+    cfg_c = TransformerConfig(**base, xent_chunk=16)
+    cfg_d = TransformerConfig(**base)
+    got_c, loss_c = _train(cfg_c, spec, toks, tgts)
+    got_d, loss_d = _train(cfg_d, spec, toks, tgts)
+    np.testing.assert_allclose(loss_c, loss_d, rtol=1e-5)
+    # params after TWO Adam steps: panel-order summation differs from
+    # the dense reduction at f32 ulp level, and Adam's m/sqrt(v) near
+    # init amplifies that to ~0.4% on individual weights — the loss
+    # parity above and the lr=0 scalar check below are the tight
+    # checks; this pins the updates to the same trajectory
+    for a, b in zip(jax.tree_util.tree_leaves(got_c),
+                    jax.tree_util.tree_leaves(got_d)):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-4)
+    # scalar parity with the single-device chunked loss
+    params = init_params(cfg_c, jax.random.PRNGKey(0))
+    want = float(loss_fn(cfg_c, params, toks, tgts))
+    _, l0 = _train(cfg_c, spec, toks, tgts, steps=1, lr=0.0)
+    np.testing.assert_allclose(l0, want, rtol=1e-5)
+
+
 def test_chunked_cross_entropy_matches_dense():
     """xent_chunk streaming loss == dense log_softmax loss in value AND
     grads (the real-vocab flagship path: never materializes [B,T,V])."""
