@@ -1,0 +1,92 @@
+"""Pretrained-model inference with prediction decoding — the
+reference's TrainedModels flow (TrainedModels.java:
+model -> preprocess -> output -> decodePredictions) end to end.
+
+Loads a locally provided Keras HDF5 model (the reference downloads
+DL4J-converted VGG16 weights; zero-egress hosts supply their own
+checkpoint — the repo's trained test fixture works out of the box),
+runs inference, and decodes predictions with the ImageNet-labels
+machinery (`modelimport/labels.py`): `get_predicted_classes` (argmax
+API), `top_k` (structured), and `decode_predictions` (the reference's
+exact string format). A custom class-index JSON stands in for
+ImageNet's when the model isn't 1000-way.
+
+Run: python examples/pretrained_inference.py \
+         [--model tests/fixtures/real_vgg16_trained.h5]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    root = os.path.join(os.path.dirname(__file__), "..")
+    ap.add_argument("--model", default=os.path.join(
+        root, "tests", "fixtures", "real_vgg16_trained.h5"))
+    ap.add_argument("--labels", default=None,
+                    help="class-index JSON (Keras schema); defaults "
+                    "to a digits table matching the fixture model")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.modelimport import (ImageNetLabels,
+                                                decode_predictions,
+                                                get_predicted_classes,
+                                                load_vgg16, top_k)
+
+    if not os.path.exists(args.model):
+        sys.exit(f"model {args.model} not found — generate fixtures "
+                 "with tests/fixtures/generate_keras_fixtures.py or "
+                 "pass --model")
+
+    default_model = os.path.abspath(ap.get_default("model"))
+    labels_path = args.labels
+    if labels_path is None and os.path.abspath(args.model) == \
+            default_model:
+        # the DEFAULT fixture model classifies sklearn digits (10
+        # classes) — a digits table stands in for ImageNet's. A
+        # user-supplied --model keeps labels.py's normal resolution
+        # chain (explicit/env/keras-cache/download) instead
+        idx = {str(i): [f"n{i:08d}", name] for i, name in enumerate(
+            ["zero", "one", "two", "three", "four", "five", "six",
+             "seven", "eight", "nine"])}
+        labels_path = os.path.join(tempfile.mkdtemp(), "idx.json")
+        with open(labels_path, "w") as f:
+            json.dump(idx, f)
+    if labels_path is not None:
+        os.environ["DL4JTPU_IMAGENET_INDEX"] = labels_path
+        ImageNetLabels._labels = None  # re-resolve against the env var
+
+    net = load_vgg16(args.model)
+    golden = os.path.splitext(args.model)[0] + "_golden.npz"
+    gdata = dict(np.load(golden)) if os.path.exists(golden) else {}
+    if "x" in gdata:
+        x = gdata["x"]
+    elif gdata:
+        sys.exit(f"{golden} has inputs {sorted(gdata)} — multi-input "
+                 "models aren't covered by this single-input example")
+    else:
+        itype = getattr(net.conf, "input_type", None)
+        shape = (tuple(itype.array_shape(4)) if itype is not None
+                 else (4, 32, 32, 3))
+        x = np.random.default_rng(0).random(shape, np.float32)
+    out = net.output(x)
+    if isinstance(out, (list, tuple)):   # ComputationGraph: [outputs]
+        out = out[0]
+    out = np.asarray(out)
+
+    classes = get_predicted_classes(out)
+    print("predicted classes:", classes.tolist())
+    for row in top_k(out[:2], k=3):
+        print("top-3:", [(lbl, round(p, 3)) for _, lbl, p in row])
+    print(decode_predictions(out[:1], top=3))
+
+
+if __name__ == "__main__":
+    main()
