@@ -378,12 +378,44 @@ def prefill(cfg: TransformerConfig, params: Dict[str, Any],
     return last_logits, (ck, cv)
 
 
+def _filter_logits(logits: Array, top_k: int, top_p: float) -> Array:
+    """Standard LM sampling filters on [B, V] f32 logits: keep the
+    top_k highest-scoring tokens (0 = off) and/or the smallest prefix
+    of the probability-sorted vocab whose cumulative mass reaches
+    top_p (1.0 = off; the top-1 token always survives). Filtered
+    entries drop to -inf before the categorical draw. ONE descending
+    sort serves both filters (this runs inside every decode step of
+    the sampling scan — a second full-vocab sort there is pure waste)."""
+    v = logits.shape[-1]
+    use_k = bool(top_k) and top_k < v
+    use_p = top_p < 1.0
+    if not (use_k or use_p):
+        return logits
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]           # desc
+    if use_k:
+        kth = sorted_l[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if use_p:
+        if use_k:   # mask the same tail in the sorted view
+            idx = jnp.arange(v)[None, :]
+            sorted_l = jnp.where(idx >= top_k, -jnp.inf, sorted_l)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # number of kept tokens = first index where cum >= top_p, +1
+        keep_n = jnp.sum((cum - probs) < top_p, axis=-1,
+                         keepdims=True)                     # >= 1
+        cutoff = jnp.take_along_axis(sorted_l, keep_n - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 @_ft.lru_cache(maxsize=64)
 def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
-                  temperature: float):
-    """One compiled prefill+sample program per (cfg, length, temp) —
-    jax.jit caches by function identity, so the closure must be reused
-    across generate() calls."""
+                  temperature: float, top_k: int = 0,
+                  top_p: float = 1.0):
+    """One compiled prefill+sample program per (cfg, length, temp,
+    top_k, top_p) — jax.jit caches by function identity, so the
+    closure must be reused across generate() calls."""
 
     def run(params, prompt, key):
         last_logits, caches = prefill(cfg, params, prompt)
@@ -398,9 +430,11 @@ def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
                 # pre-split key array scanned as xs: greedy then
                 # traces zero threefry work and the scan xs stay a
                 # plain int32 arange
+                filt = _filter_logits(
+                    logits.astype(jnp.float32) / temperature,
+                    top_k, top_p)
                 tok = jax.random.categorical(
-                    jax.random.fold_in(key, i),
-                    logits.astype(jnp.float32) / temperature, axis=-1
+                    jax.random.fold_in(key, i), filt, axis=-1
                 ).astype(jnp.int32)
             new_logits, caches = _decode_step_impl(cfg, params, tok,
                                                    caches, pos)
@@ -415,17 +449,25 @@ def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
 
 def generate(cfg: TransformerConfig, params: Dict[str, Any], prompt: Array,
              max_new_tokens: int, key: Array,
-             temperature: float = 1.0) -> Array:
+             temperature: float = 1.0, top_k: int = 0,
+             top_p: float = 1.0) -> Array:
     """Autoregressive sampling with a KV cache, ONE compiled program:
     batched prefill fills the cache, then the sampling loop scans
     max_new_tokens cached decode steps. temperature<=0 means greedy
-    argmax. Returns [B, T0 + max_new_tokens]."""
+    argmax; top_k>0 keeps only the k most likely tokens and
+    top_p<1.0 applies nucleus filtering (both composable, applied
+    after temperature). Returns [B, T0 + max_new_tokens]."""
     prompt = jnp.asarray(prompt, jnp.int32)
     total = prompt.shape[1] + max_new_tokens
     if total > cfg.max_len:
         raise ValueError(f"generation length {total} exceeds "
                          f"max_len={cfg.max_len}")
-    run = _generate_jit(cfg, int(max_new_tokens), float(temperature))
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    run = _generate_jit(cfg, int(max_new_tokens), float(temperature),
+                        int(top_k), float(top_p))
     return run(params, prompt, key)
 
 
@@ -509,8 +551,11 @@ class TransformerLM:
                              jnp.asarray(targets)))
 
     def generate(self, prompt, max_new_tokens: int, *,
-                 temperature: float = 1.0, seed: int = 0) -> Array:
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0) -> Array:
         """KV-cached autoregressive sampling (the rnnTimeStep-streaming
-        analog for this family)."""
+        analog for this family); greedy / temperature / top-k /
+        nucleus — see models.transformer.generate."""
         return generate(self.cfg, self.params, prompt, max_new_tokens,
-                        jax.random.PRNGKey(seed), temperature)
+                        jax.random.PRNGKey(seed), temperature,
+                        top_k=top_k, top_p=top_p)

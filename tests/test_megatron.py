@@ -145,6 +145,55 @@ def test_transformer_remat_same_loss_and_grads():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_generate_top_k_and_top_p():
+    """Sampling filters: top_k=1 == greedy; top-k/top-p draws stay
+    inside the allowed candidate sets at every step; _filter_logits
+    keeps exactly the documented tokens."""
+    from deeplearning4j_tpu.models.transformer import (_filter_logits,
+                                                       generate)
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=48)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    key = jax.random.PRNGKey(3)
+    greedy = np.asarray(generate(cfg, params, prompt, 12, key,
+                                 temperature=0.0))
+    k1 = np.asarray(generate(cfg, params, prompt, 12, key,
+                             temperature=1.0, top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    # k=5 actually samples (differs from k=1 for this seed — a top_k
+    # no-op regression would fail this), deterministically per key
+    k5a = np.asarray(generate(cfg, params, prompt, 12, key,
+                              temperature=1.0, top_k=5))
+    k5b = np.asarray(generate(cfg, params, prompt, 12, key,
+                              temperature=1.0, top_k=5))
+    np.testing.assert_array_equal(k5a, k5b)
+    assert not np.array_equal(k5a, k1)
+    # unfiltered sampling with the same key picks tokens OUTSIDE the
+    # top-5 at some step; the filtered run must not equal it either
+    free = np.asarray(generate(cfg, params, prompt, 12, key,
+                               temperature=1.0))
+    assert not np.array_equal(k5a, free)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(cfg, params, prompt, 4, key, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(cfg, params, prompt, 4, key, top_k=-1)
+
+    # unit checks on the filter itself
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+    f2 = np.asarray(_filter_logits(logits, 2, 1.0))[0]
+    assert np.isinf(f2[:2]).all() and (f2[2:] == [2.0, 3.0]).all()
+    # top_p tiny -> only the argmax survives
+    fp = np.asarray(_filter_logits(logits, 0, 1e-6))[0]
+    assert np.isfinite(fp[3]) and np.isinf(fp[:3]).all()
+    # top_p that spans two tokens: softmax([0,1,2,3]) top probs are
+    # ~0.644, ~0.237 -> cumulative 0.88; top_p=0.7 keeps both (the
+    # mass reaches 0.7 only WITH the second token)
+    fp2 = np.asarray(_filter_logits(logits, 0, 0.7))[0]
+    assert np.isfinite(fp2[3]) and np.isfinite(fp2[2])
+    assert np.isinf(fp2[:2]).all()
+
+
 def test_parallel_training_chunked_xent_matches_single_device(devices8):
     """xent_chunk flows through the megatron sharded step: parallel
     training with the streaming vocab-panel loss == the dense-loss
