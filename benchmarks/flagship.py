@@ -46,7 +46,7 @@ def _peak() -> float | None:
     return chip_peak_flops()
 
 
-def bench_transformer(steps: int = 20, reps: int = 3, *,
+def bench_transformer(steps: int = 20, reps: int = 2, *,
                       batch: int = 16, d_model: int = 512,
                       vocab: int = 256, xent_chunk: int = 0,
                       remat: bool = True,
@@ -124,7 +124,7 @@ def bench_transformer(steps: int = 20, reps: int = 3, *,
             "mfu": round(mfu, 4) if mfu else None}
 
 
-def bench_vgg16(reps: int = 3) -> dict:
+def bench_vgg16(reps: int = 2) -> dict:
     """VGG16-CIFAR train (batch 512), multi-epoch scanned program —
     BASELINE.md's 'VGG16 via Keras import' throughput config."""
     import jax
@@ -169,7 +169,7 @@ def bench_vgg16(reps: int = 3) -> dict:
             "mfu": round(mfu, 4) if mfu else None}
 
 
-def bench_lstm(reps: int = 3) -> dict:
+def bench_lstm(reps: int = 2) -> dict:
     """GravesLSTM char-RNN (2x200, T=64, batch 1024) scanned multi-pass
     train — BASELINE.md config 3."""
     import jax
@@ -213,7 +213,7 @@ def bench_lstm(reps: int = 3) -> dict:
         "mfu": round(mfu, 4) if mfu else None}
 
 
-def bench_decode(reps: int = 3, *, prompt_len: int = 64) -> dict:
+def bench_decode(reps: int = 2, *, prompt_len: int = 64) -> dict:
     """KV-cache decode (12L/512d, max_len 2048, B=64): marginal
     ms/token from the difference of two compiled generate lengths
     (subtracting prefill + dispatch), forced host read. Round-3: the
@@ -260,22 +260,22 @@ def bench_decode(reps: int = 3, *, prompt_len: int = 64) -> dict:
             "marginal_ms_per_step": round(ms_tok, 2)}
 
 
-def bench_decode_long() -> dict:
+def bench_decode_long(reps: int = 2) -> dict:
     """Decode at a ~full cache (prompt 1900 of max_len 2048): every
     step reads the whole ~3.2GB K+V prefix, so the marginal ms/step is
     the bandwidth-roofline probe (VERDICT r3 #2: >=4ms floor at v5e's
     ~819 GB/s; target <=2x that)."""
-    return bench_decode(prompt_len=1900)
+    return bench_decode(reps=reps, prompt_len=1900)
 
 
-def bench_transformer_1024() -> dict:
+def bench_transformer_1024(reps: int = 2) -> dict:
     """d_model=1024 / head_dim 128 variant (B=8): the MXU-native shape
     that demonstrates the framework's MFU ceiling — measured 49.4%
     round 3 (BASELINE.md) vs the flagship d=512 config's 27%."""
-    return bench_transformer(batch=8, d_model=1024)
+    return bench_transformer(reps=reps, batch=8, d_model=1024)
 
 
-def bench_transformer_32kvocab() -> dict:
+def bench_transformer_32kvocab(reps: int = 2) -> dict:
     """V=32768 real-LM vocabulary flagship (12L/512d, T=2048, B=16):
     the chunked cross-entropy path (xent_chunk=2048 — 16 streamed
     [B*T, 2048] f32 panels instead of 4.3 GB of dense [B,T,V] f32
@@ -283,7 +283,7 @@ def bench_transformer_32kvocab() -> dict:
     The D·V output-projection term is ~31% of the model FLOPs at this
     shape, so this row is the one a real LM's throughput actually
     looks like."""
-    return bench_transformer(vocab=32768, xent_chunk=2048)
+    return bench_transformer(reps=reps, vocab=32768, xent_chunk=2048)
 
 
 BENCHES = {"transformer": bench_transformer,

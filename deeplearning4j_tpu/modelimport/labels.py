@@ -40,6 +40,14 @@ class ImageNetLabels:
 
     _labels: Optional[List[str]] = None
     _wnids: Optional[List[str]] = None
+    # resolved file that populated _labels ("<download>" for the URL
+    # path): the in-memory cache is only valid while the EFFECTIVE
+    # source (path arg / env var / fallback chain) still resolves to
+    # the same file — without this key, a load(path=...) would
+    # permanently hijack later default loads, and pointing the env var
+    # at a different existing file would keep serving the stale table
+    # (advisor r4)
+    _source: Optional[str] = None
 
     @classmethod
     def _candidate_paths(cls, path: Optional[str]) -> List[str]:
@@ -59,7 +67,10 @@ class ImageNetLabels:
     @classmethod
     def load(cls, path: Optional[str] = None) -> List[str]:
         """Resolve and parse the class-index JSON (see module doc for
-        the chain). Idempotent; pass ``path`` to force a re-load.
+        the chain). Idempotent while the effective source is stable:
+        the in-memory cache is keyed on the resolved file, so a
+        load(path=...) or a changed $DL4JTPU_IMAGENET_INDEX re-parses
+        from the newly resolved source instead of serving stale data.
         An EXPLICITLY named source (path= or the env var) that does
         not exist raises instead of silently falling through to a
         cache that may hold a different table — validated BEFORE the
@@ -75,13 +86,23 @@ class ImageNetLabels:
                     f"{name} names {explicit!r}, which does not exist "
                     "(refusing to fall back to a cached table that "
                     "may differ)")
-        if cls._labels is not None and path is None:
+        # in-memory cache is valid when nothing explicit is requested
+        # (a prior explicit load keeps serving top_k/decode_predictions)
+        # OR when the explicit source is the same file that populated
+        # it; a DIFFERENT explicit file re-parses (advisor r4: a
+        # changed env var must not serve the stale table)
+        explicit = path or os.environ.get("DL4JTPU_IMAGENET_INDEX")
+        if cls._labels is not None and (
+                explicit is None
+                or os.path.abspath(explicit) == cls._source):
             return cls._labels
         tried = []
         for cand in cls._candidate_paths(path):
             if os.path.exists(cand):
                 with open(cand) as f:
-                    return cls._parse(json.load(f))
+                    out = cls._parse(json.load(f))
+                cls._source = os.path.abspath(cand)
+                return out
             tried.append(cand)
         # last resort: the reference's download (ImageNetLabels.java)
         try:
@@ -93,7 +114,9 @@ class ImageNetLabels:
                                    "imagenet_class_index.json"),
                       "w") as f:
                 json.dump(data, f)
-            return cls._parse(data)
+            out = cls._parse(data)
+            cls._source = "<download>"
+            return out
         except Exception as e:
             raise FileNotFoundError(
                 "imagenet_class_index.json not found locally and the "

@@ -71,7 +71,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         # ABI gate FIRST: a stale library must fall back gracefully, not
         # crash on a missing newer symbol below
-        if lib.dl4jtpu_io_abi_version() != 2:
+        if lib.dl4jtpu_io_abi_version() != 3:
             log.warning("native IO library ABI mismatch; rebuild needed")
             _load_failed = True
             return None
@@ -110,6 +110,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
             ctypes.c_int64]
+        lib.window_pairs.restype = ctypes.c_int64
+        lib.window_pairs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.pair_shuffle.restype = ctypes.c_int32
+        lib.pair_shuffle.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_uint64]
+        lib.neg_pool_fill.restype = ctypes.c_int32
+        lib.neg_pool_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -248,3 +265,78 @@ class FilePrefetcher:
             self.close()
         except Exception:
             pass
+
+
+def window_pairs(flat: np.ndarray, sid: np.ndarray, w: np.ndarray,
+                 window: int, bufs=None
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Skip-gram (center, context) pair expansion in C++ — the r5 fast
+    path for SequenceVectors._corpus_window_pairs (the profiled staging
+    bottleneck at reference-scale vocabularies). The reduced-window RNG
+    draw stays in numpy upstream, so this and the numpy fallback are
+    bit-identical on the same inputs. ``bufs``: an optional caller-held
+    [capacity]-int32 buffer pair reused across epochs (fresh ~80MB
+    output allocations were a profiled per-epoch cost; the returned
+    arrays are VIEWS of the buffers — consume before the next call).
+    None -> caller uses the fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(flat)
+    flat32 = np.ascontiguousarray(flat, np.int32)
+    sid32 = np.ascontiguousarray(sid, np.int32)
+    w32 = np.ascontiguousarray(w, np.int32)
+    cap = max(1, 2 * int(window) * n)
+    if bufs is not None and len(bufs[0]) >= cap:
+        centers, contexts = bufs
+    else:
+        centers = np.empty(cap, np.int32)
+        contexts = np.empty(cap, np.int32)
+        if bufs is not None:
+            bufs[0], bufs[1] = centers, contexts
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    cnt = lib.window_pairs(
+        flat32.ctypes.data_as(i32p), sid32.ctypes.data_as(i32p),
+        w32.ctypes.data_as(i32p), n, int(window),
+        centers.ctypes.data_as(i32p), contexts.ctypes.data_as(i32p))
+    if cnt < 0:
+        return None
+    return centers[:cnt], contexts[:cnt]
+
+
+def pair_shuffle(centers: np.ndarray, contexts: np.ndarray,
+                 seed: int) -> bool:
+    """IN-PLACE paired Fisher-Yates shuffle of two int32 arrays (the
+    skip-gram epoch shuffle) with the native xoshiro RNG; ``seed`` is
+    one draw from the model's numpy Generator, keeping runs
+    reproducible. False -> caller uses the numpy fallback."""
+    lib = get_lib()
+    if lib is None or len(centers) != len(contexts):
+        return False
+    if not (centers.flags.c_contiguous and contexts.flags.c_contiguous
+            and centers.dtype == np.int32
+            and contexts.dtype == np.int32):
+        return False
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    return lib.pair_shuffle(
+        centers.ctypes.data_as(i32p), contexts.ctypes.data_as(i32p),
+        len(centers), ctypes.c_uint64(seed)) == 0
+
+
+def neg_pool_fill(table: np.ndarray, shape: Tuple[int, ...],
+                  seed: int) -> Optional[np.ndarray]:
+    """A negative-sample pool of ``shape`` drawn from the unigram
+    ``table`` natively (one bounded xoshiro draw + gather per entry);
+    ``seed`` is one draw from the model's numpy Generator. None ->
+    caller uses the numpy fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    t32 = np.ascontiguousarray(table, np.int32)
+    out = np.empty(shape, np.int32)
+    n = out.size
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.neg_pool_fill(t32.ctypes.data_as(i32p), len(t32),
+                           out.ctypes.data_as(i32p), n,
+                           ctypes.c_uint64(seed))
+    return out if rc == 0 else None

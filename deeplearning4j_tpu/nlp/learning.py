@@ -162,6 +162,33 @@ skipgram_hs_step = jax.jit(skipgram_hs_impl, donate_argnums=(0, 1))
 skipgram_hs_scan = _epoch_scan(skipgram_hs_impl, 2)
 
 
+def skipgram_hs_tables_impl(syn0: Array, syn1: Array, pts_t: Array,
+                            codes_t: Array, cmask_t: Array,
+                            centers: Array, contexts: Array, lr: Array
+                            ) -> Tuple[Array, ...]:
+    """HS skip-gram with DEVICE-RESIDENT Huffman tables (r5).
+
+    The r4 path staged per-pair [B, L] points/codes/mask arrays from
+    the host — ~3 full [chunk, B, 17] panels per scanned chunk
+    (hundreds of MB of H2D per epoch over the chip tunnel, plus the
+    host-side table gathers that built them: the profiled reason HS ran
+    9x under negative sampling). Here the [V, L] tables ride the scan
+    carry in HBM — uploaded once per fit — and each batch gathers its
+    rows by context id ON DEVICE, so the host stages exactly what the
+    neg path stages: int32 index streams. Same math as
+    skipgram_hs_impl (device gather of the same table rows), so
+    scanned/stepped equivalence is preserved bit-for-bit."""
+    points = pts_t[contexts]
+    codes = codes_t[contexts]
+    cmask = cmask_t[contexts]
+    syn0, syn1, loss = skipgram_hs_impl(syn0, syn1, centers, points,
+                                        codes, cmask, lr)
+    return syn0, syn1, pts_t, codes_t, cmask_t, loss
+
+
+skipgram_hs_tables_scan = _epoch_scan(skipgram_hs_tables_impl, 5)
+
+
 def cbow_neg_impl(syn0: Array, syn1neg: Array, context_windows: Array,
                   context_mask: Array, targets: Array, negatives: Array,
                   lr: Array) -> Tuple[Array, Array, Array]:

@@ -271,7 +271,16 @@ class SegmentingSentenceIterator(SentenceIterator):
     sentences for the text pipeline — is this regex segmenter:
     terminator + whitespace boundaries with a closed abbreviation list
     (won't split after "Dr.", "e.g.", single initials, or decimal
-    numbers)."""
+    numbers).
+
+    Known limitation (advisor r4, accepted trade-off): the
+    person-initial heuristic — single UPPERCASE letter before the
+    boundary + capitalized next token — cannot distinguish an initial
+    ("J. Smith") from a genuine one-letter sentence-final noun
+    followed by a new sentence ("...chose plan B. Next we left"), so
+    the latter merges into one sentence. Disambiguating would need a
+    sentence-starter lexicon or a statistical segmenter; the regex
+    analog keeps the closed-list design and accepts this rare case."""
 
     def __init__(self, documents):
         super().__init__()

@@ -123,13 +123,57 @@ class SequenceVectors(WordVectorsMixin):
         raise NotImplementedError
 
     # -- vocab -------------------------------------------------------------
+    def _tokenized_corpus(self) -> List[List[str]]:
+        """Tokenize the corpus ONCE per model and cache the token lists.
+
+        Profiled r5 (v=100k, 2M tokens): the corpus was tokenized TWICE
+        — once for vocab counting, once for encoding — at ~3s per pass
+        through the per-token tokenizer protocol; this cache plus the
+        tokenizer fast path removes the second pass entirely. Memory:
+        the token lists hold references to the tokenizer's strings
+        (~50 bytes/token), the same order of magnitude as the corpora
+        the reference's CollectionSentenceIterator already holds in
+        RAM; file-based iterators trade that RAM for the staging speed
+        the same way the encoded-corpus cache (r3) already does."""
+        if getattr(self, "_tokens_cache", None) is None:
+            fast = self._default_tokenize_fast()
+            self._tokens_cache = (fast if fast is not None
+                                  else list(self._sequences()))
+        return self._tokens_cache
+
+    def _default_tokenize_fast(self):
+        """When the model uses a plain DefaultTokenizerFactory with no
+        preprocessor, tokenize without the per-sentence Tokenizer
+        object protocol (profiled r5: ~0.4s/2M tokens of pure object
+        overhead). Returns None when the configured factory is
+        anything else — the protocol path stays authoritative."""
+        fac = getattr(self, "tokenizer_factory", None)
+        it = getattr(self, "sentence_iterator", None)
+        from deeplearning4j_tpu.nlp.tokenization import \
+            DefaultTokenizerFactory
+        if (it is None or type(fac) is not DefaultTokenizerFactory
+                or fac._pre is not None):
+            return None
+        split = DefaultTokenizerFactory._SPLIT.split
+        it.reset()
+        out = []
+        for sentence in it:
+            toks = [t for t in split(sentence.strip()) if t]
+            if toks:
+                out.append(toks)
+        return out
+
     def build_vocab(self) -> None:
         """Reference: SequenceVectors.buildVocabIfNecessary →
         VocabConstructor.buildJointVocabulary (VocabConstructor.java:168)."""
         constructor = VocabConstructor(
             min_word_frequency=self.min_word_frequency,
             build_huffman=self.use_hs)
-        self.vocab = constructor.build_vocab(self._sequences())
+        # a vocab (re)build must see the CURRENT corpus: drop any token
+        # cache from a previous build before re-reading the iterator
+        # (the fresh cache is then shared with _encoded_corpus below)
+        self._tokens_cache = None
+        self.vocab = constructor.build_vocab(self._tokenized_corpus())
         self.lookup_table = InMemoryLookupTable(
             self.vocab, self.layer_size, seed=self.seed,
             use_hs=self.use_hs, use_neg=self.negative > 0)
@@ -141,6 +185,7 @@ class SequenceVectors(WordVectorsMixin):
         self._neg_pool = None
         self._neg_cursor = 0
         self._pv_staging = None   # ParagraphVectors' staged windows
+        self._hs_tables_dev = None  # device-resident Huffman tables
 
     # -- training pair generation (host-side, IO/string bound) ------------
     def _encode(self, seq: Sequence[str]) -> np.ndarray:
@@ -162,15 +207,37 @@ class SequenceVectors(WordVectorsMixin):
     # 60k-call-per-epoch pair generation; one pass of numpy over the
     # cached encoded corpus replaces all of it) -------------------------
     def _encoded_corpus(self):
-        """Tokenize + encode the corpus ONCE per vocab (the reference
+        """Encode the cached token corpus ONCE per vocab (the reference
         re-tokenizes every epoch, SequenceVectors.java; epochs after the
         first reuse the flat int corpus). Returns (flat ids [N] int32,
-        per-sentence lengths [S])."""
+        per-sentence KEPT-token lengths [S]).
+
+        One flat pass with a plain word->index dict + vectorized
+        unknown-word filtering (r5: the per-sentence _encode loop — 2M
+        index_of method calls + 100k small array builds — was ~3.2s of
+        the v=100k staging profile; this is ~0.6s)."""
         if getattr(self, "_corpus_cache", None) is None:
-            seqs = [self._encode(s) for s in self._sequences()]
-            lens = np.array([len(s) for s in seqs], np.int64)
-            flat = (np.concatenate(seqs).astype(np.int32, copy=False)
-                    if seqs else np.empty(0, np.int32))
+            toks = self._tokenized_corpus()
+            d = {w: i for i, w in enumerate(self.vocab.words())}
+            get = d.get
+            ids = np.array([get(t, -1) for s in toks for t in s],
+                           np.int32)
+            lens_all = np.fromiter((len(s) for s in toks), np.int64,
+                                   count=len(toks))
+            if ids.size:
+                valid = ids >= 0
+                flat = ids[valid]
+                starts = np.concatenate(
+                    [[0], np.cumsum(lens_all)[:-1]])
+                lens = np.add.reduceat(
+                    valid.astype(np.int64), starts)
+                # reduceat quirk: a zero-length sentence aliases the
+                # next sentence's first element; _sequences() never
+                # yields empty token lists, so starts are strictly
+                # increasing and this cannot trigger.
+            else:
+                flat = np.empty(0, np.int32)
+                lens = np.zeros(len(toks), np.int64)
             self._corpus_cache = (flat, lens)
         return self._corpus_cache
 
@@ -178,7 +245,7 @@ class SequenceVectors(WordVectorsMixin):
         """Per-index corpus frequencies as one array (vectorized
         subsampling; cached alongside the corpus)."""
         if getattr(self, "_freq_cache", None) is None:
-            nw = self.vocab.num_words
+            nw = self.vocab.num_words()
             self._freq_cache = np.array(
                 [self.vocab.word_at_index(i).element_frequency
                  for i in range(nw)], np.float64)
@@ -204,15 +271,27 @@ class SequenceVectors(WordVectorsMixin):
     _STAGE_CHUNK = 1 << 20
 
     def _corpus_window_pairs(self):
-        """All (center, context) pairs for one epoch, vectorized numpy
-        over center-chunks of the flat corpus; sentence boundaries
-        respected via sentence ids, token-major pair order (same as the
-        reference's per-sentence loop)."""
+        """All (center, context) pairs for one epoch; sentence
+        boundaries respected via sentence ids, token-major pair order
+        (same as the reference's per-sentence loop). The expansion runs
+        in C++ when the native IO library is available
+        (native_bridge.window_pairs — r5: this was the largest
+        per-epoch host staging cost at v=100k) with the vectorized
+        numpy fallback below; the reduced-window RNG draw happens HERE
+        either way, so both paths are bit-identical."""
         flat, sid = self._subsampled_corpus()
         n = len(flat)
         if n == 0:
             return (np.empty(0, np.int32),) * 2
         w, offs = self._reduced_windows(n)
+        from deeplearning4j_tpu import native_bridge
+        if getattr(self, "_pair_bufs", None) is None:
+            self._pair_bufs = [np.empty(0, np.int32),
+                               np.empty(0, np.int32)]
+        native = native_bridge.window_pairs(flat, sid, w, self.window,
+                                            bufs=self._pair_bufs)
+        if native is not None:
+            return native
         k = len(offs)
         cs, xs = [], []
         for lo in range(0, n, self._STAGE_CHUNK):
@@ -272,9 +351,23 @@ class SequenceVectors(WordVectorsMixin):
             n_pairs = len(centers_a)
             if n_pairs == 0:
                 continue
-            order = self._rng.permutation(n_pairs)
-            centers_a = centers_a[order]
-            contexts_a = contexts_a[order]
+            # epoch shuffle: native paired Fisher-Yates (seeded from
+            # this model's numpy Generator — ONE draw, so runs stay
+            # reproducible) with a packed-int64 numpy fallback. r5:
+            # permutation + two 10M-element gathers was a profiled
+            # per-epoch staging cost; the numpy Generator's own
+            # shuffle holds the GIL for ~0.7s at 10M pairs.
+            from deeplearning4j_tpu import native_bridge
+            seed = int(self._rng.integers(0, 2 ** 63))
+            centers_a = np.ascontiguousarray(centers_a, np.int32)
+            contexts_a = np.ascontiguousarray(contexts_a, np.int32)
+            if not native_bridge.pair_shuffle(centers_a, contexts_a,
+                                              seed):
+                packed = ((centers_a.astype(np.int64) << 32)
+                          | contexts_a.astype(np.int64))
+                self._rng.shuffle(packed)
+                centers_a = (packed >> 32).astype(np.int32)
+                contexts_a = (packed & 0xFFFFFFFF).astype(np.int32)
             alpha0 = self.learning_rate
             n_batches = (n_pairs + self.batch_size - 1) // self.batch_size
             total_steps = total_epochs * n_batches
@@ -410,15 +503,40 @@ class SequenceVectors(WordVectorsMixin):
     def _stage_negatives(self, nb: int, nb_pad: int) -> np.ndarray:
         """Negatives for one scanned chunk, zero-padded to the bucketed
         chunk size. Consumes the same pooled stream as the per-batch
-        path (_sample_negatives), so the scanned/stepped equivalence
-        holds by construction."""
-        negs = np.stack([self._sample_negatives()
-                         for _ in range(nb)]).astype(np.int32)
+        path (_sample_negatives) — in whole SLABS of consecutive pool
+        rows rather than a per-batch Python loop (r5: the
+        stack-of-1024-arrays build was a profiled staging cost), so the
+        scanned/stepped equivalence still holds by construction: the
+        pool refill points and row order are identical."""
+        slabs = []
+        need = nb
+        while need > 0:
+            pool = getattr(self, "_neg_pool", None)
+            if pool is None or self._neg_cursor >= len(pool):
+                self._refill_neg_pool()
+                pool = self._neg_pool
+            take = min(need, len(pool) - self._neg_cursor)
+            slabs.append(pool[self._neg_cursor:self._neg_cursor + take])
+            self._neg_cursor += take
+            need -= take
+        if len(slabs) == 1 and nb_pad == nb:
+            return slabs[0]            # aligned chunk: zero-copy view
+        # assemble into a cached buffer (fresh concat allocations were
+        # a profiled cost; jnp.asarray copies to device before the
+        # next chunk can overwrite this buffer)
+        shape = (nb_pad, self.batch_size, self.negative)
+        out = getattr(self, "_neg_out_buf", None)
+        if out is None or out.shape != shape:
+            out = np.empty(shape, np.int32)
+            if nb_pad == self._SCAN_CHUNK:
+                self._neg_out_buf = out
+        pos = 0
+        for s in slabs:
+            out[pos:pos + len(s)] = s
+            pos += len(s)
         if nb_pad > nb:
-            negs = np.concatenate(
-                [negs, np.zeros((nb_pad - nb, self.batch_size,
-                                 self.negative), np.int32)])
-        return negs
+            out[nb:] = 0
+        return out
 
     def _fit_epoch_scanned(self, centers_a: np.ndarray,
                            contexts_a: np.ndarray, n_batches: int,
@@ -437,10 +555,17 @@ class SequenceVectors(WordVectorsMixin):
         b = self.batch_size
         lt = self.lookup_table
         if self.use_hs:
-            # hoisted once per epoch: full Huffman tables to host
-            pts_t = np.asarray(lt.points)
-            codes_t = np.asarray(lt.codes)
-            cmask_t = np.asarray(lt.code_mask)
+            # Huffman tables DEVICE-RESIDENT for the whole fit (r5):
+            # the r4 path gathered [chunk, B, L] points/codes/mask on
+            # the host and staged ~3 full panels per chunk over the
+            # chip tunnel — the profiled reason HS ran 9x under neg
+            # sampling. [V, L] is ~20MB at v=100k; upload once, gather
+            # by context id inside the kernel.
+            if getattr(self, "_hs_tables_dev", None) is None:
+                self._hs_tables_dev = (jnp.asarray(lt.points),
+                                       jnp.asarray(lt.codes),
+                                       jnp.asarray(lt.code_mask))
+            pts_d, codes_d, cmask_d = self._hs_tables_dev
         for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
                 n_batches, len(centers_a)):
             centers_p = self._stage_chunk(centers_a, sl, nb_pad, n_valid)
@@ -450,14 +575,13 @@ class SequenceVectors(WordVectorsMixin):
             if self.use_hs:
                 # hierarchical softmax: the CONTEXT word's Huffman
                 # path/codes, the center's syn0 row (reference SkipGram
-                # HS semantics)
-                pts = pts_t[contexts_p]
-                codes = codes_t[contexts_p]
-                cmask = cmask_t[contexts_p]
-                lt.syn0, lt.syn1, _ = learning.skipgram_hs_scan(
-                    lt.syn0, lt.syn1, jnp.asarray(centers_p),
-                    jnp.asarray(pts), jnp.asarray(codes),
-                    jnp.asarray(cmask), jnp.asarray(lr_vec))
+                # HS semantics); the table rows ride the scan carry
+                (lt.syn0, lt.syn1, pts_d, codes_d, cmask_d,
+                 _) = learning.skipgram_hs_tables_scan(
+                    lt.syn0, lt.syn1, pts_d, codes_d, cmask_d,
+                    jnp.asarray(centers_p), jnp.asarray(contexts_p),
+                    jnp.asarray(lr_vec))
+                self._hs_tables_dev = (pts_d, codes_d, cmask_d)
             else:
                 negs = self._stage_negatives(nb, nb_pad)
                 scan_fn = (self._sharded_fns()[1]
@@ -478,8 +602,11 @@ class SequenceVectors(WordVectorsMixin):
         return np.concatenate([arr, np.full(pad_shape, value, arr.dtype)])
 
     # one rng call refills this many batches of negatives at once — the
-    # per-batch draw + unigram-table gather was a profiled host cost
-    _NEG_POOL_BATCHES = 512
+    # per-batch draw + unigram-table gather was a profiled host cost.
+    # Sized to SCAN_CHUNK so a full scanned chunk consumes EXACTLY one
+    # pool and _stage_negatives returns the pool itself, no concat copy
+    # (r5: the slab concatenates were ~0.3s/epoch at v=100k)
+    _NEG_POOL_BATCHES = SCAN_CHUNK
 
     def _sample_negatives(self) -> np.ndarray:
         """Next (batch_size, negative) block of negative samples. Drawn
@@ -492,16 +619,31 @@ class SequenceVectors(WordVectorsMixin):
         anyway (advisor r3), so it is gone."""
         pool = getattr(self, "_neg_pool", None)
         if pool is None or self._neg_cursor >= len(pool):
-            table = self.lookup_table.neg_table
-            picks = self._rng.integers(
-                0, len(table),
-                (self._NEG_POOL_BATCHES, self.batch_size, self.negative))
-            self._neg_pool = table[picks].astype(np.int32)
-            self._neg_cursor = 0
+            self._refill_neg_pool()
             pool = self._neg_pool
         row = pool[self._neg_cursor]
         self._neg_cursor += 1
         return row
+
+    def _refill_neg_pool(self) -> None:
+        """Refill the pooled negative stream — the ONE definition both
+        the per-batch and the slab (scanned) consumers share, so their
+        draw streams are identical by construction. Native fill when
+        the IO library is available (one numpy-Generator seed draw +
+        xoshiro draws/gather in C++; r5: the numpy integers+gather
+        refills were ~1s/epoch of GIL-held host time at v=100k), numpy
+        fallback otherwise (int32 draw, no redundant astype copy)."""
+        from deeplearning4j_tpu import native_bridge
+        table = self.lookup_table.neg_table
+        shape = (self._NEG_POOL_BATCHES, self.batch_size, self.negative)
+        seed = int(self._rng.integers(0, 2 ** 63))
+        pool = native_bridge.neg_pool_fill(table, shape, seed)
+        if pool is None:
+            picks = self._rng.integers(0, len(table), shape)
+            pool = np.ascontiguousarray(
+                table[picks].astype(np.int32, copy=False))
+        self._neg_pool = pool
+        self._neg_cursor = 0
 
     def _train_batch(self, centers: np.ndarray, contexts: np.ndarray,
                      lr: float) -> None:

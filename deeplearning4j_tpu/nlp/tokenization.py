@@ -104,6 +104,14 @@ class Tokenizer:
         return len(self._tokens)
 
     def get_tokens(self) -> List[str]:
+        if self._pre is None:
+            # fast path: one C-level comprehension instead of the
+            # per-token next_token() protocol loop (a profiled hot spot
+            # at millions of tokens, r5) — same empty-token filter and
+            # same consume-the-stream semantics as the loop below
+            out = [t for t in self._tokens[self._idx:] if t]
+            self._idx = len(self._tokens)
+            return out
         out = []
         while self.has_more_tokens():
             t = self.next_token()

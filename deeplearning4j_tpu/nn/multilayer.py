@@ -75,6 +75,14 @@ class MultiLayerNetwork:
         root = jax.random.PRNGKey(seed)
         for i, layer in enumerate(self.layers):
             name = self.layer_names[i]
+            # eager activation validation: a typo'd name should fail
+            # HERE with the valid list, not at the first forward inside
+            # a traced program (r5 verify probe)
+            act = getattr(layer, "activation", None)
+            if isinstance(act, str):
+                from deeplearning4j_tpu.nn.activations import \
+                    get_activation
+                get_activation(act)
             key = jax.random.fold_in(root, i)
             self.params[name] = layer.init_params(key, self.dtype)
             self.state[name] = layer.init_state(self.dtype)

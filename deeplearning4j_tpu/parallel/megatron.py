@@ -9,9 +9,15 @@ sharding of one traced program over the named mesh (parallel/mesh.py):
 - tensor ('model'): megatron-style — attention heads and MLP hidden sharded;
   forward psum ("g" op) paired with an identity-forward/psum-backward "f" op
   at each parallel region's entry so residual-stream gradients stay exact.
-- pipeline ('pipe'): blocks stacked [L] -> stages [S, L/S]; GPipe microbatch
-  schedule, activations hop stages via ppermute; loss is computed on the
-  last stage and psum-masked across the axis.
+- pipeline ('pipe'): blocks stacked [L] -> stages [S, L/S]; activations hop
+  stages via ppermute; loss is computed on the last stage and psum-masked
+  across the axis. Two microbatch schedules (``pipeline_schedule``):
+  'gpipe' (default) — all-forward-then-all-backward, autodiff through the
+  tick scan, activation memory O(M) microbatches deep; '1f1b' — explicit
+  per-microbatch jax.vjp with an O(S)-deep input stash, forward and
+  backward slots interleaved in one scanned round loop (see
+  _value_and_grad_1f1b for the schedule math and the honest bubble
+  accounting of a slot-synchronous SPMD 1F1B).
 - sequence ('seq'): tokens sharded over time; cfg.seq_impl picks the
   strategy — 'ring' (parallel/ring.py: K/V blocks rotate via ppermute) or
   'ulysses' (parallel/ulysses.py: all_to_all head resharding).
@@ -257,6 +263,168 @@ def _pipeline_apply(blocks_local, h_mb: Array, cfg, mesh) -> Array:
 
 
 # ---------------------------------------------------------------------------
+# 1F1B pipeline schedule (explicit per-microbatch vjp, O(S) activations)
+# ---------------------------------------------------------------------------
+
+def pipeline_bubble_fraction(schedule: str, n_stages: int,
+                             n_microbatches: int) -> float:
+    """Analytic pipeline-bubble fraction (idle slot share per stage).
+
+    gpipe: the forward tick scan runs M+S-1 ticks for M useful forwards
+    per stage (autodiff mirrors it in reverse) -> (S-1)/(M+S-1).
+    1f1b (slot-synchronous, see _value_and_grad_1f1b): M+2(S-1) rounds,
+    each carrying one F slot and one B slot, M of each useful ->
+    2(S-1)/(M+2(S-1)). The 1f1b schedule trades a larger bubble at
+    EQUAL M for activation memory independent of M — the point is that
+    M can then grow (memory freed ~M/S-fold) until the bubble is
+    smaller than any M the gpipe schedule can afford."""
+    if n_stages <= 1:
+        return 0.0
+    s, m = n_stages, n_microbatches
+    if schedule == "gpipe":
+        return (s - 1) / (m + s - 1)
+    if schedule == "1f1b":
+        return 2 * (s - 1) / (m + 2 * (s - 1))
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+
+def _value_and_grad_1f1b(params, tokens_loc, targets_loc,
+                         cfg: TransformerConfig, mesh: Mesh, m_: int):
+    """Loss + grads under a 1F1B-style pipeline schedule, computed with
+    EXPLICIT per-microbatch vjp instead of autodiff through the GPipe
+    tick scan.
+
+    Schedule (stage i of S, round r of M+2(S-1); every round holds one
+    forward slot and one backward slot, executed by every rank with
+    validity masks — SPMD can't give ranks different control flow):
+
+      forward of microbatch j at stage i  -> round i + j
+      backward of microbatch j at stage i -> round 2(S-1) - i + j
+
+    so the LAST stage runs F(j) and B(j) in the same round (the 1F1B
+    signature move) and cotangents flow upstream one stage per round
+    via reverse ppermute. In-flight forwards at stage i never exceed
+    2(S-1-i)+1 microbatches, so the input stash is a fixed 2S-slot ring
+    buffer — activation memory is O(S) and INDEPENDENT of M, vs the
+    GPipe path whose scan residuals are O(M) deep. The backward slot
+    re-runs the stage forward inside jax.vjp from the stashed input
+    (stage-granular rematerialization — the same fwd+recompute+bwd
+    FLOP count the remat'd GPipe path pays).
+
+    Equality contract: loss and every grad leaf match the GPipe path
+    (and therefore single-device training) to float tolerance — the
+    per-microbatch loss head is scaled 1/global_count so summed
+    microbatch cotangents reproduce the global-mean loss exactly
+    (tests/test_megatron.py::test_1f1b_*).
+
+    Role analog: net-new (SURVEY §5.7 — the reference has no pipeline
+    parallelism); schedule per Narayanan et al.'s PipeDream-flush /
+    Megatron-LM 1F1B, re-expressed as a masked SPMD round loop.
+    """
+    s = mesh.shape["pipe"]
+    dp = mesh.shape["data"]
+    sp_ = mesh.shape["seq"]
+    dt = cfg.activation_dtype()
+    b_loc, tl = tokens_loc.shape
+    mb = b_loc // m_
+    d = cfg.d_model
+    i = lax.axis_index("pipe")
+    toks_mb = tokens_loc.reshape(m_, mb, tl)
+    tgts_mb = targets_loc.reshape(m_, mb, tl)
+    count = b_loc * tl * dp * sp_
+    seq_idx = lax.axis_index("seq").astype(jnp.int32)
+    blocks = params["blocks"]
+    ep_params = {"embed": params["embed"], "pos": params["pos"]}
+    head_params = {"lnfg": params["lnfg"], "lnfb": params["lnfb"],
+                   "Wout": params["Wout"]}
+
+    def embed_one(ep, toks):
+        pos = lax.dynamic_slice(ep["pos"], (seq_idx * tl, jnp.int32(0)),
+                                (tl, d))
+        return ep["embed"].astype(dt)[toks] + pos.astype(dt)[None]
+
+    def head_loss_sum(hp, y, tgt):
+        hf = layer_norm(y, hp["lnfg"], hp["lnfb"], cfg.eps)
+        if cfg.xent_chunk > 0 and cfg.vocab_size > cfg.xent_chunk:
+            return chunked_cross_entropy(hf, hp["Wout"], tgt,
+                                         cfg.xent_chunk) * tgt.size
+        logits = jnp.matmul(hf, hp["Wout"].astype(hf.dtype))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.sum(-jnp.take_along_axis(
+            logp, tgt[..., None].astype(jnp.int32), axis=-1)[..., 0])
+
+    n_slots = 2 * s          # 2S-1 live ring slots + 1 trash slot
+    perm_fwd = [(j, j + 1) for j in range(s - 1)]
+    perm_bwd = [(j + 1, j) for j in range(s - 1)]
+    is_last = i == s - 1
+    t_total = m_ + 2 * (s - 1)
+
+    g0 = jax.tree_util.tree_map(
+        jnp.zeros_like, {"blocks": blocks, "head": head_params,
+                         "ep": ep_params})
+    carry0 = (jnp.zeros((mb, tl, d), dt),         # recv_f
+              jnp.zeros((mb, tl, d), dt),         # recv_b (cotangent)
+              jnp.zeros((n_slots, mb, tl, d), dt),
+              g0, jnp.zeros((), jnp.float32))
+
+    def round_body(carry, r):
+        recv_f, recv_b, stash, gacc, loss_acc = carry
+        # ---- forward slot: F(j_f) with j_f = r - i
+        j_f = r - i
+        vf = (j_f >= 0) & (j_f < m_)
+        jf_c = jnp.clip(j_f, 0, m_ - 1)
+        x0 = embed_one(ep_params, lax.dynamic_index_in_dim(
+            toks_mb, jf_c, 0, keepdims=False))
+        x_in = jnp.where(i == 0, x0, recv_f)
+        y = _stage_fn(x_in, blocks, cfg, mesh)
+        # invalid slots write to the trash slot so drain-phase garbage
+        # can't clobber a stash entry whose backward is still pending
+        slot = jnp.where(vf, jf_c % (n_slots - 1), n_slots - 1)
+        stash = lax.dynamic_update_index_in_dim(stash, x_in, slot, 0)
+        recv_f_new = lax.ppermute(y, "pipe", perm_fwd)
+
+        # ---- backward slot: B(j_b) with j_b = r - 2(S-1) + i
+        j_b = r - 2 * (s - 1) + i
+        vb = (j_b >= 0) & (j_b < m_)
+        jb_c = jnp.clip(j_b, 0, m_ - 1)
+        x_s = lax.dynamic_index_in_dim(stash, jb_c % (n_slots - 1), 0,
+                                       keepdims=False)
+        toks_j = lax.dynamic_index_in_dim(toks_mb, jb_c, 0,
+                                          keepdims=False)
+        tgt_j = lax.dynamic_index_in_dim(tgts_mb, jb_c, 0,
+                                         keepdims=False)
+
+        def fb(x, blk, hp):
+            yy = _stage_fn(x, blk, cfg, mesh)
+            # every rank computes the head (SPMD-uniform, as the GPipe
+            # path does); only the last stage's cotangent is nonzero
+            return yy, head_loss_sum(hp, yy, tgt_j) / count
+
+        (_, ls), pull = jax.vjp(fb, x_s, blocks, head_params)
+        # zero cotangents make every invalid/masked grad exactly zero
+        ct_y = jnp.where(vb & ~is_last, recv_b, 0).astype(dt)
+        ct_l = jnp.where(vb & is_last, 1.0, 0.0).astype(jnp.float32)
+        dx, dblk, dhp = pull((ct_y, ct_l))
+        _, pull_e = jax.vjp(lambda ep: embed_one(ep, toks_j), ep_params)
+        dep = pull_e(jnp.where(i == 0, dx, 0).astype(dt))[0]
+        gacc = jax.tree_util.tree_map(
+            lambda a, b: a + b, gacc,
+            {"blocks": dblk, "head": dhp, "ep": dep})
+        loss_acc = loss_acc + jnp.where(vb & is_last, ls, 0.0)
+        recv_b_new = lax.ppermute(dx, "pipe", perm_bwd)
+        return (recv_f_new, recv_b_new, stash, gacc, loss_acc), None
+
+    (_, _, _, gacc, loss_acc), _ = lax.scan(
+        round_body, carry0, jnp.arange(t_total, dtype=jnp.int32))
+    loss = lax.psum(loss_acc, ("pipe", "data", "seq"))
+    grads = {"embed": gacc["ep"]["embed"], "pos": gacc["ep"]["pos"],
+             "blocks": gacc["blocks"], "lnfg": gacc["head"]["lnfg"],
+             "lnfb": gacc["head"]["lnfb"],
+             "Wout": gacc["head"]["Wout"]}
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
 # the train step factory
 # ---------------------------------------------------------------------------
 
@@ -264,12 +432,16 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
                              learning_rate: float = 1e-3,
                              n_microbatches: Optional[int] = None,
                              b1: float = 0.9, b2: float = 0.999,
-                             eps: float = 1e-8):
+                             eps: float = 1e-8,
+                             pipeline_schedule: str = "gpipe"):
     """Build the jitted composite-parallel train step.
 
     Returns ``step(params, opt_state, tokens, targets) ->
     (params, opt_state, loss)``. ``tokens``/``targets`` are GLOBAL [B, T]
     int32 arrays (sharded on entry by the step's in_shardings).
+    ``pipeline_schedule``: 'gpipe' (all-F-then-all-B, O(M) activation
+    memory) or '1f1b' (interleaved, O(S) activation memory — see
+    _value_and_grad_1f1b); identical losses and grads either way.
     """
     s = mesh.shape["pipe"]
     dp = mesh.shape["data"]
@@ -292,8 +464,13 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
             f"seq_impl='ulysses' needs local heads (n_heads/tp = "
             f"{cfg.n_heads // tp}) divisible by seq size {sp}; use "
             "seq_impl='ring' (any head count) or change the mesh")
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline_schedule "
+                         f"{pipeline_schedule!r}: expected 'gpipe' or "
+                         "'1f1b'")
     m_ = n_microbatches or s
     specs = param_specs(cfg)
+    use_1f1b = pipeline_schedule == "1f1b" and s > 1
 
     def local_forward_loss(params, tokens_loc, targets_loc):
         """Everything after sharding: local token block -> global mean
@@ -338,8 +515,16 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh, *,
         return total / count
 
     def sharded_step(params, opt_m, opt_v, count, tokens_loc, targets_loc):
-        loss, grads = jax.value_and_grad(
-            lambda p: local_forward_loss(p, tokens_loc, targets_loc))(params)
+        if use_1f1b:
+            if tokens_loc.shape[0] % m_:
+                raise ValueError(f"local batch {tokens_loc.shape[0]} "
+                                 f"not divisible by {m_} microbatches")
+            loss, grads = _value_and_grad_1f1b(params, tokens_loc,
+                                               targets_loc, cfg, mesh, m_)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: local_forward_loss(p, tokens_loc,
+                                             targets_loc))(params)
         # sync gradients over the axes each leaf is replicated across
         grads = jax.tree_util.tree_map(
             lambda g, sp_: lax.psum(g, _grad_psum_axes(sp_, mesh))

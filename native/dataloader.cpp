@@ -323,6 +323,136 @@ int64_t vocab_count_buffer(const char* text, int64_t len,
 }
 
 // ---------------------------------------------------------------------------
-int dl4jtpu_io_abi_version() { return 2; }
+// Skip-gram training-pair expansion (deeplearning4j_tpu/nlp/
+// sequencevectors.py _corpus_window_pairs fast path). Role parity: the
+// reference generates pairs inside SkipGram.java's per-sentence Java loop
+// on every VectorCalculationsThread; here the host-side pair stream is the
+// staging bottleneck for the device scan (r5 profile), so the expansion
+// runs native. Inputs: flat encoded corpus [n], sentence ids [n], per-
+// position reduced window sizes [n] (the RNG draw stays in numpy so the
+// Python fallback is bit-identical), full window extent. Emission order
+// matches the numpy path exactly: token-major, offsets -window..-1 then
+// +1..+window. Outputs must have capacity 2*window*n. Returns pair count,
+// -1 on bad args.
+int64_t window_pairs(const int32_t* flat, const int32_t* sid,
+                     const int32_t* w, int64_t n, int32_t window,
+                     int32_t* centers_out, int32_t* contexts_out) {
+    if (flat == nullptr || sid == nullptr || w == nullptr || n < 0 ||
+        window <= 0 || centers_out == nullptr || contexts_out == nullptr)
+        return -1;
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t wi = w[i];
+        const int32_t ci = flat[i];
+        const int32_t si = sid[i];
+        int64_t lo = i - wi, hi = i + wi;
+        if (lo < 0) lo = 0;
+        if (hi >= n) hi = n - 1;
+        for (int64_t j = lo; j < i; ++j) {
+            if (sid[j] == si) { centers_out[k] = ci;
+                                contexts_out[k] = flat[j]; ++k; }
+        }
+        for (int64_t j = i + 1; j <= hi; ++j) {
+            if (sid[j] == si) { centers_out[k] = ci;
+                                contexts_out[k] = flat[j]; ++k; }
+        }
+    }
+    return k;
+}
+
+// ---------------------------------------------------------------------------
+// xoshiro256** PRNG (public-domain algorithm, Blackman/Vigna) seeded via
+// splitmix64 — the staging RNG for pair_shuffle / neg_pool_fill. The
+// Python layer draws ONE 63-bit seed per call from the model's numpy
+// Generator, so runs stay reproducible end-to-end while the million-draw
+// inner loops run native (r5: numpy Generator shuffle + integers held the
+// GIL for ~1.5s/epoch of w2v staging at v=100k).
+static inline uint64_t splitmix64(uint64_t* st) {
+    uint64_t z = (*st += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+}
+
+struct Xo256 {
+    uint64_t s[4];
+    explicit Xo256(uint64_t seed) {
+        for (int i = 0; i < 4; ++i) s[i] = splitmix64(&seed);
+    }
+    static inline uint64_t rotl(uint64_t x, int k) {
+        return (x << k) | (x >> (64 - k));
+    }
+    inline uint64_t next() {
+        const uint64_t result = rotl(s[1] * 5, 7) * 9;
+        const uint64_t t = s[1] << 17;
+        s[2] ^= s[0]; s[3] ^= s[1]; s[1] ^= s[2]; s[0] ^= s[3];
+        s[2] ^= t; s[3] = rotl(s[3], 45);
+        return result;
+    }
+    // unbiased bounded draw (Lemire's multiply-shift with rejection)
+    inline uint64_t bounded(uint64_t range) {
+        uint64_t x = next();
+        __uint128_t m = static_cast<__uint128_t>(x) * range;
+        uint64_t l = static_cast<uint64_t>(m);
+        if (l < range) {
+            uint64_t t = (0 - range) % range;
+            while (l < t) {
+                x = next();
+                m = static_cast<__uint128_t>(x) * range;
+                l = static_cast<uint64_t>(m);
+            }
+        }
+        return static_cast<uint64_t>(m >> 64);
+    }
+};
+
+// In-place Fisher-Yates over PAIRED int32 arrays (same swap indices for
+// both — the skip-gram (center, context) epoch shuffle without packing
+// or index-array materialization). Returns 0, -1 on bad args.
+int32_t pair_shuffle(int32_t* a, int32_t* b, int64_t n, uint64_t seed) {
+    if (a == nullptr || b == nullptr || n < 0) return -1;
+    Xo256 rng(seed);
+    for (int64_t i = n - 1; i > 0; --i) {
+        int64_t j = static_cast<int64_t>(
+            rng.bounded(static_cast<uint64_t>(i) + 1));
+        int32_t ta = a[i]; a[i] = a[j]; a[j] = ta;
+        int32_t tb = b[i]; b[i] = b[j]; b[j] = tb;
+    }
+    return 0;
+}
+
+// Fill a negative-sample pool: n uniform draws over the unigram table,
+// gathered to word indices. The output is split into a FIXED 4 streams
+// (each its own splitmix64-derived xoshiro state) filled by up to 4
+// threads — the stream split is part of the definition, so the result
+// is deterministic in (seed, n) regardless of hardware concurrency.
+// Returns 0, -1 on bad args.
+int32_t neg_pool_fill(const int32_t* table, int64_t table_len,
+                      int32_t* out, int64_t n, uint64_t seed) {
+    if (table == nullptr || out == nullptr || table_len <= 0 || n < 0)
+        return -1;
+    const uint64_t range = static_cast<uint64_t>(table_len);
+    constexpr int kStreams = 4;
+    uint64_t sst = seed;
+    uint64_t seeds[kStreams];
+    for (int t = 0; t < kStreams; ++t) seeds[t] = splitmix64(&sst);
+    auto fill = [&](int t) {
+        int64_t lo = n * t / kStreams, hi = n * (t + 1) / kStreams;
+        Xo256 rng(seeds[t]);
+        for (int64_t i = lo; i < hi; ++i)
+            out[i] = table[rng.bounded(range)];
+    };
+    if (n < (1 << 16)) {
+        for (int t = 0; t < kStreams; ++t) fill(t);
+        return 0;
+    }
+    std::vector<std::thread> threads;
+    for (int t = 0; t < kStreams; ++t) threads.emplace_back(fill, t);
+    for (auto& th : threads) th.join();
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+int dl4jtpu_io_abi_version() { return 3; }
 
 }  // extern "C"

@@ -69,6 +69,26 @@ def test_missing_everywhere_is_a_clear_error(tmp_path, monkeypatch):
         ImageNetLabels.load()
 
 
+def test_changed_env_var_invalidates_cached_table(index_file, tmp_path,
+                                                  monkeypatch):
+    """Pointing $DL4JTPU_IMAGENET_INDEX at a DIFFERENT existing file
+    after a successful load must serve the new table, not the stale
+    in-memory cache (advisor r4); a default load afterwards keeps the
+    explicitly loaded table (the top_k/decode_predictions flow)."""
+    monkeypatch.setenv("DL4JTPU_IMAGENET_INDEX", index_file)
+    assert ImageNetLabels.load()[0] == "tench"
+    other = tmp_path / "other_index.json"
+    other.write_text(json.dumps(
+        {str(i): [f"x{i:08d}", f"class_{i}"] for i in range(4)}))
+    monkeypatch.setenv("DL4JTPU_IMAGENET_INDEX", str(other))
+    assert ImageNetLabels.load()[0] == "class_0"
+    monkeypatch.delenv("DL4JTPU_IMAGENET_INDEX")
+    # nothing explicit requested -> cached table still serves
+    assert ImageNetLabels.get_labels()[0] == "class_0"
+    # explicit path differing from the cache source re-parses too
+    assert ImageNetLabels.load(index_file)[0] == "tench"
+
+
 def test_predicted_classes_and_topk(index_file):
     ImageNetLabels.load(index_file)
     preds = np.array([[0.1, 0.6, 0.05, 0.05, 0.1, 0.1],

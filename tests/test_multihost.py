@@ -289,3 +289,139 @@ def test_kill_process_checkpoint_restart_resume(tmp_path, monkeypatch):
     p_res = np.asarray(resumed[0]["params"])
     p_ref = np.asarray(reference[0]["params"])
     np.testing.assert_allclose(p_res, p_ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r4 #3: a TENSOR-PARALLEL axis spanning the process boundary.
+# Until round 5 every multi-host test was pure data parallelism; these run
+# the megatron composite step with 'model' (and separately 'pipe') laid
+# across the two processes, so the per-layer f/g psums (resp. the microbatch
+# ppermute hops) ride the DCN transport — the flagship's actual topology.
+# ---------------------------------------------------------------------------
+
+def _megatron_cfg_data():
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+    cfg = TransformerConfig(vocab_size=50, d_model=32, n_heads=4,
+                            n_layers=4, max_len=32)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 50, (8, 32)).astype(np.int32)
+    tgts = np.roll(toks, -1, 1).astype(np.int32)
+    return cfg, toks, tgts
+
+
+def _run_megatron_on(mesh_arr_5d, schedule="gpipe"):
+    """Shared job body: 2 megatron train steps over the given 5-axis
+    device array, params gathered back replicated."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.models.transformer import init_params
+    from deeplearning4j_tpu.parallel.megatron import (
+        init_adam_state, make_parallel_train_step, shard_params)
+    from deeplearning4j_tpu.parallel.mesh import AXES
+    from tests.test_multihost import _megatron_cfg_data
+
+    cfg, toks, tgts = _megatron_cfg_data()
+    mesh = Mesh(mesh_arr_5d, AXES)
+    step = make_parallel_train_step(cfg, mesh, learning_rate=1e-2,
+                                    pipeline_schedule=schedule)
+    ps = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+    st = init_adam_state(ps)
+    dspec = NamedSharding(mesh, P(("data",), ("seq",)))
+    tok_g = jax.device_put(jnp.asarray(toks), dspec)
+    tgt_g = jax.device_put(jnp.asarray(tgts), dspec)
+    loss = None
+    for _ in range(2):
+        ps, st, loss = step(ps, st, tok_g, tgt_g)
+    # gather shards to fully-replicated so each host can np.asarray
+    gather = jax.jit(lambda t: t,
+                     out_shardings=NamedSharding(mesh, P()))
+    host = jax.tree_util.tree_map(np.asarray, gather(ps))
+    return float(loss), host
+
+
+def _tp_span_job():
+    """'model' axis ACROSS the 2 processes: global devices [p0d0, p0d1,
+    p1d0, p1d1] arranged so each data rank's model pair is (p0dX, p1dX)
+    — every attention/MLP output psum crosses the process boundary."""
+    import jax
+    import numpy as np
+
+    from tests.test_multihost import _run_megatron_on
+
+    devs = np.array(jax.devices())
+    arr = devs.reshape(2, 2).T          # [data, model]
+    spans = len({d.process_index for d in arr[0]}) == 2
+    loss, host = _run_megatron_on(arr.reshape(1, 2, 1, 2, 1))
+    return {"process": jax.process_index(), "model_spans_procs": spans,
+            "loss": loss, "params": host}
+
+
+def _pp_span_job():
+    """'pipe' axis ACROSS the 2 processes ('model' within each), under
+    the 1F1B schedule: activation/cotangent ppermute hops cross DCN."""
+    import jax
+    import numpy as np
+
+    from tests.test_multihost import _run_megatron_on
+
+    devs = np.array(jax.devices())
+    arr = devs.reshape(2, 2)            # [pipe, model]
+    spans = len({d.process_index for d in arr[:, 0]}) == 2
+    loss, host = _run_megatron_on(arr.reshape(2, 1, 1, 2, 1),
+                                  schedule="1f1b")
+    return {"process": jax.process_index(), "pipe_spans_procs": spans,
+            "loss": loss, "params": host}
+
+
+def _single_device_reference():
+    """Single-device megatron run in the test process (CPU mesh)."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import init_params
+    from deeplearning4j_tpu.parallel.megatron import (
+        init_adam_state, make_parallel_train_step, shard_params)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg, toks, tgts = _megatron_cfg_data()
+    mesh = make_mesh(MeshSpec())
+    step = make_parallel_train_step(cfg, mesh, learning_rate=1e-2)
+    ps = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+    st = init_adam_state(ps)
+    loss = None
+    for _ in range(2):
+        ps, st, loss = step(ps, st, toks, tgts)
+    return float(loss), jax.tree_util.tree_map(np.asarray, ps)
+
+
+def _assert_matches_single(results, span_key):
+    import jax
+
+    ref_loss, ref_params = _single_device_reference()
+    assert len(results) == 2
+    for r in results:
+        assert r[span_key], "axis did not span the process boundary"
+        assert abs(r["loss"] - ref_loss) < 1e-4
+        for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                        jax.tree_util.tree_leaves(r["params"])):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_megatron_tp_axis_across_process_boundary(devices8):
+    """TP x DP with 'model' spanning 2 real processes == single-device
+    training (loss + every param leaf)."""
+    results = MultiHostLauncher(2, 2).run(_tp_span_job, timeout=240)
+    _assert_matches_single(results, "model_spans_procs")
+
+
+@pytest.mark.slow
+def test_megatron_pp_1f1b_across_process_boundary(devices8):
+    """PP(1F1B) x TP with 'pipe' spanning 2 real processes ==
+    single-device training."""
+    results = MultiHostLauncher(2, 2).run(_pp_span_job, timeout=240)
+    _assert_matches_single(results, "pipe_spans_procs")
