@@ -100,7 +100,14 @@ def main():
             out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         )(jnp.asarray(pos, jnp.int32).reshape(1), q, k, v)
 
-    for bs, bb in [(256, 4), (256, 8), (512, 4), (128, 8), (1024, 2)]:
+    # r5 geometry experiment (VERDICT r4 #7): the second row of combos
+    # doubles the per-block VMEM footprint to 4MB (more bytes in
+    # flight per DMA) and (2048, 2) reads the whole cache prefix in
+    # one block per batch-slab — probing whether the ~1.6x gap to the
+    # measured copy roofline is DMA-pipelining overhead
+    for bs, bb in [(256, 4), (256, 8), (512, 4), (128, 8), (1024, 2),
+                   (256, 16), (512, 8), (1024, 4), (2048, 2),
+                   (128, 16), (2048, 4)]:
         for pos in (100, 2000):
             try:
                 ms = timed_scan(lambda q, k, v, i, bs=bs, bb=bb, pos=pos:

@@ -174,6 +174,12 @@ class SequenceVectors(WordVectorsMixin):
         # (the fresh cache is then shared with _encoded_corpus below)
         self._tokens_cache = None
         self.vocab = constructor.build_vocab(self._tokenized_corpus())
+        self._finish_vocab_build()
+
+    def _finish_vocab_build(self) -> None:
+        """Build the lookup table and drop every vocab-derived staging
+        cache — the ONE invalidation point shared with subclass
+        build_vocab overrides (scaleout.DistributedSequenceVectors)."""
         self.lookup_table = InMemoryLookupTable(
             self.vocab, self.layer_size, seed=self.seed,
             use_hs=self.use_hs, use_neg=self.negative > 0)
@@ -217,7 +223,12 @@ class SequenceVectors(WordVectorsMixin):
         index_of method calls + 100k small array builds — was ~3.2s of
         the v=100k staging profile; this is ~0.6s)."""
         if getattr(self, "_corpus_cache", None) is None:
-            toks = self._tokenized_corpus()
+            # subclasses may yield EMPTY token lists (e.g. blank
+            # sentences through scaleout's unfiltered tokenizer);
+            # drop them here — zero-length sentences contribute no
+            # tokens and no pairs, and np.add.reduceat below needs
+            # strictly increasing starts (r5 review)
+            toks = [t for t in self._tokenized_corpus() if t]
             d = {w: i for i, w in enumerate(self.vocab.words())}
             get = d.get
             ids = np.array([get(t, -1) for s in toks for t in s],
@@ -562,9 +573,15 @@ class SequenceVectors(WordVectorsMixin):
             # sampling. [V, L] is ~20MB at v=100k; upload once, gather
             # by context id inside the kernel.
             if getattr(self, "_hs_tables_dev", None) is None:
-                self._hs_tables_dev = (jnp.asarray(lt.points),
-                                       jnp.asarray(lt.codes),
-                                       jnp.asarray(lt.code_mask))
+                # PRIVATE COPIES: the scan donates its table carries,
+                # and jnp.asarray on the lookup table's own jax arrays
+                # would be a no-op alias — donation would delete
+                # lt.points/codes/code_mask out from under the stepped
+                # and CBOW HS paths (r5 review)
+                self._hs_tables_dev = (
+                    jnp.array(lt.points, copy=True),
+                    jnp.array(lt.codes, copy=True),
+                    jnp.array(lt.code_mask, copy=True))
             pts_d, codes_d, cmask_d = self._hs_tables_dev
         for sl, nb, nb_pad, n_valid in self._iter_scan_chunks(
                 n_batches, len(centers_a)):

@@ -46,6 +46,22 @@ through the kernel — within ~1.6x of the chip's measured 554 GB/s
 sustained copy bandwidth (nominal 819 GB/s HBM was not observed on
 this chip; benchmarks/decode_kernel_sweep.py --bandwidth holds the
 probe methodology).
+
+r5 block-geometry experiment (VERDICT r4 #7 — "try double-buffering"):
+swept (bs, bb) over every compilable combination at the flagship
+shape (decode_kernel_sweep.py). Findings: (1) FULL-CACHE time is
+geometry-invariant — 0.89-0.94 ms/kernel-call across bs 128-1024 and
+bb 2-16, the signature of a DMA stream running at its sustained rate,
+so deeper buffering / bigger blocks cannot close the remaining ~1.6x
+gap to the contiguous-copy probe; the gap is the strided block-read
+pattern (per-batch 256KB slabs at 2MB stride vs the probe's single
+contiguous stream), i.e. architectural, not a pipelining defect.
+(2) Every 4MB-block variant fails to compile (remote compile-helper
+exit 1 — the r3/r4 grid_crash_repro.py signature), so >2MB in-flight
+budgets are untestable on this toolchain. (3) SHORT-prefix decode DID
+improve: bs=256 -> 128 reads a finer prefix (less over-read past
+pos), measured 2.07 -> 1.55-1.62 ms/step integrated across two
+sittings; now the default.
 """
 from __future__ import annotations
 
@@ -208,14 +224,33 @@ def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
     stacked = k_cache.ndim == 4
     if scale is None:
         scale = 1.0 / (dh ** 0.5)
-    # cache block: bs=256 rows is the prefix-read granularity; the
-    # batch block keeps each K/V block ~<=2MB VMEM (~8MB in flight
-    # double-buffered) — sized by the cache's ACTUAL itemsize, so f32
-    # caches get half the batch block instead of blowing the budget
-    bs = _largest_divisor(s, 256)
+    # cache block: bs=128 rows is the prefix-read granularity (r5
+    # sweep: finer blocks over-read less of the cache at short
+    # prefixes — 2.07 -> 1.55-1.62 ms/step at the flagship shape —
+    # and full-cache time is geometry-INVARIANT, see module
+    # docstring); the batch block keeps each K/V block ~<=2MB VMEM
+    # (~8MB in flight double-buffered) — sized by the cache's ACTUAL
+    # itemsize, so f32 caches get half the batch block instead of
+    # blowing the budget. The env knobs override the PRODUCTION
+    # dispatch only (the sweep builds its own pallas_call with
+    # explicit bs/bb): DL4JTPU_DECODE_BS caps rows per block,
+    # DL4JTPU_DECODE_BLOCK_BYTES the per-block VMEM budget (>2MB
+    # blocks crash the remote compile helper — the r3/r4
+    # grid_crash_repro.py signature). Malformed/non-positive values
+    # fall back to the defaults rather than crashing decode.
+    def _env_pos_int(name: str, default: int) -> int:
+        try:
+            v = int(os.environ.get(name, ""))
+        except ValueError:
+            return default
+        return v if v > 0 else default
+
+    bs_cap = _env_pos_int("DL4JTPU_DECODE_BS", 128)
+    blk_bytes = _env_pos_int("DL4JTPU_DECODE_BLOCK_BYTES", 1 << 21)
+    bs = _largest_divisor(s, bs_cap)
     itemsize = jnp.dtype(k_cache.dtype).itemsize
     bb = _largest_divisor(
-        b, max(1, (1 << 21) // max(1, bs * d * itemsize)))
+        b, max(1, blk_bytes // max(1, bs * d * itemsize)))
     n_blocks = s // bs
     kernel = functools.partial(_decode_kernel, scale=float(scale), h=h,
                                bs=bs, n_blocks=n_blocks)

@@ -107,10 +107,12 @@ class DistributedSequenceVectors(SequenceVectors):
         if self.use_hs:
             build_huffman_tree(cache)
         self.vocab = cache
-        self.lookup_table = InMemoryLookupTable(
-            self.vocab, self.layer_size, seed=self.seed,
-            use_hs=self.use_hs, use_neg=self.negative > 0)
-        self.lookup_table.reset_weights()
+        # shared invalidation point: rebuild the lookup table AND drop
+        # every vocab-derived staging cache (token/encoded corpus,
+        # negative pool, device HS tables) — a rebuild on a changed
+        # corpus must not train on stale ids (r5 review)
+        self._tokens_cache = None
+        self._finish_vocab_build()
 
 
 class SparkWord2Vec(DistributedSequenceVectors):

@@ -6,6 +6,11 @@ Run: python examples/char_rnn.py [--text path] [--epochs 3]
 (no --text → trains on this script's own source code)
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 
 import numpy as np
 

@@ -9,6 +9,11 @@ call initialize_multihost() first (parallel/multihost.py).
 """
 import jax
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
 from deeplearning4j_tpu.models.zoo import mlp_mnist
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

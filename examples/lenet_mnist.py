@@ -5,6 +5,11 @@ Evaluation).
 Run: python examples/lenet_mnist.py [--epochs N] [--batch 128]
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 
 from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
 from deeplearning4j_tpu.models.zoo import lenet_mnist

@@ -5,13 +5,20 @@ Net-new vs the reference, whose serving story is single-process
 decode runs SPMD over a (data x model) mesh — megatron-sharded
 heads/MLP, per-device cache shards, one psum per step
 (parallel/serving.py). Greedy parallel decode reproduces the
-single-chip `models/transformer.generate` token-for-token.
+single-chip `models/transformer.generate` token-for-token; sampled
+decode carries the full single-chip surface (temperature / top-k /
+nucleus) and matches token-for-token on TP-only meshes (r5).
 
 On a TPU slice this uses all chips; elsewhere:
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python examples/sharded_serving.py
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 
 import jax
 import jax.numpy as jnp
@@ -29,8 +36,14 @@ def main() -> None:
     ap.add_argument("--model", type=int, default=2)
     ap.add_argument("--new-tokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
     args = ap.parse_args()
 
+    n_dev = args.data * args.model
+    if len(jax.devices()) < n_dev:
+        from __graft_entry__ import _force_virtual_cpu_mesh
+        _force_virtual_cpu_mesh(n_dev)
     mesh = make_mesh(MeshSpec(data=args.data, model=args.model))
     cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
                             n_layers=4, max_len=256)
@@ -38,6 +51,7 @@ def main() -> None:
         init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
     pgen = make_parallel_generate(cfg, mesh,
                                   max_new_tokens=args.new_tokens,
+                                  top_k=args.top_k, top_p=args.top_p,
                                   temperature=args.temperature)
     prompt = jnp.tile(jnp.arange(16, dtype=jnp.int32)[None],
                       (2 * args.data, 1))

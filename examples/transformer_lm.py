@@ -4,7 +4,7 @@ is truncated BPTT; there is no attention, no tensor/pipeline/sequence/
 expert parallelism).
 
 Trains a small decoder-only LM on this script's own bytes over a device
-mesh combining data, megatron tensor, GPipe pipeline and ring-attention
+mesh combining data, megatron tensor, pipeline (GPipe or 1F1B) and ring-attention
 sequence parallelism — one shard_mapped XLA program, collectives over
 ICI. On a CPU host this runs on a forced virtual mesh; on a TPU slice
 the same code uses the real chips.
@@ -20,20 +20,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def _ensure_devices(n_dev: int):
     """Use the real backend when it can hold the mesh, else a virtual
-    CPU mesh (the multi-chip test story, SURVEY.md §4). Decided before
-    any backend initializes: a single-chip tunnel (JAX_PLATFORMS=axon)
-    can't host a multi-device mesh."""
-    if "--xla_force_host_platform_device_count" not in \
-            os.environ.get("XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") +
-            f" --xla_force_host_platform_device_count={n_dev}").strip()
-    platform = os.environ.get("JAX_PLATFORMS", "")
+    CPU mesh (the multi-chip test story, SURVEY.md §4) via the ONE
+    canonical bootstrap (__graft_entry__._force_virtual_cpu_mesh —
+    it also handles a backend that sitecustomize already
+    initialized, which env vars alone cannot resize)."""
     import jax
-    if n_dev > 1 and platform not in ("", "cpu"):
-        from jax._src import xla_bridge as xb
-        xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
+    try:
+        if len(jax.devices()) >= n_dev:
+            return jax
+    except Exception:
+        pass
+    from __graft_entry__ import _force_virtual_cpu_mesh
+    _force_virtual_cpu_mesh(n_dev)
     return jax
 
 
@@ -42,6 +40,10 @@ def main() -> None:
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="pipeline microbatch schedule (1f1b: O(S) "
+                         "activation store instead of O(M))")
     ap.add_argument("--sp", type=int, default=2)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=8)
@@ -72,7 +74,13 @@ def main() -> None:
     params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg,
                           mesh)
     opt = init_adam_state(params)
-    step = make_parallel_train_step(cfg, mesh, learning_rate=3e-3)
+    step = make_parallel_train_step(cfg, mesh, learning_rate=3e-3,
+                                    pipeline_schedule=args.schedule)
+    if args.pp > 1:
+        from deeplearning4j_tpu.parallel.megatron import \
+            pipeline_bubble_fraction
+        print(f"pipeline schedule {args.schedule}: bubble "
+              f"{pipeline_bubble_fraction(args.schedule, args.pp, args.pp):.3f}")
 
     rng = np.random.default_rng(0)
     for i in range(args.steps):

@@ -5,6 +5,11 @@ Run: python examples/word2vec_basic.py [--corpus path]
 (no --corpus → small built-in corpus)
 """
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 
 from deeplearning4j_tpu.nlp import (BasicLineIterator,
                                     CollectionSentenceIterator,

@@ -132,3 +132,77 @@ def test_vocab_constructor_text_fast_path():
     words = set(cache.words())
     assert words == {"the", "cat", "sat"}
     assert cache.word_frequency("the") == 3.0
+
+
+def test_native_window_pairs_matches_numpy():
+    """C++ pair expansion == the numpy fallback bit-for-bit on the same
+    (flat, sid, reduced-window) inputs — the r5 staging fast path's
+    proof obligation."""
+    from deeplearning4j_tpu import native_bridge
+    if not native_bridge.native_available():
+        pytest.skip("native IO library unavailable")
+    rng = np.random.default_rng(0)
+    n, window = 5000, 5
+    flat = rng.integers(0, 200, n).astype(np.int32)
+    lens = rng.integers(3, 40, 200)
+    lens = lens[np.cumsum(lens) <= n]
+    sid = np.repeat(np.arange(len(lens)), lens)
+    sid = np.concatenate([sid, np.full(n - len(sid), len(lens))])
+    sid = sid.astype(np.int32)
+    w = (window - rng.integers(0, window, n)).astype(np.int32)
+    native = native_bridge.window_pairs(flat, sid, w, window)
+    assert native is not None
+    # numpy fallback reimplemented exactly as in _corpus_window_pairs
+    offs = np.concatenate([np.arange(-window, 0),
+                           np.arange(1, window + 1)]).astype(np.int32)
+    k = len(offs)
+    ci = np.repeat(np.arange(n, dtype=np.int32), k)
+    off_t = np.tile(offs, n)
+    xi = ci + off_t
+    valid = ((xi >= 0) & (xi < n)
+             & (np.abs(off_t) <= np.repeat(w, k)))
+    xi_c = np.clip(xi, 0, n - 1)
+    valid &= sid[xi_c] == sid[ci]
+    np.testing.assert_array_equal(native[0], flat[ci[valid]])
+    np.testing.assert_array_equal(native[1], flat[xi[valid]])
+
+
+def test_native_pair_shuffle_is_seeded_permutation():
+    from deeplearning4j_tpu import native_bridge
+    if not native_bridge.native_available():
+        pytest.skip("native IO library unavailable")
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 1000, 4096).astype(np.int32)
+    b = rng.integers(0, 1000, 4096).astype(np.int32)
+    a1, b1 = a.copy(), b.copy()
+    assert native_bridge.pair_shuffle(a1, b1, seed=42)
+    # a permutation of the PAIRS (columns stay aligned)
+    packed0 = sorted(zip(a.tolist(), b.tolist()))
+    packed1 = sorted(zip(a1.tolist(), b1.tolist()))
+    assert packed0 == packed1
+    assert not np.array_equal(a1, a)
+    # deterministic in the seed
+    a2, b2 = a.copy(), b.copy()
+    assert native_bridge.pair_shuffle(a2, b2, seed=42)
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(b1, b2)
+    a3 = a.copy()
+    assert native_bridge.pair_shuffle(a3, b.copy(), seed=43)
+    assert not np.array_equal(a3, a1)
+
+
+def test_native_neg_pool_fill_deterministic_and_in_range():
+    from deeplearning4j_tpu import native_bridge
+    if not native_bridge.native_available():
+        pytest.skip("native IO library unavailable")
+    table = np.arange(100, 400, dtype=np.int32)
+    p1 = native_bridge.neg_pool_fill(table, (64, 32, 5), seed=7)
+    p2 = native_bridge.neg_pool_fill(table, (64, 32, 5), seed=7)
+    p3 = native_bridge.neg_pool_fill(table, (64, 32, 5), seed=8)
+    assert p1 is not None and p1.shape == (64, 32, 5)
+    np.testing.assert_array_equal(p1, p2)
+    assert not np.array_equal(p1, p3)
+    assert p1.min() >= 100 and p1.max() < 400
+    # draws cover the table roughly uniformly
+    counts = np.bincount(p1.ravel() - 100, minlength=300)
+    assert counts.min() > 0

@@ -514,3 +514,116 @@ def test_segmenting_sentence_iterator():
     all_s = list(it)
     assert "Single sentence here." in all_s
     assert len(all_s) >= 4
+
+
+def test_word2vec_subsampling_path():
+    """subsampling > 0 exercises _freq_arr/_subsampled_corpus (r5: a
+    num_words-as-method bug crashed this path — zero coverage before);
+    frequent words must be dropped from the training stream and the
+    model still trains."""
+    w = Word2Vec(sentences=_toy_corpus(10), layer_size=16, window=3,
+                 epochs=1, seed=13, min_word_frequency=1, batch_size=64,
+                 subsampling=1e-3, negative=3)
+    w.build_vocab()
+    flat_all, _ = w._encoded_corpus()
+    flat_sub, _sid = w._subsampled_corpus()
+    assert 0 < len(flat_sub) < len(flat_all)
+    w.fit()
+    assert np.isfinite(np.asarray(w.lookup_table.syn0)).all()
+
+
+def test_tokenizer_fast_path_matches_protocol():
+    """The no-preprocessor get_tokens fast path must keep the protocol
+    loop's semantics: empty tokens filtered, stream consumed."""
+    from deeplearning4j_tpu.nlp.tokenization import Tokenizer
+    t = Tokenizer(["a", "", "b", "", "c"], None)
+    assert t.get_tokens() == ["a", "b", "c"]
+    assert t.get_tokens() == []          # consumed
+    # protocol path (with a no-op-ish preprocessor) agrees
+    class Lower:
+        def pre_process(self, tok):
+            return tok.lower()
+    t2 = Tokenizer(["A", "", "B"], Lower())
+    assert t2.get_tokens() == ["a", "b"]
+
+
+def test_encoded_corpus_matches_per_sentence_encode():
+    """The r5 one-pass vectorized _encoded_corpus == the per-sentence
+    _encode reference (unknown words dropped, kept-lengths match)."""
+    w = Word2Vec(sentences=_toy_corpus(6), layer_size=8, window=2,
+                 epochs=1, seed=3, min_word_frequency=2, negative=2)
+    w.build_vocab()
+    flat, lens = w._encoded_corpus()
+    ref_seqs = [w._encode(s) for s in w._tokenized_corpus()]
+    ref_flat = (np.concatenate(ref_seqs) if ref_seqs
+                else np.empty(0, np.int32))
+    np.testing.assert_array_equal(flat, ref_flat)
+    np.testing.assert_array_equal(lens,
+                                  [len(s) for s in ref_seqs])
+
+
+def test_build_vocab_rereads_changed_corpus():
+    """A vocab rebuild must see the CURRENT corpus, not a stale token
+    cache (advisor-style regression for the r5 token cache)."""
+    from deeplearning4j_tpu.nlp.sentenceiterator import \
+        CollectionSentenceIterator
+    w = Word2Vec(sentences=["aa bb cc"] * 3, layer_size=8, window=2,
+                 epochs=1, seed=3, min_word_frequency=1, negative=2)
+    w.build_vocab()
+    assert w.vocab.contains_word("aa")
+    w.sentence_iterator = CollectionSentenceIterator(["xx yy zz"] * 3)
+    w.vocab = None
+    w.build_vocab()
+    assert w.vocab.contains_word("xx")
+    assert not w.vocab.contains_word("aa")
+
+
+def test_hs_scanned_then_stepped_same_model():
+    """The device-resident HS tables are PRIVATE copies: the scanned
+    fit's buffer donation must not delete the lookup table's own
+    Huffman arrays, so a stepped fit on the same model still works
+    (r5 review — 'Array has been deleted' on donating backends)."""
+    w = Word2Vec(sentences=_toy_corpus(8), layer_size=16, window=3,
+                 epochs=1, seed=13, min_word_frequency=2, batch_size=64,
+                 negative=0, use_hierarchic_softmax=True)
+    w.fit()                      # scanned path donates table carries
+    # the table arrays are still alive and usable by the stepped path
+    assert np.isfinite(np.asarray(w.lookup_table.points)).all()
+    w.scan_epochs = False
+    w.fit()                      # stepped path gathers from lt.points
+    assert np.isfinite(np.asarray(w.lookup_table.syn0)).all()
+
+
+def test_empty_sentences_do_not_misalign_corpus():
+    """Blank sentences through subclasses that do not pre-filter must
+    not break the one-pass encoder's sentence-boundary bookkeeping
+    (r5 review: reduceat needs strictly increasing starts)."""
+    from deeplearning4j_tpu.scaleout.sequencevectors import SparkWord2Vec
+    sv = SparkWord2Vec(sentences=["hello world hello", "", "   ",
+                                  "more text more"] * 4,
+                       layer_size=8, window=2, epochs=1, seed=3,
+                       min_word_frequency=1, negative=2)
+    sv.build_vocab()
+    flat, lens = sv._encoded_corpus()
+    assert int(lens.sum()) == len(flat)
+    assert (lens > 0).all()
+    c, x = sv._corpus_window_pairs()
+    assert len(c) == len(x) > 0
+
+
+def test_distributed_build_vocab_resets_staging_caches():
+    """DistributedSequenceVectors.build_vocab must drop the token and
+    encoded-corpus caches (r5 review: rebuild on a changed corpus
+    silently trained on the old corpus's ids)."""
+    from deeplearning4j_tpu.scaleout.sequencevectors import SparkWord2Vec
+    sv = SparkWord2Vec(sentences=["aa bb cc aa"] * 4, layer_size=8,
+                       window=2, epochs=1, seed=3, min_word_frequency=1,
+                       negative=2)
+    sv.build_vocab()
+    sv._encoded_corpus()
+    sv.corpus = ["xx yy zz xx"] * 4
+    sv.build_vocab()
+    assert sv.vocab.contains_word("xx")
+    flat, _ = sv._encoded_corpus()
+    words = [sv.vocab.word_at_index(int(i)).word for i in flat[:4]]
+    assert set(words) <= {"xx", "yy", "zz"}
