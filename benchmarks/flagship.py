@@ -48,6 +48,7 @@ def _peak() -> float | None:
 
 def bench_transformer(steps: int = 20, reps: int = 2, *,
                       batch: int = 16, d_model: int = 512,
+                      seq_len: int = 2048,
                       vocab: int = 256, xent_chunk: int = 0,
                       remat: bool = True,
                       remat_policy: str = "full") -> dict:
@@ -66,7 +67,7 @@ def bench_transformer(steps: int = 20, reps: int = 2, *,
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        init_params, loss_fn)
 
-    B, T, L, D, H, V = batch, 2048, 12, d_model, 8, vocab
+    B, T, L, D, H, V = batch, seq_len, 12, d_model, 8, vocab
     cfg = TransformerConfig(vocab_size=V, d_model=D, n_heads=H,
                             n_layers=L, max_len=T, dtype="bfloat16",
                             remat=remat, remat_policy=remat_policy,
@@ -114,7 +115,7 @@ def bench_transformer(steps: int = 20, reps: int = 2, *,
     peak = _peak()
     if peak:
         mfu = tok_s * flops_tok / peak
-    name = f"transformer_lm_12L{D}d_T2048"
+    name = f"transformer_lm_12L{D}d_T{T}"
     if V != 256:
         name += f"_V{V}"
     return {"config": name, "value": round(tok_s),
@@ -268,6 +269,17 @@ def bench_decode_long(reps: int = 2) -> dict:
     return bench_decode(reps=reps, prompt_len=1900)
 
 
+def bench_transformer_8k(reps: int = 2) -> dict:
+    """Long-context proof point: T=8192 (4x the flagship context) at
+    B=4 — same tokens/step as the T=2048 B=16 row, blockwise-remat +
+    flash attention (the combination that OOMs the jnp path at a
+    quarter of this length). NOT in the driver's default bench set
+    (budget); run via `flagship.py --config transformer_8k` and
+    recorded in BASELINE.md."""
+    return bench_transformer(steps=10, reps=reps, batch=4,
+                             seq_len=8192)
+
+
 def bench_transformer_1024(reps: int = 2) -> dict:
     """d_model=1024 / head_dim 128 variant (B=8): the MXU-native shape
     that demonstrates the framework's MFU ceiling — measured 49.4%
@@ -287,6 +299,7 @@ def bench_transformer_32kvocab(reps: int = 2) -> dict:
 
 
 BENCHES = {"transformer": bench_transformer,
+           "transformer_8k": bench_transformer_8k,
            "transformer_1024": bench_transformer_1024,
            "transformer_32kvocab": bench_transformer_32kvocab,
            "vgg16": bench_vgg16, "lstm": bench_lstm,

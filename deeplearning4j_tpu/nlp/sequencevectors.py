@@ -242,10 +242,12 @@ class SequenceVectors(WordVectorsMixin):
                     [[0], np.cumsum(lens_all)[:-1]])
                 lens = np.add.reduceat(
                     valid.astype(np.int64), starts)
-                # reduceat quirk: a zero-length sentence aliases the
-                # next sentence's first element; _sequences() never
-                # yields empty token lists, so starts are strictly
-                # increasing and this cannot trigger.
+                # reduceat quirk: a zero-length sentence would alias
+                # the next sentence's first element; the empty-list
+                # filter above is what guarantees strictly increasing
+                # starts — scaleout subclasses DO yield empty token
+                # lists for blank sentences, so the filter is
+                # load-bearing, not defensive.
             else:
                 flat = np.empty(0, np.int32)
                 lens = np.zeros(len(toks), np.int64)

@@ -468,7 +468,82 @@ def _fwd(q3, k3, v3, scale, causal, q_offset, kv_offset, interpret):
 _BWD_Q_CHUNK = int(os.environ.get("DL4JTPU_BWD_Q_CHUNK", "4096"))
 
 
+# K/V extent past which the backward is 2-D host-tiled (see _bwd).
+# 4096 = the longest sk the single fused call compiles at on this
+# toolchain; the TILE edge is 2048 — the per-call extent PROVEN to
+# compose (the 12-layer T=2048 training program holds 12 such calls).
+_BWD_K_CHUNK = int(os.environ.get("DL4JTPU_BWD_K_CHUNK", "4096"))
+_BWD_LONG_TILE = int(os.environ.get("DL4JTPU_BWD_LONG_TILE", "2048"))
+
+
+def _chunk_of(n: int, cap: int) -> int:
+    """Largest BLOCK_Q-multiple divisor of n that is <= cap (0 if none)."""
+    start = max(BLOCK_Q, (cap // BLOCK_Q) * BLOCK_Q)
+    for c in range(start, 0, -BLOCK_Q):
+        if n % c == 0:
+            return c
+    return 0
+
+
 def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
+    """Long-sequence backward = 2-D host tiling over the fused kernel
+    (r5). Sequences past ~4k crash the terminal compile helper even
+    with q chunked — and two (3072, 3072) kernel calls that each
+    compile ALONE crash when jitted into one program (the spurious
+    scoped-vmem accounting, grid_crash_repro.py family), while twelve
+    (2048, 2048) calls provably coexist (the flagship training
+    program). So for sk > _BWD_K_CHUNK the backward runs a q x k grid
+    of (<=2048, <=2048) kernel calls: each tile's partial
+    probabilities use the GLOBAL softmax stats (m, logl) — the same
+    decomposition the in-kernel k-superblock loop applies — so dQ
+    sums over k tiles, dK/dV sum over q tiles, and causally
+    fully-masked tiles (k tile entirely after the q tile's last row)
+    are skipped at trace time. This takes single-chip training from
+    T<=4096 to T=8192+ on this toolchain."""
+    q3, k3, v3, o3, m, logl = res
+    sk = k3.shape[1]
+    tq = q3.shape[1]
+    if sk > _BWD_K_CHUNK:
+        kc = _chunk_of(sk, _BWD_LONG_TILE)
+        qc = _chunk_of(tq, _BWD_LONG_TILE)
+        if kc and qc:
+            dqs = []
+            dks = [None] * (sk // kc)
+            dvs = [None] * (sk // kc)
+            for qlo in range(0, tq, qc):
+                qsl = slice(qlo, qlo + qc)
+                dq = None
+                for ki, klo in enumerate(range(0, sk, kc)):
+                    if causal and (kv_offset + klo
+                                   > q_offset + qlo + qc - 1):
+                        continue    # tile entirely above the diagonal
+                    ksl = slice(klo, klo + kc)
+                    dq_c, dk_c, dv_c = _flash_backward(
+                        q3[:, qsl], k3[:, ksl], v3[:, ksl], o3[:, qsl],
+                        m[:, qsl], logl[:, qsl], g[:, qsl], scale,
+                        causal, q_offset + qlo, kv_offset + klo,
+                        interpret)
+                    dq = (dq_c.astype(jnp.float32) if dq is None
+                          else dq + dq_c.astype(jnp.float32))
+                    dk32 = dk_c.astype(jnp.float32)
+                    dv32 = dv_c.astype(jnp.float32)
+                    dks[ki] = dk32 if dks[ki] is None else dks[ki] + dk32
+                    dvs[ki] = dv32 if dvs[ki] is None else dvs[ki] + dv32
+                dqs.append(jnp.zeros_like(q3[:, qsl]) if dq is None
+                           else dq.astype(q3.dtype))
+            zk = jnp.zeros((k3.shape[0], kc, k3.shape[2]), jnp.float32)
+            return (jnp.concatenate(dqs, axis=1),
+                    jnp.concatenate(
+                        [zk if d is None else d for d in dks],
+                        axis=1).astype(k3.dtype),
+                    jnp.concatenate(
+                        [zk if d is None else d for d in dvs],
+                        axis=1).astype(v3.dtype))
+    return _bwd_qchunks(scale, causal, q_offset, kv_offset, interpret,
+                        res, g)
+
+
+def _bwd_qchunks(scale, causal, q_offset, kv_offset, interpret, res, g):
     q3, k3, v3, o3, m, logl = res
     sk = k3.shape[1]
     tq = q3.shape[1]
@@ -482,15 +557,7 @@ def _bwd(scale, causal, q_offset, kv_offset, interpret, res, g):
     # at the short lengths that can actually reach it).
     chunk = tq
     if tq > _BWD_Q_CHUNK:
-        chunk = 0
-        # start from the largest BLOCK_Q multiple <= the cap: an env
-        # override like 4000 must not make the search walk values that
-        # are never BLOCK_Q-aligned and land on a tiny divisor
-        start = max(BLOCK_Q, (_BWD_Q_CHUNK // BLOCK_Q) * BLOCK_Q)
-        for c in range(start, 0, -BLOCK_Q):
-            if tq % c == 0:
-                chunk = c
-                break
+        chunk = _chunk_of(tq, _BWD_Q_CHUNK)
     if sk % min(BLOCK_Q, sk) == 0 and chunk:
         if tq > chunk:
             dqs = []
