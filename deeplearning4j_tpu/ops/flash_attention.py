@@ -345,8 +345,31 @@ def _bh_chunks(bh: int, nsb: int, cap: int):
     return [(lo, min(step, bh - lo)) for lo in range(0, bh, step)]
 
 
+# q extent per forward kernel call: at T=16384 the full-T call's
+# scoped-vmem accounting lands 156KB over the 16MB cap (measured r5),
+# so longer sequences split over q at host level — forward q chunks
+# are fully independent (per-row online-softmax stats), no merge pass.
+_FWD_Q_CHUNK = int(os.environ.get("DL4JTPU_FWD_Q_CHUNK", "8192"))
+
+
 def _flash_forward(q3, k3, v3, scale: float, causal: bool,
                    q_offset: int, kv_offset: int, interpret: bool):
+    tq = q3.shape[1]
+    if tq > _FWD_Q_CHUNK:
+        chunk = _chunk_of(tq, _FWD_Q_CHUNK)
+        if chunk and chunk < tq:
+            outs = [_flash_forward_impl(
+                q3[:, lo:lo + chunk], k3, v3, scale, causal,
+                q_offset + lo, kv_offset, interpret)
+                for lo in range(0, tq, chunk)]
+            return tuple(jnp.concatenate([o[i] for o in outs], axis=1)
+                         for i in range(3))
+    return _flash_forward_impl(q3, k3, v3, scale, causal, q_offset,
+                               kv_offset, interpret)
+
+
+def _flash_forward_impl(q3, k3, v3, scale: float, causal: bool,
+                        q_offset: int, kv_offset: int, interpret: bool):
     import jax.experimental.pallas as pl
 
     _log_caps_once()
@@ -356,8 +379,11 @@ def _flash_forward(q3, k3, v3, scale: float, causal: bool,
     bk = _inner_block(sk)
     # q-superblock: bounds per-program VMEM (full-T q/o blocks blow the
     # 16MB budget past T=2048); K/V block indices are constant in this
-    # grid dim, so they stay VMEM-resident across a head's superblocks
-    qsb = _inner_block(tq, 2048)
+    # grid dim, so they stay VMEM-resident across a head's superblocks.
+    # Env-overridable: very long K/V (>8k rows resident) needs a
+    # smaller superblock to stay under the scoped-vmem cap (r5).
+    qsb = _inner_block(tq, int(os.environ.get("DL4JTPU_FWD_QSB",
+                                              "2048")))
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
         qo=int(q_offset), ko=int(kv_offset), bq=bq, bk=bk)

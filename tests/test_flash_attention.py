@@ -208,6 +208,9 @@ def test_bwd_2d_host_tiling_matches_reference(monkeypatch):
     fa = sys.modules["deeplearning4j_tpu.ops.flash_attention"]
     monkeypatch.setattr(fa, "_BWD_K_CHUNK", 128)
     monkeypatch.setattr(fa, "_BWD_LONG_TILE", 128)
+    # also force the r5 host-level FORWARD q split (independent chunks,
+    # per-row stats) so fwd+bwd chunked paths are covered together
+    monkeypatch.setattr(fa, "_FWD_Q_CHUNK", 256)
     monkeypatch.setenv("DL4JTPU_FLASH", "interpret")
     rng = np.random.RandomState(0)
     B, T, H, Dh = 2, 512, 2, 32
@@ -218,15 +221,36 @@ def test_bwd_2d_host_tiling_matches_reference(monkeypatch):
             return jnp.sum(fa.flash_attention(
                 q, k, v, causal=causal).astype(jnp.float32) ** 2)
 
-        def loss_ref(q, k, v):
-            from deeplearning4j_tpu.nn.layers.attention import \
-                dot_product_attention
-            return jnp.sum(dot_product_attention(
-                q, k, v, causal=causal).astype(jnp.float32) ** 2)
-
+        # tiled grads (chunk attrs forced small by the monkeypatches)
         gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
-        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+        # 1) vs the UNCHUNKED fused kernel: tiling is a pure
+        #    re-scheduling, so this must match tightly
+        with monkeypatch.context() as mp:
+            mp.setattr(fa, "_BWD_K_CHUNK", 1 << 20)
+            mp.setattr(fa, "_FWD_Q_CHUNK", 1 << 20)
+            gu = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gu, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5,
+                err_msg=f"tiled vs unchunked d{name} causal={causal}")
+
+        # 2) vs the TRUE jnp reference (kernel dispatch forced OFF —
+        #    without this the 'reference' is the kernel itself):
+        #    tolerance covers the kernel's f32-accumulation-order
+        #    noise at grad scale ~5 (~1.3e-2 max-abs, present in the
+        #    unchunked kernel too)
+        with monkeypatch.context() as mp:
+            mp.setenv("DL4JTPU_FLASH", "0")
+
+            def loss_ref(q, k, v):
+                from deeplearning4j_tpu.nn.layers.attention import \
+                    dot_product_attention
+                return jnp.sum(dot_product_attention(
+                    q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b, name in zip(gk, gr, "qkv"):
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), atol=2e-3,
-                err_msg=f"d{name} causal={causal}")
+                np.asarray(a), np.asarray(b), atol=3e-2,
+                err_msg=f"tiled vs jnp d{name} causal={causal}")
