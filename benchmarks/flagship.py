@@ -198,19 +198,24 @@ def bench_lstm(reps: int = 2) -> dict:
     if last != last:
         raise RuntimeError("NaN score in lstm bench")
     chars_s = BATCH * T * POOL * EPOCHS / best
-    # cost on the UNFUSED schedule (see fit_batched_cost docstring):
-    # the wavefront moves layer 2's hoisted [B*T] input projection
-    # into the scan body, which XLA's cost model counts once instead
-    # of T times; model FLOPs are schedule-independent
-    cost = net.fit_batched_cost(xs[:1], ys[:1], epochs=1,
-                                lstm_wavefront=False)
-    step_flops = cost.get("flops")
+    # ANALYTIC model FLOPs per char — same basis as the transformer
+    # rows (flagship.py bench_transformer), replacing the XLA
+    # cost-model basis whose schedule-dependence made the MFU metric
+    # drift across rounds (VERDICT r5 weak #1; restated in BASELINE.md).
+    # Matmul-only accounting, matmul = 2 FLOPs/MAC, train = 3x fwd:
+    #   LSTM layer: 4 gates x (input + recurrent) GEMMs = 8*H*(I+H)
+    #   output projection: 2*H*V
+    # layer1 I=V, layer2 I=H; elementwise gate math excluded (the
+    # transformer basis excludes its elementwise tails too).
+    H = 200
+    flops_char = 3 * (8 * H * (V + H) + 8 * H * (H + H) + 2 * H * V)
     mfu = None
     peak = _peak()
-    if step_flops and peak:
-        mfu = step_flops * POOL * EPOCHS / best / peak
+    if peak:
+        mfu = chars_s * flops_char / peak
     return {"config": "graves_lstm_charrnn_2x200_T64", "value": round(
         chars_s), "unit": "chars/sec/chip",
+        "model_flops_per_char": flops_char,
         "mfu": round(mfu, 4) if mfu else None}
 
 
@@ -298,12 +303,93 @@ def bench_transformer_32kvocab(reps: int = 2) -> dict:
     return bench_transformer(reps=reps, vocab=32768, xent_chunk=2048)
 
 
+def bench_engine_decode(reps: int = 2, *, batch: int = 64,
+                        prompt_len: int = 64, new_tokens: int = 64,
+                        d_model: int = 512, n_layers: int = 12) -> dict:
+    """Engine-mediated vs direct sharded decode at the flagship decode
+    geometry (ISSUE-1 acceptance: the serving engine's admission/
+    batching/bookkeeping overhead must stay within 10% of the bare
+    `make_parallel_generate` call). Single-shot engine mode
+    (decode_chunk=0) — the same compiled program both ways, so the
+    delta IS the engine. Both rows forced-host-read fenced."""
+    import time as _t
+    from dataclasses import astuple
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.parallel.serving import shard_serving_params
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine,
+                                                   _compiled_generate)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=d_model, n_heads=8,
+                            n_layers=n_layers, max_len=2048,
+                            dtype="bfloat16")
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    sp = shard_serving_params(params, cfg, mesh)
+    prompts = np.zeros((batch, prompt_len), np.int32)
+    key = jax.random.PRNGKey(0)
+
+    fn = _compiled_generate(astuple(cfg), mesh, int(new_tokens),
+                            0.0, 0, 1.0)
+    _host_read(fn(sp, jnp.asarray(prompts), key))          # warm
+    direct = float("inf")
+    for _ in range(reps):
+        t0 = _t.perf_counter()
+        _host_read(fn(sp, jnp.asarray(prompts), key))
+        direct = min(direct, _t.perf_counter() - t0)
+
+    eng = InferenceEngine(cfg, mesh, params, EngineConfig(
+        max_batch_size=batch, max_queue=2 * batch,
+        max_new_tokens=new_tokens, decode_chunk=0))
+
+    def engine_round():
+        hs = [eng.submit(prompts[i]) for i in range(batch)]
+        eng.run_pending()
+        return hs[-1].result(0)
+
+    engine_round()                                          # warm
+    ebest = float("inf")
+    for _ in range(reps):
+        t0 = _t.perf_counter()
+        engine_round()
+        ebest = min(ebest, _t.perf_counter() - t0)
+
+    return {"config": f"engine_decode_{n_layers}L{d_model}d_B{batch}",
+            "value": round(batch * new_tokens / ebest),
+            "unit": "tokens/sec/chip",
+            "direct_tokens_per_sec": round(batch * new_tokens / direct),
+            "engine_overhead_pct": round(100 * (ebest - direct)
+                                         / direct, 2)}
+
+
+def bench_word2vec(reps: int = 2) -> dict:
+    """Word2Vec skip-gram+neg at the reference-workload-class vocab
+    (v=100k) — the driver-captured row VERDICT r5 weak #2 demanded
+    (the NLP perf story was previously self-attested from builder
+    sittings only). Delegates to benchmarks/word2vec_bench.run; reps
+    maps to timed warm epochs (best-of is inappropriate here — the
+    per-epoch mean over N epochs is the honest steady-state)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from word2vec_bench import run as w2v_run
+    return w2v_run(vocab=100_000, epochs=max(2, reps))
+
+
 BENCHES = {"transformer": bench_transformer,
            "transformer_8k": bench_transformer_8k,
            "transformer_1024": bench_transformer_1024,
            "transformer_32kvocab": bench_transformer_32kvocab,
            "vgg16": bench_vgg16, "lstm": bench_lstm,
-           "decode": bench_decode, "decode_long": bench_decode_long}
+           "decode": bench_decode, "decode_long": bench_decode_long,
+           "engine_decode": bench_engine_decode,
+           "word2vec": bench_word2vec}
 
 
 def main() -> None:

@@ -47,6 +47,54 @@ class FaultInjector:
                                   f"{iteration}")
 
 
+class ServingFaultInjector(FaultInjector):
+    """Serving-side deterministic fault injection (the engine-hook
+    extension of FaultInjector — serving/engine.py calls
+    ``on_decode_step`` immediately before every compiled decode
+    invocation).
+
+    Knobs:
+      - ``fail_at`` / ``persistent``: decode-step indices to fail. Step
+        indices count COMPLETED decode steps — a failed attempt is
+        retried at the same index, so a non-persistent fault vanishes on
+        the first retry (transient) while ``persistent=True`` keeps
+        failing the step through every retry (systemic hard fault; the
+        engine's circuit breaker is what eventually reacts).
+      - ``poison_requests``: request ids that fail EVERY batch
+        containing them — the per-request hard fault. The engine
+        responds by isolating the batch (solo re-runs) and quarantining
+        exactly the poisoned requests.
+      - ``delay_at``: ``{step: seconds}`` one-shot host-side stalls
+        injected before the step launches — drives deadline-miss
+        scheduling deterministically without real overload.
+    """
+
+    def __init__(self, fail_at: Iterable[int] = (),
+                 persistent: bool = False,
+                 poison_requests: Iterable[int] = (),
+                 delay_at: Optional[dict] = None):
+        super().__init__(fail_at, persistent=persistent)
+        self.poison_requests = set(int(r) for r in poison_requests)
+        self.delay_at = {int(k): float(v)
+                         for k, v in (delay_at or {}).items()}
+        self.delays_injected = 0
+
+    def on_decode_step(self, step: int,
+                       request_ids: Iterable[int] = ()) -> None:
+        d = self.delay_at.pop(int(step), 0.0)
+        if d > 0:
+            self.delays_injected += 1
+            time.sleep(d)
+        bad = self.poison_requests.intersection(
+            int(r) for r in request_ids)
+        if bad:
+            self.injected += 1
+            raise TrainingFailure(
+                f"poisoned request(s) {sorted(bad)} at decode step "
+                f"{step}")
+        self.check(int(step))
+
+
 class FaultTolerantTrainer:
     """Run fit over an iterator with checkpoint/restore-based recovery.
 

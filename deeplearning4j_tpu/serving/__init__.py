@@ -1,0 +1,24 @@
+"""Fault-tolerant serving of the sharded LM path.
+
+The reference's serving story ends at single-process
+`MultiLayerNetwork.output`/`rnnTimeStep`, and its only fault tolerance
+is what Spark's RDD retry gives training for free (SURVEY.md §5.3); a
+bare `make_parallel_generate` closure has neither. This package owns
+the layer between callers and the compiled decode step:
+
+- `InferenceEngine` — bounded admission queue, dynamic batcher,
+  per-request deadlines, retry-with-backoff on transient step
+  failures, per-request quarantine of persistent faults, a circuit
+  breaker with load shedding/degradation, health/readiness reporting,
+  and hot weight reload from a `CheckpointManager` directory without
+  draining in-flight requests.
+- Deterministic fault injection for all of the above via
+  `parallel.failure.ServingFaultInjector` (fail the Nth decode step,
+  per-request poisoning, host-side delay injection) — every behavior
+  is testable on the CPU backend (tests/test_serving_engine.py).
+
+Lifecycle and thresholds: docs/serving.md.
+"""
+from deeplearning4j_tpu.serving.engine import (  # noqa: F401
+    DeadlineExceeded, EngineConfig, InferenceEngine, OverloadError,
+    RequestHandle, RequestQuarantined, RequestStatus)

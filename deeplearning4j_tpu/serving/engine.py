@@ -1,0 +1,606 @@
+"""Fault-tolerant inference engine over `parallel/serving.py`.
+
+`InferenceEngine` turns the bare compiled-generate closure into a
+service: callers `submit()` prompts and get a `RequestHandle`; a
+dynamic batcher coalesces queued prompts (grouped by prompt length —
+the model has no pad masking, so only identical-length prompts share a
+batch; the batch dim is padded to a 'data'-axis multiple with throwaway
+rows) and drives the jitted decode step, optionally in fixed-size
+chunks so deadlines and faults are handled at chunk granularity.
+
+Failure semantics:
+- A decode-step failure (XlaRuntimeError, injected `TrainingFailure`)
+  is retried with exponential backoff up to `max_retries`. Decode is
+  deterministic given (params, prompt, key) and the per-chunk key
+  depends only on the decoded-position offset, so a retried request
+  completes with byte-identical tokens to a no-fault run.
+- When a batch exhausts its retries, the engine isolates: each
+  in-flight request is re-run solo (continuing from its decoded
+  prefix). Requests that fail solo too are QUARANTINED — the
+  per-request hard fault — without poisoning co-batched requests.
+- Consecutive step failures trip a circuit breaker: admissions are
+  rejected with `OverloadError` for `breaker_cooldown_s`, then a
+  half-open probe admission closes it again on success.
+- Load shedding: a full queue rejects admissions outright; past the
+  soft watermark (`degrade_queue_depth`) the engine degrades by
+  capping `max_new_tokens` at `degraded_max_new_tokens`.
+- Requests past their deadline are shed (`DeadlineExceeded`) or — with
+  `on_deadline="partial"` — complete early with the tokens decoded so
+  far, instead of stalling the rest of the batch.
+
+Weights hot-reload: `reload_weights()` restores a param tree from a
+`CheckpointManager` directory using the live (sharded) params as the
+placement template and swaps it in atomically; in-flight batches finish
+on the weights they started with (no drain), later batches use the new
+ones. Corrupt/partial `step_<N>` directories fall back to the previous
+good step.
+
+Every behavior is deterministically testable on the CPU backend via
+`parallel.failure.ServingFaultInjector` — see
+tests/test_serving_engine.py and docs/serving.md.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, astuple
+from functools import lru_cache
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.parallel.serving import (make_parallel_generate,
+                                                 shard_serving_params)
+from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class OverloadError(RuntimeError):
+    """Admission rejected: queue full or circuit breaker open."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request shed because its deadline passed before completion."""
+
+
+class RequestQuarantined(RuntimeError):
+    """Request failed persistently (solo, after max retries) and was
+    quarantined so it cannot poison further batches."""
+
+
+class RequestStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    SHED = "shed"
+    QUARANTINED = "quarantined"
+
+
+@dataclass
+class EngineConfig:
+    """Queueing / batching / fault-handling policy knobs.
+
+    ``decode_chunk=0`` decodes each batch's full token budget in ONE
+    compiled call (lowest overhead — the benchmark mode);
+    ``decode_chunk=N`` decodes N tokens per call so deadlines are
+    enforced and faults retried at chunk granularity (each chunk
+    re-prefills the grown prompt — the robustness/latency mode)."""
+    max_queue: int = 64              # hard admission bound
+    max_batch_size: int = 8          # dynamic-batcher coalescing cap
+    batch_timeout_s: float = 0.005   # worker coalescing window
+    max_new_tokens: int = 32         # engine default AND per-request cap
+    decode_chunk: int = 0            # 0 = single-shot decode
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_retries: int = 3             # per decode step (batch, then solo)
+    backoff_base_s: float = 0.01     # exponential: base * 2^(attempt-1)
+    backoff_max_s: float = 1.0
+    breaker_failure_threshold: int = 5   # consecutive step failures
+    breaker_cooldown_s: float = 5.0
+    degrade_queue_depth: int = 48    # soft watermark -> degraded mode
+    degraded_max_new_tokens: int = 8
+    seed: int = 0                    # sampling key root
+
+
+class RequestHandle:
+    """Caller-facing future for one submitted prompt."""
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new: int,
+                 deadline_at: Optional[float], on_deadline: str):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new
+        self.deadline_at = deadline_at
+        self.on_deadline = on_deadline
+        self.status = RequestStatus.QUEUED
+        self.error: Optional[BaseException] = None
+        self.deadline_exceeded = False
+        self._generated: List[np.ndarray] = []
+        self._done = threading.Event()
+
+    @property
+    def generated(self) -> np.ndarray:
+        """Tokens decoded so far (may be partial)."""
+        if not self._generated:
+            return np.zeros((0,), np.int32)
+        return np.concatenate(self._generated)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Full sequence [T0 + generated] (mirrors `generate`'s layout).
+        Raises the terminal error for shed/quarantined requests."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} not done")
+        if self.error is not None:
+            raise self.error
+        return np.concatenate([self.prompt, self.generated])
+
+    # -- engine-side terminal transitions ------------------------------
+    def _finish(self, status: str,
+                error: Optional[BaseException] = None) -> None:
+        self.status = status
+        self.error = error
+        self._done.set()
+
+
+class _BatchDecodeFailed(RuntimeError):
+    """Internal: a batch exhausted its retries (carries the last
+    underlying error); triggers the solo-isolation path."""
+
+
+@lru_cache(maxsize=64)
+def _compiled_generate(cfg_fields: tuple, mesh, max_new_tokens: int,
+                       temperature: float, top_k: int, top_p: float):
+    """Process-wide compiled-pgen cache: engines over the same
+    (config, mesh, sampling) share the jit cache instead of re-tracing
+    per engine instance (fault-injection tests build many engines)."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_parallel_generate(cfg, mesh, max_new_tokens,
+                                  temperature=temperature, top_k=top_k,
+                                  top_p=top_p)
+
+
+class InferenceEngine:
+    """Bounded-queue, deadline-aware, fault-tolerant front end for the
+    sharded generate path. See module docstring for semantics; see
+    EngineConfig for the policy knobs.
+
+    Drive it either synchronously — `submit()` then `run_pending()` on
+    the caller thread (deterministic; what the tests use) — or with the
+    background worker via `start()`/`stop()`."""
+
+    def __init__(self, cfg: TransformerConfig, mesh, params,
+                 config: Optional[EngineConfig] = None,
+                 fault_injector=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.config = config or EngineConfig()
+        self._dp = mesh.shape["data"]
+        self._params = shard_serving_params(params, cfg, mesh)
+        self._injector = fault_injector
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._rids = itertools.count(1)
+        self._accepting = True
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+        self._listeners: list = []
+        # breaker: closed -> open (consecutive failures) -> half-open
+        # (cooldown elapsed) -> closed (probe success) | open (failure)
+        self._breaker = "closed"
+        self._opened_at = 0.0
+        self._consec_failures = 0
+        # step counter indexes COMPLETED decode steps: a failed attempt
+        # retries the same index (ServingFaultInjector contract)
+        self._step_counter = 0
+        self._weights_step: Optional[int] = None
+        self.stats = {"completed": 0, "shed_overload": 0,
+                      "shed_deadline": 0, "quarantined": 0,
+                      "retries": 0, "step_failures": 0, "batches": 0,
+                      "reloads": 0, "in_flight": 0}
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_deadline: str = "shed") -> RequestHandle:
+        """Admit one prompt. Raises OverloadError when the queue is full
+        or the circuit breaker is open; in degraded mode the token
+        budget is silently capped (reported via health())."""
+        if on_deadline not in ("shed", "partial"):
+            raise ValueError(f"on_deadline must be 'shed' or 'partial', "
+                             f"got {on_deadline!r}")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        now = self._clock()
+        with self._lock:
+            if not self._accepting:
+                raise RuntimeError("engine is stopped")
+            self._tick_breaker(now)
+            if self._breaker == "open":
+                self.stats["shed_overload"] += 1
+                raise OverloadError(
+                    "circuit breaker open (recent step failures); "
+                    f"retry after {self.config.breaker_cooldown_s}s")
+            if len(self._queue) >= self.config.max_queue:
+                self.stats["shed_overload"] += 1
+                raise OverloadError(
+                    f"queue full ({self.config.max_queue})")
+            cap = (self.config.degraded_max_new_tokens
+                   if self._degraded_locked()
+                   else self.config.max_new_tokens)
+            eff = min(max_new_tokens or self.config.max_new_tokens,
+                      cap, self.config.max_new_tokens)
+            if eff < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if prompt.shape[0] + eff > self.cfg.max_len:
+                raise ValueError(
+                    f"prompt {prompt.shape[0]} + {eff} new tokens "
+                    f"exceeds max_len={self.cfg.max_len}")
+            handle = RequestHandle(
+                next(self._rids), prompt, eff,
+                now + deadline_s if deadline_s is not None else None,
+                on_deadline)
+            self._queue.append(handle)
+            self._cv.notify()
+        return handle
+
+    # ------------------------------------------------------------------
+    # driving: synchronous drain or background worker
+    # ------------------------------------------------------------------
+    def run_pending(self) -> int:
+        """Process queued requests on the caller thread until the queue
+        is empty. Returns the number of batches run."""
+        n = 0
+        while True:
+            batch = self._form_batch()
+            if not batch:
+                return n
+            self._process_batch(batch)
+            n += 1
+
+    def start(self) -> "InferenceEngine":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop_flag = False
+            self._thread = threading.Thread(target=self._worker,
+                                            daemon=True,
+                                            name="inference-engine")
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        with self._cv:
+            self._accepting = not drain and self._accepting
+            self._stop_flag = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            self.run_pending()
+        self._accepting = False
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop_flag:
+                    self._cv.wait(0.05)
+                if self._stop_flag:
+                    return
+            # coalescing window: let near-simultaneous submissions join
+            if self.config.batch_timeout_s > 0:
+                time.sleep(self.config.batch_timeout_s)
+            batch = self._form_batch()
+            if batch:
+                self._process_batch(batch)
+
+    def set_listeners(self, *listeners) -> None:
+        """Attach train-listener-protocol observers: after every batch
+        the engine calls `record_batch(batch_size)` (when present —
+        PerformanceListener's hook) then `iteration_done(engine,
+        batch_index, batch_latency_s)`."""
+        self._listeners = list(listeners)
+
+    # ------------------------------------------------------------------
+    # batching
+    # ------------------------------------------------------------------
+    def _form_batch(self) -> List[RequestHandle]:
+        """Pop the head request plus every queued request with the SAME
+        prompt length, up to max_batch_size (no pad masking in the
+        model, so mixed lengths cannot share a batch)."""
+        with self._lock:
+            if not self._queue:
+                return []
+            head = self._queue.popleft()
+            t0 = head.prompt.shape[0]
+            batch = [head]
+            rest = deque()
+            while self._queue and len(batch) < self.config.max_batch_size:
+                r = self._queue.popleft()
+                if r.prompt.shape[0] == t0:
+                    batch.append(r)
+                else:
+                    rest.append(r)
+            rest.extend(self._queue)
+            self._queue = rest
+            self.stats["in_flight"] += len(batch)
+        for r in batch:
+            r.status = RequestStatus.RUNNING
+        return batch
+
+    def _process_batch(self, batch: List[RequestHandle]) -> None:
+        t_start = self._clock()
+        params = self._params    # batch runs on the weights at start
+        try:
+            self._decode_loop(batch, params)
+        finally:
+            with self._lock:
+                self.stats["in_flight"] -= len(batch)
+                self.stats["batches"] += 1
+                idx = self.stats["batches"]
+            latency = self._clock() - t_start
+            for l in self._listeners:
+                if hasattr(l, "record_batch"):
+                    l.record_batch(len(batch))
+                try:
+                    l.iteration_done(self, idx, latency)
+                except Exception:     # listeners must not kill serving
+                    log.exception("engine listener failed")
+
+    def _decode_loop(self, batch: List[RequestHandle], params) -> None:
+        self._shed_expired(batch)
+        while True:
+            active = [r for r in batch
+                      if r.status == RequestStatus.RUNNING]
+            if not active:
+                return
+            done = active[0].generated.shape[0]
+            remaining = max(r.max_new_tokens - done for r in active)
+            if remaining <= 0:
+                for r in active:
+                    self._complete(r)
+                return
+            n = remaining if self.config.decode_chunk <= 0 \
+                else min(self.config.decode_chunk, remaining)
+            prompts = np.stack(
+                [np.concatenate([r.prompt, r.generated])
+                 for r in active]).astype(np.int32)
+            try:
+                toks = self._invoke(params, prompts, n,
+                                    [r.rid for r in active])
+            except _BatchDecodeFailed as e:
+                self._isolate(active, params, e)
+                return
+            for i, r in enumerate(active):
+                need = min(n, r.max_new_tokens - done)
+                r._generated.append(toks[i, :need])
+                if r.generated.shape[0] >= r.max_new_tokens:
+                    self._complete(r)
+            self._shed_expired(batch)
+
+    def _shed_expired(self, batch: Sequence[RequestHandle]) -> None:
+        now = self._clock()
+        for r in batch:
+            if (r.status in (RequestStatus.RUNNING, RequestStatus.QUEUED)
+                    and r.deadline_at is not None
+                    and now > r.deadline_at):
+                r.deadline_exceeded = True
+                if r.on_deadline == "partial":
+                    # return what we have; the rest of the batch moves on
+                    self._complete(r)
+                else:
+                    with self._lock:
+                        self.stats["shed_deadline"] += 1
+                    r._finish(RequestStatus.SHED, DeadlineExceeded(
+                        f"request {r.rid} past deadline with "
+                        f"{r.generated.shape[0]}/{r.max_new_tokens} "
+                        "tokens decoded"))
+
+    def _complete(self, r: RequestHandle) -> None:
+        with self._lock:
+            self.stats["completed"] += 1
+        r._finish(RequestStatus.COMPLETED)
+
+    # ------------------------------------------------------------------
+    # the guarded decode step
+    # ------------------------------------------------------------------
+    def _invoke(self, params, prompts: np.ndarray, n: int,
+                rids: List[int]) -> np.ndarray:
+        """One compiled decode call (batch padded to a 'data' multiple),
+        retried with exponential backoff. Returns [B_real, n] new
+        tokens. Raises _BatchDecodeFailed after max_retries."""
+        import jax
+        import jax.numpy as jnp
+
+        b = prompts.shape[0]
+        b_pad = -(-b // self._dp) * self._dp
+        if b_pad != b:
+            prompts = np.concatenate(
+                [prompts, np.repeat(prompts[:1], b_pad - b, axis=0)])
+        # key depends only on the decoded-position offset, so a retry —
+        # and a solo continuation — reproduces the same tokens
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.seed), prompts.shape[1])
+        fn = _compiled_generate(astuple(self.cfg), self.mesh, int(n),
+                                float(self.config.temperature),
+                                int(self.config.top_k),
+                                float(self.config.top_p))
+        attempt = 0
+        while True:
+            try:
+                if self._injector is not None:
+                    self._injector.on_decode_step(self._step_counter,
+                                                  rids)
+                out = np.asarray(fn(params, jnp.asarray(prompts), key))
+                self._record_success()
+                self._step_counter += 1
+                return out[:b, prompts.shape[1]:]
+            except RuntimeError as e:       # XlaRuntimeError, injected
+                self._record_failure(e)
+                attempt += 1
+                if attempt > self.config.max_retries:
+                    raise _BatchDecodeFailed(str(e)) from e
+                with self._lock:
+                    self.stats["retries"] += 1
+                delay = min(self.config.backoff_base_s
+                            * (2 ** (attempt - 1)),
+                            self.config.backoff_max_s)
+                log.warning(
+                    "decode step %d failed (%s); retry %d/%d in %.3fs",
+                    self._step_counter, e, attempt,
+                    self.config.max_retries, delay)
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _isolate(self, active: List[RequestHandle], params,
+                 batch_err: _BatchDecodeFailed) -> None:
+        """Batch-level retries exhausted: re-run each request solo so a
+        single poisoned request cannot starve its co-batched peers.
+        Solo survivors complete; solo failures are quarantined."""
+        log.warning("batch of %d exhausted retries (%s); isolating",
+                    len(active), batch_err)
+        for r in active:
+            if r.status != RequestStatus.RUNNING:
+                continue
+            try:
+                self._decode_solo(r, params)
+            except _BatchDecodeFailed as e:
+                with self._lock:
+                    self.stats["quarantined"] += 1
+                log.error("request %d quarantined after solo retries "
+                          "(%s)", r.rid, e)
+                r._finish(RequestStatus.QUARANTINED, RequestQuarantined(
+                    f"request {r.rid} failed persistently: {e}"))
+
+    def _decode_solo(self, r: RequestHandle, params) -> None:
+        while r.status == RequestStatus.RUNNING:
+            self._shed_expired([r])
+            if r.status != RequestStatus.RUNNING:
+                return
+            done = r.generated.shape[0]
+            if done >= r.max_new_tokens:
+                self._complete(r)
+                return
+            n = r.max_new_tokens - done
+            if self.config.decode_chunk > 0:
+                n = min(self.config.decode_chunk, n)
+            prompts = np.concatenate([r.prompt, r.generated])[None]
+            toks = self._invoke(params, prompts.astype(np.int32), n,
+                                [r.rid])
+            r._generated.append(toks[0])
+
+    # ------------------------------------------------------------------
+    # circuit breaker / degradation
+    # ------------------------------------------------------------------
+    def _record_failure(self, err: BaseException) -> None:
+        with self._lock:
+            self.stats["step_failures"] += 1
+            self._consec_failures += 1
+            if (self._breaker != "open" and self._consec_failures
+                    >= self.config.breaker_failure_threshold):
+                self._breaker = "open"
+                self._opened_at = self._clock()
+                log.error("circuit breaker OPEN after %d consecutive "
+                          "step failures (last: %s)",
+                          self._consec_failures, err)
+
+    def _record_success(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+            # any completed decode step proves the path healthy — close
+            # from half-open (the probe) AND from open (e.g. the failure
+            # streak came from one poisoned request whose co-batched
+            # peers then completed solo; automatic recovery, no cooldown
+            # wait needed)
+            if self._breaker != "closed":
+                log.info("circuit breaker closed (was %s: decode step "
+                         "succeeded)", self._breaker)
+                self._breaker = "closed"
+
+    def _tick_breaker(self, now: float) -> None:
+        if (self._breaker == "open"
+                and now - self._opened_at
+                >= self.config.breaker_cooldown_s):
+            self._breaker = "half-open"
+            log.info("circuit breaker half-open (cooldown elapsed)")
+
+    def _degraded_locked(self) -> bool:
+        return (len(self._queue) >= self.config.degrade_queue_depth
+                or self._breaker != "closed")
+
+    # ------------------------------------------------------------------
+    # health / readiness / weights
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        with self._lock:
+            self._tick_breaker(self._clock())
+            return {"ready": self.ready(),
+                    "breaker": self._breaker,
+                    "degraded": self._degraded_locked(),
+                    "queue_depth": len(self._queue),
+                    "weights_step": self._weights_step,
+                    **dict(self.stats)}
+
+    def ready(self) -> bool:
+        with self._lock:
+            self._tick_breaker(self._clock())
+            return self._accepting and self._breaker != "open"
+
+    def reload_weights(self, source, step: Optional[int] = None) -> int:
+        """Hot-swap serving weights from a CheckpointManager (or a
+        checkpoint directory path) WITHOUT draining: in-flight batches
+        finish on their snapshot, subsequent batches use the new tree.
+        The live sharded params are the restore template, so arrays
+        come back placed on this engine's mesh. A corrupt/partial
+        newest step falls back to the previous good one. Returns the
+        step loaded."""
+        if isinstance(source, CheckpointManager):
+            mgr = source
+        else:
+            # sniff the on-disk format: a step_<N>/arrays.npz layout was
+            # written by the npz fallback and is unreadable through an
+            # orbax-backed manager (whose constructor scans step dirs)
+            from pathlib import Path
+            is_npz = any(Path(str(source)).glob("step_*/arrays.npz"))
+            mgr = CheckpointManager(str(source),
+                                    use_orbax=False if is_npz else None)
+        steps = ([int(step)] if step is not None
+                 else list(reversed(mgr.all_steps())))
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint steps under {mgr.directory}")
+        last_err: Optional[BaseException] = None
+        for s in steps:
+            try:
+                tree = mgr.restore_tree(self._params, step=s)
+            except Exception as e:           # corrupt / partial step dir
+                last_err = e
+                log.warning("weight reload: step %s unreadable (%s); "
+                            "falling back", s, e)
+                continue
+            if tree is None:
+                continue
+            with self._lock:
+                self._params = tree
+                self._weights_step = int(s)
+                self.stats["reloads"] += 1
+            log.info("weights hot-reloaded from step %d", int(s))
+            return int(s)
+        raise RuntimeError(
+            f"no readable checkpoint step under {mgr.directory}"
+        ) from last_err

@@ -154,6 +154,33 @@ class NanScoreGuardListener(IterationListener):
             log.warning(msg)
 
 
+class EngineHealthListener(IterationListener):
+    """Serving-side health telemetry riding the standard listener
+    protocol: `serving.InferenceEngine.set_listeners()` calls
+    `iteration_done(engine, batch_index, batch_latency_s)` after every
+    batch, so the whole training listener suite (PerformanceListener
+    gets batches/sec via `record_batch`, CollectScores collects
+    latencies) works on the serving path unchanged. This listener
+    additionally snapshots `engine.health()` — breaker state, queue
+    depth, shed/quarantine counters, weights version — into a bounded
+    ring so an operator (or test) can audit degradation windows."""
+
+    def __init__(self, frequency: int = 1, capacity: int = 256):
+        self.frequency = max(1, frequency)
+        self.capacity = max(1, capacity)
+        self.snapshots: List[dict] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency != 0:
+            return
+        snap = {"iteration": int(iteration),
+                "latency_s": float(score)}
+        if hasattr(model, "health"):
+            snap.update(model.health())
+        self.snapshots.append(snap)
+        del self.snapshots[:-self.capacity]
+
+
 class ParamAndGradientIterationListener(IterationListener):
     """Per-iteration parameter and update statistics, tab-delimited to a
     file and/or the log (reference: optimize/listeners/

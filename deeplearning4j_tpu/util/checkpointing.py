@@ -148,15 +148,41 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def _resolve_readable(self, template: Dict,
+                          step: Optional[int]):
+        """Read the requested step, or — when ``step`` is None — the
+        NEWEST readable one: a corrupt/partial `step_<N>` directory
+        (killed mid-write, torn copy) logs a warning and falls back to
+        the previous good step instead of failing restore outright. An
+        explicitly requested step still fails hard. Returns
+        (payload, step) or (None, None) when no checkpoint exists."""
+        steps = ([int(step)] if step is not None
+                 else list(reversed(self.all_steps())))
+        last_err: Optional[BaseException] = None
+        for s in steps:
+            try:
+                return self._read_payload(template, s), s
+            except Exception as e:
+                if step is not None:
+                    raise
+                last_err = e
+                log.warning("checkpoint step_%d unreadable (%s); "
+                            "falling back to previous step", s, e)
+        if last_err is not None:
+            raise RuntimeError(
+                f"no readable checkpoint under {self.directory}"
+            ) from last_err
+        return None, None
+
     def restore(self, net, step: Optional[int] = None):
         """Restore in place; returns the step restored from (None if no
-        checkpoint exists)."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return None
+        checkpoint exists). With step=None a corrupt newest step falls
+        back to the previous good one (_resolve_readable)."""
         template = {"params": net.params, "state": net.state,
                     "updater_state": net.updater_state}
-        restored = self._read_payload(template, step)
+        restored, step = self._resolve_readable(template, step)
+        if restored is None:
+            return None
         net.params = restored["params"]
         net.state = restored["state"]
         # Cast to the freshly-initialized skeleton's dtypes: updater state
@@ -191,10 +217,10 @@ class CheckpointManager:
         sharded template re-places each leaf into its shards (orbax), so
         a job can resume on a different mesh layout by passing the new
         mesh's template. Returns None if no checkpoint exists."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        payload, step = self._resolve_readable({"tree": template}, step)
+        if payload is None:
             return None
-        out = self._read_payload({"tree": template}, step)["tree"]
+        out = payload["tree"]
         if not self.use_orbax:
             # npz fallback loads host arrays; re-place onto the
             # template's shardings. Abstract templates (jax.eval_shape

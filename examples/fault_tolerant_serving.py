@@ -1,0 +1,114 @@
+"""Fault-tolerant serving of the sharded LM — the engine demo.
+
+Runs the full ISSUE-1 story on the CPU backend with deterministic
+fault injection: an `InferenceEngine` over a (data x model) mesh
+survives a transient mid-decode failure (retry → byte-identical),
+quarantines a poisoned request without hurting its batch peers, sheds
+a deadline-blown request while the batch completes, trips + recovers
+its circuit breaker, and hot-reloads weights from a checkpoint
+directory — printing health() along the way.
+
+On a TPU slice this uses all chips; elsewhere:
+  JAX_PLATFORMS=cpu python examples/fault_tolerant_serving.py
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    n_dev = 4
+    # bootstrap BEFORE the first backend touch: on jax<0.6 a live CPU
+    # client cannot be resized (no jax_num_cpu_devices), so querying
+    # jax.devices() first would lock in a 1-device mesh
+    if not _xb.backends_are_initialized():
+        from __graft_entry__ import _force_virtual_cpu_mesh
+        try:
+            _force_virtual_cpu_mesh(n_dev)
+        except Exception:
+            pass              # fall through to whatever mesh exists
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving import (DeadlineExceeded,
+                                            EngineConfig,
+                                            InferenceEngine,
+                                            OverloadError,
+                                            RequestQuarantined)
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+    cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=4,
+                            n_layers=2, max_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    if len(jax.devices()) >= n_dev:
+        mesh = make_mesh(MeshSpec(data=2, model=2))
+    else:                     # unresizable 1-device client (old jax)
+        mesh = make_mesh(MeshSpec(data=1, model=1))
+    prompt = np.arange(16, dtype=np.int32)
+
+    inj = ServingFaultInjector(fail_at=[1])      # one transient fault
+    eng = InferenceEngine(
+        cfg, mesh, params,
+        EngineConfig(decode_chunk=4, max_new_tokens=16,
+                     backoff_base_s=0.001, breaker_failure_threshold=3,
+                     breaker_cooldown_s=0.2),
+        fault_injector=inj)
+
+    # 1. transient fault: retried, completes
+    h = eng.submit(prompt)
+    eng.run_pending()
+    print(f"[transient] completed after {eng.stats['retries']} retry; "
+          f"tokens={h.result().shape[0]}")
+
+    # 2. poisoned request quarantined; co-batched peer completes
+    bad = eng.submit(prompt)
+    good = eng.submit(prompt)
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+    try:
+        bad.result()
+    except RequestQuarantined as e:
+        print(f"[quarantine] {e}")
+    print(f"[quarantine] peer status={good.status}")
+
+    # 3. deadline shed mid-decode (injected host stall)
+    inj.delay_at[eng._step_counter + 1] = 0.1
+    doomed = eng.submit(prompt, deadline_s=0.05)
+    peer = eng.submit(prompt)
+    eng.run_pending()
+    try:
+        doomed.result()
+    except DeadlineExceeded as e:
+        print(f"[deadline] {e}")
+    print(f"[deadline] peer decoded {peer.result().shape[0] - 16} "
+          "tokens")
+
+    # 4. load shedding + breaker
+    try:
+        for _ in range(200):
+            eng.submit(prompt)
+    except OverloadError as e:
+        print(f"[overload] {e}")
+    eng.run_pending()
+    print(f"[health] {eng.health()}")
+
+    # 5. hot weight reload from a checkpoint directory
+    ckpt = tempfile.mkdtemp(prefix="serving_ckpt_")
+    mgr = CheckpointManager(ckpt, use_orbax=False)
+    mgr.save_tree(params, step=7)
+    step = eng.reload_weights(mgr)
+    print(f"[reload] weights hot-reloaded from step {step}; "
+          f"ready={eng.ready()}")
+
+
+if __name__ == "__main__":
+    main()

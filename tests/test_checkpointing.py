@@ -108,6 +108,73 @@ def test_fault_tolerant_trainer_gives_up(tmp_path):
         trainer.fit(it)
 
 
+def test_restore_falls_back_past_corrupt_latest_step(tmp_path):
+    """A torn/partial newest step_<N> (process killed mid-write) must
+    not lose the training run: restore(step=None) falls back to the
+    previous good step instead of raising."""
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    good = np.asarray(net.params_flat())
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+    net.fit(x, y)
+    mgr.save(net, step=2)
+    # corrupt the newest step two ways across two sub-cases: missing
+    # arrays file (torn copy) after verifying partial npz also fails
+    (mgr.directory / "step_2" / "arrays.npz").unlink()
+
+    net2 = _net(seed=9)
+    assert mgr.restore(net2) == 1
+    np.testing.assert_allclose(np.asarray(net2.params_flat()), good,
+                               atol=1e-7)
+    # retention bookkeeping still sees both dirs; the corrupt one is
+    # only skipped at read time
+    assert mgr.all_steps() == [1, 2]
+
+
+def test_restore_tree_falls_back_past_partial_npz(tmp_path):
+    """Partial write variant: step dir + arrays.npz exist but the
+    payload is truncated garbage — restore_tree falls back."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    tree = {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+    mgr.save_tree(tree, 1)
+    mgr.save_tree({"w": jnp.zeros(4), "b": jnp.zeros(2)}, 2)
+    (mgr.directory / "step_2" / "arrays.npz").write_bytes(b"not-a-zip")
+
+    out = mgr.restore_tree(tree)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.arange(4.0))
+
+
+def test_restore_explicit_corrupt_step_raises(tmp_path):
+    """An EXPLICITLY requested step never silently falls back — the
+    caller asked for that step's bytes."""
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+    mgr.save(net, step=2)
+    (mgr.directory / "step_2" / "arrays.npz").unlink()
+    with pytest.raises(Exception):
+        mgr.restore(net, step=2)
+    assert mgr.restore(net, step=1) == 1
+
+
+def test_restore_all_steps_corrupt_raises(tmp_path):
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+    (mgr.directory / "step_1" / "arrays.npz").unlink()
+    with pytest.raises(RuntimeError, match="no readable checkpoint"):
+        mgr.restore(net)
+
+
 def test_restore_casts_legacy_bf16_updater_state(tmp_path):
     """Checkpoints written before the >=f32 updater-state policy hold bf16
     moments; restore must cast to the skeleton dtype or the fit_batched

@@ -1,0 +1,435 @@
+"""Fault-tolerant serving engine: deterministic fault-injection suite.
+
+Every ISSUE-1 acceptance behavior, proven on the CPU backend with
+`ServingFaultInjector` (no real overload, no real device faults):
+transient retry == byte-identical completion; persistent per-request
+faults quarantined without poisoning co-batched peers; deadline-
+exceeded requests shed (or returned partial) while the batch
+completes; the circuit breaker opens under injected failure and closes
+after recovery; bounded-queue load shedding; degraded admission;
+hot weight reload with corrupt-step fallback.
+"""
+import logging
+import time
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   generate, init_params)
+from deeplearning4j_tpu.parallel.failure import (ServingFaultInjector,
+                                                 TrainingFailure)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (DeadlineExceeded, EngineConfig,
+                                        InferenceEngine, OverloadError,
+                                        RequestQuarantined, RequestStatus)
+from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=6, backoff_base_s=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# correctness of the happy path
+# ---------------------------------------------------------------------------
+
+def test_single_shot_matches_direct_generate(params, mesh1):
+    """decode_chunk=0 (the benchmark mode) is the same compiled call as
+    bare make_parallel_generate — token-for-token."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(decode_chunk=0))
+    h = eng.submit(_prompt())
+    assert eng.run_pending() == 1
+    got = h.result(0)
+    want = np.asarray(generate(CFG, params, _prompt()[None], 6,
+                               key=jax.random.PRNGKey(0),
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(got, want)
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_batcher_groups_by_prompt_length(params, mesh1):
+    """Mixed prompt lengths cannot share a batch (no pad masking);
+    the batcher buckets them and everything still completes."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    hs = [eng.submit(_prompt(8, i)) for i in range(3)]
+    hs += [eng.submit(_prompt(12, i)) for i in range(2)]
+    assert eng.run_pending() == 2          # one batch per length bucket
+    for h in hs:
+        assert h.result(0).shape[0] == h.prompt.shape[0] + 6
+
+
+def test_batch_padding_on_data_axis(params, devices8):
+    """3 requests on a data=2 mesh: the batch dim pads to a 'data'
+    multiple with throwaway rows; results match the solo runs."""
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    eng = InferenceEngine(CFG, mesh, params, _config())
+    hs = [eng.submit(_prompt(8, i)) for i in range(3)]
+    eng.run_pending()
+    solo = InferenceEngine(CFG, mesh, params, _config())
+    for i, h in enumerate(hs):
+        s = solo.submit(_prompt(8, i))
+        solo.run_pending()
+        np.testing.assert_array_equal(h.result(0), s.result(0))
+
+
+def test_submit_validation(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    with pytest.raises(ValueError, match="on_deadline"):
+        eng.submit(_prompt(), on_deadline="explode")
+    with pytest.raises(ValueError, match="1-D"):
+        eng.submit(np.zeros((2, 4), np.int32))
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.zeros(CFG.max_len - 1, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: transient fault -> retried -> byte-identical
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retried_byte_identical(params, mesh1):
+    """A mid-decode transient failure (2nd chunk) is retried with
+    backoff and the request completes byte-identical to the no-fault
+    run. This is the tier-1 robustness smoke test (not slow)."""
+    ref = InferenceEngine(CFG, mesh1, params, _config())
+    h_ref = ref.submit(_prompt())
+    ref.run_pending()
+
+    inj = ServingFaultInjector(fail_at=[1])      # fail decode step 1
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+
+    np.testing.assert_array_equal(h.result(0), h_ref.result(0))
+    assert inj.injected == 1
+    assert eng.stats["retries"] == 1
+    assert eng.stats["step_failures"] == 1
+    assert eng.health()["breaker"] == "closed"
+
+
+def test_transient_fault_multi_request_batch(params, mesh1):
+    """Whole-batch retry: both co-batched requests complete identically
+    to the fault-free batch after a transient step failure."""
+    ref = InferenceEngine(CFG, mesh1, params, _config())
+    r1, r2 = ref.submit(_prompt(8, 1)), ref.submit(_prompt(8, 2))
+    ref.run_pending()
+
+    inj = ServingFaultInjector(fail_at=[0, 2])   # two transient faults
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h1, h2 = eng.submit(_prompt(8, 1)), eng.submit(_prompt(8, 2))
+    eng.run_pending()
+    np.testing.assert_array_equal(h1.result(0), r1.result(0))
+    np.testing.assert_array_equal(h2.result(0), r2.result(0))
+    assert inj.injected == 2 and eng.stats["retries"] == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: persistent per-request fault -> quarantine, peers unharmed
+# ---------------------------------------------------------------------------
+
+def test_poisoned_request_quarantined_peers_complete(params, mesh1):
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_retries=2), fault_injector=inj)
+    bad = eng.submit(_prompt(8, 1))
+    good = eng.submit(_prompt(8, 2))
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+
+    assert bad.status == RequestStatus.QUARANTINED
+    with pytest.raises(RequestQuarantined):
+        bad.result(0)
+    # the co-batched peer completed with the same tokens a clean
+    # solo run produces (isolation re-ran it alone)
+    ref = InferenceEngine(CFG, mesh1, params, _config())
+    r = ref.submit(_prompt(8, 2))
+    ref.run_pending()
+    np.testing.assert_array_equal(good.result(0), r.result(0))
+    assert eng.stats["quarantined"] == 1
+    # engine still serves after the quarantine
+    h = eng.submit(_prompt(8, 3))
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_quarantine_only_after_max_retries(params, mesh1):
+    """The engine never quarantines early: a poisoned batch is retried
+    max_retries times at batch level, then max_retries more solo,
+    before the request is declared persistent."""
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_retries=2), fault_injector=inj)
+    bad = eng.submit(_prompt())
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+    assert bad.status == RequestStatus.QUARANTINED
+    # 1 initial + 2 batch retries, then 1 solo + 2 solo retries
+    assert inj.injected == 6
+    assert eng.stats["retries"] == 4
+
+
+# ---------------------------------------------------------------------------
+# acceptance: deadline scheduling
+# ---------------------------------------------------------------------------
+
+def test_deadline_shed_while_batch_completes(params, mesh1):
+    """An injected host-side delay pushes one request past its
+    deadline mid-decode: it is shed with a typed error, the co-batched
+    peer still completes its full budget."""
+    inj = ServingFaultInjector(delay_at={1: 0.08})
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    doomed = eng.submit(_prompt(8, 1), deadline_s=0.04)
+    peer = eng.submit(_prompt(8, 2))
+    eng.run_pending()
+
+    assert doomed.status == RequestStatus.SHED
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(0)
+    assert peer.result(0).shape[0] == 8 + 6
+    assert eng.stats["shed_deadline"] == 1
+    assert inj.delays_injected == 1
+
+
+def test_deadline_partial_returns_decoded_prefix(params, mesh1):
+    """on_deadline='partial': the caller opts into the tokens decoded
+    so far instead of a shed — and the prefix equals the full run's."""
+    ref = InferenceEngine(CFG, mesh1, params, _config())
+    h_ref = ref.submit(_prompt())
+    ref.run_pending()
+
+    inj = ServingFaultInjector(delay_at={1: 0.08})
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h = eng.submit(_prompt(), deadline_s=0.04, on_deadline="partial")
+    eng.run_pending()
+    out = h.result(0)
+    assert h.status == RequestStatus.COMPLETED
+    assert h.deadline_exceeded
+    assert 0 < h.generated.shape[0] < h.max_new_tokens
+    np.testing.assert_array_equal(out,
+                                  h_ref.result(0)[:out.shape[0]])
+
+
+def test_expired_before_launch_is_shed_cheaply(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          clock=time.monotonic)
+    h = eng.submit(_prompt(), deadline_s=-1.0)   # already past
+    eng.run_pending()
+    assert h.status == RequestStatus.SHED
+    assert h.generated.shape[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: circuit breaker + load shedding
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_opens_and_recovers(params, mesh1):
+    inj = ServingFaultInjector(fail_at=range(100), persistent=True)
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(max_retries=1, breaker_failure_threshold=3,
+                breaker_cooldown_s=0.05),
+        fault_injector=inj)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    # systemic persistent fault: batch + solo retries all fail
+    assert h.status == RequestStatus.QUARANTINED
+    assert eng.health()["breaker"] == "open"
+    assert not eng.ready()
+    with pytest.raises(OverloadError, match="circuit breaker"):
+        eng.submit(_prompt())
+
+    time.sleep(0.06)                 # cooldown elapses
+    inj.fail_at.clear()              # the fault condition recovers
+    probe = eng.submit(_prompt())    # half-open probe admission
+    assert eng.health()["breaker"] == "half-open"
+    eng.run_pending()
+    assert probe.status == RequestStatus.COMPLETED
+    assert eng.health()["breaker"] == "closed"
+    assert eng.ready()
+
+
+def test_queue_full_sheds_with_typed_error(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_queue=2))
+    eng.submit(_prompt())
+    eng.submit(_prompt())
+    with pytest.raises(OverloadError, match="queue full"):
+        eng.submit(_prompt())
+    assert eng.stats["shed_overload"] == 1
+    eng.run_pending()                # drains; admissions resume
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_degraded_mode_caps_token_budget(params, mesh1):
+    """Past the soft watermark the engine degrades gracefully: new
+    admissions get the degraded token cap instead of a rejection."""
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(degrade_queue_depth=2, degraded_max_new_tokens=2,
+                max_queue=16))
+    a = eng.submit(_prompt(8, 1))
+    b = eng.submit(_prompt(8, 2))
+    assert eng.health()["degraded"]
+    c = eng.submit(_prompt(8, 3))          # admitted degraded
+    assert c.max_new_tokens == 2
+    assert a.max_new_tokens == 6
+    eng.run_pending()
+    assert c.result(0).shape[0] == 8 + 2
+    assert b.result(0).shape[0] == 8 + 6
+    assert not eng.health()["degraded"]
+
+
+# ---------------------------------------------------------------------------
+# health, listeners, background worker
+# ---------------------------------------------------------------------------
+
+def test_health_reports_counters(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    health = eng.health()
+    assert health["ready"] and health["breaker"] == "closed"
+    assert health["completed"] == 1 and health["batches"] == 1
+    assert health["queue_depth"] == 0 and health["in_flight"] == 0
+    assert h.done()
+
+
+def test_engine_drives_train_listener_stream(params, mesh1):
+    from deeplearning4j_tpu.train.listeners import (
+        CollectScoresIterationListener, EngineHealthListener,
+        PerformanceListener)
+    perf = PerformanceListener(frequency=1, report=False)
+    coll = CollectScoresIterationListener()
+    healthl = EngineHealthListener()
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    eng.set_listeners(perf, coll, healthl)
+    for i in range(3):
+        eng.submit(_prompt(8, i))
+        eng.run_pending()
+    assert len(coll.scores) == 3               # one latency per batch
+    assert len(healthl.snapshots) == 3
+    assert healthl.snapshots[-1]["completed"] == 3
+    assert healthl.snapshots[-1]["breaker"] == "closed"
+
+
+def test_background_worker_thread(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(batch_timeout_s=0.002)).start()
+    try:
+        hs = [eng.submit(_prompt(8, i)) for i in range(4)]
+        outs = [h.result(timeout=60) for h in hs]
+        assert all(o.shape[0] == 8 + 6 for o in outs)
+    finally:
+        eng.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit(_prompt())
+
+
+# ---------------------------------------------------------------------------
+# hot weight reload
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_swaps_weights_without_drain(tmp_path, params, mesh1):
+    """Reload mid-stream: queued work keeps flowing, the weights step
+    is reported, and new batches use the new tree (zeroed weights
+    change the output; the original tree restores it)."""
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    zeroed = jax.tree_util.tree_map(lambda a: a * 0, params)
+    mgr.save_tree(zeroed, 2)
+
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    before = eng.submit(_prompt())
+    eng.run_pending()
+    assert eng.reload_weights(mgr, step=2) == 2
+    after = eng.submit(_prompt())
+    eng.run_pending()
+    assert eng.health()["weights_step"] == 2
+    assert not np.array_equal(before.result(0), after.result(0))
+
+    assert eng.reload_weights(mgr, step=1) == 1
+    again = eng.submit(_prompt())
+    eng.run_pending()
+    np.testing.assert_array_equal(before.result(0), again.result(0))
+    assert eng.stats["reloads"] == 2
+
+
+def test_hot_reload_falls_back_past_corrupt_step(tmp_path, params,
+                                                 mesh1):
+    """A torn/partial newest step_<N> (killed mid-write) must not take
+    serving down: reload falls back to the previous good step."""
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    mgr.save_tree(params, 2)
+    (mgr.directory / "step_2" / "arrays.npz").unlink()   # torn write
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    assert eng.reload_weights(mgr) == 1
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_hot_reload_empty_dir_raises(tmp_path, params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    with pytest.raises(FileNotFoundError):
+        eng.reload_weights(str(tmp_path / "none"))
+
+
+# ---------------------------------------------------------------------------
+# ServingFaultInjector unit behavior
+# ---------------------------------------------------------------------------
+
+def test_injector_delay_is_one_shot():
+    inj = ServingFaultInjector(delay_at={0: 0.02})
+    t0 = time.perf_counter()
+    inj.on_decode_step(0)
+    assert time.perf_counter() - t0 >= 0.02
+    t0 = time.perf_counter()
+    inj.on_decode_step(0)                       # consumed
+    assert time.perf_counter() - t0 < 0.02
+    assert inj.delays_injected == 1
+
+
+def test_injector_transient_vs_persistent_steps():
+    t = ServingFaultInjector(fail_at=[2])
+    with pytest.raises(TrainingFailure):
+        t.on_decode_step(2)
+    t.on_decode_step(2)                         # transient: gone
+    p = ServingFaultInjector(fail_at=[2], persistent=True)
+    for _ in range(3):
+        with pytest.raises(TrainingFailure):
+            p.on_decode_step(2)
+
+
+def test_injector_poison_matches_request_ids():
+    inj = ServingFaultInjector(poison_requests=[7])
+    inj.on_decode_step(0, request_ids=[1, 2])   # clean batch passes
+    with pytest.raises(TrainingFailure, match="poisoned"):
+        inj.on_decode_step(1, request_ids=[2, 7])
