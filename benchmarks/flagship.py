@@ -368,6 +368,74 @@ def bench_engine_decode(reps: int = 2, *, batch: int = 64,
                                          / direct, 2)}
 
 
+def bench_engine_decode_metrics(reps: int = 2, *, batch: int = 64,
+                                prompt_len: int = 64,
+                                new_tokens: int = 64,
+                                d_model: int = 512,
+                                n_layers: int = 12) -> dict:
+    """Instrumented vs bare engine decode at the engine_decode
+    geometry (ISSUE-2 acceptance: observability overhead <= 1%). Both
+    arms run the SAME engine code and the SAME compiled program; the
+    only difference is the injected registry — a live MetricsRegistry
+    (counters, gauges, per-step latency histograms) vs NULL_REGISTRY
+    (every instrument a no-op) — so the delta IS the metrics
+    substrate. Both arms forced-host-read fenced via result()."""
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.observability import (MetricsRegistry,
+                                                  NULL_REGISTRY)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=d_model, n_heads=8,
+                            n_layers=n_layers, max_len=2048,
+                            dtype="bfloat16")
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts = np.zeros((batch, prompt_len), np.int32)
+    econf = EngineConfig(max_batch_size=batch, max_queue=2 * batch,
+                         max_new_tokens=new_tokens, decode_chunk=0)
+
+    def one_round(eng):
+        hs = [eng.submit(prompts[i]) for i in range(batch)]
+        eng.run_pending()
+        return hs[-1].result(0)
+
+    bare_eng = InferenceEngine(cfg, mesh, params, econf,
+                               registry=NULL_REGISTRY)
+    reg = MetricsRegistry()
+    inst_eng = InferenceEngine(cfg, mesh, params, econf, registry=reg)
+    one_round(bare_eng)                                # warm (shared
+    one_round(inst_eng)                                # jit cache)
+    # INTERLEAVED best-of: the per-round instrumentation cost is tens
+    # of microseconds against 10^2..10^3 ms of decode, far below the
+    # machine's slow drift (thermal, co-tenants) — alternating rounds
+    # cancels that drift out of the A-B delta instead of folding it in
+    bare = inst = float("inf")
+    for _ in range(reps):
+        t0 = _t.perf_counter()
+        one_round(bare_eng)
+        bare = min(bare, _t.perf_counter() - t0)
+        t0 = _t.perf_counter()
+        one_round(inst_eng)
+        inst = min(inst, _t.perf_counter() - t0)
+    # sanity: the instrumented arm really recorded its decode steps
+    assert reg.get("serving_decode_step_seconds") is not None
+
+    return {"config":
+            f"engine_decode_metrics_{n_layers}L{d_model}d_B{batch}",
+            "value": round(batch * new_tokens / inst),
+            "unit": "tokens/sec/chip",
+            "bare_tokens_per_sec": round(batch * new_tokens / bare),
+            "metrics_overhead_pct": round(100 * (inst - bare) / bare,
+                                          2)}
+
+
 def bench_word2vec(reps: int = 2) -> dict:
     """Word2Vec skip-gram+neg at the reference-workload-class vocab
     (v=100k) — the driver-captured row VERDICT r5 weak #2 demanded
@@ -389,6 +457,7 @@ BENCHES = {"transformer": bench_transformer,
            "vgg16": bench_vgg16, "lstm": bench_lstm,
            "decode": bench_decode, "decode_long": bench_decode_long,
            "engine_decode": bench_engine_decode,
+           "engine_decode_metrics": bench_engine_decode_metrics,
            "word2vec": bench_word2vec}
 
 

@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator, List, Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.observability.metrics import default_registry
 
 
 @dataclass
@@ -130,51 +133,93 @@ class ExistingDataSetIterator:
 class AsyncDataSetIterator:
     """Background-thread prefetch (reference:
     datasets/iterator/AsyncDataSetIterator.java — used automatically by
-    MultiLayerNetwork.fit at MultiLayerNetwork.java:951)."""
+    MultiLayerNetwork.fit at MultiLayerNetwork.java:951).
+
+    Observability: publishes `prefetch_queue_depth`,
+    `prefetch_consumer_wait_last_seconds` (how long the training loop
+    just blocked on the queue — the "is the input pipeline the
+    bottleneck?" signal) and `prefetch_producer_stall_last_seconds`
+    gauges, plus cumulative wait/stall-seconds and batch counters, to
+    the process default registry (injectable via `registry`)."""
 
     _SENTINEL = object()
 
-    def __init__(self, base, queue_size: int = 2):
+    def __init__(self, base, queue_size: int = 2, registry=None):
         self.base = base
         self.queue_size = queue_size
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        reg = registry if registry is not None else default_registry()
+        self._m_depth = reg.gauge(
+            "prefetch_queue_depth", "Prefetched batches waiting")
+        self._m_wait_last = reg.gauge(
+            "prefetch_consumer_wait_last_seconds",
+            "Consumer's most recent block on the prefetch queue")
+        self._m_stall_last = reg.gauge(
+            "prefetch_producer_stall_last_seconds",
+            "Producer's most recent block on a full queue")
+        self._m_wait = reg.counter(
+            "prefetch_consumer_wait_seconds",
+            "Total consumer time blocked on the prefetch queue")
+        self._m_stall = reg.counter(
+            "prefetch_producer_stall_seconds",
+            "Total producer time blocked on a full queue")
+        self._m_batches = reg.counter(
+            "prefetch_batches", "Batches delivered through prefetch")
 
-    def _worker(self):
+    def _worker(self, q: queue.Queue):
         try:
             for item in self.base:
-                self._queue.put(item)
+                t0 = time.perf_counter()
+                q.put(item)
+                stall = time.perf_counter() - t0
+                self._m_stall_last.set(stall)
+                self._m_stall.inc(stall)
         except BaseException as e:  # propagate to consumer
             self._error = e
         finally:
-            self._queue.put(self._SENTINEL)
+            q.put(self._SENTINEL)
+
+    def _join_worker(self):
+        """Drain + join a still-alive producer so re-iteration (or
+        reset) can never leak a second producer feeding a stale queue
+        — the worker may be blocked in `put` on the old queue."""
+        if self._thread is not None and self._thread.is_alive():
+            while True:
+                if self._queue.get() is self._SENTINEL:
+                    break
+            self._thread.join(timeout=5)
+        self._thread = None
 
     def __iter__(self):
+        self._join_worker()
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._error = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue,),
+                                        daemon=True)
         self._thread.start()
         return self
 
     def __next__(self):
         if self._queue is None:
             iter(self)
+        t0 = time.perf_counter()
         item = self._queue.get()
+        wait = time.perf_counter() - t0
+        self._m_wait_last.set(wait)
+        self._m_wait.inc(wait)
+        self._m_depth.set(self._queue.qsize())
         if item is self._SENTINEL:
             if self._error is not None:
                 raise self._error
             raise StopIteration
+        self._m_batches.inc()
         return item
 
     def reset(self):
-        if self._thread is not None and self._thread.is_alive():
-            # drain so the worker can exit
-            while True:
-                item = self._queue.get()
-                if item is self._SENTINEL:
-                    break
-            self._thread.join(timeout=5)
+        self._join_worker()
         if hasattr(self.base, "reset"):
             self.base.reset()
         self._queue = None

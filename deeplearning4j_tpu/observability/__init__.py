@@ -1,0 +1,36 @@
+"""Unified observability: metrics registry + trace spans + exposition.
+
+Every layer of the system previously self-reported in a different
+dialect — `InferenceEngine.health()`'s ad-hoc dict, train listeners
+printing to the log, `ui/stats.py` and `scaleout/stats.py` keeping
+private timing state — and nothing was scrapeable. This package is the
+one substrate they all publish into:
+
+- `metrics` — thread-safe `MetricsRegistry` of labeled
+  `Counter`/`Gauge`/`Histogram` (fixed buckets, per-cell locks,
+  monotonic `perf_counter` timers); a process default registry plus
+  injectable instances; `NULL_REGISTRY` to disable by injection.
+- `tracing` — nestable `span(name)` context managers recording
+  wall-time histograms and forwarding to
+  `jax.profiler.TraceAnnotation` so spans land in XLA profiles.
+- `export` — Prometheus text exposition + JSON snapshot, served by the
+  stdlib `MetricsServer` (`/metrics`, `/healthz`, `/readyz` with
+  pluggable health callables) and mountable on the training dashboard
+  (`ui.server.UIServer.attach_metrics`).
+
+Publishers: `serving.InferenceEngine` (queue/batch/shed/quarantine/
+retry/breaker/decode-latency; `health()` is registry-backed),
+`train.listeners.{PerformanceListener,ScoreIterationListener}`,
+`scaleout.stats.SparkTrainingStats` + `scaleout.parallel_trainer`
+spans, and `datasets.iterators.AsyncDataSetIterator` prefetch gauges.
+Lifecycle, naming conventions and a scrape walkthrough:
+docs/observability.md.
+"""
+from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    NULL_REGISTRY, NullRegistry, default_registry)
+from deeplearning4j_tpu.observability.tracing import (  # noqa: F401
+    current_span, span, traced)
+from deeplearning4j_tpu.observability.export import (  # noqa: F401
+    CONTENT_TYPE_LATEST, MetricsServer, json_snapshot, probe_response,
+    prometheus_text)

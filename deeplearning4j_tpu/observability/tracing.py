@@ -1,0 +1,96 @@
+"""Nestable trace spans: wall-time histograms + XLA profile annotations.
+
+`span(name)` is the one tracing primitive: it records the block's wall
+time (monotonic `perf_counter`) into the `trace_span_seconds{span=...}`
+histogram of a registry, under the slash-joined qualified name of the
+enclosing span stack ("fit" inside "epoch" records as "epoch/fit"), and
+— when the jax profiler is importable — forwards the same qualified
+name to `jax.profiler.TraceAnnotation`, so host-side spans line up with
+device activity in TensorBoard/xprof traces captured by
+`train.listeners.ProfilerListener`.
+
+The span stack is thread-local: concurrent threads (the serving
+engine's background worker, async prefetch producers) nest
+independently.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from deeplearning4j_tpu.observability.metrics import default_registry
+
+_now = time.perf_counter
+_tls = threading.local()
+
+_SPAN_HELP = ("Wall time of observability.tracing spans, labeled by "
+              "slash-qualified span name")
+
+
+def current_span() -> Optional[str]:
+    """Qualified name of the innermost active span on this thread."""
+    stack = getattr(_tls, "stack", None)
+    return "/".join(stack) if stack else None
+
+
+def _trace_annotation(name: str):
+    """A jax.profiler.TraceAnnotation for `name`, or None when the
+    profiler isn't importable (jax-free callers, stripped builds)."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+@contextmanager
+def span(name: str, registry=None):
+    """Time a block into `trace_span_seconds{span=<qualified name>}`.
+
+    Nestable; yields the qualified name. `registry=None` publishes to
+    the process default registry; pass a `MetricsRegistry` for
+    isolation or `NULL_REGISTRY` to disable recording (the annotation
+    still fires so XLA profiles keep their span markers).
+    """
+    reg = registry if registry is not None else default_registry()
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(str(name))
+    qual = "/".join(stack)
+    annot = _trace_annotation(qual)
+    if annot is not None:
+        try:
+            annot.__enter__()
+        except Exception:
+            annot = None             # profiler backends can refuse
+    t0 = _now()
+    try:
+        yield qual
+    finally:
+        dt = _now() - t0
+        if annot is not None:
+            try:
+                annot.__exit__(None, None, None)
+            except Exception:
+                pass
+        stack.pop()
+        reg.histogram("trace_span_seconds", _SPAN_HELP,
+                      labelnames=("span",)).labels(qual).observe(dt)
+
+
+def traced(name: Optional[str] = None, registry=None):
+    """Decorator form of `span` (span name defaults to the function's
+    qualified name)."""
+    def deco(fn):
+        span_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(span_name, registry=registry):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco

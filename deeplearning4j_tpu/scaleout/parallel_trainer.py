@@ -14,6 +14,7 @@ from deeplearning4j_tpu.earlystopping.config import (
     EarlyStoppingConfiguration, EarlyStoppingResult)
 from deeplearning4j_tpu.earlystopping.trainer import BaseEarlyStoppingTrainer
 from deeplearning4j_tpu.nn.multilayer import _unpack_batch
+from deeplearning4j_tpu.observability.tracing import span
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
 
@@ -27,8 +28,12 @@ class EarlyStoppingParallelTrainer(BaseEarlyStoppingTrainer):
 
     def _fit_batch(self, batch) -> None:
         feats, labs, fmask, lmask = _unpack_batch(batch)
-        self.wrapper.fit(feats, labs,
-                         lmask if lmask is not None else fmask)
+        # span: per-batch fit wall time lands in the
+        # trace_span_seconds{span="scaleout/parallel_fit"} histogram
+        # AND in XLA profiles (TraceAnnotation) when one is recording
+        with span("scaleout/parallel_fit"):
+            self.wrapper.fit(feats, labs,
+                             lmask if lmask is not None else fmask)
 
 
 class SparkEarlyStoppingTrainer(BaseEarlyStoppingTrainer):
@@ -52,10 +57,11 @@ class SparkEarlyStoppingTrainer(BaseEarlyStoppingTrainer):
     def _fit_batch(self, batch) -> None:
         feats, labs, fmask, lmask = _unpack_batch(batch)
         mask = lmask if lmask is not None else fmask
-        if mask is not None:
-            # the TrainingMaster facade fits plain arrays; masked
-            # (padded-sequence) batches go through the underlying
-            # sharded wrapper, which honors them
-            self.distributed.pw.fit(feats, labs, mask)
-        else:
-            self.distributed.fit(feats, labs)
+        with span("scaleout/spark_fit"):
+            if mask is not None:
+                # the TrainingMaster facade fits plain arrays; masked
+                # (padded-sequence) batches go through the underlying
+                # sharded wrapper, which honors them
+                self.distributed.pw.fit(feats, labs, mask)
+            else:
+                self.distributed.fit(feats, labs)

@@ -16,18 +16,30 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
+from deeplearning4j_tpu.observability.metrics import default_registry
+
 
 class SparkTrainingStats:
     """Accumulates (phase → list of (start, duration_ms)) timings
-    (reference: CommonSparkTrainingStats)."""
+    (reference: CommonSparkTrainingStats). Every `add_time` also
+    publishes the duration into the `scaleout_phase_seconds{phase=...}`
+    histogram of the metrics registry (process default unless
+    injected), so phase timings are scrapeable alongside the HTML
+    timeline export."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self.timings: Dict[str, List[Tuple[float, float]]] = \
             defaultdict(list)
         self._t0 = time.time()
+        reg = registry if registry is not None else default_registry()
+        self._m_phase = reg.histogram(
+            "scaleout_phase_seconds",
+            "Distributed-training phase wall time",
+            labelnames=("phase",))
 
     def add_time(self, phase: str, start: float, duration_s: float) -> None:
         self.timings[phase].append((start, duration_s * 1000.0))
+        self._m_phase.labels(phase).observe(duration_s)
 
     def get_keys(self) -> List[str]:
         return sorted(self.timings)
@@ -102,8 +114,12 @@ rows.forEach(r => {{
 
 @contextmanager
 def timed_phase(stats: SparkTrainingStats, phase: str):
+    # wall-clock start stays for the HTML timeline's display axis; the
+    # DURATION is measured on the monotonic clock so rate/phase metrics
+    # survive wall-clock steps (NTP slew, manual resets)
     start = time.time()
+    t0 = time.perf_counter()
     try:
         yield
     finally:
-        stats.add_time(phase, start, time.time() - start)
+        stats.add_time(phase, start, time.perf_counter() - t0)

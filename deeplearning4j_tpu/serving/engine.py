@@ -35,6 +35,18 @@ on the weights they started with (no drain), later batches use the new
 ones. Corrupt/partial `step_<N>` directories fall back to the previous
 good step.
 
+Observability: every counter the engine keeps (completed / shed /
+quarantined / retries / step failures / batches / reloads), the
+queue-depth / breaker-state / degraded gauges, and the per-step decode
++ per-batch latency histograms live in an
+`observability.MetricsRegistry` — a private one by default (per-engine
+counts stay exact), or inject a shared registry /
+`observability.NULL_REGISTRY` via the `registry` kwarg. `stats` and
+`health()` are read-through views over the same instruments, so the
+dict surface is unchanged while `GET /metrics` (observability.export)
+serves the identical numbers. Pull-model gauges (`set_function`) keep
+the hot decode path free of scrape-time work.
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -53,11 +65,16 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
 from deeplearning4j_tpu.parallel.serving import (make_parallel_generate,
                                                  shard_serving_params)
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+_perf = time.perf_counter
+
+_BREAKER_STATE = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
 
 
 class OverloadError(RuntimeError):
@@ -180,7 +197,8 @@ class InferenceEngine:
     def __init__(self, cfg: TransformerConfig, mesh, params,
                  config: Optional[EngineConfig] = None,
                  fault_injector=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or EngineConfig()
@@ -205,10 +223,75 @@ class InferenceEngine:
         # retries the same index (ServingFaultInjector contract)
         self._step_counter = 0
         self._weights_step: Optional[int] = None
-        self.stats = {"completed": 0, "shed_overload": 0,
-                      "shed_deadline": 0, "quarantined": 0,
-                      "retries": 0, "step_failures": 0, "batches": 0,
-                      "reloads": 0, "in_flight": 0}
+        # observability: every counter the old ad-hoc stats dict held
+        # now lives in a MetricsRegistry; `stats`/`health()` are
+        # read-through views. A fresh private registry per engine keeps
+        # per-engine counts exact — inject a shared registry (e.g.
+        # observability.default_registry()) to publish into a process
+        # scrape, or NULL_REGISTRY to disable instrumentation.
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self._init_metrics(self.registry)
+
+    def _init_metrics(self, r) -> None:
+        self._m_completed = r.counter(
+            "serving_requests_completed", "Requests fully decoded")
+        shed = r.counter("serving_requests_shed",
+                         "Requests rejected or abandoned, by reason",
+                         labelnames=("reason",))
+        self._m_shed_overload = shed.labels("overload")
+        self._m_shed_deadline = shed.labels("deadline")
+        self._m_quarantined = r.counter(
+            "serving_requests_quarantined",
+            "Requests that failed persistently after solo retries")
+        self._m_retries = r.counter(
+            "serving_decode_retries", "Decode step retry attempts")
+        self._m_step_failures = r.counter(
+            "serving_decode_step_failures", "Failed decode step calls")
+        self._m_batches = r.counter(
+            "serving_batches", "Dynamic batches processed")
+        self._m_reloads = r.counter(
+            "serving_weight_reloads", "Successful hot weight reloads")
+        self._m_in_flight = r.gauge(
+            "serving_in_flight_requests",
+            "Requests currently inside the decode loop")
+        # pull-model gauges: evaluated only at scrape/snapshot time, so
+        # the hot path pays nothing for them
+        r.gauge("serving_queue_depth",
+                "Admitted requests waiting for a batch").set_function(
+            lambda: float(len(self._queue)))
+        r.gauge("serving_breaker_state",
+                "Circuit breaker: 0=closed 1=half-open 2=open"
+                ).set_function(
+            lambda: _BREAKER_STATE.get(self._breaker, -1.0))
+        r.gauge("serving_degraded",
+                "1 while admissions are token-budget-capped"
+                ).set_function(lambda: float(
+                    len(self._queue) >= self.config.degrade_queue_depth
+                    or self._breaker != "closed"))
+        self._m_batch_size = r.histogram(
+            "serving_batch_size", "Coalesced batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._m_batch_seconds = r.histogram(
+            "serving_batch_latency_seconds",
+            "Wall time from batch formation to completion")
+        self._m_step_seconds = r.histogram(
+            "serving_decode_step_seconds",
+            "Wall time of one compiled decode call")
+
+    @property
+    def stats(self) -> dict:
+        """Counter snapshot (registry-backed; keys unchanged from the
+        pre-observability ad-hoc dict)."""
+        return {"completed": int(self._m_completed.value),
+                "shed_overload": int(self._m_shed_overload.value),
+                "shed_deadline": int(self._m_shed_deadline.value),
+                "quarantined": int(self._m_quarantined.value),
+                "retries": int(self._m_retries.value),
+                "step_failures": int(self._m_step_failures.value),
+                "batches": int(self._m_batches.value),
+                "reloads": int(self._m_reloads.value),
+                "in_flight": int(self._m_in_flight.value)}
 
     # ------------------------------------------------------------------
     # admission
@@ -231,12 +314,12 @@ class InferenceEngine:
                 raise RuntimeError("engine is stopped")
             self._tick_breaker(now)
             if self._breaker == "open":
-                self.stats["shed_overload"] += 1
+                self._m_shed_overload.inc()
                 raise OverloadError(
                     "circuit breaker open (recent step failures); "
                     f"retry after {self.config.breaker_cooldown_s}s")
             if len(self._queue) >= self.config.max_queue:
-                self.stats["shed_overload"] += 1
+                self._m_shed_overload.inc()
                 raise OverloadError(
                     f"queue full ({self.config.max_queue})")
             cap = (self.config.degraded_max_new_tokens
@@ -338,7 +421,8 @@ class InferenceEngine:
                     rest.append(r)
             rest.extend(self._queue)
             self._queue = rest
-            self.stats["in_flight"] += len(batch)
+            self._m_in_flight.inc(len(batch))
+        self._m_batch_size.observe(len(batch))
         for r in batch:
             r.status = RequestStatus.RUNNING
         return batch
@@ -349,11 +433,11 @@ class InferenceEngine:
         try:
             self._decode_loop(batch, params)
         finally:
-            with self._lock:
-                self.stats["in_flight"] -= len(batch)
-                self.stats["batches"] += 1
-                idx = self.stats["batches"]
+            self._m_in_flight.dec(len(batch))
+            self._m_batches.inc()
+            idx = int(self._m_batches.value)
             latency = self._clock() - t_start
+            self._m_batch_seconds.observe(latency)
             for l in self._listeners:
                 if hasattr(l, "record_batch"):
                     l.record_batch(len(batch))
@@ -404,16 +488,14 @@ class InferenceEngine:
                     # return what we have; the rest of the batch moves on
                     self._complete(r)
                 else:
-                    with self._lock:
-                        self.stats["shed_deadline"] += 1
+                    self._m_shed_deadline.inc()
                     r._finish(RequestStatus.SHED, DeadlineExceeded(
                         f"request {r.rid} past deadline with "
                         f"{r.generated.shape[0]}/{r.max_new_tokens} "
                         "tokens decoded"))
 
     def _complete(self, r: RequestHandle) -> None:
-        with self._lock:
-            self.stats["completed"] += 1
+        self._m_completed.inc()
         r._finish(RequestStatus.COMPLETED)
 
     # ------------------------------------------------------------------
@@ -446,7 +528,9 @@ class InferenceEngine:
                 if self._injector is not None:
                     self._injector.on_decode_step(self._step_counter,
                                                   rids)
+                t_step = _perf()
                 out = np.asarray(fn(params, jnp.asarray(prompts), key))
+                self._m_step_seconds.observe(_perf() - t_step)
                 self._record_success()
                 self._step_counter += 1
                 return out[:b, prompts.shape[1]:]
@@ -455,8 +539,7 @@ class InferenceEngine:
                 attempt += 1
                 if attempt > self.config.max_retries:
                     raise _BatchDecodeFailed(str(e)) from e
-                with self._lock:
-                    self.stats["retries"] += 1
+                self._m_retries.inc()
                 delay = min(self.config.backoff_base_s
                             * (2 ** (attempt - 1)),
                             self.config.backoff_max_s)
@@ -480,8 +563,7 @@ class InferenceEngine:
             try:
                 self._decode_solo(r, params)
             except _BatchDecodeFailed as e:
-                with self._lock:
-                    self.stats["quarantined"] += 1
+                self._m_quarantined.inc()
                 log.error("request %d quarantined after solo retries "
                           "(%s)", r.rid, e)
                 r._finish(RequestStatus.QUARANTINED, RequestQuarantined(
@@ -508,8 +590,8 @@ class InferenceEngine:
     # circuit breaker / degradation
     # ------------------------------------------------------------------
     def _record_failure(self, err: BaseException) -> None:
+        self._m_step_failures.inc()
         with self._lock:
-            self.stats["step_failures"] += 1
             self._consec_failures += 1
             if (self._breaker != "open" and self._consec_failures
                     >= self.config.breaker_failure_threshold):
@@ -598,7 +680,7 @@ class InferenceEngine:
             with self._lock:
                 self._params = tree
                 self._weights_step = int(s)
-                self.stats["reloads"] += 1
+            self._m_reloads.inc()
             log.info("weights hot-reloaded from step %d", int(s))
             return int(s)
         raise RuntimeError(

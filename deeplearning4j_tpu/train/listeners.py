@@ -16,6 +16,8 @@ import logging
 import time
 from typing import List, Optional, Tuple
 
+from deeplearning4j_tpu.observability.metrics import default_registry
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -39,20 +41,32 @@ class IterationListener:
 
 class ScoreIterationListener(IterationListener):
     """Log score every N iterations (reference:
-    ScoreIterationListener.java)."""
+    ScoreIterationListener.java) AND publish it: every call sets the
+    `training_score` gauge in the metrics registry (process default
+    unless injected), so the score series is scrapeable at /metrics
+    instead of only greppable from stdout."""
 
-    def __init__(self, print_iterations: int = 10):
+    def __init__(self, print_iterations: int = 10, registry=None):
         self.print_iterations = max(1, print_iterations)
+        reg = registry if registry is not None else default_registry()
+        self._m_score = reg.gauge(
+            "training_score", "Last score a training listener saw")
 
     def iteration_done(self, model, iteration, score):
+        self._m_score.set(float(score))
         if iteration % self.print_iterations == 0:
             log.info("Score at iteration %d is %s", iteration, score)
 
 
 class PerformanceListener(IterationListener):
-    """Samples/sec + batches/sec (reference: PerformanceListener.java)."""
+    """Samples/sec + batches/sec (reference: PerformanceListener.java),
+    published to the metrics registry as well as the log: per-call
+    `training_iterations` / `training_samples` counters, and
+    `training_samples_per_second` / `training_batches_per_second`
+    gauges refreshed each time a reporting window closes."""
 
-    def __init__(self, frequency: int = 1, report: bool = True):
+    def __init__(self, frequency: int = 1, report: bool = True,
+                 registry=None):
         self.frequency = max(1, frequency)
         self.report = report
         self._last_time: Optional[float] = None
@@ -60,12 +74,26 @@ class PerformanceListener(IterationListener):
         self._samples_since = 0
         self.last_samples_per_sec = 0.0
         self.last_batches_per_sec = 0.0
+        reg = registry if registry is not None else default_registry()
+        self._m_iterations = reg.counter(
+            "training_iterations", "Iterations seen by "
+            "PerformanceListener (serving: batches)")
+        self._m_samples = reg.counter(
+            "training_samples", "Samples counted via record_batch")
+        self._m_samples_rate = reg.gauge(
+            "training_samples_per_second",
+            "Throughput over the last reporting window")
+        self._m_batches_rate = reg.gauge(
+            "training_batches_per_second",
+            "Batch rate over the last reporting window")
 
     def record_batch(self, batch_size: int):
         self._samples_since += batch_size
+        self._m_samples.inc(batch_size)
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
+        self._m_iterations.inc()
         if self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
@@ -77,6 +105,8 @@ class PerformanceListener(IterationListener):
             self.last_batches_per_sec = batches / dt if dt > 0 else 0.0
             self.last_samples_per_sec = (self._samples_since / dt
                                          if dt > 0 else 0.0)
+            self._m_samples_rate.set(self.last_samples_per_sec)
+            self._m_batches_rate.set(self.last_batches_per_sec)
             if self.report:
                 log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, "
                          "score %s", iteration, self.last_samples_per_sec,

@@ -23,6 +23,11 @@ Endpoints:
                               (reference: ActivationsModule)
   GET  /flow                  layer flow graph written by
                               FlowIterationListener (reference: FlowModule)
+  GET  /metrics               Prometheus text exposition of the
+                              registry mounted via attach_metrics()
+  GET  /metrics.json          same registry as a JSON snapshot
+  GET  /healthz, /readyz      pluggable health/readiness probes
+                              (observability.export.probe_response)
 """
 from __future__ import annotations
 
@@ -133,6 +138,9 @@ class _Handler(BaseHTTPRequestHandler):
     remote_enabled = True         # --no-remote turns off /remote/receive
     activations_dir = None        # Path written by Conv listener
     flow_path = None              # Path written by Flow listener
+    metrics_registry = None       # attach_metrics() mounts /metrics
+    health_fn = None              # pluggable /healthz callable
+    ready_fn = None               # pluggable /readyz callable
 
     def log_message(self, *args) -> None:  # silence request logging
         pass
@@ -153,6 +161,34 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _observability(self, path: str) -> None:
+        """Metrics/health endpoints mounted by attach_metrics — the
+        dashboard port doubles as the scrape target. Class-attribute
+        access so plain-function callables never descriptor-bind."""
+        from deeplearning4j_tpu.observability.export import (
+            CONTENT_TYPE_LATEST, json_snapshot, probe_response,
+            prometheus_text)
+        cls = type(self)
+        if cls.metrics_registry is None and path in ("/metrics",
+                                                     "/metrics.json"):
+            self._json({"error": "no metrics registry attached"}, 404)
+            return
+        if path == "/metrics":
+            body = prometheus_text(cls.metrics_registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/metrics.json":
+            self._json(json_snapshot(cls.metrics_registry))
+        elif path == "/healthz":
+            code, body = probe_response(cls.health_fn)
+            self._json(body, code)
+        else:                                    # /readyz
+            code, body = probe_response(cls.ready_fn or cls.health_fn)
+            self._json(body, code)
+
     @classmethod
     def set_tsne(cls, coords, labels=None) -> None:
         """The one normalizer for t-SNE uploads (HTTP and Python API)."""
@@ -170,6 +206,10 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         if url.path in ("/", "/train", "/train/overview.html"):
             self._html(_PAGE)
+            return
+        if url.path in ("/metrics", "/metrics.json", "/healthz",
+                        "/readyz"):
+            self._observability(url.path)
             return
         if url.path == "/train/sessions":
             self._json(self.storage.list_session_ids())
@@ -302,6 +342,20 @@ class UIServer:
         TsneModule upload)."""
         self._handler.set_tsne(coords, labels)
 
+    def attach_metrics(self, registry=None, health=None,
+                       ready=None) -> None:
+        """Mount /metrics, /metrics.json, /healthz, /readyz on this
+        server over `registry` (default: the process default
+        observability registry) — one port serves charts AND scrapes.
+        `health`/`ready` follow observability.export.probe_response
+        semantics (e.g. pass InferenceEngine.health / .ready)."""
+        from deeplearning4j_tpu.observability.metrics import \
+            default_registry
+        self._handler.metrics_registry = (
+            registry if registry is not None else default_registry())
+        self._handler.health_fn = health
+        self._handler.ready_fn = ready
+
     def attach(self, storage: StatsStorage) -> None:
         """Mirror records from `storage` into the server's own store
         (reference: UIServer.attach)."""
@@ -342,10 +396,15 @@ def main(argv=None) -> None:
                     help="serve ConvolutionalIterationListener grids")
     ap.add_argument("--flow", default=None,
                     help="serve FlowIterationListener JSON")
+    ap.add_argument("--metrics", action="store_true",
+                    help="mount /metrics (+healthz/readyz) over the "
+                         "process default observability registry")
     args = ap.parse_args(argv)
     server = UIServer(port=args.port)
     if args.no_remote:
         server._handler.remote_enabled = False
+    if args.metrics:
+        server.attach_metrics()
     if args.activations_dir:
         server.attach_activations_dir(args.activations_dir)
     if args.flow:

@@ -98,13 +98,16 @@ class StatsListener(IterationListener):
             self._start_time = time.time()
         if iteration % self.frequency != 0:
             return
-        now = time.time()
-        duration = (now - self._last_iter_time) if self._last_iter_time \
-            else 0.0
-        self._last_iter_time = now
+        # display timestamps stay wall-clock; the iteration INTERVAL is
+        # measured on the monotonic clock so the duration series (and
+        # any rate derived from it) survives wall-clock steps
+        now_mono = time.perf_counter()
+        duration = (now_mono - self._last_iter_time) \
+            if self._last_iter_time else 0.0
+        self._last_iter_time = now_mono
         record = Persistable({
             "session_id": self.session_id, "type_id": "Update",
-            "worker_id": self.worker_id, "timestamp": now,
+            "worker_id": self.worker_id, "timestamp": time.time(),
             "iteration": iteration,
             "score": float(score),
             "iteration_duration_s": duration,

@@ -8,6 +8,12 @@ a deadline-blown request while the batch completes, trips + recovers
 its circuit breaker, and hot-reloads weights from a checkpoint
 directory — printing health() along the way.
 
+ISSUE-2 addendum: everything publishes into ONE observability
+registry (engine counters/histograms, a PerformanceListener's
+training series, an AsyncDataSetIterator's prefetch gauges), a
+`MetricsServer` exports it, and the demo ends by fetching and
+printing a real curl-able `/metrics` sample.
+
 On a TPU slice this uses all chips; elsewhere:
   JAX_PLATFORMS=cpu python examples/fault_tolerant_serving.py
 """
@@ -35,6 +41,7 @@ def main() -> None:
         except Exception:
             pass              # fall through to whatever mesh exists
 
+    from deeplearning4j_tpu import observability as obs
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        init_params)
     from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
@@ -44,6 +51,7 @@ def main() -> None:
                                             InferenceEngine,
                                             OverloadError,
                                             RequestQuarantined)
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
     from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
     cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=4,
@@ -55,13 +63,22 @@ def main() -> None:
         mesh = make_mesh(MeshSpec(data=1, model=1))
     prompt = np.arange(16, dtype=np.int32)
 
+    # one shared registry: engine + training listener + prefetch all
+    # publish into it, and the exporter serves it
+    registry = obs.default_registry()
     inj = ServingFaultInjector(fail_at=[1])      # one transient fault
     eng = InferenceEngine(
         cfg, mesh, params,
         EngineConfig(decode_chunk=4, max_new_tokens=16,
                      backoff_base_s=0.001, breaker_failure_threshold=3,
                      breaker_cooldown_s=0.2),
-        fault_injector=inj)
+        fault_injector=inj, registry=registry)
+    eng.set_listeners(PerformanceListener(frequency=1, report=False,
+                                          registry=registry))
+    exporter = obs.MetricsServer(registry, port=0, health=eng.health,
+                                 ready=eng.ready)
+    print(f"[metrics] exporter at {exporter.url}/metrics "
+          "(healthz/readyz wired to the engine)")
 
     # 1. transient fault: retried, completes
     h = eng.submit(prompt)
@@ -108,6 +125,33 @@ def main() -> None:
     step = eng.reload_weights(mgr)
     print(f"[reload] weights hot-reloaded from step {step}; "
           f"ready={eng.ready()}")
+
+    # 6. input pipeline: a few batches through AsyncDataSetIterator
+    # publish prefetch_* series into the SAME registry the engine and
+    # listener already feed
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncDataSetIterator, DataSet, ExistingDataSetIterator)
+    batches = [DataSet(np.zeros((4, 8), np.float32),
+                       np.zeros((4, 2), np.float32)) for _ in range(6)]
+    n = sum(1 for _ in AsyncDataSetIterator(
+        ExistingDataSetIterator(batches), queue_size=2,
+        registry=registry))
+    print(f"[prefetch] {n} batches through the async prefetcher")
+
+    # 7. scrape the exporter exactly like `curl <url>/metrics` would:
+    # one end-to-end run produced serving, training, AND prefetch
+    # series on one endpoint
+    from urllib.request import urlopen
+    text = urlopen(f"{exporter.url}/metrics", timeout=5).read().decode()
+    lines = text.splitlines()
+    keep = ("serving_requests", "serving_decode_step_seconds_count",
+            "serving_batch_size_count", "training_", "prefetch_")
+    sample = [l for l in lines
+              if not l.startswith("#") and l.startswith(keep)]
+    print(f"[metrics] GET /metrics -> {len(lines)} lines; sample:")
+    for line in sample:
+        print(f"  {line}")
+    exporter.stop()
 
 
 if __name__ == "__main__":

@@ -124,6 +124,32 @@ def test_iterator_dataset_iterator_and_async():
     assert vals2 == [0, 1, 2, 3, 4]
 
 
+def test_async_reiteration_joins_stale_worker():
+    """ISSUE-2 regression: re-iterating while a previous epoch's
+    producer thread is still alive (e.g. the consumer abandoned the
+    epoch early) must drain + join it, not leak a second producer
+    into a fresh queue."""
+    from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+    base = BaseDatasetIterator(
+        np.arange(40, dtype=np.float32).reshape(20, 2),
+        np.zeros((20, 1), np.float32), 2)
+    it = AsyncDataSetIterator(base, queue_size=1)
+    first = iter(it)
+    next(first)                      # worker alive, blocked on put
+    stale = it._thread
+    assert stale is not None and stale.is_alive()
+
+    second = iter(it)                # must join the stale producer
+    assert not stale.is_alive()
+    assert it._thread is not stale
+    # the fresh epoch is complete — no batches stolen by the old worker
+    assert len(list(second)) == 10
+    # and a clean third epoch still works
+    assert len(list(iter(it))) == 10
+    it._thread.join(timeout=5)
+    assert not it._thread.is_alive()
+
+
 def test_mnist_iterator_shapes():
     it = MnistDataSetIterator(batch_size=16, num_examples=64)
     b = next(iter(it))

@@ -1,0 +1,426 @@
+"""Unified observability subsystem (ISSUE-2 acceptance suite).
+
+Registry exactness under concurrency (8 threads, no lost updates),
+Prometheus text exposition that actually parses (label escaping,
+histogram bucket cumulativity), span nesting, the HTTP exporter's
+/metrics + /healthz + /readyz, the UIServer mount, engine counters
+agreeing with ServingFaultInjector-driven outcomes, and one
+end-to-end scrape containing serving + training + prefetch series.
+"""
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.observability import (MetricsRegistry,
+                                              MetricsServer,
+                                              NULL_REGISTRY,
+                                              json_snapshot,
+                                              prometheus_text, span)
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("reqs", "requests", labelnames=("outcome",))
+    c.labels("ok").inc()
+    c.labels(outcome="ok").inc(2)
+    c.labels("err").inc()
+    assert c.labels("ok").value == 3 and c.labels("err").value == 1
+    with pytest.raises(ValueError, match="only go up"):
+        c.labels("ok").inc(-1)
+
+    g = r.gauge("depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+    lazy = r.gauge("lazy", "pull-model")
+    lazy.set_function(lambda: 7.5)
+    assert lazy.value == 7.5
+
+    h = r.histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    cum, total, count = h._unlabeled().snapshot()
+    assert cum == [1, 2, 3, 4]           # cumulative, +Inf == count
+    assert count == 4 and abs(total - 5.555) < 1e-9
+    with h.time():
+        pass
+    assert h._unlabeled().snapshot()[2] == 5
+
+
+def test_registry_get_or_create_idempotent_and_conflicts():
+    r = MetricsRegistry()
+    a = r.counter("x", "first")
+    assert r.counter("x") is a           # idempotent re-request
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("x")                     # kind conflict
+    with pytest.raises(ValueError, match="already registered"):
+        r.counter("x", labelnames=("l",))   # label-shape conflict
+    with pytest.raises(ValueError, match="invalid metric name"):
+        r.counter("2bad")
+    with pytest.raises(ValueError, match="expects labels"):
+        r.counter("y", labelnames=("a", "b")).labels("only-one")
+
+
+def test_null_registry_is_inert():
+    c = NULL_REGISTRY.counter("anything")
+    c.inc()
+    c.labels("x").inc(5)
+    with NULL_REGISTRY.histogram("h").time():
+        pass
+    assert NULL_REGISTRY.collect() == []
+    assert prometheus_text(NULL_REGISTRY) == "\n"
+
+
+def test_registry_concurrency_8_threads_no_lost_updates():
+    """ISSUE-2 satellite: 8 threads hammering one registry — counts
+    exact, no lost updates (counter, labeled counter, histogram)."""
+    r = MetricsRegistry()
+    c = r.counter("hits", "")
+    lc = r.counter("labeled_hits", "", labelnames=("t",))
+    h = r.histogram("obs", "", buckets=(0.5,))
+    N, T = 5000, 8
+
+    def work(tid):
+        for i in range(N):
+            c.inc()
+            lc.labels(str(tid % 2)).inc()
+            h.observe(i % 2)             # half below, half above 0.5
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert lc.labels("0").value == N * T / 2
+    assert lc.labels("1").value == N * T / 2
+    cum, total, count = h._unlabeled().snapshot()
+    assert count == N * T and cum[-1] == N * T
+    assert cum[0] == N * T / 2           # exact bucket counts too
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prom(text):
+    """Minimal Prometheus text-format parser: returns
+    {name: [(labels_dict, value_str)]}; asserts line validity."""
+    out = {}
+    for line in text.strip().split("\n"):
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = dict(_LABEL_RE.findall(m.group(3) or ""))
+        out.setdefault(m.group(1), []).append((labels, m.group(4)))
+    return out
+
+
+def test_prometheus_text_parses_and_escapes():
+    r = MetricsRegistry()
+    c = r.counter("reqs", 'help with "quotes"\nand newline',
+                  labelnames=("path",))
+    weird = 'a"b\\c\nd'
+    c.labels(weird).inc(3)
+    r.gauge("depth", "plain").set(2)
+    text = prometheus_text(r)
+    samples = _parse_prom(text)
+    # counter rendered with the _total suffix
+    assert "reqs_total" in samples and "depth" in samples
+    # HELP newline escaped: the exposition must stay line-oriented
+    assert "\nand newline" not in text.split("# TYPE")[0]
+    ((labels, value),) = samples["reqs_total"]
+    assert value == "3"
+    # unescaping the label value round-trips the weird string
+    unescaped = (labels["path"].replace(r"\n", "\n")
+                 .replace(r'\"', '"').replace(r"\\", "\\"))
+    assert unescaped == weird
+
+
+def test_prometheus_histogram_bucket_cumulativity():
+    r = MetricsRegistry()
+    h = r.histogram("lat", "latency", labelnames=("op",),
+                    buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 9.0):
+        h.labels("decode").observe(v)
+    samples = _parse_prom(prometheus_text(r))
+    buckets = [(l["le"], float(v)) for l, v in samples["lat_bucket"]
+               if l["op"] == "decode"]
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert buckets[-1][0] == "+Inf"
+    assert counts[-1] == float(samples["lat_count"][0][1])
+    assert float(samples["lat_sum"][0][1]) == pytest.approx(9.56)
+
+
+def test_json_snapshot_roundtrips():
+    r = MetricsRegistry()
+    r.counter("c").inc(2)
+    r.histogram("h", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(json.dumps(json_snapshot(r)))
+    assert snap["c"]["samples"][0]["value"] == 2
+    assert snap["h"]["samples"][0]["count"] == 1
+    assert snap["h"]["samples"][0]["buckets"]["+Inf"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tracing spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_qualified_names():
+    r = MetricsRegistry()
+    with span("epoch", registry=r) as outer:
+        assert outer == "epoch"
+        with span("fit", registry=r) as inner:
+            assert inner == "epoch/fit"
+    hist = r.get("trace_span_seconds")
+    names = [l[0] for l, _ in hist.collect()]
+    assert names == ["epoch", "epoch/fit"]
+    for _, child in hist.collect():
+        assert child.snapshot()[2] == 1
+
+
+def test_span_records_on_exception_and_pops_stack():
+    from deeplearning4j_tpu.observability import current_span
+    r = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with span("doomed", registry=r):
+            raise RuntimeError("boom")
+    assert current_span() is None        # stack unwound
+    assert r.get("trace_span_seconds").labels("doomed").snapshot()[2] == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter + UIServer mount
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_server_endpoints():
+    r = MetricsRegistry()
+    r.counter("served", "").inc(4)
+    state = {"ready": True}
+    srv = MetricsServer(r, port=0,
+                        health=lambda: {"ready": state["ready"],
+                                        "note": "up"},
+                        ready=lambda: state["ready"])
+    try:
+        code, text = _get(srv.url + "/metrics")
+        assert code == 200
+        assert _parse_prom(text)["served_total"][0][1] == "4"
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["note"] == "up"
+        code, _ = _get(srv.url + "/readyz")
+        assert code == 200
+
+        state["ready"] = False           # breaker-open analog
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/readyz")
+        assert e.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/healthz")
+        assert e.value.code == 503
+        code, body = _get(srv.url + "/metrics.json")
+        assert json.loads(body)["served"]["samples"][0]["value"] == 4
+    finally:
+        srv.stop()
+
+
+def test_ui_server_mounts_metrics():
+    from deeplearning4j_tpu.ui.server import UIServer
+    r = MetricsRegistry()
+    r.gauge("training_score", "").set(1.25)
+    srv = UIServer(port=0)
+    try:
+        # before attach: the dashboard still works, /metrics 404s
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/metrics")
+        assert e.value.code == 404
+        srv.attach_metrics(r, health=lambda: {"ready": True})
+        code, text = _get(srv.url + "/metrics")
+        assert code == 200
+        assert _parse_prom(text)["training_score"][0][1] == "1.25"
+        assert _get(srv.url + "/healthz")[0] == 200
+        assert _get(srv.url + "/readyz")[0] == 200
+        assert _get(srv.url + "/train/sessions")[0] == 200   # coexists
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine instrumentation vs fault injection
+# ---------------------------------------------------------------------------
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,  # noqa: E402
+                                                   init_params)
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def test_engine_counters_agree_with_fault_injection(params, mesh1):
+    """Shed/quarantine/retry counters in the registry must agree with
+    ServingFaultInjector-driven outcomes AND with the stats dict view
+    (they are the same instruments)."""
+    from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+    from deeplearning4j_tpu.serving import (EngineConfig,
+                                            InferenceEngine,
+                                            OverloadError)
+    inj = ServingFaultInjector(fail_at=[0])      # one transient fault
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        EngineConfig(decode_chunk=2, max_new_tokens=4, max_retries=2,
+                     backoff_base_s=0.0, max_queue=2),
+        fault_injector=inj)
+    good = eng.submit(_prompt(8, 1))
+    bad = eng.submit(_prompt(8, 2))
+    inj.poison_requests.add(bad.rid)
+    with pytest.raises(OverloadError):           # queue full at 2
+        eng.submit(_prompt(8, 3))
+    eng.run_pending()
+
+    r = eng.registry
+    assert r.get("serving_requests_completed").value == 1
+    assert r.get("serving_requests_quarantined").value == 1
+    assert r.get("serving_requests_shed").labels("overload").value == 1
+    assert r.get("serving_requests_shed").labels("deadline").value == 0
+    # transient fault (1 retry) + poisoned batch/solo retries
+    assert r.get("serving_decode_retries").value == eng.stats["retries"]
+    assert (r.get("serving_decode_step_failures").value
+            == eng.stats["step_failures"]) and inj.injected > 1
+    assert r.get("serving_queue_depth").value == 0
+    assert r.get("serving_breaker_state").value == 0.0
+    assert r.get("serving_in_flight_requests").value == 0
+    # the stats dict is a read-through view of the same registry
+    assert eng.stats["completed"] == 1
+    assert eng.stats["quarantined"] == 1
+    assert eng.stats["shed_overload"] == 1
+    assert good.done() and bad.done()
+
+    # decode latency histogram saw every successful compiled call
+    steps = r.get("serving_decode_step_seconds")._unlabeled()
+    assert steps.snapshot()[2] >= 2
+    sizes = r.get("serving_batch_size")._unlabeled()
+    assert sizes.snapshot()[2] == eng.stats["batches"]
+
+    # and the whole thing is scrapeable
+    text = prometheus_text(r)
+    assert "serving_requests_quarantined_total 1" in text
+    assert 'serving_requests_shed_total{reason="overload"} 1' in text
+
+
+def test_engine_health_is_registry_backed(params, mesh1):
+    from deeplearning4j_tpu.serving import EngineConfig, InferenceEngine
+    eng = InferenceEngine(CFG, mesh1, params,
+                          EngineConfig(decode_chunk=0,
+                                       max_new_tokens=4))
+    eng.submit(_prompt())
+    eng.run_pending()
+    health = eng.health()
+    assert health["completed"] == 1 and health["ready"]
+    assert health["completed"] == int(
+        eng.registry.get("serving_requests_completed").value)
+    # breaker gauge mirrors the health() field
+    state = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
+    assert (eng.registry.get("serving_breaker_state").value
+            == state[health["breaker"]])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one scrape with serving + training + prefetch series
+# ---------------------------------------------------------------------------
+
+
+def test_end_to_end_scrape_serving_training_prefetch(params, mesh1):
+    """The ISSUE-2 acceptance demo in test form: one shared registry,
+    all three subsystem families visible in a single GET /metrics."""
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncDataSetIterator, BaseDatasetIterator)
+    from deeplearning4j_tpu.serving import EngineConfig, InferenceEngine
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
+
+    reg = MetricsRegistry()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          EngineConfig(decode_chunk=0,
+                                       max_new_tokens=4),
+                          registry=reg)
+    eng.set_listeners(PerformanceListener(frequency=1, report=False,
+                                          registry=reg))
+    eng.submit(_prompt())
+    eng.submit(_prompt(8, 1))
+    eng.run_pending()
+
+    base = BaseDatasetIterator(np.zeros((8, 4), np.float32),
+                               np.zeros((8, 2), np.float32), 2)
+    for _ in AsyncDataSetIterator(base, queue_size=2, registry=reg):
+        pass
+
+    srv = MetricsServer(reg, port=0, health=eng.health,
+                        ready=eng.ready)
+    try:
+        code, text = _get(srv.url + "/metrics")
+        assert code == 200
+        samples = _parse_prom(text)
+        assert samples["serving_requests_completed_total"][0][1] == "2"
+        assert "serving_decode_step_seconds_bucket" in samples
+        assert float(samples["training_samples_total"][0][1]) == 2.0
+        assert samples["prefetch_batches_total"][0][1] == "4"
+        assert "prefetch_consumer_wait_seconds_total" in samples
+        assert _get(srv.url + "/healthz")[0] == 200
+        assert _get(srv.url + "/readyz")[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_scaleout_phase_histogram_and_span(params):
+    from deeplearning4j_tpu.scaleout.stats import (SparkTrainingStats,
+                                                   timed_phase)
+    reg = MetricsRegistry()
+    st = SparkTrainingStats(registry=reg)
+    with timed_phase(st, "fit"):
+        pass
+    with timed_phase(st, "split"):
+        pass
+    hist = reg.get("scaleout_phase_seconds")
+    assert {l[0] for l, _ in hist.collect()} == {"fit", "split"}
+    assert hist.labels("fit").snapshot()[2] == 1
+    # the legacy timeline view still accumulates alongside
+    assert st.get_keys() == ["fit", "split"]
