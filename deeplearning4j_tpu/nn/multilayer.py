@@ -61,6 +61,8 @@ class MultiLayerNetwork:
         # UNFUSED schedule without touching process-global env state
         self.lstm_wavefront = True
         self.listeners: List[Any] = []
+        self.training_guard: Optional[Any] = None
+        self.last_grad_norm: float = float("nan")
         self.score_value: float = float("nan")
         self._jit_cache: Dict[Any, Any] = {}
         self._pretrain_counts: Dict[int, int] = {}
@@ -93,6 +95,13 @@ class MultiLayerNetwork:
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
+
+    def set_training_guard(self, guard) -> None:
+        """Install (or clear, with None) a `train.guard.TrainingGuard`:
+        `fit`'s SGD path switches to the guarded step — post-step score
+        AND global grad-norm checked, non-finite updates discarded on
+        device, skip/rollback policy applied host-side."""
+        self.training_guard = guard
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, state, x, *, train: bool,
@@ -269,6 +278,40 @@ class MultiLayerNetwork:
         ParallelWrapper) compile the same step with mesh shardings."""
         return jax.jit(self._step_math(), donate_argnums=(0, 1, 2),
                        **jit_kwargs)
+
+    def _make_guarded_train_step(self):
+        """TrainingGuard variant of the minibatch step: additionally
+        returns the global gradient norm, discards a non-finite update
+        ON DEVICE (params/state/opt pass through unchanged when score
+        or grad-norm is NaN/Inf — a poisoned batch cannot contaminate
+        the tree even before the host sees the score), and does NOT
+        donate its inputs, so the host keeps the pre-step tree and a
+        guard SKIP is a no-op commit."""
+        tc = self.conf.training
+        lr_mult = self._lr_multipliers()
+        trainable = self._trainable()
+
+        def step(params, state, opt_state, iteration, x, y, key, mask):
+            def loss_fn(p):
+                return self._loss_fn(p, state, x, y, key, mask)
+            (score, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(promote_score(g) ** 2)
+                for g in jax.tree_util.tree_leaves(grads)))
+            new_params, new_opt = apply_updater(
+                tc, params, grads, opt_state, iteration,
+                lr_multipliers=lr_mult, trainable=trainable)
+            ok = jnp.isfinite(score) & jnp.isfinite(gnorm)
+
+            def keep(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new, old)
+
+            return (keep(new_params, params), keep(new_state, state),
+                    keep(new_opt, opt_state), score, gnorm)
+
+        return jax.jit(step)
 
     def _make_epoch_program(self, mb_body_factory, epochs: int,
                             **jit_kwargs):
@@ -515,6 +558,9 @@ class MultiLayerNetwork:
                 x, y, mask,
                 iteration_callback=lambda s: self._notify_iteration(s, x))
             return
+        if self.training_guard is not None:
+            self._fit_batch_guarded(x, y, mask)
+            return
         step = self._get_train_step((x.shape, y.shape,
                                      mask is not None))
         for _ in range(max(1, self.conf.training.num_iterations)):
@@ -525,6 +571,47 @@ class MultiLayerNetwork:
                 self.iteration_count, x, y, key,
                 None if mask is None else jnp.asarray(mask))
             self._notify_iteration(score, x)
+
+    def _fit_batch_guarded(self, x, y, mask=None) -> None:
+        """SGD minibatch step under a TrainingGuard: run the guarded
+        step (no donation; non-finite update already discarded on
+        device), then let the guard judge (score, grad_norm). ACCEPT
+        commits the new tree; SKIP keeps the pre-step tree (the
+        iteration counter still advances, so the dropout/RNG stream and
+        LR schedule move past the bad batch); ROLLBACK raises
+        DivergenceError for the caller's checkpoint-restore policy
+        (FaultTolerantTrainer catches it; a bare fit propagates)."""
+        from deeplearning4j_tpu.train.guard import (DivergenceError,
+                                                    TrainingGuard)
+        cache_key = ("train-guarded", x.shape, y.shape, mask is not None)
+        step = self._jit_cache.get(cache_key)
+        if step is None:
+            step = self._make_guarded_train_step()
+            self._jit_cache[cache_key] = step
+        for _ in range(max(1, self.conf.training.num_iterations)):
+            key = jax.random.fold_in(jax.random.PRNGKey(
+                self.conf.training.seed), self.iteration_count)
+            new_p, new_s, new_o, score, gnorm = step(
+                self.params, self.state, self.updater_state,
+                self.iteration_count, x, y, key,
+                None if mask is None else jnp.asarray(mask))
+            score_f = float(score)
+            self.last_grad_norm = float(gnorm)
+            action = self.training_guard.update(score_f,
+                                                self.last_grad_norm)
+            if action == TrainingGuard.ACCEPT:
+                self.params, self.state, self.updater_state = (
+                    new_p, new_s, new_o)
+            elif action == TrainingGuard.ROLLBACK:
+                raise DivergenceError(
+                    f"training diverged at iteration "
+                    f"{self.iteration_count}: "
+                    f"{self.training_guard.max_consecutive} consecutive "
+                    f"bad steps (last: {self.training_guard.last_reason},"
+                    f" score={score_f}, grad_norm="
+                    f"{self.last_grad_norm})")
+            # SKIP: pre-step tree kept; fall through to notify
+            self._notify_iteration(score_f, x)
 
     def _fit_tbptt(self, x, y, mask=None) -> None:
         """Truncated BPTT (reference: doTruncatedBPTT,

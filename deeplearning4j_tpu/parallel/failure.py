@@ -9,13 +9,34 @@ periodic checkpoints, detects step failures (device OOM, preempted
 TPU grant, injected faults), restores the last good checkpoint, and
 resumes; plus a `FaultInjector` for deterministic failure testing
 (the fault-injection harness the reference also lacks).
+
+Durability extensions (ISSUE-3) — every long-run killer has a
+deterministic CPU-testable injection knob:
+
+- **Torn checkpoints**: `FaultInjector(crash_write_at=...)` kills a
+  write mid-staging (orphan `.tmp` left behind);
+  `torn_write_at=...` corrupts the published arrays AFTER the atomic
+  rename (zip-valid bytes, wrong content — exactly what only the
+  CRC32 manifest catches).
+- **Silent divergence**: `nan_at=...` poisons a batch so the loss goes
+  NaN without raising; pair with `train.guard.TrainingGuard` via
+  `FaultTolerantTrainer(guard=...)` for skip/rollback + LR backoff.
+- **Preemption**: `PreemptionHandler` turns SIGTERM/SIGINT into a
+  graceful stop-at-next-step-boundary + resumable checkpoint;
+  `preempt_at=...` simulates the signal deterministically.
+- **Hung steps**: `StepWatchdog` flags steps exceeding a deadline from
+  a monitor thread (the TPU grant that neither completes nor errors).
 """
 from __future__ import annotations
 
 import logging
+import threading
 import time
+from pathlib import Path
 from typing import Callable, Iterable, Optional
 
+from deeplearning4j_tpu.observability.metrics import default_registry
+from deeplearning4j_tpu.train.guard import DivergenceError, TrainingGuard
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -30,13 +51,45 @@ class FaultInjector:
     """Deterministically fail chosen iterations (test harness).
     `persistent=True` keeps failing the same iteration on retry —
     models a hard fault (bad host, poisoned input) rather than a
-    transient one."""
+    transient one.
+
+    Durability knobs (all one-shot unless ``persistent``):
+
+    - ``nan_at``: iterations whose BATCH gets poisoned to NaN by the
+      trainer — the loss goes non-finite without any exception (the
+      silent-divergence failure mode; checked via `check_nan`).
+    - ``preempt_at``: iterations at which a simulated SIGTERM requests
+      a graceful stop (checked via `check_preempt`).
+    - ``crash_write_at``: checkpoint steps whose write dies MID-STAGING
+      (before the atomic rename) — leaves an orphaned `.tmp` dir, the
+      published layout never sees a partial step.
+    - ``torn_write_at``: checkpoint steps whose arrays.npz is replaced
+      AFTER publication with zip-valid zeroed arrays — readable
+      without the manifest, caught only by checksum verification.
+    - ``write_delay_s``: stall every checkpoint write by this many
+      seconds (async-ordering tests: latest_step must not surface the
+      in-flight write).
+    """
 
     def __init__(self, fail_at: Iterable[int] = (),
-                 persistent: bool = False):
+                 persistent: bool = False,
+                 nan_at: Iterable[int] = (),
+                 preempt_at: Iterable[int] = (),
+                 crash_write_at: Iterable[int] = (),
+                 torn_write_at: Iterable[int] = (),
+                 write_delay_s: float = 0.0):
         self.fail_at = set(int(i) for i in fail_at)
         self.persistent = persistent
+        self.nan_at = set(int(i) for i in nan_at)
+        self.preempt_at = set(int(i) for i in preempt_at)
+        self.crash_write_at = set(int(i) for i in crash_write_at)
+        self.torn_write_at = set(int(i) for i in torn_write_at)
+        self.write_delay_s = float(write_delay_s)
         self.injected = 0
+        self.nans_injected = 0
+        self.preempts_injected = 0
+        self.writes_crashed = 0
+        self.writes_torn = 0
 
     def check(self, iteration: int) -> None:
         if iteration in self.fail_at:
@@ -45,6 +98,55 @@ class FaultInjector:
             self.injected += 1
             raise TrainingFailure(f"injected fault at iteration "
                                   f"{iteration}")
+
+    def check_nan(self, iteration: int) -> bool:
+        """True when this iteration's batch should be NaN-poisoned."""
+        if iteration in self.nan_at:
+            if not self.persistent:
+                self.nan_at.discard(iteration)
+            self.nans_injected += 1
+            return True
+        return False
+
+    def check_preempt(self, iteration: int) -> bool:
+        """True when a simulated preemption signal lands here."""
+        if iteration in self.preempt_at:
+            self.preempt_at.discard(iteration)
+            self.preempts_injected += 1
+            return True
+        return False
+
+    # -- CheckpointManager hooks (util/checkpointing) -------------------
+    def on_checkpoint_write(self, step: int, staging_dir) -> None:
+        """Runs after staging is fully written, BEFORE the atomic
+        rename — a raise here models a kill mid-write (the .tmp dir
+        survives for the startup sweep; the step never publishes)."""
+        if self.write_delay_s > 0:
+            time.sleep(self.write_delay_s)
+        if step in self.crash_write_at:
+            if not self.persistent:
+                self.crash_write_at.discard(step)
+            self.writes_crashed += 1
+            raise TrainingFailure(
+                f"injected crash during checkpoint write of step {step}")
+
+    def on_checkpoint_published(self, step: int, final_dir) -> None:
+        """Runs after the atomic rename: torn-write injection replaces
+        the published arrays with zip-valid zeroed content (same names,
+        shapes, dtypes) — np.load succeeds, only the CRC32 manifest can
+        tell the step is garbage."""
+        if step not in self.torn_write_at:
+            return
+        if not self.persistent:
+            self.torn_write_at.discard(step)
+        import numpy as np
+        p = Path(final_dir) / "arrays.npz"
+        with np.load(p) as data:
+            zeroed = {k: np.zeros_like(data[k]) for k in data.files}
+        np.savez(p, **zeroed)
+        self.writes_torn += 1
+        log.warning("injected torn write: step %d arrays zeroed "
+                    "post-publication", step)
 
 
 class ServingFaultInjector(FaultInjector):
@@ -95,6 +197,166 @@ class ServingFaultInjector(FaultInjector):
         self.check(int(step))
 
 
+class PreemptionHandler:
+    """Graceful-stop coordination for SIGTERM/SIGINT preemptions.
+
+    `install()` hooks the signals (main thread only — elsewhere the
+    handler degrades to flag-only mode, driven via `request_stop()`,
+    which is also what `FaultInjector.preempt_at` simulation uses).
+    The flag is checked by `FaultTolerantTrainer` at every step
+    boundary: the current step finishes, a checkpoint is written, and
+    `fit` returns resumable instead of dying mid-step with hours of
+    work discarded. Publishes `preemption_stop_requested` (gauge) and
+    `preemption_signals_total`."""
+
+    def __init__(self, signals: Optional[Iterable[int]] = None,
+                 registry=None):
+        import signal as _signal
+        self._signal_mod = _signal
+        if signals is None:
+            signals = [s for s in (getattr(_signal, "SIGTERM", None),
+                                   getattr(_signal, "SIGINT", None))
+                       if s is not None]
+        self.signals = tuple(signals)
+        self._stop = threading.Event()
+        self._prev: dict = {}
+        self.installed = False
+        self.signals_seen = 0
+        reg = registry if registry is not None else default_registry()
+        self._m_signals = reg.counter(
+            "preemption_signals_total",
+            "Preemption signals (or simulations) observed")
+        reg.gauge(
+            "preemption_stop_requested",
+            "1 while a graceful stop is pending"
+        ).set_function(lambda: 1.0 if self._stop.is_set() else 0.0)
+
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            log.warning("PreemptionHandler: not on the main thread; "
+                        "signal hooks unavailable (flag-only mode)")
+            return self
+        for sig in self.signals:
+            self._prev[sig] = self._signal_mod.signal(sig,
+                                                      self._on_signal)
+        self.installed = True
+        return self
+
+    def _on_signal(self, signum, frame) -> None:
+        self.signals_seen += 1
+        self._m_signals.inc()
+        log.warning("signal %s received: graceful stop requested at "
+                    "next step boundary", signum)
+        self.request_stop()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def stop_requested(self) -> bool:
+        return self._stop.is_set()
+
+    def clear(self) -> None:
+        self._stop.clear()
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                self._signal_mod.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class StepWatchdog:
+    """Monitor thread flagging training steps that exceed a wall-clock
+    deadline — the hung-grant failure mode where a step neither
+    completes nor raises. `arm()` before the step, `disarm()` after;
+    a step still armed past ``deadline_s`` is flagged once (logged,
+    `watchdog_hung_steps_total` bumped, ``on_hung(iteration,
+    elapsed_s)`` called if given — e.g. a PreemptionHandler's
+    request_stop for checkpoint-and-exit policies)."""
+
+    def __init__(self, deadline_s: float,
+                 on_hung: Optional[Callable[[int, float], None]] = None,
+                 poll_s: Optional[float] = None,
+                 registry=None):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.on_hung = on_hung
+        self.poll_s = (max(0.005, min(self.deadline_s / 4.0, 0.25))
+                       if poll_s is None else float(poll_s))
+        self._lock = threading.Lock()
+        self._armed_at: Optional[float] = None
+        self._iteration = 0
+        self._flagged = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.hung_iterations: list = []
+        reg = registry if registry is not None else default_registry()
+        self._m_hung = reg.counter(
+            "watchdog_hung_steps_total",
+            "Steps that exceeded the watchdog deadline")
+        reg.gauge(
+            "watchdog_step_deadline_seconds",
+            "Configured per-step watchdog deadline").set(self.deadline_s)
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            name="step-watchdog",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def arm(self, iteration: int) -> None:
+        with self._lock:
+            self._armed_at = time.perf_counter()
+            self._iteration = int(iteration)
+            self._flagged = False
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed_at = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            cb = None
+            with self._lock:
+                if self._armed_at is None or self._flagged:
+                    continue
+                elapsed = time.perf_counter() - self._armed_at
+                if elapsed > self.deadline_s:
+                    self._flagged = True
+                    self.hung_iterations.append(self._iteration)
+                    self._m_hung.inc()
+                    it, cb = self._iteration, self.on_hung
+                    log.error("watchdog: step %d exceeded %.3fs "
+                              "deadline (%.3fs elapsed and counting)",
+                              self._iteration, self.deadline_s, elapsed)
+            if cb is not None:
+                cb(it, elapsed)
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
 class FaultTolerantTrainer:
     """Run fit over an iterator with checkpoint/restore-based recovery.
 
@@ -102,54 +364,154 @@ class FaultTolerantTrainer:
     from the latest checkpoint and the epoch continues from the current
     batch (at-least-once batch semantics — same guarantee as the
     reference's Spark retry, which may also re-process a split).
+
+    ``max_restarts`` bounds CONSECUTIVE failures, not lifetime
+    failures: the counter resets on every successful step, so
+    max_restarts transient faults spread across a long job no longer
+    abort it — only a fault that persists through max_restarts
+    back-to-back recovery attempts does. ``restarts`` stays the
+    cumulative total for reporting.
+
+    Durability integrations (all optional):
+
+    - ``guard``: a `TrainingGuard` installed on the net — NaN/spike
+      steps are skipped; a `DivergenceError` rollback restores the
+      last checkpoint AND backs the learning rate off.
+    - ``preemption``: a `PreemptionHandler` (or True to create+install
+      one) — a pending stop checkpoints at the step boundary and
+      `fit` returns False (resumable) instead of True (completed).
+    - ``step_deadline_s``: arms a `StepWatchdog` around every step.
+    - ``async_save``: checkpoint writes happen off the step loop's
+      critical path (see CheckpointManager.async_save).
     """
 
     def __init__(self, net, checkpoint_dir: str,
                  checkpoint_frequency: int = 50, max_restarts: int = 3,
                  fault_injector: Optional[FaultInjector] = None,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None,
+                 guard: Optional[TrainingGuard] = None,
+                 preemption=None,
+                 step_deadline_s: Optional[float] = None,
+                 async_save: bool = False,
+                 registry=None):
         self.net = net
         self.manager = CheckpointManager(checkpoint_dir,
-                                         use_orbax=use_orbax)
+                                         use_orbax=use_orbax,
+                                         async_save=async_save,
+                                         fault_injector=fault_injector,
+                                         registry=registry)
         self.checkpoint_frequency = max(1, checkpoint_frequency)
         self.max_restarts = max_restarts
         self.fault_injector = fault_injector
-        self.restarts = 0
+        self.guard = guard
+        if guard is not None and hasattr(net, "set_training_guard"):
+            net.set_training_guard(guard)
+        if preemption is True:
+            preemption = PreemptionHandler(registry=registry).install()
+        self.preemption: Optional[PreemptionHandler] = preemption
+        self.step_deadline_s = step_deadline_s
+        self._registry = registry
+        self.restarts = 0              # cumulative (reporting)
+        self.consecutive_failures = 0  # gates max_restarts
+        self.preempted = False
 
     def _maybe_checkpoint(self) -> None:
         if self.net.iteration_count % self.checkpoint_frequency == 0:
             self.manager.save(self.net)
 
-    def fit(self, iterator, epochs: int = 1) -> None:
+    def _stop_requested(self) -> bool:
+        return (self.preemption is not None
+                and self.preemption.stop_requested())
+
+    def _checkpoint_and_yield(self) -> bool:
+        """Preemption exit: persist a resumable checkpoint, flush the
+        writer, report not-completed."""
+        self.preempted = True
+        self.manager.save(self.net)
+        self.manager.wait()
+        log.warning("preemption: checkpointed at iteration %d and "
+                    "stopping (resumable — rerun fit to continue)",
+                    self.net.iteration_count)
+        return False
+
+    def _recover(self, err: RuntimeError) -> None:
+        """One failure: count it, restore the last good checkpoint,
+        apply LR backoff on divergence rollbacks, or re-raise when the
+        consecutive budget is exhausted."""
+        self.restarts += 1
+        self.consecutive_failures += 1
+        if self.consecutive_failures > self.max_restarts:
+            raise err
+        log.warning(
+            "step failed (%s); restoring last checkpoint "
+            "(consecutive failure %d/%d, %d total)", err,
+            self.consecutive_failures, self.max_restarts, self.restarts)
+        if self.manager.restore(self.net) is None:
+            log.warning("no checkpoint yet; retrying from current "
+                        "params")
+        if isinstance(err, DivergenceError) and self.guard is not None:
+            self.guard.apply_lr_backoff(self.net)
+
+    def fit(self, iterator, epochs: int = 1) -> bool:
+        """Train; True when all epochs completed, False when a
+        preemption stop was honored (checkpoint written; call fit
+        again to resume — the iteration count continues)."""
         if not self.net._initialized:
             self.net.init()
+        self.preempted = False
         restored = self.manager.restore(self.net)
         if restored is not None:
             log.info("resumed from checkpoint step %d", restored)
+        watchdog = None
+        if self.step_deadline_s is not None:
+            watchdog = StepWatchdog(self.step_deadline_s,
+                                    registry=self._registry).start()
         from deeplearning4j_tpu.nn.multilayer import _unpack_batch
-        for _ in range(epochs):
-            for batch in iterator:
-                feats, labs, fmask, lmask = _unpack_batch(batch)
-                while True:
-                    try:
-                        if self.fault_injector is not None:
-                            self.fault_injector.check(
-                                self.net.iteration_count)
-                        self.net.fit(feats, labs,
-                                     lmask if lmask is not None else fmask)
-                        break
-                    except RuntimeError as e:
-                        self.restarts += 1
-                        if self.restarts > self.max_restarts:
-                            raise
-                        log.warning(
-                            "step failed (%s); restoring last checkpoint "
-                            "(restart %d/%d)", e, self.restarts,
-                            self.max_restarts)
-                        if self.manager.restore(self.net) is None:
-                            log.warning("no checkpoint yet; retrying from "
-                                        "current params")
-                self._maybe_checkpoint()
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+        try:
+            for _ in range(epochs):
+                for batch in iterator:
+                    feats, labs, fmask, lmask = _unpack_batch(batch)
+                    it = self.net.iteration_count
+                    if self.fault_injector is not None \
+                            and self.fault_injector.check_preempt(it) \
+                            and self.preemption is not None:
+                        self.preemption.request_stop()
+                    if self._stop_requested():
+                        return self._checkpoint_and_yield()
+                    while True:
+                        try:
+                            # per-attempt view: a NaN-poisoned batch
+                            # must not stay poisoned across the retry
+                            # after a rollback restore
+                            step_feats = feats
+                            if self.fault_injector is not None:
+                                self.fault_injector.check(
+                                    self.net.iteration_count)
+                                if self.fault_injector.check_nan(
+                                        self.net.iteration_count):
+                                    import numpy as np
+                                    step_feats = (np.asarray(feats)
+                                                  * np.float32("nan"))
+                            if watchdog is not None:
+                                watchdog.arm(self.net.iteration_count)
+                            self.net.fit(step_feats, labs,
+                                         lmask if lmask is not None
+                                         else fmask)
+                            self.consecutive_failures = 0
+                            break
+                        except RuntimeError as e:
+                            self._recover(e)
+                        finally:
+                            if watchdog is not None:
+                                watchdog.disarm()
+                    self._maybe_checkpoint()
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                if self._stop_requested():
+                    return self._checkpoint_and_yield()
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         self.manager.save(self.net)
+        self.manager.wait()
+        return True

@@ -669,6 +669,13 @@ class InferenceEngine:
         last_err: Optional[BaseException] = None
         for s in steps:
             try:
+                # checksum-verify the manifest BEFORE deserializing onto
+                # the mesh: a torn/corrupted step (zip-valid but wrong
+                # bytes) must never swap in — serving stays on the
+                # current weights and falls back to an older step
+                if hasattr(mgr, "verify_step") and not mgr.verify_step(s):
+                    raise RuntimeError(
+                        f"step {s} failed checksum verification")
                 tree = mgr.restore_tree(self._params, step=s)
             except Exception as e:           # corrupt / partial step dir
                 last_err = e

@@ -24,3 +24,8 @@ from deeplearning4j_tpu.train.listeners import (  # noqa: F401
     ComposableIterationListener,
     ParamAndGradientIterationListener,
 )
+from deeplearning4j_tpu.train.guard import (  # noqa: F401
+    DivergenceError,
+    TrainingGuard,
+    TrainingGuardListener,
+)

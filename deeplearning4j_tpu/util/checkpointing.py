@@ -11,6 +11,33 @@ coordinates across hosts, versioned step directories, retention.
 `CheckpointManager` wraps orbax when available and falls back to the
 npz serializer otherwise; `CheckpointListener` snapshots every N
 iterations from inside the normal listener stream.
+
+Durability model of the npz path (the orbax path inherits orbax's own
+guarantees):
+
+- **Atomic publication.** Every `step_<N>` is written into a
+  `step_<N>.tmp` staging directory, each file fsynced, then published
+  with one `os.replace` (+ parent-dir fsync) — `all_steps()` /
+  `latest_step()` can never observe a half-written step. Orphaned
+  `.tmp` staging dirs from a mid-write kill are swept at startup.
+- **Integrity manifest.** `manifest.json` records a CRC32 + shape +
+  dtype per stored array and the payload tree structure. Restore
+  verifies the checksum of every array it reads; a mismatch raises
+  `CheckpointCorruptError`, which the `step=None` restore path treats
+  like any unreadable step — it falls through to the next older
+  verified step. A template leaf absent from the manifest fails with
+  an explicit tree-structure-mismatch message.
+- **Async saves.** `async_save=True` snapshots the payload to host
+  memory synchronously (the only work on the step loop's critical
+  path) and performs CRC + fsync + rename on a single background
+  writer thread, bounded to one write in flight. Write errors are
+  surfaced on the next `save()` (or `wait()`); atomic publication
+  means `latest_step()` never points at the in-flight write.
+
+Metrics (`observability` registry, injectable via ``registry=``):
+`checkpoint_write_seconds`, `checkpoint_save_stall_seconds`,
+`checkpoint_saves_total{mode}`, `checkpoint_verify_failures_total`,
+`checkpoint_async_pending`.
 """
 from __future__ import annotations
 
@@ -19,6 +46,8 @@ import logging
 import os
 import re
 import shutil
+import zlib
+from concurrent.futures import Future, ThreadPoolExecutor
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -29,6 +58,7 @@ import jax
 # same-width integer container for dtypes numpy can't round-trip via npz
 _UINT_OF_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32}
 
+from deeplearning4j_tpu.observability.metrics import default_registry
 from deeplearning4j_tpu.train.listeners import IterationListener
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -39,59 +69,270 @@ try:
 except ImportError:  # pragma: no cover
     HAVE_ORBAX = False
 
+MANIFEST_VERSION = 1
+_TMP_SUFFIX = ".tmp"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A step directory failed checksum/structure verification."""
+
+
+def _fsync_path(path: Path) -> None:
+    """fsync a file or directory; best-effort on platforms/filesystems
+    that refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
 
 class CheckpointManager:
     """Save/restore (params, state, updater_state, iteration) for a
     network. Orbax path: async multi-host-safe array checkpointing.
-    Fallback: npz files. Either way, directory layout is
-    `<root>/step_<N>/` with `latest` resolution and retention."""
+    Fallback: npz files with atomic publication + CRC32 manifests.
+    Either way, directory layout is `<root>/step_<N>/` with `latest`
+    resolution and retention.
+
+    ``async_save=True`` moves the npz write (CRC, fsync, rename) off
+    the caller's thread — `save()` only pays the host-snapshot cost.
+    With orbax, the same flag defers `wait_until_finished()` to
+    `wait()` so orbax's native async pipeline overlaps the step loop.
+
+    ``fault_injector`` (tests) receives `on_checkpoint_write(step,
+    staging_dir)` before publication and `on_checkpoint_published(step,
+    final_dir)` after — the torn-write / mid-write-crash hooks of
+    `parallel.failure.FaultInjector`.
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None,
+                 async_save: bool = False,
+                 fault_injector=None,
+                 registry=None):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self.use_orbax = HAVE_ORBAX if use_orbax is None else use_orbax
+        self.async_save = bool(async_save)
+        self.fault_injector = fault_injector
+        reg = registry if registry is not None else default_registry()
+        self._m_write = reg.histogram(
+            "checkpoint_write_seconds",
+            "Disk time of one checkpoint write (CRC+fsync+rename)")
+        self._m_stall = reg.histogram(
+            "checkpoint_save_stall_seconds",
+            "Time save() blocked its caller (async: snapshot only)")
+        self._m_saves = reg.counter(
+            "checkpoint_saves_total", "Completed checkpoint saves",
+            labelnames=("mode",))
+        self._m_verify_fail = reg.counter(
+            "checkpoint_verify_failures_total",
+            "Array checksum / structure verification failures on read")
+        self._m_pending = reg.gauge(
+            "checkpoint_async_pending",
+            "Async checkpoint writes currently in flight")
+        # single background writer; bounded to ONE in-flight write
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._inflight: Optional[Future] = None
+        self._async_error: Optional[BaseException] = None
         self._ocp_mgr = None
         if self.use_orbax:
             self._ocp_mgr = ocp.CheckpointManager(
                 self.directory.resolve(),
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep, create=True))
+        else:
+            self._sweep_orphans()
+
+    # -- orphan staging dirs ----------------------------------------------
+    def _sweep_orphans(self) -> None:
+        """Remove `step_<N>.tmp` staging dirs left by a mid-write kill.
+        Only ever unpublished garbage: a completed write has already
+        been renamed away from the .tmp name."""
+        for p in self.directory.glob(f"step_*{_TMP_SUFFIX}"):
+            log.warning("sweeping orphaned checkpoint staging dir %s "
+                        "(previous writer died mid-write)", p.name)
+            shutil.rmtree(p, ignore_errors=True)
 
     # -- payload plumbing (shared by net- and tree-level APIs) -------------
-    def _write_payload(self, payload: Dict, step: int) -> None:
-        if self.use_orbax:
-            self._ocp_mgr.save(step, args=ocp.args.StandardSave(payload))
-            self._ocp_mgr.wait_until_finished()
+    def _write_payload(self, payload: Dict, step: int,
+                       meta: Optional[Dict] = None) -> None:
+        with self._m_stall.time():
+            if self.use_orbax:
+                self._ocp_mgr.save(step, args=ocp.args.StandardSave(payload))
+                if self.async_save:
+                    self._m_saves.labels("orbax_async").inc()
+                else:
+                    self._ocp_mgr.wait_until_finished()
+                    self._m_saves.labels("orbax").inc()
+                if meta is not None:
+                    self._write_meta(meta, step)
+                return
+            # Host snapshot: the one synchronous cost of an async save.
+            # np.asarray materializes device arrays on host; exotic
+            # dtypes (bf16/fp8) go to same-width uints + a sidecar so
+            # np.load round-trips exactly.
+            flat: Dict[str, np.ndarray] = {}
+            exotic: Dict[str, str] = {}
+            for k, tree in payload.items():
+                leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+                for path, leaf in leaves:
+                    name = k + "|" + "/".join(
+                        str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+                    a = np.asarray(leaf)
+                    if not hasattr(np, a.dtype.name):
+                        exotic[name] = a.dtype.name
+                        a = a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
+                    flat[name] = a
+            if not self.async_save:
+                self._write_npz(flat, exotic, int(step), meta)
+                return
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix="ckpt-writer")
+            if self._inflight is not None:     # bound: 1 write in flight
+                self._await_inflight()
+            self._surface_async_error()
+            self._m_pending.set(1)
+            self._inflight = self._executor.submit(
+                self._write_npz, flat, exotic, int(step), meta)
+
+    def _write_npz(self, flat: Dict[str, np.ndarray],
+                   exotic: Dict[str, str], step: int,
+                   meta: Optional[Dict]) -> None:
+        """CRC + stage + fsync + atomic publish of one step (runs on
+        the writer thread in async mode)."""
+        with self._m_write.time():
+            manifest = {
+                "version": MANIFEST_VERSION,
+                "step": step,
+                "arrays": {
+                    name: {"crc32": _crc(a), "shape": list(a.shape),
+                           "dtype": str(a.dtype),
+                           "stored_dtype": exotic.get(name)}
+                    for name, a in flat.items()},
+            }
+            final = self.directory / f"step_{step}"
+            tmp = self.directory / f"step_{step}{_TMP_SUFFIX}"
+            if tmp.exists():
+                shutil.rmtree(tmp, ignore_errors=True)
+            tmp.mkdir(parents=True)
+            np.savez(tmp / "arrays.npz", **flat)
+            (tmp / "dtypes.json").write_text(json.dumps(exotic))
+            (tmp / "manifest.json").write_text(json.dumps(manifest))
+            for f in ("arrays.npz", "dtypes.json", "manifest.json"):
+                _fsync_path(tmp / f)
+            _fsync_path(tmp)
+            if self.fault_injector is not None and hasattr(
+                    self.fault_injector, "on_checkpoint_write"):
+                self.fault_injector.on_checkpoint_write(step, tmp)
+            if final.exists():
+                shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            _fsync_path(self.directory)
+            if self.fault_injector is not None and hasattr(
+                    self.fault_injector, "on_checkpoint_published"):
+                self.fault_injector.on_checkpoint_published(step, final)
+            if meta is not None:
+                self._write_meta(meta, step)
+            self._retain()
+        self._m_saves.labels(
+            "async" if self.async_save else "sync").inc()
+
+    def _write_meta(self, meta: Dict, step: int) -> None:
+        """meta_<N>.json, atomically (tmp + replace) so a torn meta
+        can't shadow a good step dir."""
+        final = self.directory / f"meta_{step}.json"
+        tmp = self.directory / f"meta_{step}.json{_TMP_SUFFIX}"
+        tmp.write_text(json.dumps(meta))
+        _fsync_path(tmp)
+        os.replace(tmp, final)
+
+    # -- async bookkeeping -------------------------------------------------
+    def _await_inflight(self) -> None:
+        fut, self._inflight = self._inflight, None
+        if fut is None:
             return
+        try:
+            fut.result()
+        except BaseException as e:   # surfaced on the NEXT save / wait
+            self._async_error = e
+        finally:
+            self._m_pending.set(0)
+
+    def _surface_async_error(self) -> None:
+        if self._async_error is not None:
+            e, self._async_error = self._async_error, None
+            raise RuntimeError(
+                "previous async checkpoint write failed") from e
+
+    def wait(self) -> None:
+        """Join any in-flight async write; raises if it (or a previous
+        one) failed. Call at step-loop exit / before reading back."""
+        if self.use_orbax and self._ocp_mgr is not None:
+            self._ocp_mgr.wait_until_finished()
+        self._await_inflight()
+        self._surface_async_error()
+
+    # -- read-side verification --------------------------------------------
+    def _load_manifest(self, step: int) -> Optional[Dict]:
+        p = self.directory / f"step_{step}" / "manifest.json"
+        if not p.exists():      # pre-manifest checkpoint: legacy-readable
+            return None
+        return json.loads(p.read_text())
+
+    def verify_step(self, step: int) -> bool:
+        """Full-step integrity check: every manifest array present in
+        arrays.npz with a matching CRC32 (and nothing extra). Legacy
+        steps without a manifest verify by readability alone. Failures
+        bump `checkpoint_verify_failures_total`."""
+        if self.use_orbax:
+            return int(step) in self.all_steps()
         d = self.directory / f"step_{step}"
-        d.mkdir(parents=True, exist_ok=True)
-        flat = {}
-        exotic: Dict[str, str] = {}
-        for k, tree in payload.items():
-            leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-            for path, leaf in leaves:
-                name = k + "|" + "/".join(
-                    str(getattr(p, "key", getattr(p, "idx", p)))
-                    for p in path)
-                a = np.asarray(leaf)
-                # np.load returns raw void for ml_dtypes dtypes
-                # (bf16/fp8); persist them as same-width uints plus a
-                # dtype sidecar so the round-trip is exact.
-                if not hasattr(np, a.dtype.name):
-                    exotic[name] = a.dtype.name
-                    a = a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
-                flat[name] = a
-        np.savez(d / "arrays.npz", **flat)
-        (d / "dtypes.json").write_text(json.dumps(exotic))
-        self._retain()
+        try:
+            manifest = self._load_manifest(int(step))
+            with np.load(d / "arrays.npz") as data:
+                if manifest is None:
+                    for name in data.files:    # readability probe
+                        data[name]
+                    return True
+                arrays = manifest["arrays"]
+                if set(arrays) != set(data.files):
+                    raise CheckpointCorruptError(
+                        f"step {step}: manifest lists "
+                        f"{len(arrays)} arrays, npz holds "
+                        f"{len(data.files)}")
+                for name, m in arrays.items():
+                    if _crc(data[name]) != m["crc32"]:
+                        raise CheckpointCorruptError(
+                            f"step {step}: checksum mismatch for "
+                            f"{name!r}")
+            return True
+        except Exception as e:
+            self._m_verify_fail.inc()
+            log.warning("checkpoint step_%s failed verification: %s",
+                        step, e)
+            return False
 
     def _read_payload(self, template: Dict, step: int) -> Dict:
         if self.use_orbax:
             return self._ocp_mgr.restore(
                 step, args=ocp.args.StandardRestore(template))
         d = self.directory / f"step_{step}"
+        manifest = self._load_manifest(step)
+        man_arrays = manifest["arrays"] if manifest else None
         data = np.load(d / "arrays.npz")
         exotic: Dict[str, str] = {}
         if (d / "dtypes.json").exists():
@@ -104,7 +345,24 @@ class CheckpointManager:
                 name = k + "|" + "/".join(
                     str(getattr(p, "key", getattr(p, "idx", p)))
                     for p in path)
-                a = data[name]
+                if man_arrays is not None and name not in man_arrays:
+                    raise CheckpointCorruptError(
+                        f"checkpoint tree-structure mismatch: template "
+                        f"leaf {name!r} is not in step {step}'s "
+                        f"manifest ({len(man_arrays)} arrays: "
+                        f"{sorted(man_arrays)[:4]}...)")
+                try:
+                    a = data[name]
+                except KeyError:
+                    raise CheckpointCorruptError(
+                        f"checkpoint tree-structure mismatch: template "
+                        f"leaf {name!r} is not stored in step {step}")
+                if man_arrays is not None \
+                        and _crc(a) != man_arrays[name]["crc32"]:
+                    self._m_verify_fail.inc()
+                    raise CheckpointCorruptError(
+                        f"checksum mismatch for {name!r} in step {step} "
+                        "(torn or corrupted write)")
                 if name in exotic:
                     a = a.view(getattr(ml_dtypes, exotic[name]))
                 vals.append(jax.numpy.asarray(a))
@@ -117,11 +375,10 @@ class CheckpointManager:
         step = int(net.iteration_count if step is None else step)
         payload = {"params": net.params, "state": net.state,
                    "updater_state": net.updater_state}
-        self._write_payload(payload, step)
         meta = {"step": step,
                 "iteration_count": int(net.iteration_count),
                 "epoch_count": int(net.epoch_count)}
-        (self.directory / f"meta_{step}.json").write_text(json.dumps(meta))
+        self._write_payload(payload, step, meta=meta)
         return step
 
     def _retain(self) -> None:
@@ -151,11 +408,12 @@ class CheckpointManager:
     def _resolve_readable(self, template: Dict,
                           step: Optional[int]):
         """Read the requested step, or — when ``step`` is None — the
-        NEWEST readable one: a corrupt/partial `step_<N>` directory
-        (killed mid-write, torn copy) logs a warning and falls back to
-        the previous good step instead of failing restore outright. An
-        explicitly requested step still fails hard. Returns
-        (payload, step) or (None, None) when no checkpoint exists."""
+        NEWEST readable AND verified one: a corrupt/partial/checksum-
+        failing `step_<N>` directory (killed mid-write, torn copy, bit
+        rot) logs a warning and falls back to the previous good step
+        instead of failing restore outright. An explicitly requested
+        step still fails hard. Returns (payload, step) or (None, None)
+        when no checkpoint exists."""
         steps = ([int(step)] if step is not None
                  else list(reversed(self.all_steps())))
         last_err: Optional[BaseException] = None
@@ -177,7 +435,9 @@ class CheckpointManager:
     def restore(self, net, step: Optional[int] = None):
         """Restore in place; returns the step restored from (None if no
         checkpoint exists). With step=None a corrupt newest step falls
-        back to the previous good one (_resolve_readable)."""
+        back to the previous good one (_resolve_readable). Joins any
+        in-flight async write first so the newest step is findable."""
+        self.wait()
         template = {"params": net.params, "state": net.state,
                     "updater_state": net.updater_state}
         restored, step = self._resolve_readable(template, step)
@@ -217,6 +477,7 @@ class CheckpointManager:
         sharded template re-places each leaf into its shards (orbax), so
         a job can resume on a different mesh layout by passing the new
         mesh's template. Returns None if no checkpoint exists."""
+        self.wait()
         payload, step = self._resolve_readable({"tree": template}, step)
         if payload is None:
             return None

@@ -11,7 +11,8 @@ from deeplearning4j_tpu.nn.layers.output import OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.parallel.failure import (FaultInjector,
                                                  FaultTolerantTrainer)
-from deeplearning4j_tpu.util.checkpointing import (CheckpointListener,
+from deeplearning4j_tpu.util.checkpointing import (CheckpointCorruptError,
+                                                   CheckpointListener,
                                                    CheckpointManager)
 
 
@@ -173,6 +174,125 @@ def test_restore_all_steps_corrupt_raises(tmp_path):
     (mgr.directory / "step_1" / "arrays.npz").unlink()
     with pytest.raises(RuntimeError, match="no readable checkpoint"):
         mgr.restore(net)
+
+
+def test_manifest_written_and_atomic_layout(tmp_path):
+    """Every published step carries a CRC32 manifest; no staging dirs
+    survive a clean save; meta is published atomically alongside."""
+    import json
+
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+    d = mgr.directory / "step_1"
+    man = json.loads((d / "manifest.json").read_text())
+    assert man["step"] == 1
+    assert len(man["arrays"]) > 0
+    for m in man["arrays"].values():
+        assert isinstance(m["crc32"], int)
+    assert not list(mgr.directory.glob("*.tmp"))
+    assert mgr.verify_step(1) is True
+
+
+def test_restore_falls_back_on_checksum_mismatch(tmp_path):
+    """Zip-VALID corruption (zeroed bytes, same names/shapes): np.load
+    succeeds, only the manifest CRC catches it; restore(step=None)
+    falls through to the older verified step."""
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    good = np.asarray(net.params_flat())
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(net, step=1)
+    net.fit(x, y)
+    mgr.save(net, step=2)
+    p = mgr.directory / "step_2" / "arrays.npz"
+    with np.load(p) as data:
+        zeroed = {k: np.zeros_like(data[k]) for k in data.files}
+    np.savez(p, **zeroed)                     # valid zip, wrong bytes
+
+    assert mgr.verify_step(2) is False
+    net2 = _net(seed=9)
+    assert mgr.restore(net2) == 1
+    np.testing.assert_allclose(np.asarray(net2.params_flat()), good,
+                               atol=1e-7)
+    # an explicit request for the corrupt step fails hard with the
+    # checksum diagnosis
+    with pytest.raises(CheckpointCorruptError, match="checksum mismatch"):
+        mgr.restore(_net(), step=2)
+
+
+def test_restore_tree_structure_mismatch_message(tmp_path):
+    """A template leaf the checkpoint never stored fails with an
+    explicit tree-structure-mismatch error naming the leaf."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save_tree({"w": jnp.arange(4.0)}, 1)
+    with pytest.raises(CheckpointCorruptError,
+                       match="tree-structure mismatch.*extra"):
+        mgr.restore_tree({"w": jnp.zeros(4), "extra": jnp.zeros(2)},
+                         step=1)
+
+
+def test_orphaned_tmp_dirs_swept_on_startup(tmp_path):
+    root = tmp_path / "ckpt"
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    mgr = CheckpointManager(str(root), use_orbax=False)
+    mgr.save(net, step=1)
+    orphan = root / "step_2.tmp"
+    orphan.mkdir()
+    (orphan / "arrays.npz").write_bytes(b"partial")
+    mgr2 = CheckpointManager(str(root), use_orbax=False)
+    assert not orphan.exists()
+    assert mgr2.all_steps() == [1]            # orphan never a step
+
+
+def test_async_save_ordering_and_byte_identical_restore(tmp_path):
+    """latest_step never points at the in-flight async write (atomic
+    publication), and the restored params are byte-identical to the
+    snapshot taken at save() time."""
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    inj = FaultInjector(write_delay_s=0.25)   # slow writer
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False,
+                            async_save=True, fault_injector=inj)
+    mgr.save(net, step=1)
+    mgr.wait()
+    net.fit(x, y)
+    flat_at_save = np.asarray(net.params_flat()).tobytes()
+    mgr.save(net, step=2)                     # returns before the write
+    assert mgr.latest_step() == 1             # in-flight step invisible
+    net.fit(x, y)                             # caller keeps training
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    net2 = _net(seed=9)
+    assert mgr.restore(net2) == 2
+    assert np.asarray(net2.params_flat()).tobytes() == flat_at_save
+
+
+def test_async_write_error_surfaces_on_next_save(tmp_path):
+    net = _net()
+    x, y = _data()
+    net.fit(x, y)
+    inj = FaultInjector(crash_write_at=[2])
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False,
+                            async_save=True, fault_injector=inj)
+    mgr.save(net, step=1)
+    mgr.save(net, step=2)                     # background write dies
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        mgr.save(net, step=3)                 # surfaced here, step 3
+    mgr.wait()                                # not submitted
+    assert mgr.all_steps() == [1]             # crash never published 2
+    # the surfaced error is one-shot: the manager keeps working
+    mgr.save(net, step=4)
+    mgr.wait()
+    assert mgr.all_steps() == [1, 4]
 
 
 def test_restore_casts_legacy_bf16_updater_state(tmp_path):

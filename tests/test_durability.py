@@ -1,0 +1,395 @@
+"""Training durability suite (ISSUE-3): divergence guard, preemption-
+safe resume, hung-step watchdog, and the torn-checkpoint /
+NaN-injection / simulated-preemption knobs of FaultInjector — every
+long-TPU-run killer exercised deterministically on the CPU mesh."""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterators import BaseDatasetIterator
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import MetricsRegistry, prometheus_text
+from deeplearning4j_tpu.parallel.failure import (FaultInjector,
+                                                 FaultTolerantTrainer,
+                                                 PreemptionHandler,
+                                                 StepWatchdog,
+                                                 TrainingFailure)
+from deeplearning4j_tpu.train.guard import (DivergenceError, TrainingGuard,
+                                            TrainingGuardListener)
+
+
+def _net(seed=1, lr=0.01):
+    conf = NeuralNetConfiguration(seed=seed, updater="adam",
+                                  learning_rate=lr).list(
+        DenseLayer(n_in=6, n_out=12, activation="tanh"),
+        OutputLayer(n_out=2, activation="softmax", loss_function="mcxent"))
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return x, y
+
+
+def _iter(x, y, batch=16):
+    return BaseDatasetIterator(x, y, batch)
+
+
+# ---------------------------------------------------------------------------
+# TrainingGuard policy unit behavior
+# ---------------------------------------------------------------------------
+
+def test_guard_accepts_normal_steps_and_tracks_ema():
+    g = TrainingGuard(registry=MetricsRegistry())
+    for s in (1.0, 0.9, 0.8):
+        assert g.update(s, 0.5) == TrainingGuard.ACCEPT
+    assert g.consecutive_bad == 0
+    assert 0.8 < g.score_ema <= 1.0
+
+
+def test_guard_skips_then_rolls_back_on_consecutive_bad():
+    g = TrainingGuard(max_consecutive=3, registry=MetricsRegistry())
+    g.update(1.0, 0.5)
+    assert g.update(float("nan"), 0.5) == TrainingGuard.SKIP
+    assert g.update(1.0, float("inf")) == TrainingGuard.SKIP
+    assert g.update(float("nan"), 0.5) == TrainingGuard.ROLLBACK
+    assert g.rollbacks == 1
+    # rollback resets the consecutive counter
+    assert g.update(float("nan"), 0.5) == TrainingGuard.SKIP
+
+
+def test_guard_spike_detection_after_warmup():
+    g = TrainingGuard(warmup_steps=3, spike_factor=3.0,
+                      registry=MetricsRegistry())
+    # during warmup a spike is accepted (no trend to compare against)
+    assert g.update(5.0) == TrainingGuard.ACCEPT
+    for _ in range(4):
+        assert g.update(1.0) == TrainingGuard.ACCEPT
+    assert g.update(50.0) == TrainingGuard.SKIP
+    assert g.last_reason == "score_spike"
+    # a success resets the streak
+    assert g.update(1.0) == TrainingGuard.ACCEPT
+    assert g.consecutive_bad == 0
+
+
+def test_guard_validates_config():
+    with pytest.raises(ValueError, match="ema_beta"):
+        TrainingGuard(ema_beta=1.5, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="spike_factor"):
+        TrainingGuard(spike_factor=0.5, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="lr_backoff"):
+        TrainingGuard(lr_backoff=0.0, registry=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# guarded train step: on-device protection + skip semantics
+# ---------------------------------------------------------------------------
+
+def test_guarded_step_keeps_params_finite_through_nan_batch():
+    """One NaN-poisoned batch under the guard: the update is discarded
+    (pre-step params kept bit-exact) and training continues finite."""
+    x, y = _data(16)
+    net = _net()
+    net.fit(x, y)
+    net.set_training_guard(TrainingGuard(registry=MetricsRegistry()))
+    before = np.asarray(net.params_flat())
+    it_before = net.iteration_count
+    net.fit(x * np.float32("nan"), y)
+    after = np.asarray(net.params_flat())
+    np.testing.assert_array_equal(before, after)
+    assert np.all(np.isfinite(after))
+    # the iteration counter still advanced past the skipped step
+    assert net.iteration_count == it_before + 1
+    assert not np.isfinite(net.last_grad_norm)
+    net.fit(x, y)                       # training continues normally
+    assert np.isfinite(net.score(x, y))
+
+
+def test_guarded_fit_matches_unguarded_on_clean_data():
+    """The guarded step is the same math: identical params after
+    identical clean batches (guard only adds the gnorm/commit layer)."""
+    x, y = _data(32)
+    a, b = _net(seed=3), _net(seed=3)
+    b.set_training_guard(TrainingGuard(registry=MetricsRegistry()))
+    for _ in range(3):
+        a.fit(x, y)
+        b.fit(x, y)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=1e-7)
+
+
+def test_guard_listener_aborts_plain_fit_on_divergence():
+    """Listener mode (no guarded step): detect-and-abort after K
+    consecutive bad scores in a vanilla net.fit loop."""
+    x, y = _data(16)
+    net = _net()
+    net.set_listeners(TrainingGuardListener(
+        guard=TrainingGuard(max_consecutive=2,
+                            registry=MetricsRegistry())))
+    bad = x * np.float32("nan")
+    net.fit(bad, y)                     # skip 1 (logged only)
+    with pytest.raises(DivergenceError, match="diverged"):
+        net.fit(bad, y)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: injected NaN skipped, run converges, metrics visible
+# ---------------------------------------------------------------------------
+
+def test_nan_injection_skipped_and_run_converges(tmp_path):
+    x, y = _data(96, seed=2)
+    reg = MetricsRegistry()
+    guard = TrainingGuard(registry=reg)
+    inj = FaultInjector(nan_at=[3])
+    net = _net(seed=3)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=2,
+                                   fault_injector=inj, use_orbax=False,
+                                   guard=guard, registry=reg)
+    assert trainer.fit(_iter(x, y), epochs=2) is True
+    assert inj.nans_injected == 1
+    assert np.isfinite(net.score(x, y))
+    assert np.all(np.isfinite(np.asarray(net.params_flat())))
+    # the guard's decisions are scrapeable at /metrics
+    text = prometheus_text(reg)
+    assert 'training_guard_events_total{action="skip"} 1' in text
+    assert 'training_guard_events_total{action="accept"}' in text
+
+
+def test_consecutive_nans_roll_back_with_lr_backoff(tmp_path):
+    x, y = _data(96, seed=4)
+    guard = TrainingGuard(max_consecutive=2, lr_backoff=0.5,
+                          registry=MetricsRegistry())
+    inj = FaultInjector(nan_at=[4, 5])
+    net = _net(seed=5)
+    lr0 = net.conf.training.learning_rate
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=2,
+                                   fault_injector=inj, use_orbax=False,
+                                   guard=guard, max_restarts=3)
+    assert trainer.fit(_iter(x, y), epochs=2) is True
+    assert guard.rollbacks == 1
+    assert net.conf.training.learning_rate == pytest.approx(0.5 * lr0)
+    assert trainer.restarts == 1        # the rollback counted once
+    assert trainer.consecutive_failures == 0
+    assert np.isfinite(net.score(x, y))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: torn checkpoint write never corrupts restore
+# ---------------------------------------------------------------------------
+
+def test_crash_mid_write_resume_from_previous_verified_step(tmp_path):
+    """Kill mid-checkpoint-write (via injector): the run dies with an
+    orphaned staging dir; a fresh trainer on the same directory sweeps
+    it, restores the previous VERIFIED step, and completes."""
+    x, y = _data(96, seed=6)
+    inj = FaultInjector(crash_write_at=[4])
+    net = _net(seed=7)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=2,
+                                   fault_injector=inj, use_orbax=False,
+                                   max_restarts=0)
+    with pytest.raises(TrainingFailure, match="crash during checkpoint"):
+        trainer.fit(_iter(x, y), epochs=2)
+    assert (tmp_path / "ckpt" / "step_4.tmp").exists()
+    assert trainer.manager.latest_step() == 2   # partial never published
+
+    net2 = _net(seed=8)
+    trainer2 = FaultTolerantTrainer(net2, str(tmp_path / "ckpt"),
+                                    checkpoint_frequency=2,
+                                    use_orbax=False)
+    # the orphan is swept at manager construction
+    assert not (tmp_path / "ckpt" / "step_4.tmp").exists()
+    assert trainer2.fit(_iter(x, y), epochs=2) is True
+    # resumed from step 2, so the counter moved monotonically past it
+    assert net2.iteration_count > 2
+    assert np.isfinite(net2.score(x, y))
+
+
+def test_torn_write_falls_back_to_previous_verified_step(tmp_path):
+    """Post-publication corruption (zip-valid zeroed arrays): only the
+    checksum manifest can detect it; restore falls back."""
+    x, y = _data(96, seed=8)
+    inj = FaultInjector(torn_write_at=[4])
+    net = _net(seed=9)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=2,
+                                   fault_injector=inj, use_orbax=False)
+    assert trainer.fit(_iter(x, y), epochs=1) is True
+    assert inj.writes_torn == 1
+    mgr = trainer.manager
+    assert mgr.verify_step(4) is False
+    assert mgr.verify_step(2) is True
+    net2 = _net(seed=10)
+    restored = mgr.restore(net2)
+    assert restored is not None and restored != 4
+    assert np.all(np.isfinite(np.asarray(net2.params_flat())))
+    assert np.any(np.asarray(net2.params_flat()) != 0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: preemption -> resumable checkpoint -> monotonic resume
+# ---------------------------------------------------------------------------
+
+def test_simulated_preemption_mid_epoch_is_resumable(tmp_path):
+    x, y = _data(96, seed=10)
+    ph = PreemptionHandler(registry=MetricsRegistry())  # flag-only use
+    inj = FaultInjector(preempt_at=[4])
+    net = _net(seed=11)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=100,
+                                   fault_injector=inj, use_orbax=False,
+                                   preemption=ph)
+    assert trainer.fit(_iter(x, y), epochs=2) is False
+    assert trainer.preempted
+    stop_iter = net.iteration_count
+    assert trainer.manager.latest_step() == stop_iter
+
+    # second fit continues from the checkpoint: iteration monotonic
+    ph.clear()
+    assert trainer.fit(_iter(x, y), epochs=1) is True
+    assert net.iteration_count > stop_iter
+    assert np.isfinite(net.score(x, y))
+
+
+@pytest.mark.skipif(os.name != "posix",
+                    reason="raise_signal/SIGTERM semantics need posix")
+def test_real_sigterm_checkpoints_and_stops(tmp_path):
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal handlers need the main thread")
+    x, y = _data(96, seed=12)
+    net = _net(seed=13)
+
+    class SignalingIterator:
+        """Raises a real SIGTERM in-process while the epoch runs."""
+
+        def __init__(self):
+            self.inner = _iter(x, y)
+            self.count = 0
+
+        def __iter__(self):
+            for b in self.inner:
+                self.count += 1
+                if self.count == 3:
+                    signal.raise_signal(signal.SIGTERM)
+                yield b
+
+        def reset(self):
+            self.inner.reset()
+
+    with PreemptionHandler(registry=MetricsRegistry()) as ph:
+        assert ph.installed
+        trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                       checkpoint_frequency=100,
+                                       use_orbax=False, preemption=ph)
+        assert trainer.fit(SignalingIterator(), epochs=2) is False
+        assert ph.signals_seen == 1
+        stop_iter = net.iteration_count
+        assert trainer.manager.latest_step() == stop_iter
+        ph.clear()
+        assert trainer.fit(_iter(x, y), epochs=1) is True
+        assert net.iteration_count > stop_iter
+    # handler uninstalled by the context manager
+    assert not ph.installed
+
+
+def test_preemption_handler_flag_only_off_main_thread():
+    """install() from a worker thread degrades to flag-only mode
+    instead of crashing (signal.signal is main-thread-only)."""
+    ph = PreemptionHandler(registry=MetricsRegistry())
+    out = {}
+
+    def worker():
+        out["handler"] = ph.install()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert out["handler"] is ph and not ph.installed
+    ph.request_stop()
+    assert ph.stop_requested()
+
+
+# ---------------------------------------------------------------------------
+# hung-step watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_flags_step_exceeding_deadline():
+    hung = []
+    reg = MetricsRegistry()
+    wd = StepWatchdog(0.05, on_hung=lambda i, e: hung.append(i),
+                      registry=reg).start()
+    try:
+        wd.arm(7)
+        time.sleep(0.2)
+        wd.disarm()
+    finally:
+        wd.stop()
+    assert wd.hung_iterations == [7] and hung == [7]
+    assert reg.get("watchdog_hung_steps_total").value == 1
+
+
+def test_watchdog_quiet_for_fast_steps():
+    wd = StepWatchdog(0.5, poll_s=0.01).start()
+    try:
+        for i in range(5):
+            wd.arm(i)
+            time.sleep(0.01)
+            wd.disarm()
+    finally:
+        wd.stop()
+    assert wd.hung_iterations == []
+
+
+def test_trainer_arms_watchdog_around_steps(tmp_path):
+    """step_deadline_s wires a watchdog through the trainer; fast CPU
+    steps never trip it and the thread is stopped at exit."""
+    x, y = _data(32)
+    net = _net()
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=100,
+                                   use_orbax=False, step_deadline_s=30.0)
+    assert trainer.fit(_iter(x, y), epochs=1) is True
+
+
+# ---------------------------------------------------------------------------
+# FaultTolerantTrainer consecutive-restart accounting (satellite)
+# ---------------------------------------------------------------------------
+
+def test_spaced_transient_faults_do_not_exhaust_budget(tmp_path):
+    """Regression (ISSUE-3 satellite): max_restarts bounds CONSECUTIVE
+    failures. 3 transient faults spread across a run with max_restarts=2
+    must complete — under the old cumulative accounting it aborted."""
+    x, y = _data(96, seed=14)
+    inj = FaultInjector(fail_at=[2, 5, 9])
+    net = _net(seed=15)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   checkpoint_frequency=2,
+                                   max_restarts=2, fault_injector=inj,
+                                   use_orbax=False)
+    assert trainer.fit(_iter(x, y), epochs=2) is True
+    assert inj.injected == 3
+    assert trainer.restarts == 3            # cumulative, for reporting
+    assert trainer.consecutive_failures == 0
+
+
+def test_persistent_fault_still_exhausts_consecutive_budget(tmp_path):
+    x, y = _data(32)
+    net = _net()
+    inj = FaultInjector(fail_at=[1], persistent=True)
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                   max_restarts=2, fault_injector=inj,
+                                   use_orbax=False)
+    with pytest.raises(RuntimeError):
+        trainer.fit(_iter(x, y))
+    assert trainer.consecutive_failures == 3   # the budget-breaker

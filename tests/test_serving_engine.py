@@ -396,6 +396,44 @@ def test_hot_reload_falls_back_past_corrupt_step(tmp_path, params,
     assert h.status == RequestStatus.COMPLETED
 
 
+def test_hot_reload_torn_write_keeps_serving_old_weights(
+        tmp_path, params, mesh1):
+    """ISSUE-3 satellite: a torn checkpoint write (zip-VALID zeroed
+    bytes — only the CRC32 manifest can tell) must never swap in.
+    Reload verifies the manifest first and falls back to the previous
+    verified step; with no verified step at all, the engine keeps
+    serving on its current weights."""
+    from deeplearning4j_tpu.parallel.failure import FaultInjector
+
+    inj = FaultInjector(torn_write_at=[2])
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False,
+                            fault_injector=inj)
+    mgr.save_tree(params, 1)
+    mgr.save_tree(jax.tree_util.tree_map(lambda a: a * 0, params), 2)
+    assert inj.writes_torn == 1
+
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    before = eng.submit(_prompt())
+    eng.run_pending()
+    # newest step is torn -> verified fallback to step 1 (== params)
+    assert eng.reload_weights(mgr) == 1
+    after = eng.submit(_prompt())
+    eng.run_pending()
+    np.testing.assert_array_equal(before.result(0), after.result(0))
+
+    # ALL steps torn: reload refuses, serving continues on old weights
+    inj2 = FaultInjector(torn_write_at=[7])
+    mgr2 = CheckpointManager(str(tmp_path / "w2"), use_orbax=False,
+                             fault_injector=inj2)
+    mgr2.save_tree(jax.tree_util.tree_map(lambda a: a * 0, params), 7)
+    with pytest.raises(RuntimeError, match="no readable checkpoint"):
+        eng.reload_weights(mgr2)
+    assert eng.health()["weights_step"] == 1      # unchanged
+    still = eng.submit(_prompt())
+    eng.run_pending()
+    np.testing.assert_array_equal(before.result(0), still.result(0))
+
+
 def test_hot_reload_empty_dir_raises(tmp_path, params, mesh1):
     eng = InferenceEngine(CFG, mesh1, params, _config())
     with pytest.raises(FileNotFoundError):
