@@ -176,7 +176,8 @@ def flagship_lines(which: str) -> None:
              "decode", "decode_long"]
     if which != "transformer":
         names += ["vgg16", "lstm", "word2vec", "engine_decode",
-                  "engine_decode_metrics", "ckpt_async"]
+                  "engine_decode_metrics", "engine_continuous",
+                  "ckpt_async"]
     for n in names:
         elapsed = time.monotonic() - _T0
         reps = 1 if elapsed > 0.6 * budget else 2

@@ -251,14 +251,29 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
 # for XLA: one compiled step regardless of position)
 # ---------------------------------------------------------------------------
 
+def slot_cache_shape(cfg: TransformerConfig, num_slots: int,
+                     max_len: Optional[int] = None
+                     ) -> Tuple[int, int, int, int]:
+    """Canonical slot-pool KV-cache geometry [L, num_slots, S, D] —
+    init_cache's batch axis generalized to a PERSISTENT slot axis:
+    continuous batching (serving/engine.py, parallel/serving.py
+    init_slot_state) keeps one such buffer pair resident on device
+    across decode chunks, admitting requests into and freeing slot
+    rows while the buffer never changes shape — no reallocation, no
+    recompile. Heads stay FLATTENED (D = H*Dh) for the same tiling
+    reasons as init_cache (the serving mesh additionally shards the
+    slot axis over 'data' and D over 'model')."""
+    return (cfg.n_layers, num_slots, max_len or cfg.max_len,
+            cfg.d_model)
+
+
 def init_cache(cfg: TransformerConfig, batch: int,
                max_len: Optional[int] = None) -> Tuple[Array, Array]:
     """Stacked per-layer KV caches [L, B, S, D] (k, v) — heads kept
     FLATTENED in the cache (D = H*Dh): the minor-most dims are then
     (S-tile, D=lane-full), a clean 2D tiling for the per-position
     dynamic_update_slice; views reshape to heads at the attention."""
-    s = max_len or cfg.max_len
-    shape = (cfg.n_layers, batch, s, cfg.d_model)
+    shape = slot_cache_shape(cfg, batch, max_len)
     dt = cfg.activation_dtype()
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 

@@ -27,8 +27,8 @@ Lifecycle, naming conventions and a scrape walkthrough:
 docs/observability.md.
 """
 from deeplearning4j_tpu.observability.metrics import (  # noqa: F401
-    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
-    NULL_REGISTRY, NullRegistry, default_registry)
+    DECODE_LATENCY_BUCKETS, DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+    MetricsRegistry, NULL_REGISTRY, NullRegistry, default_registry)
 from deeplearning4j_tpu.observability.tracing import (  # noqa: F401
     current_span, span, traced)
 from deeplearning4j_tpu.observability.export import (  # noqa: F401

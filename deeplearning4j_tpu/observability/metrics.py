@@ -43,6 +43,16 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0)
 
+# Compiled-call latency buckets (seconds) for serving prefill / decode
+# chunk histograms: those calls run milliseconds (chip) to tens of
+# seconds (CPU containers, cold traffic), so DEFAULT_BUCKETS — five of
+# whose fourteen edges sit below 10 ms — would pile every observation
+# into the top few cells. These trade the sub-ms resolution away for
+# an upper range that still separates a 10 s call from a 60 s one.
+DECODE_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 

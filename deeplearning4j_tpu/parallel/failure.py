@@ -169,17 +169,31 @@ class ServingFaultInjector(FaultInjector):
       - ``delay_at``: ``{step: seconds}`` one-shot host-side stalls
         injected before the step launches — drives deadline-miss
         scheduling deterministically without real overload.
+      - ``prefill_fail_at``: step indices at which a PREFILL call
+        fails (continuous batching: the engine's admission prefill and
+        its decode chunks share one step counter; this knob targets
+        only the prefill calls, so tests can poison an admission
+        without touching co-resident decoding slots).
+
+    Continuous batching: the engine reports the request ids of ALL
+    co-resident slots at every call, so ``poison_requests`` models a
+    per-slot hard fault that takes down any pool containing it; the
+    engine's slot isolation (evict + solo re-run) is what confines the
+    blast radius to the poisoned slot's request.
     """
 
     def __init__(self, fail_at: Iterable[int] = (),
                  persistent: bool = False,
                  poison_requests: Iterable[int] = (),
-                 delay_at: Optional[dict] = None):
+                 delay_at: Optional[dict] = None,
+                 prefill_fail_at: Iterable[int] = ()):
         super().__init__(fail_at, persistent=persistent)
         self.poison_requests = set(int(r) for r in poison_requests)
         self.delay_at = {int(k): float(v)
                          for k, v in (delay_at or {}).items()}
         self.delays_injected = 0
+        self.prefill_fail_at = set(int(i) for i in prefill_fail_at)
+        self.prefills_failed = 0
 
     def on_decode_step(self, step: int,
                        request_ids: Iterable[int] = ()) -> None:
@@ -195,6 +209,22 @@ class ServingFaultInjector(FaultInjector):
                 f"poisoned request(s) {sorted(bad)} at decode step "
                 f"{step}")
         self.check(int(step))
+
+    def on_prefill(self, step: int,
+                   request_ids: Iterable[int] = ()) -> None:
+        """Prefill-side hook (continuous batching). Same shared step
+        counter and poison/fail_at/delay semantics as on_decode_step
+        — a fault index fires at whichever call (prefill or chunk)
+        holds that step — plus the prefill-only ``prefill_fail_at``
+        knob."""
+        if int(step) in self.prefill_fail_at:
+            if not self.persistent:
+                self.prefill_fail_at.discard(int(step))
+            self.injected += 1
+            self.prefills_failed += 1
+            raise TrainingFailure(
+                f"injected prefill fault at step {step}")
+        self.on_decode_step(step, request_ids)
 
 
 class PreemptionHandler:

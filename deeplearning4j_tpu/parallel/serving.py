@@ -36,6 +36,39 @@ rank index into the key (equal prompts on different ranks must not
 sample identical continuations). Equivalence tests:
 tests/test_parallel_serving.py — greedy (dense AND MoE) + sampled
 top-k/top-p.
+
+CONTINUOUS BATCHING (ISSUE-4): `make_parallel_generate` fuses prefill
+and the whole decode budget into one program — right for one batch run
+to completion, wrong for mixed, streaming traffic (the engine would
+re-run prefill over the grown sequence every chunk). The split surface
+below serves the slotted engine instead:
+
+- `init_slot_state(cfg, mesh, num_slots)` — a PERSISTENT pool of
+  `num_slots` KV-cache rows ([L, Ns, S, D] sharded batch-over-'data',
+  flattened heads over-'model') plus per-slot `pos`/`tok` vectors,
+  resident on device across chunk calls.
+- `make_continuous_prefill(cfg, mesh, bucket_len, num_slots, ...)` —
+  one FIXED-SHAPE program per (bucket_len, num_slots) that prefills
+  any subset of slots (`plen > 0` marks admissions) from prompts
+  right-padded to the bucket, writes their cache rows, and samples
+  each admitted slot's first token. Mixed prompt lengths share the
+  program: causal attention means padded positions never influence
+  valid ones, the last-token logits are gathered at `plen-1` per row,
+  and (for MoE) padded tokens are masked out of expert dispatch.
+- `make_continuous_decode(cfg, mesh, chunk, num_slots, ...)` — one
+  fixed-shape program per (chunk, num_slots) advancing every active
+  slot `chunk` tokens: per-slot cache-row writes at each slot's own
+  `pos`, attention masked to each slot's filled prefix, slots
+  deactivating themselves when their remaining-token budget hits 0
+  (no wasted writes for finished slots). `active`/`rem` are data, not
+  shapes — steady-state mixed traffic triggers ZERO recompiles.
+
+Sampling key schedule for the split path: the token generated at
+sequence index j uses fold_in(root_key, j) (per-slot vmapped), so a
+retried, solo-isolated, or preempted-and-resumed request reproduces
+its continuation exactly — the schedule depends on absolute position
+only, never on slot placement or chunk boundaries. (This differs from
+the fused path's chunk-shaped schedule; greedy decode is identical.)
 """
 from __future__ import annotations
 
@@ -58,7 +91,7 @@ from deeplearning4j_tpu.parallel.megatron import (_g_sync, param_specs,
 Array = jax.Array
 
 
-def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int):
+def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int, valid=None):
     """Top-1 MoE on this data shard's tokens x2 [N_loc, D] with
     model-sharded expert FFNs (We1 [E, D, F/tp], We2 [E, F/tp, D]) —
     returns the PARTIAL output (caller psums over 'model').
@@ -70,7 +103,14 @@ def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int):
     Local buffer slots then only need to be collision-free, so kept
     tokens re-rank locally; dispatch/combine read the same slots, so
     the combined output is exactly the single-chip one for every kept
-    token and 0 for dropped ones."""
+    token and 0 for dropped ones.
+
+    ``valid`` ([N_loc] bool, continuous-batching bucket prefill): pad
+    tokens are masked out of dispatch so they can never claim expert
+    capacity from real tokens. The cap itself stays computed from the
+    PADDED token count (it sizes static buffers), so a bucket-padded
+    MoE prefill can drop fewer tokens than an exact-length run —
+    documented divergence, docs/serving.md."""
     n_loc = x2.shape[0]
     e = cfg.n_experts
     logits = jnp.matmul(x2.astype(jnp.float32), p["router"])
@@ -79,6 +119,8 @@ def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int):
     prob = jnp.take_along_axis(gates, expert[:, None], 1)[:, 0]
     cap = max(1, int(cfg.capacity_factor * n_loc * dp / e))
     onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)       # [N, E]
+    if valid is not None:
+        onehot = onehot * valid.astype(jnp.float32)[:, None]
     counts = jnp.sum(onehot, axis=0)                            # [E]
     all_counts = lax.all_gather(counts, "data")                 # [dp, E]
     r = lax.axis_index("data")
@@ -101,12 +143,16 @@ def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int):
     return jnp.einsum("nec,ecd->nd", comb, out).astype(x2.dtype)
 
 
-def _local_mlp(h, x, p, cfg: TransformerConfig, dp: int, g_model):
+def _local_mlp(h, x, p, cfg: TransformerConfig, dp: int, g_model,
+               valid=None):
     """Shared MLP tail for prefill/decode blocks: dense TP or MoE
-    expert-tensor-parallel, partial-output psum'd over 'model'."""
+    expert-tensor-parallel, partial-output psum'd over 'model'.
+    ``valid`` ([B, T] bool) masks pad tokens out of MoE dispatch."""
     if cfg.n_experts > 0:
         b, t, d = x.shape
-        y = _local_moe_mlp(x.reshape(b * t, d), p, cfg, dp)
+        y = _local_moe_mlp(x.reshape(b * t, d), p, cfg, dp,
+                           valid=None if valid is None
+                           else valid.reshape(b * t))
         return h + g_model(y.reshape(b, t, d))
     z = jax.nn.gelu(jnp.matmul(x, p["W1"].astype(x.dtype))
                     + p["b1"].astype(x.dtype))
@@ -115,9 +161,14 @@ def _local_mlp(h, x, p, cfg: TransformerConfig, dp: int, g_model):
 
 
 def _local_block_prefill(h, p, cfg: TransformerConfig, tp: int,
-                         dp: int):
+                         dp: int, valid=None):
     """TP block forward over the full prompt, returning the block's
     LOCAL k/v rows (flattened local heads) for the cache.
+
+    ``valid`` ([B, T] bool) marks real (non-pad) tokens in a bucket-
+    padded continuous-batching prefill; causal attention already keeps
+    pad positions (always to the RIGHT of valid ones) from influencing
+    valid outputs, so the mask is only consumed by MoE dispatch.
 
     NOTE: this and _local_block_decode deliberately mirror
     models/transformer.block_forward/_block_decode and
@@ -139,7 +190,7 @@ def _local_block_prefill(h, p, cfg: TransformerConfig, tp: int,
     a = a.reshape(a.shape[0], a.shape[1], h_loc * cfg.d_head)
     h = h + g_model(jnp.matmul(a, p["Wo"].astype(a.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
-    h = _local_mlp(h, x, p, cfg, dp, g_model)
+    h = _local_mlp(h, x, p, cfg, dp, g_model, valid=valid)
     kf = k.reshape(k.shape[0], k.shape[1], h_loc * cfg.d_head)
     vf = v.reshape(v.shape[0], v.shape[1], h_loc * cfg.d_head)
     return h, (kf, vf)
@@ -189,24 +240,7 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
     `_filter_logits` semantics (after temperature, before the
     categorical draw) — logits are replicated across 'model' ranks,
     so every rank filters and samples identically."""
-    tp = mesh.shape["model"]
-    dp = mesh.shape["data"]
-    if not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_k < 0:
-        raise ValueError(f"top_k must be >= 0, got {top_k}")
-    if cfg.n_heads % tp:
-        raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
-                         f"model axis {tp}")
-    if cfg.d_ff % tp:
-        raise ValueError(f"d_ff {cfg.d_ff} not divisible by "
-                         f"model axis {tp}")
-    for ax in ("pipe", "seq", "expert"):
-        if mesh.shape.get(ax, 1) > 1:
-            raise ValueError(
-                f"serving mesh uses only ('data', 'model'); axis "
-                f"'{ax}'={mesh.shape[ax]} would silently shard the "
-                "stacked layers with no schedule to reassemble them")
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
     specs = serving_param_specs(cfg)
 
     def run(params, prompt, key):
@@ -278,6 +312,261 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
     sharded = shard_map(run, mesh=mesh,
                         in_specs=(specs, P("data", None), P()),
                         out_specs=P("data", None), check_rep=True)
+    return jax.jit(sharded)
+
+
+def _check_serving_mesh(cfg: TransformerConfig, mesh: Mesh,
+                        top_k: int, top_p: float):
+    """Shared validation for every serving program factory. Returns
+    (tp, dp)."""
+    tp = mesh.shape["model"]
+    dp = mesh.shape["data"]
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
+                         f"model axis {tp}")
+    if cfg.d_ff % tp:
+        raise ValueError(f"d_ff {cfg.d_ff} not divisible by "
+                         f"model axis {tp}")
+    for ax in ("pipe", "seq", "expert"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"serving mesh uses only ('data', 'model'); axis "
+                f"'{ax}'={mesh.shape[ax]} would silently shard the "
+                "stacked layers with no schedule to reassemble them")
+    return tp, dp
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: persistent slot pool + prefill/decode split
+# ---------------------------------------------------------------------------
+
+_SLOT_CACHE_SPEC = P(None, "data", None, "model")   # [L, Ns, S, D]
+_SLOT_VEC_SPEC = P("data")                          # per-slot scalars
+
+
+def _sample_slots(logits, posidx, key, dp: int, temperature: float,
+                  top_k: int, top_p: float):
+    """Per-slot sampling on [Ns, V] logits: the token generated at
+    sequence index ``posidx[i]`` draws from fold_in(key, posidx[i]) —
+    position-keyed, slot-placement-independent, so retries, solo
+    isolation, and preempt-resume reproduce the same continuation.
+    Greedy (temperature<=0) ignores the key entirely."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if dp > 1:
+        key = jax.random.fold_in(key, lax.axis_index("data"))
+    filt = _filter_logits(logits.astype(jnp.float32) / temperature,
+                          top_k, top_p)
+    keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(
+        posidx.astype(jnp.int32))
+    return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+
+
+def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
+                                act, cfg: TransformerConfig, tp: int,
+                                dp: int):
+    """One TP block, one new position PER SLOT: h [Ns, 1, D], stacked
+    caches [L, Ns, S, D_loc], pos [Ns] (each slot's own filled length),
+    act [Ns] (inactive slots neither write their cache row nor advance).
+    The K/V row write is a per-slot scatter at (layer, slot, pos[slot]);
+    attention masks each slot to its own filled prefix 0..pos[slot] —
+    the per-slot generalization of _local_block_decode, with
+    reference_decode_attention's exact masking/softmax numerics so a
+    slotted greedy decode is token-identical to the fused path."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    ns = h.shape[0]
+    s_max = ck_all.shape[2]
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    q = jnp.matmul(x[:, 0], p["Wq"].astype(x.dtype)) \
+        .reshape(ns, h_loc, cfg.d_head)
+    k = jnp.matmul(x[:, 0], p["Wk"].astype(x.dtype))      # [Ns, D_loc]
+    v = jnp.matmul(x[:, 0], p["Wv"].astype(x.dtype))
+    rows = jnp.arange(ns)
+    wp = jnp.clip(pos, 0, s_max - 1)
+    # masked in-place row write: inactive slots re-write their current
+    # row with itself (scatter shape stays static; no branches)
+    k_wr = jnp.where(act[:, None], k.astype(ck_all.dtype),
+                     ck_all[layer, rows, wp])
+    v_wr = jnp.where(act[:, None], v.astype(cv_all.dtype),
+                     cv_all[layer, rows, wp])
+    ck_all = ck_all.at[layer, rows, wp].set(k_wr)
+    cv_all = cv_all.at[layer, rows, wp].set(v_wr)
+    kh = ck_all[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+    vh = cv_all[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
+        * (1.0 / (cfg.d_head ** 0.5))
+    sc = jnp.where(jnp.arange(s_max)[None, None, :]
+                   <= wp[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhs,bshd->bhd", pr.astype(q.dtype), vh)
+    h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
+                               p["Wo"].astype(h.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    h = _local_mlp(h, x, p, cfg, dp, g_model)
+    return h, ck_all, cv_all
+
+
+def init_slot_state(cfg: TransformerConfig, mesh: Mesh, num_slots: int):
+    """Allocate the persistent slot-pool state (ck, cv, pos, tok) on
+    the serving mesh: KV caches [L, Ns, S, D] (slot axis over 'data',
+    flattened heads over 'model' — models/transformer.slot_cache_shape)
+    plus per-slot position and last-token vectors. These four arrays
+    live on device for the engine's lifetime; every prefill/decode
+    program consumes and returns them functionally, so a failed call
+    leaves the pool bit-identical (retry/isolation need no repair)."""
+    from jax.sharding import NamedSharding
+
+    from deeplearning4j_tpu.models.transformer import slot_cache_shape
+    dp = mesh.shape["data"]
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    dt = cfg.activation_dtype()
+    shape = slot_cache_shape(cfg, num_slots)
+    kv_sh = NamedSharding(mesh, _SLOT_CACHE_SPEC)
+    vec_sh = NamedSharding(mesh, _SLOT_VEC_SPEC)
+    ck = jax.device_put(jnp.zeros(shape, dt), kv_sh)
+    cv = jax.device_put(jnp.zeros(shape, dt), kv_sh)
+    pos = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    tok = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    return ck, cv, pos, tok
+
+
+def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
+                            bucket_len: int, num_slots: int,
+                            temperature: float = 0.0,
+                            top_k: int = 0, top_p: float = 1.0):
+    """Compiled slot-pool prefill: (params, ck, cv, pos, tok,
+    prompts [Ns, Tb], plen [Ns], key) -> (ck, cv, pos, tok,
+    first [Ns]).
+
+    Slots with plen[i] > 0 are ADMISSIONS: their prompt (right-padded
+    to the Tb bucket) is prefilled in one batched pass, their cache
+    rows [0, plen) are written (pad rows land too but sit beyond pos
+    and are overwritten before ever being attended), pos[i] <- plen[i],
+    and the slot's first generated token is sampled from the logits at
+    row plen[i]-1 (returned in ``first``; -1 for non-admitted slots).
+    Slots with plen[i] == 0 pass through untouched — so one fixed
+    (bucket_len, num_slots) geometry serves every admission pattern
+    with zero recompiles."""
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    if not 0 < bucket_len <= cfg.max_len:
+        raise ValueError(f"bucket_len {bucket_len} out of "
+                         f"(0, {cfg.max_len}]")
+    specs = serving_param_specs(cfg)
+
+    def run(params, ck, cv, pos, tok, prompts, plen, key):
+        dt = cfg.activation_dtype()
+        ns, tb = prompts.shape
+        admit = plen > 0
+        h = (params["embed"].astype(dt)[prompts]
+             + params["pos"].astype(dt)[:tb][None])
+        valid = (jnp.arange(tb)[None, :] < plen[:, None]) \
+            if cfg.n_experts > 0 else None
+
+        def pf_body(hh, p):
+            return _local_block_prefill(hh, p, cfg, tp, dp, valid=valid)
+
+        h, (ks, vs) = lax.scan(pf_body, h, params["blocks"])
+        keep = admit[None, :, None, None]
+        ck = ck.at[:, :, :tb, :].set(
+            jnp.where(keep, ks.astype(ck.dtype), ck[:, :, :tb, :]))
+        cv = cv.at[:, :, :tb, :].set(
+            jnp.where(keep, vs.astype(cv.dtype), cv[:, :, :tb, :]))
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        last = h[jnp.arange(ns), jnp.clip(plen - 1, 0, tb - 1)]
+        logits = jnp.matmul(last, params["Wout"].astype(last.dtype))
+        first = _sample_slots(logits, plen, key, dp, temperature,
+                              top_k, top_p)
+        pos = jnp.where(admit, plen.astype(pos.dtype), pos)
+        tok = jnp.where(admit, first, tok)
+        return (ck, cv, pos, tok,
+                jnp.where(admit, first, jnp.asarray(-1, jnp.int32)))
+
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                  _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                  _SLOT_VEC_SPEC, P()),
+        out_specs=(_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
+                   _SLOT_VEC_SPEC, _SLOT_VEC_SPEC),
+        check_rep=True)
+    return jax.jit(sharded)
+
+
+def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
+                           chunk: int, num_slots: int,
+                           temperature: float = 0.0,
+                           top_k: int = 0, top_p: float = 1.0):
+    """Compiled slot-pool decode chunk: (params, ck, cv, pos, tok,
+    active [Ns] bool, rem [Ns] int32, key) -> (ck, cv, pos, tok,
+    toks [Ns, chunk]).
+
+    Advances every active slot up to ``chunk`` tokens from its own
+    position: each scanned step embeds the slot's pending token at its
+    own pos, writes its K/V cache row in place, attends only the
+    slot's filled prefix, and samples the next token. A slot whose
+    remaining budget (``rem``) hits 0 deactivates itself mid-chunk —
+    no further writes, pos frozen, emitted tokens -1 — so per-slot
+    budgets never overrun the cache and finished slots stop burning
+    writes. active/rem/pos are runtime DATA: one compiled program per
+    (chunk, num_slots) geometry covers all traffic."""
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    specs = serving_param_specs(cfg)
+
+    def run(params, ck, cv, pos, tok, active, rem, key):
+        dt = cfg.activation_dtype()
+
+        def step(carry, _):
+            ck, cv, pos, tok, rem = carry
+            act = active & (rem > 0)
+            emb = params["embed"].astype(dt)[tok]
+            pv = params["pos"].astype(dt)[
+                jnp.clip(pos, 0, cfg.max_len - 1)]
+            h = (emb + pv)[:, None, :]
+            for layer in range(cfg.n_layers):
+                p_l = {kk: vv[layer]
+                       for kk, vv in params["blocks"].items()}
+                h, ck, cv = _local_block_decode_slotted(
+                    h, p_l, ck, cv, layer, pos, act, cfg, tp, dp)
+            h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+            logits = jnp.matmul(h[:, 0],
+                                params["Wout"].astype(h.dtype))
+            nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
+                                top_k, top_p)
+            tok = jnp.where(act, nxt, tok)
+            emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
+            pos = jnp.where(act, pos + 1, pos)
+            rem = jnp.where(act, rem - 1, rem)
+            return (ck, cv, pos, tok, rem), emit
+
+        (ck, cv, pos, tok, _), toks = lax.scan(
+            step, (ck, cv, pos, tok, rem), None, length=chunk)
+        return ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1)
+
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                  _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                  _SLOT_VEC_SPEC, P()),
+        out_specs=(_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
+                   _SLOT_VEC_SPEC, P("data", None)),
+        check_rep=True)
     return jax.jit(sharded)
 
 

@@ -1,12 +1,29 @@
 """Fault-tolerant inference engine over `parallel/serving.py`.
 
-`InferenceEngine` turns the bare compiled-generate closure into a
-service: callers `submit()` prompts and get a `RequestHandle`; a
-dynamic batcher coalesces queued prompts (grouped by prompt length —
-the model has no pad masking, so only identical-length prompts share a
-batch; the batch dim is padded to a 'data'-axis multiple with throwaway
-rows) and drives the jitted decode step, optionally in fixed-size
-chunks so deadlines and faults are handled at chunk granularity.
+`InferenceEngine` turns the compiled sharded decode programs into a
+service: callers `submit()` prompts and get a `RequestHandle`.
+
+CONTINUOUS BATCHING (the default, ``mode="continuous"``, ISSUE-4):
+requests live in a fixed pool of ``num_slots`` slots whose KV cache,
+position, and pending token stay RESIDENT ON DEVICE across decode
+chunks (parallel/serving.init_slot_state). Each scheduling round
+(`tick()`): free slots are filled from the queue and prefilled in ONE
+fixed-shape pad-tolerant program (mixed prompt lengths share it — the
+bucket, not the exact length, keys the compiled-program cache), then
+every occupied slot advances one decode chunk through ONE fixed-shape
+program whose active/remaining-budget masks are runtime data. A slot
+frees the moment its request completes or is shed, and the next tick
+refills it — so a 4-token request admitted behind a 512-token one
+finishes thousands of tokens earlier (no head-of-line blocking), a
+request's prompt is prefilled exactly ONCE (no quadratic re-prefill),
+and steady-state mixed traffic triggers zero XLA recompiles.
+
+``mode="batch"`` keeps the PR-1 batch-to-completion path: a dynamic
+batcher coalesces queued prompts of IDENTICAL length, re-stacks
+prompt+generated, and re-invokes `make_parallel_generate` per chunk —
+the benchmark baseline (`flagship.py --config engine_continuous`
+replays one trace through both modes) and the single-shot
+(`decode_chunk=0`) lowest-overhead mode.
 
 Failure semantics:
 - A decode-step failure (XlaRuntimeError, injected `TrainingFailure`)
@@ -14,10 +31,12 @@ Failure semantics:
   deterministic given (params, prompt, key) and the per-chunk key
   depends only on the decoded-position offset, so a retried request
   completes with byte-identical tokens to a no-fault run.
-- When a batch exhausts its retries, the engine isolates: each
-  in-flight request is re-run solo (continuing from its decoded
-  prefix). Requests that fail solo too are QUARANTINED — the
-  per-request hard fault — without poisoning co-batched requests.
+- When a batch (or the slot pool) exhausts its retries, the engine
+  isolates: each in-flight request is re-run solo, continuing from its
+  decoded prefix (continuous mode: evicted from its slot — counted as
+  preempted — and re-run on a SCRATCH slot pool so surviving state is
+  never clobbered). Requests that fail solo too are QUARANTINED — the
+  per-request hard fault — without poisoning co-resident requests.
 - Consecutive step failures trip a circuit breaker: admissions are
   rejected with `OverloadError` for `breaker_cooldown_s`, then a
   half-open probe admission closes it again on success.
@@ -30,10 +49,16 @@ Failure semantics:
 
 Weights hot-reload: `reload_weights()` restores a param tree from a
 `CheckpointManager` directory using the live (sharded) params as the
-placement template and swaps it in atomically; in-flight batches finish
-on the weights they started with (no drain), later batches use the new
-ones. Corrupt/partial `step_<N>` directories fall back to the previous
-good step.
+placement template and swaps it in atomically. Batch mode: in-flight
+batches finish on the weights they started with (no drain), later
+batches use the new ones. Continuous mode: a slot's KV cache encodes
+the weights that wrote it, so in-flight slots are PREEMPTED instead —
+evicted and requeued at the queue front with their committed tokens
+preserved; they re-prefill under the new weights and continue, and
+newly admitted slots use the new weights immediately (tokens decoded
+but not yet committed at the swap are discarded and re-decoded).
+Corrupt/partial `step_<N>` directories fall back to the previous good
+step.
 
 Observability: every counter the engine keeps (completed / shed /
 quarantined / retries / step failures / batches / reloads), the
@@ -65,8 +90,12 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.models.transformer import TransformerConfig
-from deeplearning4j_tpu.observability.metrics import MetricsRegistry
-from deeplearning4j_tpu.parallel.serving import (make_parallel_generate,
+from deeplearning4j_tpu.observability.metrics import (
+    DECODE_LATENCY_BUCKETS, MetricsRegistry)
+from deeplearning4j_tpu.parallel.serving import (init_slot_state,
+                                                 make_continuous_decode,
+                                                 make_continuous_prefill,
+                                                 make_parallel_generate,
                                                  shard_serving_params)
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
@@ -98,20 +127,30 @@ class RequestStatus:
     QUARANTINED = "quarantined"
 
 
+DEFAULT_CONTINUOUS_CHUNK = 8
+
+
 @dataclass
 class EngineConfig:
     """Queueing / batching / fault-handling policy knobs.
 
-    ``decode_chunk=0`` decodes each batch's full token budget in ONE
-    compiled call (lowest overhead — the benchmark mode);
-    ``decode_chunk=N`` decodes N tokens per call so deadlines are
-    enforced and faults retried at chunk granularity (each chunk
-    re-prefills the grown prompt — the robustness/latency mode)."""
+    ``mode="continuous"`` (default) runs the slotted continuous-
+    batching scheduler: ``max_batch_size`` sizes the slot pool (unless
+    ``num_slots`` overrides it; both are rounded up to a 'data'-axis
+    multiple), ``decode_chunk`` is the tokens-per-chunk scheduling
+    quantum (0 falls back to DEFAULT_CONTINUOUS_CHUNK — continuous
+    mode always chunks: chunk boundaries are where slots are freed and
+    admitted). ``mode="batch"`` keeps the PR-1 batch-to-completion
+    batcher, where ``decode_chunk=0`` decodes each batch's full token
+    budget in ONE compiled call (lowest overhead — the benchmark mode)
+    and ``decode_chunk=N`` re-prefills the grown prompt every N
+    tokens."""
     max_queue: int = 64              # hard admission bound
-    max_batch_size: int = 8          # dynamic-batcher coalescing cap
+    max_batch_size: int = 8          # slot-pool size / coalescing cap
     batch_timeout_s: float = 0.005   # worker coalescing window
     max_new_tokens: int = 32         # engine default AND per-request cap
-    decode_chunk: int = 0            # 0 = single-shot decode
+    decode_chunk: int = 0            # 0 = single-shot (batch mode) /
+    #                                  DEFAULT_CONTINUOUS_CHUNK (cont.)
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
@@ -123,6 +162,9 @@ class EngineConfig:
     degrade_queue_depth: int = 48    # soft watermark -> degraded mode
     degraded_max_new_tokens: int = 8
     seed: int = 0                    # sampling key root
+    mode: str = "continuous"         # "continuous" | "batch"
+    num_slots: int = 0               # 0 = max_batch_size
+    prefill_bucket_min: int = 16     # smallest prefill-length bucket
 
 
 class RequestHandle:
@@ -140,6 +182,7 @@ class RequestHandle:
         self.deadline_exceeded = False
         self._generated: List[np.ndarray] = []
         self._done = threading.Event()
+        self._in_flight = False          # continuous-mode accounting
 
     @property
     def generated(self) -> np.ndarray:
@@ -185,6 +228,34 @@ def _compiled_generate(cfg_fields: tuple, mesh, max_new_tokens: int,
                                   top_p=top_p)
 
 
+@lru_cache(maxsize=64)
+def _compiled_prefill(cfg_fields: tuple, mesh, bucket_len: int,
+                      num_slots: int, temperature: float, top_k: int,
+                      top_p: float):
+    """Compiled-program cache for the continuous-batching admission
+    prefill, keyed on BUCKET geometry (bucket_len, num_slots) rather
+    than exact prompt length: all traffic whose prompts round up to
+    the same bucket shares one entry — the no-recompile guard test
+    counts this cache's entries before/after mixed-length traffic."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_continuous_prefill(cfg, mesh, bucket_len, num_slots,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p)
+
+
+@lru_cache(maxsize=64)
+def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
+                           num_slots: int, temperature: float,
+                           top_k: int, top_p: float):
+    """Compiled-program cache for the continuous-batching decode
+    chunk: ONE entry per engine geometry — occupancy, per-slot
+    positions, and budgets are runtime data, not shapes."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_continuous_decode(cfg, mesh, chunk, num_slots,
+                                  temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
+
+
 class InferenceEngine:
     """Bounded-queue, deadline-aware, fault-tolerant front end for the
     sharded generate path. See module docstring for semantics; see
@@ -202,7 +273,24 @@ class InferenceEngine:
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or EngineConfig()
+        if self.config.mode not in ("continuous", "batch"):
+            raise ValueError(f"mode must be 'continuous' or 'batch', "
+                             f"got {self.config.mode!r}")
         self._dp = mesh.shape["data"]
+        self._continuous = self.config.mode == "continuous"
+        ns = self.config.num_slots or self.config.max_batch_size
+        self._num_slots = -(-ns // self._dp) * self._dp
+        self._chunk = (self.config.decode_chunk
+                       if self.config.decode_chunk > 0
+                       else DEFAULT_CONTINUOUS_CHUNK)
+        # slot pool: host-side seating; device-side persistent state
+        # (KV caches, per-slot pos + pending token) allocated lazily on
+        # the first admission
+        self._slots: List[Optional[RequestHandle]] = \
+            [None] * self._num_slots
+        self._cache_k = self._cache_v = None
+        self._slot_pos = self._slot_tok = None
+        self._key = None
         self._params = shard_serving_params(params, cfg, mesh)
         self._injector = fault_injector
         self._clock = clock
@@ -269,6 +357,13 @@ class InferenceEngine:
                 ).set_function(lambda: float(
                     len(self._queue) >= self.config.degrade_queue_depth
                     or self._breaker != "closed"))
+        self._m_preempted = r.counter(
+            "serving_requests_preempted",
+            "In-flight requests evicted from their slot (isolation or "
+            "weight reload) and re-run from their committed prefix")
+        r.gauge("serving_slot_occupancy",
+                "Occupied continuous-batching slots").set_function(
+            lambda: float(sum(s is not None for s in self._slots)))
         self._m_batch_size = r.histogram(
             "serving_batch_size", "Coalesced batch sizes",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
@@ -277,7 +372,12 @@ class InferenceEngine:
             "Wall time from batch formation to completion")
         self._m_step_seconds = r.histogram(
             "serving_decode_step_seconds",
-            "Wall time of one compiled decode call")
+            "Wall time of one compiled decode call",
+            buckets=DECODE_LATENCY_BUCKETS)
+        self._m_prefill_seconds = r.histogram(
+            "serving_prefill_seconds",
+            "Wall time of one compiled admission-prefill call",
+            buckets=DECODE_LATENCY_BUCKETS)
 
     @property
     def stats(self) -> dict:
@@ -291,6 +391,7 @@ class InferenceEngine:
                 "step_failures": int(self._m_step_failures.value),
                 "batches": int(self._m_batches.value),
                 "reloads": int(self._m_reloads.value),
+                "preempted": int(self._m_preempted.value),
                 "in_flight": int(self._m_in_flight.value)}
 
     # ------------------------------------------------------------------
@@ -345,15 +446,30 @@ class InferenceEngine:
     # driving: synchronous drain or background worker
     # ------------------------------------------------------------------
     def run_pending(self) -> int:
-        """Process queued requests on the caller thread until the queue
-        is empty. Returns the number of batches run."""
+        """Process queued requests on the caller thread until the
+        queue AND the slot pool are drained. Returns the number of
+        scheduling rounds run (batch mode: batches; continuous mode:
+        ticks)."""
         n = 0
-        while True:
-            batch = self._form_batch()
-            if not batch:
-                return n
-            self._process_batch(batch)
+        while self.tick():
             n += 1
+        return n
+
+    def tick(self) -> bool:
+        """Advance the engine by one scheduling round and return
+        whether any work was done. Batch mode: form one same-length
+        batch and run it to completion. Continuous mode: fill free
+        slots from the queue (one fused prefill), then advance every
+        occupied slot one decode chunk. Public so callers (and the
+        engine_continuous benchmark's arrival-replay loop) can
+        interleave submissions with decode progress."""
+        if self._continuous:
+            return self._tick_continuous()
+        batch = self._form_batch()
+        if not batch:
+            return False
+        self._process_batch(batch)
+        return True
 
     def start(self) -> "InferenceEngine":
         with self._lock:
@@ -381,16 +497,21 @@ class InferenceEngine:
     def _worker(self) -> None:
         while True:
             with self._cv:
-                while not self._queue and not self._stop_flag:
+                while (not self._queue and not self._pool_busy()
+                       and not self._stop_flag):
                     self._cv.wait(0.05)
                 if self._stop_flag:
                     return
-            # coalescing window: let near-simultaneous submissions join
-            if self.config.batch_timeout_s > 0:
+            # coalescing window: let near-simultaneous submissions
+            # join — but never stall an actively decoding slot pool
+            # (admissions happen at the next chunk boundary anyway)
+            if self.config.batch_timeout_s > 0 and not self._pool_busy():
                 time.sleep(self.config.batch_timeout_s)
-            batch = self._form_batch()
-            if batch:
-                self._process_batch(batch)
+            self.tick()
+
+    def _pool_busy(self) -> bool:
+        return self._continuous and any(s is not None
+                                        for s in self._slots)
 
     def set_listeners(self, *listeners) -> None:
         """Attach train-listener-protocol observers: after every batch
@@ -499,13 +620,336 @@ class InferenceEngine:
         r._finish(RequestStatus.COMPLETED)
 
     # ------------------------------------------------------------------
+    # continuous batching: slot-pool scheduling
+    # ------------------------------------------------------------------
+    def _tick_continuous(self) -> bool:
+        """One scheduling round: admit into free slots (one fused
+        prefill over the pool), then advance every occupied slot one
+        decode chunk. Slots free the moment their request completes or
+        is shed, so the next round refills them from the queue."""
+        t_start = self._clock()
+        params = self._params    # admissions + this chunk share a tree
+        admitted = self._fill_slots()
+        if admitted:
+            try:
+                self._prefill_slots(admitted, params)
+            except _BatchDecodeFailed as e:
+                self._isolate_slots([r for _, r in admitted], e)
+        occupied = self._occupied()
+        if occupied:
+            try:
+                self._decode_chunk_slots(occupied, params)
+            except _BatchDecodeFailed as e:
+                self._isolate_slots([r for _, r in occupied], e)
+            self._reap(shed=True)
+        if not admitted and not occupied:
+            return False
+        self._m_batches.inc()
+        n_active = len(occupied) or len(admitted)
+        self._m_batch_size.observe(n_active)
+        idx = int(self._m_batches.value)
+        latency = self._clock() - t_start
+        self._m_batch_seconds.observe(latency)
+        for l in self._listeners:
+            if hasattr(l, "record_batch"):
+                l.record_batch(n_active)
+            try:
+                l.iteration_done(self, idx, latency)
+            except Exception:     # listeners must not kill serving
+                log.exception("engine listener failed")
+        return True
+
+    def _fill_slots(self) -> List[tuple]:
+        """Admission at a chunk boundary: seat queued requests into
+        free slots (deadline-expired ones are shed or completed
+        partial instead of seated). Returns [(slot, handle)]."""
+        admitted = []
+        with self._lock:
+            free = [i for i in range(self._num_slots)
+                    if self._slots[i] is None]
+            while free and self._queue:
+                r = self._queue.popleft()
+                self._shed_expired([r])
+                if r.done():
+                    continue
+                i = free.pop(0)
+                self._slots[i] = r
+                r.status = RequestStatus.RUNNING
+                r._in_flight = True
+                self._m_in_flight.inc()
+                admitted.append((i, r))
+        return admitted
+
+    def _occupied(self) -> List[tuple]:
+        with self._lock:
+            return [(i, r) for i, r in enumerate(self._slots)
+                    if r is not None]
+
+    def _ensure_state(self) -> None:
+        if self._cache_k is None:
+            (self._cache_k, self._cache_v, self._slot_pos,
+             self._slot_tok) = init_slot_state(self.cfg, self.mesh,
+                                               self._num_slots)
+
+    def _root_key(self):
+        if self._key is None:
+            import jax
+            self._key = jax.random.PRNGKey(self.config.seed)
+        return self._key
+
+    def _bucket_len(self, need: int) -> int:
+        """Prefill bucket policy: the smallest power-of-two scaling of
+        prefill_bucket_min that covers ``need``, capped at max_len.
+        The compiled prefill program is keyed on the BUCKET, so all
+        prompts rounding to one bucket share one program — the
+        no-recompile guarantee under mixed-length traffic."""
+        b = max(1, self.config.prefill_bucket_min)
+        while b < need:
+            b *= 2
+        return min(b, self.cfg.max_len)
+
+    def _call_prefill(self, params, state, entries):
+        """One guarded fused admit+prefill over ``state`` for
+        ``entries`` [(slot, handle)] — each entry's committed prefix
+        (prompt + generated-so-far: requeued preempted requests resume
+        mid-stream) is right-padded to the bucket. Returns
+        ((ck, cv, pos, tok), first_tokens)."""
+        ck, cv, pos, tok = state
+        prefixes = {i: np.concatenate([r.prompt, r.generated]
+                                      ).astype(np.int32)
+                    for i, r in entries}
+        tb = self._bucket_len(max(p.shape[0]
+                                  for p in prefixes.values()))
+        prompts = np.zeros((self._num_slots, tb), np.int32)
+        plen = np.zeros((self._num_slots,), np.int32)
+        for i, r in entries:
+            pre = prefixes[i]
+            prompts[i, :pre.shape[0]] = pre
+            plen[i] = pre.shape[0]
+        fn = _compiled_prefill(astuple(self.cfg), self.mesh, int(tb),
+                               self._num_slots,
+                               float(self.config.temperature),
+                               int(self.config.top_k),
+                               float(self.config.top_p))
+        key = self._root_key()
+
+        def call():
+            o = fn(params, ck, cv, pos, tok, prompts, plen, key)
+            return o[:4], np.asarray(o[4])
+
+        return self._guarded(call, [r.rid for _, r in entries],
+                             self._m_prefill_seconds, prefill=True)
+
+    def _call_chunk(self, params, state, entries):
+        """One guarded decode chunk over ``state`` for the occupied
+        ``entries``: per-slot budgets ride as the ``rem`` mask, so a
+        slot finishing mid-chunk stops decoding on device. Returns
+        ((ck, cv, pos, tok), toks [Ns, chunk])."""
+        ck, cv, pos, tok = state
+        active = np.zeros((self._num_slots,), bool)
+        rem = np.zeros((self._num_slots,), np.int32)
+        for i, r in entries:
+            active[i] = True
+            rem[i] = r.max_new_tokens - r.generated.shape[0]
+        fn = _compiled_decode_chunk(astuple(self.cfg), self.mesh,
+                                    self._chunk, self._num_slots,
+                                    float(self.config.temperature),
+                                    int(self.config.top_k),
+                                    float(self.config.top_p))
+        key = self._root_key()
+
+        def call():
+            o = fn(params, ck, cv, pos, tok, active, rem, key)
+            return o[:4], np.asarray(o[4])
+
+        return self._guarded(call, [r.rid for _, r in entries],
+                             self._m_step_seconds)
+
+    def _prefill_slots(self, admitted, params) -> None:
+        """Admission prefill on the LIVE pool; appends each admitted
+        request's first generated token. On persistent failure the
+        admitted slots are evicted (running peers' device state is
+        untouched — the failed call produced no new state) and the
+        _BatchDecodeFailed propagates to slot isolation."""
+        self._ensure_state()
+        try:
+            state, first = self._call_prefill(
+                params, (self._cache_k, self._cache_v,
+                         self._slot_pos, self._slot_tok), admitted)
+        except _BatchDecodeFailed:
+            with self._lock:
+                for i, r in admitted:
+                    if self._slots[i] is r:
+                        self._slots[i] = None
+            raise
+        (self._cache_k, self._cache_v,
+         self._slot_pos, self._slot_tok) = state
+        for i, r in admitted:
+            with self._lock:
+                if self._slots[i] is not r:   # preempted by a reload
+                    continue
+            r._generated.append(np.asarray([first[i]], np.int32))
+            if r.generated.shape[0] >= r.max_new_tokens:
+                self._complete(r)
+        self._reap()
+
+    def _decode_chunk_slots(self, occupied, params) -> None:
+        state, toks = self._call_chunk(
+            params, (self._cache_k, self._cache_v,
+                     self._slot_pos, self._slot_tok), occupied)
+        (self._cache_k, self._cache_v,
+         self._slot_pos, self._slot_tok) = state
+        for i, r in occupied:
+            with self._lock:
+                if self._slots[i] is not r:   # preempted by a reload:
+                    continue                  # uncommitted tokens drop
+            need = min(self._chunk,
+                       r.max_new_tokens - r.generated.shape[0])
+            r._generated.append(toks[i, :need].astype(np.int32))
+            if r.generated.shape[0] >= r.max_new_tokens:
+                self._complete(r)
+
+    def _reap(self, shed: bool = False) -> None:
+        """Free slots whose request reached a terminal state; with
+        ``shed``, first run the deadline check over occupied slots."""
+        if shed:
+            self._shed_expired([r for _, r in self._occupied()])
+        with self._lock:
+            for i, r in enumerate(self._slots):
+                if r is not None and r.done():
+                    self._slots[i] = None
+                    self._leave_flight(r)
+
+    def _leave_flight(self, r: RequestHandle) -> None:
+        if r._in_flight:
+            r._in_flight = False
+            self._m_in_flight.dec()
+
+    def _isolate_slots(self, requests: List[RequestHandle],
+                       batch_err: _BatchDecodeFailed) -> None:
+        """Continuous-batching isolation: the pool call exhausted its
+        retries, so every implicated request is PREEMPTED (evicted
+        from its slot) and re-run solo on a scratch pool, continuing
+        from its committed prefix. Solo survivors complete; solo
+        failures are quarantined — a poisoned slot's request cannot
+        take down co-resident slots, and the pool keeps serving."""
+        log.warning("slot pool of %d exhausted retries (%s); "
+                    "isolating", len(requests), batch_err)
+        with self._lock:
+            implicated = set(id(r) for r in requests)
+            for i, r in enumerate(self._slots):
+                if r is not None and id(r) in implicated:
+                    self._slots[i] = None
+        for r in requests:
+            if r.status != RequestStatus.RUNNING:
+                if r.done():
+                    self._leave_flight(r)
+                continue
+            self._m_preempted.inc()
+            try:
+                self._run_isolated(r)
+            except _BatchDecodeFailed as e:
+                self._m_quarantined.inc()
+                log.error("request %d quarantined after solo retries "
+                          "(%s)", r.rid, e)
+                r._finish(RequestStatus.QUARANTINED,
+                          RequestQuarantined(
+                              f"request {r.rid} failed persistently: "
+                              f"{e}"))
+            self._leave_flight(r)
+
+    def _run_isolated(self, r: RequestHandle) -> None:
+        """Solo re-run on a SCRATCH slot pool (the live pool's caches
+        stay intact for later traffic; the scratch pool reuses the
+        same compiled programs): re-prefill the committed prefix, then
+        decode chunks to completion. The position-keyed sampling
+        schedule makes the continuation identical to what the pooled
+        run would have produced."""
+        params = self._params
+        state = init_slot_state(self.cfg, self.mesh, self._num_slots)
+        state, first = self._call_prefill(params, state, [(0, r)])
+        r._generated.append(np.asarray([first[0]], np.int32))
+        while True:
+            self._shed_expired([r])
+            if r.status != RequestStatus.RUNNING:
+                return
+            if r.generated.shape[0] >= r.max_new_tokens:
+                self._complete(r)
+                return
+            state, toks = self._call_chunk(params, state, [(0, r)])
+            need = min(self._chunk,
+                       r.max_new_tokens - r.generated.shape[0])
+            r._generated.append(toks[0, :need].astype(np.int32))
+
+    def _evict_all_locked(self) -> int:
+        """Weight-reload preemption (continuous mode; caller holds the
+        lock): every in-flight slot's request is evicted and requeued
+        at the FRONT of the queue with its committed tokens preserved
+        — it re-prefills under the new weights and continues, since
+        its KV cache encodes the OLD weights and mixing the two would
+        be incoherent. Returns the number preempted."""
+        if not self._continuous:
+            return 0
+        n = 0
+        for i in range(self._num_slots - 1, -1, -1):
+            r = self._slots[i]
+            if r is None:
+                continue
+            self._slots[i] = None
+            r.status = RequestStatus.QUEUED
+            self._leave_flight(r)
+            self._queue.appendleft(r)
+            n += 1
+        return n
+
+    # ------------------------------------------------------------------
     # the guarded decode step
     # ------------------------------------------------------------------
+    def _guarded(self, call, rids: List[int], hist,
+                 prefill: bool = False):
+        """One compiled-call guard shared by every decode path:
+        fault-injection hook (the injector sees the request ids of ALL
+        co-resident work), latency histogram, retry with exponential
+        backoff, breaker accounting. The step counter indexes
+        COMPLETED calls — prefills and chunks share it — so a failed
+        attempt retries the same index (ServingFaultInjector
+        contract). Raises _BatchDecodeFailed after max_retries."""
+        attempt = 0
+        while True:
+            try:
+                if self._injector is not None:
+                    hook = self._injector.on_decode_step
+                    if prefill and hasattr(self._injector,
+                                           "on_prefill"):
+                        hook = self._injector.on_prefill
+                    hook(self._step_counter, rids)
+                t_step = _perf()
+                out = call()
+                hist.observe(_perf() - t_step)
+                self._record_success()
+                self._step_counter += 1
+                return out
+            except RuntimeError as e:       # XlaRuntimeError, injected
+                self._record_failure(e)
+                attempt += 1
+                if attempt > self.config.max_retries:
+                    raise _BatchDecodeFailed(str(e)) from e
+                self._m_retries.inc()
+                delay = min(self.config.backoff_base_s
+                            * (2 ** (attempt - 1)),
+                            self.config.backoff_max_s)
+                log.warning(
+                    "decode step %d failed (%s); retry %d/%d in %.3fs",
+                    self._step_counter, e, attempt,
+                    self.config.max_retries, delay)
+                if delay > 0:
+                    time.sleep(delay)
+
     def _invoke(self, params, prompts: np.ndarray, n: int,
                 rids: List[int]) -> np.ndarray:
-        """One compiled decode call (batch padded to a 'data' multiple),
-        retried with exponential backoff. Returns [B_real, n] new
-        tokens. Raises _BatchDecodeFailed after max_retries."""
+        """One compiled batch-mode decode call (batch padded to a
+        'data' multiple), retried via _guarded. Returns [B_real, n]
+        new tokens. Raises _BatchDecodeFailed after max_retries."""
         import jax
         import jax.numpy as jnp
 
@@ -522,33 +966,12 @@ class InferenceEngine:
                                 float(self.config.temperature),
                                 int(self.config.top_k),
                                 float(self.config.top_p))
-        attempt = 0
-        while True:
-            try:
-                if self._injector is not None:
-                    self._injector.on_decode_step(self._step_counter,
-                                                  rids)
-                t_step = _perf()
-                out = np.asarray(fn(params, jnp.asarray(prompts), key))
-                self._m_step_seconds.observe(_perf() - t_step)
-                self._record_success()
-                self._step_counter += 1
-                return out[:b, prompts.shape[1]:]
-            except RuntimeError as e:       # XlaRuntimeError, injected
-                self._record_failure(e)
-                attempt += 1
-                if attempt > self.config.max_retries:
-                    raise _BatchDecodeFailed(str(e)) from e
-                self._m_retries.inc()
-                delay = min(self.config.backoff_base_s
-                            * (2 ** (attempt - 1)),
-                            self.config.backoff_max_s)
-                log.warning(
-                    "decode step %d failed (%s); retry %d/%d in %.3fs",
-                    self._step_counter, e, attempt,
-                    self.config.max_retries, delay)
-                if delay > 0:
-                    time.sleep(delay)
+
+        def call():
+            return np.asarray(fn(params, jnp.asarray(prompts), key))
+
+        out = self._guarded(call, rids, self._m_step_seconds)
+        return out[:b, prompts.shape[1]:]
 
     def _isolate(self, active: List[RequestHandle], params,
                  batch_err: _BatchDecodeFailed) -> None:
@@ -635,6 +1058,8 @@ class InferenceEngine:
                     "breaker": self._breaker,
                     "degraded": self._degraded_locked(),
                     "queue_depth": len(self._queue),
+                    "slots_occupied": sum(s is not None
+                                          for s in self._slots),
                     "weights_step": self._weights_step,
                     **dict(self.stats)}
 
@@ -687,6 +1112,15 @@ class InferenceEngine:
             with self._lock:
                 self._params = tree
                 self._weights_step = int(s)
+                # continuous mode: in-flight slots' KV caches encode
+                # the OLD weights — preempt them (requeue at the queue
+                # front, committed tokens preserved) so they re-prefill
+                # under the new tree; new admissions see it immediately
+                preempted = self._evict_all_locked()
+            if preempted:
+                self._m_preempted.inc(preempted)
+                log.info("weight reload preempted %d in-flight "
+                         "slot(s); requeued for re-prefill", preempted)
             self._m_reloads.inc()
             log.info("weights hot-reloaded from step %d", int(s))
             return int(s)

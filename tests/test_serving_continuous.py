@@ -1,0 +1,426 @@
+"""Continuous batching (ISSUE-4): slotted persistent-KV-cache suite.
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- token fidelity: continuous greedy decode is byte-identical to
+  single-chip `generate`, across chunk sizes, slot placements, and a
+  (data x model) mesh;
+- NO quadratic re-prefill: a request's prompt is prefilled exactly
+  once regardless of how many chunks its decode spans (the named
+  regression test for the PR-1 `_decode_loop` re-prefill bug);
+- NO steady-state recompiles: mixed prompt lengths within one bucket
+  add at most one compiled-program cache entry per bucket geometry;
+- no head-of-line blocking: a short request admitted behind a long
+  one completes first, into a slot freed mid-stream;
+- slot-level fault isolation: a poisoned slot's request is preempted
+  + quarantined while co-resident slots' requests complete with the
+  exact tokens a clean run produces;
+- hot-reload preemption: in-flight slots are evicted/requeued with
+  their committed tokens preserved and continue under the new
+  weights, while new admissions see the new weights immediately.
+"""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   generate, init_params)
+from deeplearning4j_tpu.parallel.failure import (ServingFaultInjector,
+                                                 TrainingFailure)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestQuarantined, RequestStatus)
+from deeplearning4j_tpu.serving.engine import (_compiled_decode_chunk,
+                                               _compiled_prefill)
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=6, backoff_base_s=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _prefill_count(eng):
+    return eng.registry.get(
+        "serving_prefill_seconds")._unlabeled().snapshot()[2]
+
+
+def _step_count(eng):
+    return eng.registry.get(
+        "serving_decode_step_seconds")._unlabeled().snapshot()[2]
+
+
+# ---------------------------------------------------------------------------
+# token fidelity
+# ---------------------------------------------------------------------------
+
+def test_continuous_matches_direct_generate(params, mesh1):
+    """Slotted chunked decode == single-chip generate, byte for byte
+    (pad-tolerant prefill + per-slot-pos decode reproduce the fused
+    program's math exactly)."""
+    for chunk in (2, 5):
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(decode_chunk=chunk))
+        h = eng.submit(_prompt())
+        eng.run_pending()
+        want = np.asarray(generate(CFG, params, _prompt()[None], 6,
+                                   key=jax.random.PRNGKey(0),
+                                   temperature=0.0))[0]
+        np.testing.assert_array_equal(h.result(0), want)
+
+
+def test_mixed_lengths_share_one_admission(params, mesh1):
+    """The PR-1 batcher collapsed mixed-length traffic to one batch
+    per distinct prompt length; the continuous pool admits them all in
+    ONE pad-masked prefill (same bucket), and every request's tokens
+    still match its solo run."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    hs = [eng.submit(_prompt(8, i)) for i in range(3)]
+    hs += [eng.submit(_prompt(12, i)) for i in range(2)]
+    eng.run_pending()
+    assert _prefill_count(eng) == 1        # one admission, 5 requests
+    for h in hs:
+        solo = InferenceEngine(CFG, mesh1, params, _config())
+        s = solo.submit(h.prompt)
+        solo.run_pending()
+        np.testing.assert_array_equal(h.result(0), s.result(0))
+
+
+def test_continuous_on_data_model_mesh(params, devices8):
+    """Slots shard over 'data', heads over 'model': results on a 2x2
+    mesh equal the 1x1 runs, slot placement notwithstanding."""
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    mesh1 = make_mesh(MeshSpec(data=1, model=1))
+    eng = InferenceEngine(CFG, mesh, params, _config())
+    hs = [eng.submit(_prompt(8, i)) for i in range(3)]
+    hs += [eng.submit(_prompt(12, i)) for i in range(2)]
+    eng.run_pending()
+    for h in hs:
+        solo = InferenceEngine(CFG, mesh1, params, _config())
+        s = solo.submit(h.prompt)
+        solo.run_pending()
+        np.testing.assert_array_equal(h.result(0), s.result(0))
+
+
+# ---------------------------------------------------------------------------
+# satellite: the quadratic re-prefill regression, by name
+# ---------------------------------------------------------------------------
+
+def test_prefill_invocations_constant_in_chunk_count(params, mesh1):
+    """REGRESSION (ISSUE-4 satellite): PR-1's `_decode_loop` re-ran
+    full prefill over prompt+generated every `decode_chunk` tokens —
+    O(max_new_tokens / decode_chunk) prefill invocations, quadratic
+    prefill FLOPs. Continuous batching prefills a request exactly ONCE
+    no matter how its budget divides into chunks."""
+    counts = {}
+    for chunk in (1, 2, 6):
+        eng = InferenceEngine(
+            CFG, mesh1, params,
+            _config(decode_chunk=chunk, max_new_tokens=12))
+        h = eng.submit(_prompt())
+        eng.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+        counts[chunk] = _prefill_count(eng)
+        # and the decode side really did run ~budget/chunk chunks
+        assert _step_count(eng) == -(-11 // chunk)
+    assert counts == {1: 1, 2: 1, 6: 1}
+
+    # the batch-mode path is the O(chunks) counterpoint: its chunked
+    # decode re-invokes the fused prefill+decode program per chunk
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(decode_chunk=2, max_new_tokens=12, mode="batch"))
+    eng.submit(_prompt())
+    eng.run_pending()
+    assert _step_count(eng) == 6           # 6 full re-prefills
+
+
+# ---------------------------------------------------------------------------
+# satellite: no-recompile guard
+# ---------------------------------------------------------------------------
+
+def test_no_recompile_within_bucket(params, mesh1):
+    """Mixed prompt lengths inside ONE bucket (prefill_bucket_min=16
+    covers 1..16) must add at most one prefill-program cache entry per
+    bucket geometry and exactly one decode-program entry — steady-state
+    traffic triggers zero XLA recompiles."""
+    cfg = _config(max_new_tokens=4)
+    eng = InferenceEngine(CFG, mesh1, params, cfg)
+    # warm: one short prompt compiles the bucket-16 prefill + chunk
+    eng.submit(_prompt(8))
+    eng.run_pending()
+    pf0 = _compiled_prefill.cache_info().currsize
+    dc0 = _compiled_decode_chunk.cache_info().currsize
+    for t0, seed in [(9, 1), (11, 2), (16, 3), (8, 4), (13, 5)]:
+        eng.submit(_prompt(t0, seed))
+    eng.run_pending()
+    assert _compiled_prefill.cache_info().currsize == pf0
+    assert _compiled_decode_chunk.cache_info().currsize == dc0
+    # a prompt in the NEXT bucket adds exactly one prefill entry and
+    # still reuses the same decode program
+    eng.submit(_prompt(20))
+    eng.run_pending()
+    assert _compiled_prefill.cache_info().currsize == pf0 + 1
+    assert _compiled_decode_chunk.cache_info().currsize == dc0
+
+
+# ---------------------------------------------------------------------------
+# slot lifecycle: no head-of-line blocking
+# ---------------------------------------------------------------------------
+
+def test_short_request_overtakes_long_one(params, mesh1):
+    """A short request admitted while a long one is mid-decode lands
+    in a free slot at the next chunk boundary and finishes first —
+    the head-of-line blocking the batch-to-completion path cannot
+    avoid."""
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(decode_chunk=2, max_new_tokens=40))
+    long_req = eng.submit(_prompt(), max_new_tokens=40)
+    eng.tick()                             # long admitted, decoding
+    short = eng.submit(_prompt(12, 5), max_new_tokens=2)
+    eng.tick()                             # short joins mid-stream
+    assert short.status == RequestStatus.COMPLETED
+    assert long_req.status == RequestStatus.RUNNING
+    eng.run_pending()
+    assert long_req.status == RequestStatus.COMPLETED
+    assert long_req.generated.shape[0] == 40
+
+
+def test_freed_slot_is_refilled_from_queue(params, mesh1):
+    """With a 2-slot pool and 4 requests, later requests are admitted
+    into slots freed by earlier completions — and every result matches
+    its solo run (slot reuse never leaks stale cache rows)."""
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(max_batch_size=2, max_new_tokens=4))
+    hs = [eng.submit(_prompt(8, i)) for i in range(4)]
+    eng.run_pending()
+    for h in hs:
+        assert h.status == RequestStatus.COMPLETED
+        solo = InferenceEngine(CFG, mesh1, params,
+                               _config(max_new_tokens=4))
+        s = solo.submit(h.prompt)
+        solo.run_pending()
+        np.testing.assert_array_equal(h.result(0), s.result(0))
+
+
+# ---------------------------------------------------------------------------
+# satellite: slot-level fault isolation
+# ---------------------------------------------------------------------------
+
+def test_poisoned_slot_quarantined_co_resident_survive(params, mesh1):
+    """Per-request poison in a 3-resident pool: the pool call fails,
+    ALL residents are preempted to solo isolation, the poisoned slot's
+    request is quarantined, and both co-resident requests complete
+    with exactly their clean-run tokens."""
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_retries=1), fault_injector=inj)
+    a = eng.submit(_prompt(8, 1))
+    bad = eng.submit(_prompt(12, 2))
+    b = eng.submit(_prompt(10, 3))
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+    assert bad.status == RequestStatus.QUARANTINED
+    with pytest.raises(RequestQuarantined):
+        bad.result(0)
+    assert eng.stats["quarantined"] == 1
+    assert eng.stats["preempted"] == 3     # all residents evicted
+    for h in (a, b):
+        solo = InferenceEngine(CFG, mesh1, params, _config())
+        s = solo.submit(h.prompt)
+        solo.run_pending()
+        np.testing.assert_array_equal(h.result(0), s.result(0))
+    # the pool is clean afterwards: next request decodes normally
+    nxt = eng.submit(_prompt(8, 7))
+    eng.run_pending()
+    assert nxt.status == RequestStatus.COMPLETED
+
+
+def test_mid_stream_poison_preserves_committed_prefix(params, mesh1):
+    """A request POISONED only after some of its neighbour's chunks
+    committed: the next pool chunk fails, BOTH residents are evicted,
+    and the healthy one resumes solo from its committed prefix — final
+    tokens equal to the clean run's, byte for byte (no re-decode
+    drift across the preemption boundary)."""
+    ref = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=10))
+    h_ref = ref.submit(_prompt())
+    ref.run_pending()
+
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=10, max_retries=1),
+                          fault_injector=inj)
+    good = eng.submit(_prompt())
+    bad = eng.submit(_prompt(12, 2))
+    eng.tick()                             # both admitted, 1 chunk in
+    committed = good.generated.copy()
+    assert committed.shape[0] > 0
+    inj.poison_requests.add(bad.rid)       # poison lands MID-STREAM
+    eng.run_pending()
+    assert bad.status == RequestStatus.QUARANTINED
+    assert good.status == RequestStatus.COMPLETED
+    assert eng.stats["preempted"] == 2
+    np.testing.assert_array_equal(
+        good.generated[:committed.shape[0]], committed)
+    np.testing.assert_array_equal(good.result(0), h_ref.result(0))
+
+
+def test_prefill_fault_knob_transient_and_persistent(params, mesh1):
+    """ServingFaultInjector.prefill_fail_at targets ONLY admission
+    prefills: transient -> retried and completed; persistent at every
+    step -> the admission quarantines while an already-decoding slot
+    keeps its request alive and completes."""
+    inj = ServingFaultInjector(prefill_fail_at=[0])
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    assert inj.prefills_failed == 1 and eng.stats["retries"] == 1
+
+    inj2 = ServingFaultInjector(prefill_fail_at=range(100),
+                                persistent=True)
+    eng2 = InferenceEngine(CFG, mesh1, params,
+                           _config(max_retries=1, max_new_tokens=12,
+                                   breaker_failure_threshold=100),
+                           fault_injector=inj2)
+    ok = eng2.submit(_prompt(8, 1))
+    eng2.tick()                            # ok admitted (no injector
+    #                                        hit: prefill step 0 fails,
+    #                                        retries, isolates...
+    # -> actually step 0 IS a prefill: ok's admission fails pool-side
+    # and solo-side too; it is quarantined. The knob's guarantee is
+    # that DECODE steps never fail: a second engine with the knob
+    # cleared after one admission proves decode is untouched.
+    assert ok.status == RequestStatus.QUARANTINED
+    inj2.prefill_fail_at.clear()
+    ok2 = eng2.submit(_prompt(8, 2))
+    eng2.run_pending()
+    assert ok2.status == RequestStatus.COMPLETED
+    assert inj2.prefills_failed >= 2
+
+
+# ---------------------------------------------------------------------------
+# hot reload: preempt-and-resume semantics
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_preempts_inflight_slots(tmp_path, params, mesh1):
+    """Reload mid-stream: the in-flight slot is preempted (evicted,
+    requeued at the queue front, committed tokens preserved), the
+    request re-prefills under the NEW weights and completes; new
+    admissions use the new weights immediately."""
+    mgr = CheckpointManager_for(tmp_path, params)
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=10))
+    h = eng.submit(_prompt())
+    eng.tick()                             # prefill + 1 chunk
+    committed = h.generated.copy()
+    assert 0 < committed.shape[0] < 10
+    assert eng.health()["slots_occupied"] == 1
+
+    assert eng.reload_weights(mgr, step=2) == 2   # zeroed weights
+    assert eng.stats["preempted"] == 1
+    assert h.status == RequestStatus.QUEUED       # requeued, not lost
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    # committed prefix survived the preemption byte-for-byte
+    np.testing.assert_array_equal(
+        h.generated[:committed.shape[0]], committed)
+    # ... but the continuation ran under the new (zeroed) weights
+    ref = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=10))
+    hr = ref.submit(_prompt())
+    ref.run_pending()
+    assert not np.array_equal(h.generated, hr.generated)
+
+    # back to the original weights: a fresh request reproduces the
+    # old-weights run exactly (reload state fully swapped both ways)
+    assert eng.reload_weights(mgr, step=1) == 1
+    again = eng.submit(_prompt())
+    eng.run_pending()
+    np.testing.assert_array_equal(again.result(0), hr.result(0))
+    assert eng.stats["reloads"] == 2
+
+
+def CheckpointManager_for(tmp_path, params):
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    mgr.save_tree(jax.tree_util.tree_map(lambda a: a * 0, params), 2)
+    return mgr
+
+
+# ---------------------------------------------------------------------------
+# satellite: continuous-batching metrics
+# ---------------------------------------------------------------------------
+
+def test_slot_metrics_published_and_scrapeable(params, mesh1):
+    """serving_slot_occupancy (pull gauge), serving_prefill_seconds /
+    serving_decode_step_seconds (decode-bucketed histograms) and
+    serving_requests_preempted_total all publish into the engine
+    registry and render in the Prometheus exposition."""
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    from deeplearning4j_tpu.observability.metrics import (
+        DECODE_LATENCY_BUCKETS)
+
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=12))
+    occ = eng.registry.get("serving_slot_occupancy")
+    assert occ.value == 0.0
+    h = eng.submit(_prompt(), max_new_tokens=12)
+    eng.tick()
+    assert occ.value == 1.0                # pull-model: live view
+    eng.run_pending()
+    assert occ.value == 0.0 and h.done()
+
+    pf = eng.registry.get("serving_prefill_seconds")
+    st = eng.registry.get("serving_decode_step_seconds")
+    assert pf.buckets == tuple(sorted(DECODE_LATENCY_BUCKETS))
+    assert st.buckets == tuple(sorted(DECODE_LATENCY_BUCKETS))
+    assert pf._unlabeled().snapshot()[2] == 1
+    assert st._unlabeled().snapshot()[2] == 6   # 11 tokens / chunk 2
+
+    text = prometheus_text(eng.registry)
+    assert "serving_slot_occupancy 0" in text
+    assert "serving_prefill_seconds_bucket" in text
+    assert "serving_requests_preempted_total 0" in text
+    assert eng.stats["preempted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# injector unit behavior
+# ---------------------------------------------------------------------------
+
+def test_injector_on_prefill_semantics():
+    inj = ServingFaultInjector(fail_at=[1], prefill_fail_at=[0],
+                               poison_requests=[9])
+    with pytest.raises(TrainingFailure, match="prefill"):
+        inj.on_prefill(0)                  # prefill-only knob
+    inj.on_prefill(0)                      # one-shot: consumed
+    with pytest.raises(TrainingFailure):
+        inj.on_prefill(1)                  # shared fail_at fires too
+    with pytest.raises(TrainingFailure, match="poisoned"):
+        inj.on_prefill(2, request_ids=[9])
+    inj.on_prefill(2, request_ids=[3])
+    assert inj.prefills_failed == 1 and inj.injected == 3
