@@ -673,6 +673,105 @@ def bench_ckpt_async(reps: int = 2, *, saves: int = 5,
             "restored_byte_identical": bool(identical)}
 
 
+def bench_quant_decode(reps: int = 2, *, n_requests: int = 16,
+                       new_tokens: int = 32, num_slots: int = 8,
+                       d_model: int = 256, n_layers: int = 4,
+                       seed: int = 0) -> dict:
+    """Quantized inference 2x2 (ISSUE-5 acceptance): int8 vs float32
+    WEIGHTS crossed with int8 vs float KV on the continuous-batching
+    engine — same traffic, same pool geometry, same chunk quantum; the
+    only difference between arms is the precision knobs. Reported per
+    arm: aggregate tokens/sec over a burst of mixed-length requests
+    (best-of ``reps`` replays after a warm run compiles every bucket)
+    and RESIDENT BYTES (weight tree + slot-pool KV state — the
+    at-rest HBM the quantization exists to reclaim; on this
+    memory-bound decode path bytes ARE capacity: halve them and the
+    same HBM hosts twice the slots). Accuracy sidecar:
+    max-logit-divergence of the int8 weight tree vs float32 over a
+    prompt batch, and the int8-KV arm's greedy token match fraction
+    vs the float arm (the strict fidelity guarantee lives in
+    tests/test_quant.py on the sharpened harness; the bench reports
+    the raw-model number). CPU-container honest: at-rest byte ratios
+    are backend-invariant; chip tokens/sec rows land with the next
+    driver capture, where int8 HBM streaming is the actual win."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.quant.model import (max_logit_divergence,
+                                                quantize_params)
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=d_model, n_heads=8,
+                            n_layers=n_layers, max_len=256)
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(8, 33))).astype(np.int32)
+               for _ in range(n_requests)]
+
+    arms = {"f32_w_f32_kv": (None, None),
+            "int8_w_f32_kv": ("int8", None),
+            "f32_w_int8_kv": (None, "int8"),
+            "int8_w_int8_kv": ("int8", "int8")}
+    econf = EngineConfig(max_batch_size=num_slots,
+                         max_queue=2 * n_requests,
+                         max_new_tokens=new_tokens, decode_chunk=8)
+
+    out: dict = {"config": f"quant_decode_{n_layers}L{d_model}d_"
+                           f"Ns{num_slots}"}
+    tokens = {}
+    total_new = n_requests * new_tokens
+    for arm, (qw, qkv) in arms.items():
+        eng = InferenceEngine(cfg, mesh, params, econf,
+                              quantize=qw, kv_quantize=qkv)
+
+        def replay():
+            hs = [eng.submit(p) for p in prompts]
+            eng.run_pending()
+            return [h.result(0) for h in hs]
+
+        replay()                                   # warm: compiles
+        best = float("inf")
+        res = None
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            res = replay()
+            best = min(best, _t.perf_counter() - t0)
+        tokens[arm] = res
+        h = eng.health()
+        resident = h["param_bytes"] + h["kv_pool_bytes"]
+        out[arm] = {"tokens_per_sec": round(total_new / best, 1),
+                    "param_bytes": h["param_bytes"],
+                    "kv_pool_bytes": h["kv_pool_bytes"],
+                    "resident_bytes": resident}
+
+    f32 = out["f32_w_f32_kv"]["resident_bytes"]
+    q = out["int8_w_int8_kv"]["resident_bytes"]
+    out["resident_bytes_reduction_pct"] = round(100 * (1 - q / f32), 1)
+    out["value"] = out["int8_w_int8_kv"]["tokens_per_sec"]
+    out["unit"] = "tokens/sec/chip"
+    # accuracy sidecars
+    toks = jnp.asarray(np.stack(
+        [p[:8] for p in prompts if p.shape[0] >= 8][:4]))
+    out["max_logit_divergence_int8_w"] = round(
+        max_logit_divergence(cfg, params, quantize_params(params),
+                             toks), 4)
+    match = np.mean([np.mean(a[len(p):] == b[len(p):])
+                     for p, a, b in zip(prompts,
+                                        tokens["f32_w_f32_kv"],
+                                        tokens["f32_w_int8_kv"])])
+    out["int8_kv_token_match_frac"] = round(float(match), 4)
+    return out
+
+
 def bench_word2vec(reps: int = 2) -> dict:
     """Word2Vec skip-gram+neg at the reference-workload-class vocab
     (v=100k) — the driver-captured row VERDICT r5 weak #2 demanded
@@ -697,6 +796,7 @@ BENCHES = {"transformer": bench_transformer,
            "engine_decode_metrics": bench_engine_decode_metrics,
            "engine_continuous": bench_engine_continuous,
            "ckpt_async": bench_ckpt_async,
+           "quant_decode": bench_quant_decode,
            "word2vec": bench_word2vec}
 
 

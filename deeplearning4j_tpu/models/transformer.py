@@ -16,7 +16,12 @@ off. Design:
   `dot_product_attention` core as the DSL layer, so ring attention drops in
   by replacing that one call.
 - Weights stay float32 at rest; activations can run bfloat16 (`dtype`),
-  accumulating in f32 on the MXU.
+  accumulating in f32 on the MXU. For SERVING, `quant/model.py`
+  quantizes the tree to int8 (per-output-channel scales); every weight
+  use here goes through `.astype(activation_dtype)`, which doubles as
+  the on-the-fly dequantization when the leaf is a
+  `quant.core.QuantizedTensor` — a quantized tree is a drop-in
+  `params` argument for forward/forward_hidden/decode/generate.
 """
 from __future__ import annotations
 
@@ -81,6 +86,12 @@ class TransformerConfig:
     # with an online logsumexp and never materializes more than
     # [B*T, C] — see chunked_cross_entropy
     xent_chunk: int = 0
+    # KV-cache at-rest dtype (None = the activation dtype). bf16 caches
+    # under f32 activations halve decode-cache HBM on their own; the
+    # quantized serving path (quant/kv.py) goes further with int8 rows
+    # + per-row scales. Cache writes cast on store; attention reads
+    # promote back through the usual matmul dtype rules.
+    cache_dtype: Optional[str] = None
 
     @property
     def d_head(self) -> int:
@@ -93,6 +104,14 @@ class TransformerConfig:
     def activation_dtype(self):
         return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                 "float64": jnp.float64}[self.dtype]
+
+    def cache_jnp_dtype(self):
+        """KV-cache storage dtype: `cache_dtype` when set, else the
+        activation dtype (the pre-quantization default)."""
+        if not self.cache_dtype:
+            return self.activation_dtype()
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float64": jnp.float64}[self.cache_dtype]
 
 
 def _winit(key, shape, fan_in):
@@ -167,8 +186,12 @@ def moe_mlp(h: Array, p: Dict[str, Array], cfg: TransformerConfig) -> Array:
             * keep[..., None].astype(jnp.float32)
             * onehot[..., None])                                 # [N, E, C]
     xin = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
-    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["We1"]))
-    out = jnp.einsum("ecf,efd->ecd", z, p["We2"])                # [E, C, D]
+    # .astype(f32) is a no-op on the float tree and the on-the-fly
+    # dequantization on a quantized one (quant/core.QuantizedTensor)
+    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin,
+                               p["We1"].astype(jnp.float32)))
+    out = jnp.einsum("ecf,efd->ecd", z,
+                     p["We2"].astype(jnp.float32))               # [E, C, D]
     comb = disp * prob[:, None, None]
     y = jnp.einsum("nec,ecd->nd", comb, out)
     return y.astype(h.dtype).reshape(b, t, d)
@@ -268,13 +291,19 @@ def slot_cache_shape(cfg: TransformerConfig, num_slots: int,
 
 
 def init_cache(cfg: TransformerConfig, batch: int,
-               max_len: Optional[int] = None) -> Tuple[Array, Array]:
+               max_len: Optional[int] = None,
+               cache_dtype=None) -> Tuple[Array, Array]:
     """Stacked per-layer KV caches [L, B, S, D] (k, v) — heads kept
     FLATTENED in the cache (D = H*Dh): the minor-most dims are then
     (S-tile, D=lane-full), a clean 2D tiling for the per-position
-    dynamic_update_slice; views reshape to heads at the attention."""
+    dynamic_update_slice; views reshape to heads at the attention.
+
+    ``cache_dtype`` (a jnp dtype) overrides `cfg.cache_dtype` for this
+    allocation — the explicit passthrough for bf16 caches under f32
+    activations (writes cast on store via `.astype(cache.dtype)`, the
+    attention promotes reads back)."""
     shape = slot_cache_shape(cfg, batch, max_len)
-    dt = cfg.activation_dtype()
+    dt = cache_dtype if cache_dtype is not None else cfg.cache_jnp_dtype()
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 

@@ -137,8 +137,12 @@ def _local_moe_mlp(x2, p, cfg: TransformerConfig, dp: int, valid=None):
     disp = (jax.nn.one_hot(posc, cap_loc, dtype=jnp.float32)
             * keep_oh[..., None])                               # [N,E,C]
     xin = jnp.einsum("nec,nd->ecd", disp, x2.astype(jnp.float32))
-    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["We1"]))
-    out = jnp.einsum("ecf,efd->ecd", z, p["We2"])   # partial over tp
+    # .astype(f32): identity on float trees, on-the-fly dequantization
+    # on quantized ones (quant/core.QuantizedTensor)
+    z = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin,
+                               p["We1"].astype(jnp.float32)))
+    out = jnp.einsum("ecf,efd->ecd", z,
+                     p["We2"].astype(jnp.float32))  # partial over tp
     comb = disp * prob[:, None, None]
     return jnp.einsum("nec,ecd->nd", comb, out).astype(x2.dtype)
 
@@ -230,7 +234,8 @@ def _local_block_decode(h, p, ck_all, cv_all, layer: int, pos,
 def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
                            max_new_tokens: int,
                            temperature: float = 0.0,
-                           top_k: int = 0, top_p: float = 1.0):
+                           top_k: int = 0, top_p: float = 1.0,
+                           quantized=None):
     """Compiled sharded generate: (params, prompt [B, T0], key) ->
     [B, T0 + max_new_tokens]. Params must be placed with
     `shard_serving_params`; batch shards over 'data', heads/MLP over
@@ -239,9 +244,15 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
     temperature<=0 is greedy; top_k/top_p apply the single-chip
     `_filter_logits` semantics (after temperature, before the
     categorical draw) — logits are replicated across 'model' ranks,
-    so every rank filters and samples identically."""
+    so every rank filters and samples identically.
+
+    ``quantized`` ("int8"/"fp8"): params are a
+    `quant.model.quantize_params` tree placed with
+    `shard_quantized_serving_params`; the decode math is unchanged —
+    every weight use dequantizes on the fly via `.astype`."""
     tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
-    specs = serving_param_specs(cfg)
+    quantized, _ = _resolve_quant(quantized, None)
+    specs = _serving_specs(cfg, quantized)
 
     def run(params, prompt, key):
         dt = cfg.activation_dtype()
@@ -265,10 +276,11 @@ def make_parallel_generate(cfg: TransformerConfig, mesh: Mesh,
 
         h, (ks, vs) = lax.scan(pf_body, h, params["blocks"])
         d_loc = (cfg.n_heads // tp) * cfg.d_head
-        ck = jnp.zeros((cfg.n_layers, b, cfg.max_len, d_loc), dt)
+        cdt = cfg.cache_jnp_dtype()
+        ck = jnp.zeros((cfg.n_layers, b, cfg.max_len, d_loc), cdt)
         cv = jnp.zeros_like(ck)
-        ck = ck.at[:, :, :t0].set(ks.astype(dt))
-        cv = cv.at[:, :, :t0].set(vs.astype(dt))
+        ck = ck.at[:, :, :t0].set(ks.astype(cdt))
+        cv = cv.at[:, :, :t0].set(vs.astype(cdt))
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         logits = jnp.matmul(h[:, -1], params["Wout"].astype(h.dtype))
         pos0 = jnp.asarray(t0, jnp.int32)
@@ -346,6 +358,31 @@ def _check_serving_mesh(cfg: TransformerConfig, mesh: Mesh,
 
 _SLOT_CACHE_SPEC = P(None, "data", None, "model")   # [L, Ns, S, D]
 _SLOT_VEC_SPEC = P("data")                          # per-slot scalars
+# quantized-KV per-row scales [L, Ns, S, tp]: the trailing axis holds
+# each model-rank's independent scale for its D_loc head shard (local
+# view [L, ns, S, 1]) — see quant/kv.py for the layout rationale
+_SLOT_SCALE_SPEC = P(None, "data", None, "model")
+
+
+def _resolve_quant(quantized, kv_mode):
+    """Normalize the two quantization knobs through
+    `quant.core.resolve_mode` (fp8 falls back to int8 off-TPU) without
+    importing quant at module load."""
+    if quantized is None and kv_mode is None:
+        return None, None
+    from deeplearning4j_tpu.quant.core import resolve_mode
+    return resolve_mode(quantized), resolve_mode(kv_mode)
+
+
+def _serving_specs(cfg: TransformerConfig, quantized):
+    """Param in_specs/placement tree: the serving layout, run through
+    `quant.model.quantize_specs` when the tree is quantized (values
+    keep the float spec, scales drop sharding on their size-1 axis)."""
+    specs = serving_param_specs(cfg)
+    if quantized:
+        from deeplearning4j_tpu.quant.model import quantize_specs
+        specs = quantize_specs(specs, mode=quantized)
+    return specs
 
 
 def _sample_slots(logits, posidx, key, dp: int, temperature: float,
@@ -413,22 +450,94 @@ def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
     return h, ck_all, cv_all
 
 
-def init_slot_state(cfg: TransformerConfig, mesh: Mesh, num_slots: int):
+def _local_block_decode_slotted_q(h, p, ck_all, cv_all, ksc, vsc,
+                                  layer: int, pos, act,
+                                  cfg: TransformerConfig, tp: int,
+                                  dp: int, kv_mode: str):
+    """Quantized-KV variant of _local_block_decode_slotted: the new
+    K/V row is quantized ON WRITE (per-row absmax — quant/kv.py) into
+    the int8/fp8 caches, with its float32 scale written to the
+    parallel [L, Ns, S, 1]-local scale planes. The attention consumer
+    never rebuilds a dequantized cache: the K scale folds into the
+    score row (``(q·k_int)·kscale_s``) and the V scale into the
+    probability row (``(p·vscale_s)·v_int``) — algebraically the
+    dequantized attention, touching [Ns, S] scale vectors instead of
+    [Ns, S, D] panels. Masking/softmax numerics match the float path
+    exactly (same NEG_INF mask, f32 softmax)."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    from deeplearning4j_tpu.quant.kv import quantize_rows
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    ns = h.shape[0]
+    s_max = ck_all.shape[2]
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    q = jnp.matmul(x[:, 0], p["Wq"].astype(x.dtype)) \
+        .reshape(ns, h_loc, cfg.d_head)
+    k = jnp.matmul(x[:, 0], p["Wk"].astype(x.dtype))      # [Ns, D_loc]
+    v = jnp.matmul(x[:, 0], p["Wv"].astype(x.dtype))
+    rows = jnp.arange(ns)
+    wp = jnp.clip(pos, 0, s_max - 1)
+    kq, ksr = quantize_rows(k, kv_mode)
+    vq, vsr = quantize_rows(v, kv_mode)
+    # masked in-place row+scale writes (same static-scatter trick as
+    # the float path: inactive slots rewrite their current row/scale)
+    k_wr = jnp.where(act[:, None], kq, ck_all[layer, rows, wp])
+    v_wr = jnp.where(act[:, None], vq, cv_all[layer, rows, wp])
+    ks_wr = jnp.where(act, ksr, ksc[layer, rows, wp, 0])
+    vs_wr = jnp.where(act, vsr, vsc[layer, rows, wp, 0])
+    ck_all = ck_all.at[layer, rows, wp].set(k_wr)
+    cv_all = cv_all.at[layer, rows, wp].set(v_wr)
+    ksc = ksc.at[layer, rows, wp, 0].set(ks_wr)
+    vsc = vsc.at[layer, rows, wp, 0].set(vs_wr)
+    kh = ck_all[layer].astype(jnp.float32) \
+        .reshape(ns, s_max, h_loc, cfg.d_head)
+    vh = cv_all[layer].astype(jnp.float32) \
+        .reshape(ns, s_max, h_loc, cfg.d_head)
+    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
+        * ksc[layer, :, :, 0][:, None, :] \
+        * (1.0 / (cfg.d_head ** 0.5))
+    sc = jnp.where(jnp.arange(s_max)[None, None, :]
+                   <= wp[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhs,bshd->bhd",
+                   pr * vsc[layer, :, :, 0][:, None, :], vh)
+    a = a.astype(q.dtype)
+    h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
+                               p["Wo"].astype(h.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    h = _local_mlp(h, x, p, cfg, dp, g_model)
+    return h, ck_all, cv_all, ksc, vsc
+
+
+def init_slot_state(cfg: TransformerConfig, mesh: Mesh, num_slots: int,
+                    kv_mode=None, cache_dtype=None):
     """Allocate the persistent slot-pool state (ck, cv, pos, tok) on
     the serving mesh: KV caches [L, Ns, S, D] (slot axis over 'data',
     flattened heads over 'model' — models/transformer.slot_cache_shape)
-    plus per-slot position and last-token vectors. These four arrays
-    live on device for the engine's lifetime; every prefill/decode
-    program consumes and returns them functionally, so a failed call
-    leaves the pool bit-identical (retry/isolation need no repair)."""
+    plus per-slot position and last-token vectors. These arrays live
+    on device for the engine's lifetime; every prefill/decode program
+    consumes and returns them functionally, so a failed call leaves
+    the pool bit-identical (retry/isolation need no repair).
+
+    ``kv_mode`` ("int8"/"fp8") switches to the QUANTIZED pool —
+    `quant.kv.init_quant_slot_state`'s 6-tuple (ck, cv, kscale,
+    vscale, pos, tok) consumed by the ``kv_mode=...`` program
+    variants. ``cache_dtype`` (jnp dtype) overrides `cfg.cache_dtype`
+    for the float pool (bf16 caches under f32 activations)."""
     from jax.sharding import NamedSharding
 
     from deeplearning4j_tpu.models.transformer import slot_cache_shape
+    _, kv_mode = _resolve_quant(None, kv_mode)
+    if kv_mode is not None:
+        from deeplearning4j_tpu.quant.kv import init_quant_slot_state
+        return init_quant_slot_state(cfg, mesh, num_slots, kv_mode)
     dp = mesh.shape["data"]
     if num_slots % dp:
         raise ValueError(f"num_slots {num_slots} not divisible by "
                          f"data axis {dp}")
-    dt = cfg.activation_dtype()
+    dt = (cache_dtype if cache_dtype is not None
+          else cfg.cache_jnp_dtype())
     shape = slot_cache_shape(cfg, num_slots)
     kv_sh = NamedSharding(mesh, _SLOT_CACHE_SPEC)
     vec_sh = NamedSharding(mesh, _SLOT_VEC_SPEC)
@@ -442,7 +551,8 @@ def init_slot_state(cfg: TransformerConfig, mesh: Mesh, num_slots: int):
 def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
                             bucket_len: int, num_slots: int,
                             temperature: float = 0.0,
-                            top_k: int = 0, top_p: float = 1.0):
+                            top_k: int = 0, top_p: float = 1.0,
+                            quantized=None, kv_mode=None):
     """Compiled slot-pool prefill: (params, ck, cv, pos, tok,
     prompts [Ns, Tb], plen [Ns], key) -> (ck, cv, pos, tok,
     first [Ns]).
@@ -455,17 +565,27 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
     row plen[i]-1 (returned in ``first``; -1 for non-admitted slots).
     Slots with plen[i] == 0 pass through untouched — so one fixed
     (bucket_len, num_slots) geometry serves every admission pattern
-    with zero recompiles."""
+    with zero recompiles.
+
+    ``quantized`` ("int8"/"fp8") marks the params as a quantized tree
+    (specs adapt; math is unchanged via on-the-fly dequant).
+    ``kv_mode`` switches to the QUANTIZED slot pool: the state grows
+    per-row scale planes — (params, ck, cv, kscale, vscale, pos, tok,
+    prompts, plen, key) -> (ck, cv, kscale, vscale, pos, tok, first)
+    — and prefilled K/V rows are quantized on write (quant/kv.py)."""
     tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
     if num_slots % dp:
         raise ValueError(f"num_slots {num_slots} not divisible by "
                          f"data axis {dp}")
     if not 0 < bucket_len <= cfg.max_len:
         raise ValueError(f"bucket_len {bucket_len} out of "
                          f"(0, {cfg.max_len}]")
-    specs = serving_param_specs(cfg)
+    specs = _serving_specs(cfg, quantized)
 
-    def run(params, ck, cv, pos, tok, prompts, plen, key):
+    def compute(params, prompts, plen, key):
+        """Shared prefill math: block scan + first-token sampling.
+        Returns (admit, ks, vs, first, pos_new-ready pieces)."""
         dt = cfg.activation_dtype()
         ns, tb = prompts.shape
         admit = plen > 0
@@ -478,36 +598,75 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
             return _local_block_prefill(hh, p, cfg, tp, dp, valid=valid)
 
         h, (ks, vs) = lax.scan(pf_body, h, params["blocks"])
-        keep = admit[None, :, None, None]
-        ck = ck.at[:, :, :tb, :].set(
-            jnp.where(keep, ks.astype(ck.dtype), ck[:, :, :tb, :]))
-        cv = cv.at[:, :, :tb, :].set(
-            jnp.where(keep, vs.astype(cv.dtype), cv[:, :, :tb, :]))
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         last = h[jnp.arange(ns), jnp.clip(plen - 1, 0, tb - 1)]
         logits = jnp.matmul(last, params["Wout"].astype(last.dtype))
         first = _sample_slots(logits, plen, key, dp, temperature,
                               top_k, top_p)
+        return admit, tb, ks, vs, first
+
+    def finish(admit, first, plen, pos, tok):
         pos = jnp.where(admit, plen.astype(pos.dtype), pos)
         tok = jnp.where(admit, first, tok)
-        return (ck, cv, pos, tok,
-                jnp.where(admit, first, jnp.asarray(-1, jnp.int32)))
+        return pos, tok, jnp.where(admit, first,
+                                   jnp.asarray(-1, jnp.int32))
 
-    sharded = shard_map(
-        run, mesh=mesh,
-        in_specs=(specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                  _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                  _SLOT_VEC_SPEC, P()),
-        out_specs=(_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
-                   _SLOT_VEC_SPEC, _SLOT_VEC_SPEC),
-        check_rep=True)
+    if kv_mode is None:
+        def run(params, ck, cv, pos, tok, prompts, plen, key):
+            admit, tb, ks, vs, first = compute(params, prompts, plen,
+                                               key)
+            keep = admit[None, :, None, None]
+            ck = ck.at[:, :, :tb, :].set(
+                jnp.where(keep, ks.astype(ck.dtype), ck[:, :, :tb, :]))
+            cv = cv.at[:, :, :tb, :].set(
+                jnp.where(keep, vs.astype(cv.dtype), cv[:, :, :tb, :]))
+            pos, tok, first = finish(admit, first, plen, pos, tok)
+            return ck, cv, pos, tok, first
+
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                    _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+    else:
+        def run(params, ck, cv, ksc, vsc, pos, tok, prompts, plen,
+                key):
+            from deeplearning4j_tpu.quant.kv import quantize_rows
+            admit, tb, ks, vs, first = compute(params, prompts, plen,
+                                               key)
+            kq, ksr = quantize_rows(ks, kv_mode)   # [L, Ns, Tb, D_loc]
+            vq, vsr = quantize_rows(vs, kv_mode)
+            keep = admit[None, :, None, None]
+            keep3 = admit[None, :, None]
+            ck = ck.at[:, :, :tb, :].set(
+                jnp.where(keep, kq, ck[:, :, :tb, :]))
+            cv = cv.at[:, :, :tb, :].set(
+                jnp.where(keep, vq, cv[:, :, :tb, :]))
+            ksc = ksc.at[:, :, :tb, 0].set(
+                jnp.where(keep3, ksr, ksc[:, :, :tb, 0]))
+            vsc = vsc.at[:, :, :tb, 0].set(
+                jnp.where(keep3, vsr, vsc[:, :, :tb, 0]))
+            pos, tok, first = finish(admit, first, plen, pos, tok)
+            return ck, cv, ksc, vsc, pos, tok, first
+
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                    _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
     return jax.jit(sharded)
 
 
 def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
                            chunk: int, num_slots: int,
                            temperature: float = 0.0,
-                           top_k: int = 0, top_p: float = 1.0):
+                           top_k: int = 0, top_p: float = 1.0,
+                           quantized=None, kv_mode=None):
     """Compiled slot-pool decode chunk: (params, ck, cv, pos, tok,
     active [Ns] bool, rem [Ns] int32, key) -> (ck, cv, pos, tok,
     toks [Ns, chunk]).
@@ -520,53 +679,96 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
     no further writes, pos frozen, emitted tokens -1 — so per-slot
     budgets never overrun the cache and finished slots stop burning
     writes. active/rem/pos are runtime DATA: one compiled program per
-    (chunk, num_slots) geometry covers all traffic."""
+    (chunk, num_slots) geometry covers all traffic.
+
+    ``quantized`` ("int8"/"fp8") marks the params as a quantized tree;
+    ``kv_mode`` switches to the quantized slot pool — the state grows
+    per-row scale planes ((params, ck, cv, kscale, vscale, pos, tok,
+    active, rem, key) -> (..., toks)) and the per-step K/V row is
+    quantized on write (_local_block_decode_slotted_q)."""
     tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
     if num_slots % dp:
         raise ValueError(f"num_slots {num_slots} not divisible by "
                          f"data axis {dp}")
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
-    specs = serving_param_specs(cfg)
+    specs = _serving_specs(cfg, quantized)
 
-    def run(params, ck, cv, pos, tok, active, rem, key):
+    def sample_and_advance(params, h, act, pos, tok, rem, key):
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
+        nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
+                            top_k, top_p)
+        tok = jnp.where(act, nxt, tok)
+        emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
+        pos = jnp.where(act, pos + 1, pos)
+        rem = jnp.where(act, rem - 1, rem)
+        return pos, tok, rem, emit
+
+    def embed_step(params, pos, tok):
         dt = cfg.activation_dtype()
+        emb = params["embed"].astype(dt)[tok]
+        pv = params["pos"].astype(dt)[
+            jnp.clip(pos, 0, cfg.max_len - 1)]
+        return (emb + pv)[:, None, :]
 
-        def step(carry, _):
-            ck, cv, pos, tok, rem = carry
-            act = active & (rem > 0)
-            emb = params["embed"].astype(dt)[tok]
-            pv = params["pos"].astype(dt)[
-                jnp.clip(pos, 0, cfg.max_len - 1)]
-            h = (emb + pv)[:, None, :]
-            for layer in range(cfg.n_layers):
-                p_l = {kk: vv[layer]
-                       for kk, vv in params["blocks"].items()}
-                h, ck, cv = _local_block_decode_slotted(
-                    h, p_l, ck, cv, layer, pos, act, cfg, tp, dp)
-            h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
-            logits = jnp.matmul(h[:, 0],
-                                params["Wout"].astype(h.dtype))
-            nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
-                                top_k, top_p)
-            tok = jnp.where(act, nxt, tok)
-            emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
-            pos = jnp.where(act, pos + 1, pos)
-            rem = jnp.where(act, rem - 1, rem)
-            return (ck, cv, pos, tok, rem), emit
+    if kv_mode is None:
+        def run(params, ck, cv, pos, tok, active, rem, key):
+            def step(carry, _):
+                ck, cv, pos, tok, rem = carry
+                act = active & (rem > 0)
+                h = embed_step(params, pos, tok)
+                for layer in range(cfg.n_layers):
+                    p_l = {kk: vv[layer]
+                           for kk, vv in params["blocks"].items()}
+                    h, ck, cv = _local_block_decode_slotted(
+                        h, p_l, ck, cv, layer, pos, act, cfg, tp, dp)
+                pos, tok, rem, emit = sample_and_advance(
+                    params, h, act, pos, tok, rem, key)
+                return (ck, cv, pos, tok, rem), emit
 
-        (ck, cv, pos, tok, _), toks = lax.scan(
-            step, (ck, cv, pos, tok, rem), None, length=chunk)
-        return ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1)
+            (ck, cv, pos, tok, _), toks = lax.scan(
+                step, (ck, cv, pos, tok, rem), None, length=chunk)
+            return ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1)
 
-    sharded = shard_map(
-        run, mesh=mesh,
-        in_specs=(specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                  _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                  _SLOT_VEC_SPEC, P()),
-        out_specs=(_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
-                   _SLOT_VEC_SPEC, P("data", None)),
-        check_rep=True)
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None))
+    else:
+        def run(params, ck, cv, ksc, vsc, pos, tok, active, rem, key):
+            def step(carry, _):
+                ck, cv, ksc, vsc, pos, tok, rem = carry
+                act = active & (rem > 0)
+                h = embed_step(params, pos, tok)
+                for layer in range(cfg.n_layers):
+                    p_l = {kk: vv[layer]
+                           for kk, vv in params["blocks"].items()}
+                    h, ck, cv, ksc, vsc = _local_block_decode_slotted_q(
+                        h, p_l, ck, cv, ksc, vsc, layer, pos, act,
+                        cfg, tp, dp, kv_mode)
+                pos, tok, rem, emit = sample_and_advance(
+                    params, h, act, pos, tok, rem, key)
+                return (ck, cv, ksc, vsc, pos, tok, rem), emit
+
+            (ck, cv, ksc, vsc, pos, tok, _), toks = lax.scan(
+                step, (ck, cv, ksc, vsc, pos, tok, rem), None,
+                length=chunk)
+            return (ck, cv, ksc, vsc, pos, tok,
+                    jnp.swapaxes(toks, 0, 1))
+
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None))
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
     return jax.jit(sharded)
 
 
@@ -597,6 +799,18 @@ def shard_serving_params(params, cfg: TransformerConfig, mesh: Mesh):
     """Place params for serving — megatron layout (pipe=1 on a
     serving mesh, so the stacked [L, ...] blocks stay whole per
     device while heads/MLP split over 'model'), with the serving MoE
-    overrides of serving_param_specs."""
+    overrides of serving_param_specs. Quantized trees
+    (`quant.model.quantize_params`) are detected and placed with
+    their derived specs — one entry point for both."""
+    from deeplearning4j_tpu.quant.core import QuantizedTensor
+    blocks = params.get("blocks", {}) if isinstance(params, dict) else {}
+    q = next((leaf for leaf in list(params.values()) +
+              list(blocks.values())
+              if isinstance(leaf, QuantizedTensor)), None)
+    if q is not None:
+        from deeplearning4j_tpu.quant.model import (
+            shard_quantized_serving_params)
+        return shard_quantized_serving_params(params, cfg, mesh,
+                                              mode=q.mode)
     return shard_params(params, cfg, mesh,
                         specs=serving_param_specs(cfg))

@@ -1,0 +1,204 @@
+"""QuantizedTensor: symmetric absmax weight quantization primitives.
+
+Layout contract
+---------------
+A `QuantizedTensor` holds `values` (int8, or fp8 `e4m3` where the
+backend supports it) and `scales` (float32) with the SAME rank as
+`values`: every quantized (reduction) axis is kept as size 1 in
+`scales`, so dequantization is a plain broadcast multiply —
+``values.astype(f32) * scales`` — with no axis bookkeeping at the use
+site. Per-output-channel weight quantization of ``W [in, out]`` stores
+``scales [1, out]``; the stacked block weights ``[L, in, out]`` store
+``[L, 1, out]`` so `lax.scan` over the leading layer axis slices
+values and scales in lockstep.
+
+The class is registered as a pytree WITH KEY PATHS, which is what
+makes a quantized tree a drop-in `params` argument everywhere trees
+flow: `jax.jit` / `shard_map` trace through it, `lax.scan` scans it,
+and `util/checkpointing.py`'s manifest writer flattens it into
+addressable leaves (`.../Wq/.values`, `.../Wq/.scales`) that
+round-trip through `save_tree`/`restore_tree` with CRC + dtype
+verification.
+
+Why symmetric absmax: weights are zero-centered, so a zero-point buys
+nothing while costing an add on every dequant; absmax per OUTPUT
+channel keeps each channel's quantization step proportional to its own
+dynamic range (the per-tensor variant loses whole channels when one
+outlier channel stretches the grid). Error bound: for int8 the
+round-to-nearest step is ``scale = absmax/127``, so
+``|x - dequant(quant(x))| <= scale/2`` elementwise —
+tests/test_quant.py asserts exactly that.
+
+fp8: the `e4m3` variant (`mode="fp8"`) maps absmax to ±448 (the e4m3
+finite max) and lets the cast do the rounding. It sits behind
+`fp8_supported()` — MXU-era TPU/GPU backends only; `resolve_mode`
+falls back to int8 elsewhere (CPU ml_dtypes emulation is correct but
+defeats the purpose and is painfully slow), so every call site can ask
+for "fp8" unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# quantized-grid endpoints: int8 uses the symmetric [-127, 127] range
+# (dropping -128 keeps the grid symmetric so negation is exact); e4m3's
+# largest finite value is 448
+INT8_QMAX = 127.0
+FP8_QMAX = 448.0
+
+_MODES = ("int8", "fp8")
+
+
+def fp8_supported() -> bool:
+    """True when fp8 `e4m3` quantization is worth using: the dtype
+    exists in this jax AND the default backend has hardware-ish fp8
+    (TPU/GPU). CPU runs e4m3 through ml_dtypes emulation — correct but
+    slower than the float path it is supposed to beat — so it reports
+    False and `resolve_mode` falls back to int8."""
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+    return backend in ("tpu", "gpu")
+
+
+def resolve_mode(mode: Union[str, None]) -> Union[str, None]:
+    """Normalize a requested quantization mode against this backend:
+    None passes through (no quantization), "int8" is always available,
+    "fp8" degrades to "int8" when `fp8_supported()` is False — the
+    capability check every integration point routes through."""
+    if mode is None or mode == "":
+        return None
+    if mode not in _MODES:
+        raise ValueError(f"unknown quantization mode {mode!r}: "
+                         f"expected one of {_MODES} or None")
+    if mode == "fp8" and not fp8_supported():
+        return "int8"
+    return mode
+
+
+def _qmax(mode: str) -> float:
+    return INT8_QMAX if mode == "int8" else FP8_QMAX
+
+
+def _qdtype(mode: str):
+    return jnp.int8 if mode == "int8" else jnp.float8_e4m3fn
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantizedTensor:
+    """int8/fp8 ``values`` + broadcast-ready float32 ``scales``.
+
+    Behaves enough like an array for the model code paths that touch
+    weights: `.shape`/`.ndim` report the logical (values) geometry,
+    `.astype(dt)` DEQUANTIZES into ``dt`` (which is why
+    ``jnp.matmul(x, p["Wq"].astype(x.dtype))`` — the idiom every
+    forward/decode path already uses — works unchanged on a quantized
+    tree), and `qt[i]` slices values and scales in lockstep (the
+    per-layer indexing of the unrolled decode loop). ``mode`` rides as
+    pytree aux data, so it survives tracing and checkpoint templates.
+    """
+
+    __slots__ = ("values", "scales", "mode")
+
+    def __init__(self, values, scales, mode: str = "int8"):
+        self.values = values
+        self.scales = scales
+        self.mode = mode
+
+    # -- array-ish surface -------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.values.shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.values.shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nbytes(self) -> int:
+        """At-rest bytes (values + scales) — the HBM accounting unit."""
+        return int(self.values.nbytes) + int(self.scales.nbytes)
+
+    def astype(self, dt) -> Array:
+        """Dequantize into ``dt`` — the on-the-fly path: weights rest
+        quantized, each use rebuilds the activation-dtype panel. The
+        multiply happens in float32 before the final cast so bf16
+        activation dtypes don't round the scale application itself."""
+        return (self.values.astype(jnp.float32)
+                * self.scales).astype(dt)
+
+    def __getitem__(self, idx) -> "QuantizedTensor":
+        return QuantizedTensor(self.values[idx], self.scales[idx],
+                               self.mode)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"QuantizedTensor(mode={self.mode!r}, "
+                f"values={self.values.shape}@{self.values.dtype}, "
+                f"scales={getattr(self.scales, 'shape', ())})")
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten_with_keys(self):
+        return (((jax.tree_util.GetAttrKey("values"), self.values),
+                 (jax.tree_util.GetAttrKey("scales"), self.scales)),
+                self.mode)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def quantize(x, axis: Union[int, Tuple[int, ...]] = -2,
+             mode: str = "int8") -> QuantizedTensor:
+    """Symmetric absmax quantization of ``x`` along ``axis`` (the
+    reduction/contraction axes — everything NOT in ``axis`` gets its
+    own scale). For a weight ``W [in, out]`` the default ``axis=-2``
+    is per-output-channel. All-zero channels get scale 1.0 so
+    dequantization never divides by zero."""
+    mode = resolve_mode(mode)
+    if mode is None:
+        raise ValueError("quantize() needs a concrete mode, got None")
+    x = jnp.asarray(x)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / _qmax(mode), 1.0)
+    scale = scale.astype(jnp.float32)
+    if mode == "int8":
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                     -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    else:
+        q = (x.astype(jnp.float32) / scale).astype(_qdtype(mode))
+    return QuantizedTensor(q, scale, mode)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> Array:
+    """Broadcast-multiply back to a dense array in ``dtype``."""
+    return qt.astype(dtype)
+
+
+def fake_quant(x, axis: Union[int, Tuple[int, ...]] = -2,
+               mode: str = "int8") -> Array:
+    """quantize → dequantize round trip at the input's dtype: the
+    accuracy-study primitive (exactly the numeric error a quantized
+    deployment sees, without changing the tree structure)."""
+    x = jnp.asarray(x)
+    return dequantize(quantize(x, axis=axis, mode=mode), x.dtype)
+
+
+def quantized_matmul(x: Array, w: Any) -> Array:
+    """``x @ w`` where ``w`` may be a QuantizedTensor or a plain
+    array: quantized weights are dequantized ON THE FLY into the
+    activation dtype (never materialized at rest), plain arrays take
+    the ordinary cast — one call site serves mixed trees."""
+    return jnp.matmul(x, w.astype(x.dtype))

@@ -1,0 +1,126 @@
+"""Quantized slot-pool KV cache: int8 rows + per-row float32 scales.
+
+The continuous-batching pool (parallel/serving.py `init_slot_state`)
+keeps [L, Ns, S, D] K/V buffers resident for the engine's lifetime —
+at activation dtype that is the second-largest HBM tenant after the
+weights, and it scales with `num_slots`. Quantizing it row-wise buys
+~4x more slots per byte (int8 values + one f32 scale per D-row ≈
+D + 4·tp bytes vs 4·D float32):
+
+- **Granularity: one scale per written K/V ROW** (per layer, slot,
+  position — and per model-rank: each rank quantizes its own D_loc
+  head shard independently, so no collective ever touches scales).
+  A row is written exactly once (position p's K/V never changes), so
+  quantize-on-write is a single absmax+round on a [D_loc] vector and
+  the scale is final — no requantization, no running maxima.
+- **Dequantize-on-read happens in the SCORES, not the cache**: the
+  attention consumer folds the K scale into the logits
+  (``(q·k_int) * kscale_row``) and the V scale into the probabilities
+  (``(p * vscale_row) · v_int``) — algebraically identical to
+  dequantizing the cache but touching only [Ns, S]-shaped scale
+  vectors instead of rebuilding [Ns, S, D] panels.
+- **Scale layout** ``[L, Ns, S, tp]`` with spec
+  ``P(None, 'data', None, 'model')``: the trailing axis holds each
+  model-rank's independent scale (local shape [L, ns, S, 1]), which
+  keeps shard_map's replication checking honest — the scales ARE
+  different per rank and the spec says so.
+
+Error shape: per-row absmax int8 keeps relative row error <= 1/254,
+uniform across positions — unlike per-tensor scales, where one hot
+row would stretch the grid for every cached position. Accuracy
+obligations (token fidelity of int8-KV continuous decode vs the float
+path) are pinned in tests/test_quant.py.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.quant.core import (FP8_QMAX, INT8_QMAX,
+                                           resolve_mode)
+
+Array = jax.Array
+
+# mirrors parallel/serving.py's slot-pool placement
+_KV_SPEC = P(None, "data", None, "model")      # [L, Ns, S, D]
+_SCALE_SPEC = P(None, "data", None, "model")   # [L, Ns, S, tp]
+_VEC_SPEC = P("data")
+
+
+def kv_cache_dtype(kv_mode: str):
+    return jnp.int8 if kv_mode == "int8" else jnp.float8_e4m3fn
+
+
+def quantize_rows(x: Array, kv_mode: str = "int8"
+                  ) -> Tuple[Array, Array]:
+    """Quantize ``x [..., D]`` row-wise (absmax over the last axis):
+    returns (values [..., D] int8/fp8, scales [...] float32). Zero
+    rows (never-written cache slots) get scale 1.0."""
+    qmax = INT8_QMAX if kv_mode == "int8" else FP8_QMAX
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / qmax, 1.0)
+    if kv_mode == "int8":
+        q = jnp.clip(jnp.round(xf / scale),
+                     -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    else:
+        q = (xf / scale).astype(kv_cache_dtype(kv_mode))
+    return q, scale[..., 0].astype(jnp.float32)
+
+
+def init_quant_slot_state(cfg, mesh: Mesh, num_slots: int,
+                          kv_mode: str = "int8"):
+    """Allocate the quantized slot-pool state on the serving mesh:
+    (ck, cv) int8/fp8 [L, Ns, S, D] + (kscale, vscale) float32
+    [L, Ns, S, tp] + per-slot (pos, tok) — the 6-tuple analog of
+    `parallel.serving.init_slot_state`'s 4-tuple, consumed by the
+    `kv_mode=...` variants of the continuous prefill/decode programs.
+    Same functional contract: every program consumes and returns the
+    whole state, so a failed call leaves the pool bit-identical."""
+    from deeplearning4j_tpu.models.transformer import slot_cache_shape
+    kv_mode = resolve_mode(kv_mode)
+    if kv_mode is None:
+        raise ValueError("init_quant_slot_state needs kv_mode "
+                         "('int8'/'fp8')")
+    dp = mesh.shape["data"]
+    tp = mesh.shape["model"]
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    shape = slot_cache_shape(cfg, num_slots)
+    sshape = shape[:3] + (tp,)
+    qdt = kv_cache_dtype(kv_mode)
+    kv_sh = NamedSharding(mesh, _KV_SPEC)
+    sc_sh = NamedSharding(mesh, _SCALE_SPEC)
+    vec_sh = NamedSharding(mesh, _VEC_SPEC)
+    ck = jax.device_put(jnp.zeros(shape, qdt), kv_sh)
+    cv = jax.device_put(jnp.zeros(shape, qdt), kv_sh)
+    ksc = jax.device_put(jnp.ones(sshape, jnp.float32), sc_sh)
+    vsc = jax.device_put(jnp.ones(sshape, jnp.float32), sc_sh)
+    pos = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    tok = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    return ck, cv, ksc, vsc, pos, tok
+
+
+def slot_pool_bytes(cfg, num_slots: int,
+                    kv_mode: Optional[str] = None, tp: int = 1,
+                    cache_dtype=None) -> int:
+    """Analytic at-rest bytes of one slot pool (caches + scales +
+    per-slot vectors) — the `serving_kv_bytes_per_slot` /
+    `serving_kv_pool_bytes` gauges' backing computation. Analytic
+    rather than measured so operators can size pools BEFORE the lazily
+    allocated state exists."""
+    from deeplearning4j_tpu.models.transformer import slot_cache_shape
+    L, ns, s, d = slot_cache_shape(cfg, num_slots)
+    if kv_mode is not None:
+        item = jnp.dtype(kv_cache_dtype(kv_mode)).itemsize
+        scales = 2 * L * ns * s * tp * 4
+    else:
+        dt = cache_dtype if cache_dtype is not None \
+            else cfg.cache_jnp_dtype()
+        item = jnp.dtype(dt).itemsize
+        scales = 0
+    return 2 * L * ns * s * d * item + scales + 2 * ns * 4

@@ -16,6 +16,11 @@ the layer between callers and the compiled decode step:
   `parallel.failure.ServingFaultInjector` (fail the Nth decode step,
   per-request poisoning, host-side delay injection) — every behavior
   is testable on the CPU backend (tests/test_serving_engine.py).
+- Quantized inference (round 10): `InferenceEngine(quantize="int8",
+  kv_quantize="int8")` quantizes weights on load/hot-reload and runs
+  the slot pool as int8 rows + per-row scales — ~4x fewer at-rest
+  bytes on both axes (`deeplearning4j_tpu/quant/`,
+  docs/quantization.md).
 
 Lifecycle and thresholds: docs/serving.md.
 """

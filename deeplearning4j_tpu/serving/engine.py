@@ -60,6 +60,17 @@ but not yet committed at the swap are discarded and re-decoded).
 Corrupt/partial `step_<N>` directories fall back to the previous good
 step.
 
+Quantized inference (round 10, `quantize=` / `kv_quantize=` — engine
+kwargs or EngineConfig fields, "int8"/"fp8"/None): the weight tree is
+quantized ON LOAD (float weights never reach the mesh) and on every
+hot reload (checkpoints stay float; restore goes through a float
+template, then requantizes), and the continuous slot pool switches to
+int8 rows + per-row scales (quant/kv.py). The compiled-program caches
+key on the modes, `quantize=None` stays bit-identical to the
+pre-quantization engine, and HBM accounting (`serving_param_bytes`,
+`serving_kv_bytes_per_slot`, `serving_kv_pool_bytes`) surfaces as
+pull gauges + health()/stats fields. See docs/quantization.md.
+
 Observability: every counter the engine keeps (completed / shed /
 quarantined / retries / step failures / batches / reloads), the
 queue-depth / breaker-state / degraded gauges, and the per-step decode
@@ -165,6 +176,13 @@ class EngineConfig:
     mode: str = "continuous"         # "continuous" | "batch"
     num_slots: int = 0               # 0 = max_batch_size
     prefill_bucket_min: int = 16     # smallest prefill-length bucket
+    # quantized inference (quant/): "int8" | "fp8" | None. ``quantize``
+    # quantizes the WEIGHT tree on load (and on every hot reload);
+    # ``kv_quantize`` switches the continuous slot pool to int8/fp8
+    # rows + per-row scales (~4x fewer cache bytes per slot). Both go
+    # through quant.core.resolve_mode, so "fp8" lands on int8 off-TPU.
+    quantize: Optional[str] = None
+    kv_quantize: Optional[str] = None
 
 
 class RequestHandle:
@@ -218,42 +236,50 @@ class _BatchDecodeFailed(RuntimeError):
 
 @lru_cache(maxsize=64)
 def _compiled_generate(cfg_fields: tuple, mesh, max_new_tokens: int,
-                       temperature: float, top_k: int, top_p: float):
+                       temperature: float, top_k: int, top_p: float,
+                       quantized=None):
     """Process-wide compiled-pgen cache: engines over the same
     (config, mesh, sampling) share the jit cache instead of re-tracing
     per engine instance (fault-injection tests build many engines)."""
     cfg = TransformerConfig(*cfg_fields)
     return make_parallel_generate(cfg, mesh, max_new_tokens,
                                   temperature=temperature, top_k=top_k,
-                                  top_p=top_p)
+                                  top_p=top_p, quantized=quantized)
 
 
 @lru_cache(maxsize=64)
 def _compiled_prefill(cfg_fields: tuple, mesh, bucket_len: int,
                       num_slots: int, temperature: float, top_k: int,
-                      top_p: float):
+                      top_p: float, quantized=None, kv_mode=None):
     """Compiled-program cache for the continuous-batching admission
     prefill, keyed on BUCKET geometry (bucket_len, num_slots) rather
     than exact prompt length: all traffic whose prompts round up to
     the same bucket shares one entry — the no-recompile guard test
-    counts this cache's entries before/after mixed-length traffic."""
+    counts this cache's entries before/after mixed-length traffic.
+    The quantization modes ride in the key: a quantized engine's
+    programs are distinct geometry."""
     cfg = TransformerConfig(*cfg_fields)
     return make_continuous_prefill(cfg, mesh, bucket_len, num_slots,
                                    temperature=temperature,
-                                   top_k=top_k, top_p=top_p)
+                                   top_k=top_k, top_p=top_p,
+                                   quantized=quantized,
+                                   kv_mode=kv_mode)
 
 
 @lru_cache(maxsize=64)
 def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
                            num_slots: int, temperature: float,
-                           top_k: int, top_p: float):
+                           top_k: int, top_p: float, quantized=None,
+                           kv_mode=None):
     """Compiled-program cache for the continuous-batching decode
     chunk: ONE entry per engine geometry — occupancy, per-slot
     positions, and budgets are runtime data, not shapes."""
     cfg = TransformerConfig(*cfg_fields)
     return make_continuous_decode(cfg, mesh, chunk, num_slots,
                                   temperature=temperature,
-                                  top_k=top_k, top_p=top_p)
+                                  top_k=top_k, top_p=top_p,
+                                  quantized=quantized,
+                                  kv_mode=kv_mode)
 
 
 class InferenceEngine:
@@ -269,7 +295,9 @@ class InferenceEngine:
                  config: Optional[EngineConfig] = None,
                  fault_injector=None,
                  clock: Callable[[], float] = time.monotonic,
-                 registry=None):
+                 registry=None,
+                 quantize: Optional[str] = None,
+                 kv_quantize: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or EngineConfig()
@@ -283,13 +311,32 @@ class InferenceEngine:
         self._chunk = (self.config.decode_chunk
                        if self.config.decode_chunk > 0
                        else DEFAULT_CONTINUOUS_CHUNK)
+        # quantized inference: resolve the requested modes against the
+        # backend (fp8 -> int8 off-TPU), quantize the weight tree ON
+        # LOAD — float weights never reach the mesh — and remember a
+        # float restore TEMPLATE so hot reloads can read a float
+        # checkpoint and requantize (quant/model.py)
+        from deeplearning4j_tpu.quant.core import resolve_mode
+        self._qmode = resolve_mode(
+            quantize if quantize is not None else self.config.quantize)
+        self._kv_mode = resolve_mode(
+            kv_quantize if kv_quantize is not None
+            else self.config.kv_quantize)
+        self._float_template = None
+        if self._qmode:
+            import jax
+            from deeplearning4j_tpu.quant.model import quantize_params
+            self._float_template = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+                params)
+            params = quantize_params(params, mode=self._qmode)
         # slot pool: host-side seating; device-side persistent state
-        # (KV caches, per-slot pos + pending token) allocated lazily on
-        # the first admission
+        # (KV caches + scales, per-slot pos + pending token) allocated
+        # lazily on the first admission — an opaque tuple whose arity
+        # the compiled programs own (4 float / 6 quantized-KV)
         self._slots: List[Optional[RequestHandle]] = \
             [None] * self._num_slots
-        self._cache_k = self._cache_v = None
-        self._slot_pos = self._slot_tok = None
+        self._slot_state = None
         self._key = None
         self._params = shard_serving_params(params, cfg, mesh)
         self._injector = fault_injector
@@ -364,6 +411,22 @@ class InferenceEngine:
         r.gauge("serving_slot_occupancy",
                 "Occupied continuous-batching slots").set_function(
             lambda: float(sum(s is not None for s in self._slots)))
+        # HBM accounting (pull-model: sized at scrape time, nothing on
+        # the decode path) — the operator's slot-pool sizing inputs:
+        # bytes of weights at rest, bytes one slot's KV costs, and the
+        # whole pool. With quantize="int8"/kv_quantize="int8" these are
+        # the numbers that shrink ~4x (docs/quantization.md).
+        r.gauge("serving_param_bytes",
+                "At-rest bytes of the serving weight tree "
+                "(values + scales when quantized)").set_function(
+            lambda: float(self.param_bytes()))
+        r.gauge("serving_kv_bytes_per_slot",
+                "KV-cache bytes one continuous-batching slot costs "
+                "(caches + scales + slot vectors)").set_function(
+            lambda: float(self.kv_bytes_per_slot()))
+        r.gauge("serving_kv_pool_bytes",
+                "Total at-rest bytes of the slot-pool KV state"
+                ).set_function(lambda: float(self.kv_pool_bytes()))
         self._m_batch_size = r.histogram(
             "serving_batch_size", "Coalesced batch sizes",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
@@ -379,11 +442,38 @@ class InferenceEngine:
             "Wall time of one compiled admission-prefill call",
             buckets=DECODE_LATENCY_BUCKETS)
 
+    # ------------------------------------------------------------------
+    # HBM accounting (quant subsystem; backs the serving_param_bytes /
+    # serving_kv_* pull gauges and the health()/stats surfaces)
+    # ------------------------------------------------------------------
+    def param_bytes(self) -> int:
+        """At-rest bytes of the serving weight tree (quantized trees
+        count int8 values + float32 scales)."""
+        from deeplearning4j_tpu.quant.model import param_bytes
+        return param_bytes(self._params)
+
+    def kv_pool_bytes(self) -> int:
+        """At-rest bytes of the slot-pool KV state: measured when the
+        lazily-allocated pool exists, analytic otherwise (so operators
+        can size pools before traffic arrives)."""
+        if self._slot_state is not None:
+            return int(sum(int(a.nbytes) for a in self._slot_state))
+        from deeplearning4j_tpu.quant.kv import slot_pool_bytes
+        return slot_pool_bytes(self.cfg, self._num_slots,
+                               kv_mode=self._kv_mode,
+                               tp=self.mesh.shape["model"])
+
+    def kv_bytes_per_slot(self) -> int:
+        return self.kv_pool_bytes() // max(1, self._num_slots)
+
     @property
     def stats(self) -> dict:
         """Counter snapshot (registry-backed; keys unchanged from the
-        pre-observability ad-hoc dict)."""
-        return {"completed": int(self._m_completed.value),
+        pre-observability ad-hoc dict) plus the HBM accounting trio."""
+        return {"param_bytes": self.param_bytes(),
+                "kv_bytes_per_slot": self.kv_bytes_per_slot(),
+                "kv_pool_bytes": self.kv_pool_bytes(),
+                "completed": int(self._m_completed.value),
                 "shed_overload": int(self._m_shed_overload.value),
                 "shed_deadline": int(self._m_shed_deadline.value),
                 "quarantined": int(self._m_quarantined.value),
@@ -686,10 +776,21 @@ class InferenceEngine:
                     if r is not None]
 
     def _ensure_state(self) -> None:
-        if self._cache_k is None:
-            (self._cache_k, self._cache_v, self._slot_pos,
-             self._slot_tok) = init_slot_state(self.cfg, self.mesh,
-                                               self._num_slots)
+        if self._slot_state is None:
+            self._slot_state = init_slot_state(
+                self.cfg, self.mesh, self._num_slots,
+                kv_mode=self._kv_mode)
+
+    def _quant_kwargs(self) -> dict:
+        """Compiled-program cache key extension: only present when a
+        quantization mode is on, so unquantized engines keep sharing
+        cache entries with direct (legacy-signature) callers."""
+        kw = {}
+        if self._qmode:
+            kw["quantized"] = self._qmode
+        if self._kv_mode:
+            kw["kv_mode"] = self._kv_mode
+        return kw
 
     def _root_key(self):
         if self._key is None:
@@ -712,9 +813,9 @@ class InferenceEngine:
         """One guarded fused admit+prefill over ``state`` for
         ``entries`` [(slot, handle)] — each entry's committed prefix
         (prompt + generated-so-far: requeued preempted requests resume
-        mid-stream) is right-padded to the bucket. Returns
-        ((ck, cv, pos, tok), first_tokens)."""
-        ck, cv, pos, tok = state
+        mid-stream) is right-padded to the bucket. ``state`` is the
+        opaque slot-state tuple (4 arrays float KV / 6 quantized KV).
+        Returns (state', first_tokens)."""
         prefixes = {i: np.concatenate([r.prompt, r.generated]
                                       ).astype(np.int32)
                     for i, r in entries}
@@ -730,12 +831,14 @@ class InferenceEngine:
                                self._num_slots,
                                float(self.config.temperature),
                                int(self.config.top_k),
-                               float(self.config.top_p))
+                               float(self.config.top_p),
+                               **self._quant_kwargs())
         key = self._root_key()
+        n_state = len(state)
 
         def call():
-            o = fn(params, ck, cv, pos, tok, prompts, plen, key)
-            return o[:4], np.asarray(o[4])
+            o = fn(params, *state, prompts, plen, key)
+            return tuple(o[:n_state]), np.asarray(o[n_state])
 
         return self._guarded(call, [r.rid for _, r in entries],
                              self._m_prefill_seconds, prefill=True)
@@ -744,8 +847,7 @@ class InferenceEngine:
         """One guarded decode chunk over ``state`` for the occupied
         ``entries``: per-slot budgets ride as the ``rem`` mask, so a
         slot finishing mid-chunk stops decoding on device. Returns
-        ((ck, cv, pos, tok), toks [Ns, chunk])."""
-        ck, cv, pos, tok = state
+        (state', toks [Ns, chunk])."""
         active = np.zeros((self._num_slots,), bool)
         rem = np.zeros((self._num_slots,), np.int32)
         for i, r in entries:
@@ -755,12 +857,14 @@ class InferenceEngine:
                                     self._chunk, self._num_slots,
                                     float(self.config.temperature),
                                     int(self.config.top_k),
-                                    float(self.config.top_p))
+                                    float(self.config.top_p),
+                                    **self._quant_kwargs())
         key = self._root_key()
+        n_state = len(state)
 
         def call():
-            o = fn(params, ck, cv, pos, tok, active, rem, key)
-            return o[:4], np.asarray(o[4])
+            o = fn(params, *state, active, rem, key)
+            return tuple(o[:n_state]), np.asarray(o[n_state])
 
         return self._guarded(call, [r.rid for _, r in entries],
                              self._m_step_seconds)
@@ -773,17 +877,16 @@ class InferenceEngine:
         _BatchDecodeFailed propagates to slot isolation."""
         self._ensure_state()
         try:
-            state, first = self._call_prefill(
-                params, (self._cache_k, self._cache_v,
-                         self._slot_pos, self._slot_tok), admitted)
+            state, first = self._call_prefill(params,
+                                              self._slot_state,
+                                              admitted)
         except _BatchDecodeFailed:
             with self._lock:
                 for i, r in admitted:
                     if self._slots[i] is r:
                         self._slots[i] = None
             raise
-        (self._cache_k, self._cache_v,
-         self._slot_pos, self._slot_tok) = state
+        self._slot_state = state
         for i, r in admitted:
             with self._lock:
                 if self._slots[i] is not r:   # preempted by a reload
@@ -794,11 +897,9 @@ class InferenceEngine:
         self._reap()
 
     def _decode_chunk_slots(self, occupied, params) -> None:
-        state, toks = self._call_chunk(
-            params, (self._cache_k, self._cache_v,
-                     self._slot_pos, self._slot_tok), occupied)
-        (self._cache_k, self._cache_v,
-         self._slot_pos, self._slot_tok) = state
+        state, toks = self._call_chunk(params, self._slot_state,
+                                       occupied)
+        self._slot_state = state
         for i, r in occupied:
             with self._lock:
                 if self._slots[i] is not r:   # preempted by a reload:
@@ -866,7 +967,8 @@ class InferenceEngine:
         schedule makes the continuation identical to what the pooled
         run would have produced."""
         params = self._params
-        state = init_slot_state(self.cfg, self.mesh, self._num_slots)
+        state = init_slot_state(self.cfg, self.mesh, self._num_slots,
+                                kv_mode=self._kv_mode)
         state, first = self._call_prefill(params, state, [(0, r)])
         r._generated.append(np.asarray([first[0]], np.int32))
         while True:
@@ -962,10 +1064,11 @@ class InferenceEngine:
         # and a solo continuation — reproduces the same tokens
         key = jax.random.fold_in(
             jax.random.PRNGKey(self.config.seed), prompts.shape[1])
+        qkw = ({"quantized": self._qmode} if self._qmode else {})
         fn = _compiled_generate(astuple(self.cfg), self.mesh, int(n),
                                 float(self.config.temperature),
                                 int(self.config.top_k),
-                                float(self.config.top_p))
+                                float(self.config.top_p), **qkw)
 
         def call():
             return np.asarray(fn(params, jnp.asarray(prompts), key))
@@ -1061,6 +1164,8 @@ class InferenceEngine:
                     "slots_occupied": sum(s is not None
                                           for s in self._slots),
                     "weights_step": self._weights_step,
+                    "quantize": self._qmode,
+                    "kv_quantize": self._kv_mode,
                     **dict(self.stats)}
 
     def ready(self) -> bool:
@@ -1101,7 +1206,12 @@ class InferenceEngine:
                 if hasattr(mgr, "verify_step") and not mgr.verify_step(s):
                     raise RuntimeError(
                         f"step {s} failed checksum verification")
-                tree = mgr.restore_tree(self._params, step=s)
+                # quantized engines restore against the FLOAT template
+                # (checkpoints hold training-precision weights) and
+                # requantize below — quantize-on-hot-reload
+                template = (self._float_template if self._qmode
+                            else self._params)
+                tree = mgr.restore_tree(template, step=s)
             except Exception as e:           # corrupt / partial step dir
                 last_err = e
                 log.warning("weight reload: step %s unreadable (%s); "
@@ -1109,6 +1219,12 @@ class InferenceEngine:
                 continue
             if tree is None:
                 continue
+            if self._qmode:
+                from deeplearning4j_tpu.quant.model import \
+                    quantize_params
+                tree = shard_serving_params(
+                    quantize_params(tree, mode=self._qmode), self.cfg,
+                    self.mesh)
             with self._lock:
                 self._params = tree
                 self._weights_step = int(s)

@@ -315,7 +315,15 @@ class CheckpointManager:
                         f"{len(arrays)} arrays, npz holds "
                         f"{len(data.files)}")
                 for name, m in arrays.items():
-                    if _crc(data[name]) != m["crc32"]:
+                    a = data[name]
+                    if str(a.dtype) != m["dtype"]:
+                        # same-width views keep the CRC identical —
+                        # only the manifest dtype catches them
+                        raise CheckpointCorruptError(
+                            f"step {step}: dtype mismatch for "
+                            f"{name!r} (manifest {m['dtype']}, "
+                            f"stored {a.dtype})")
+                    if _crc(a) != m["crc32"]:
                         raise CheckpointCorruptError(
                             f"step {step}: checksum mismatch for "
                             f"{name!r}")
@@ -357,6 +365,19 @@ class CheckpointManager:
                     raise CheckpointCorruptError(
                         f"checkpoint tree-structure mismatch: template "
                         f"leaf {name!r} is not stored in step {step}")
+                if man_arrays is not None \
+                        and str(a.dtype) != man_arrays[name]["dtype"]:
+                    # a rewritten npy header reinterprets the SAME
+                    # bytes under a different dtype: CRC (over bytes)
+                    # still matches, so restore would silently hand
+                    # back garbage values — fail loudly instead
+                    self._m_verify_fail.inc()
+                    raise CheckpointCorruptError(
+                        f"dtype mismatch for {name!r} in step {step}: "
+                        f"manifest records "
+                        f"{man_arrays[name]['dtype']}, stored array "
+                        f"reads back as {a.dtype} — refusing to "
+                        "silently reinterpret bytes")
                 if man_arrays is not None \
                         and _crc(a) != man_arrays[name]["crc32"]:
                     self._m_verify_fail.inc()

@@ -321,3 +321,80 @@ def test_restore_casts_legacy_bf16_updater_state(tmp_path):
     ys = np.stack([y, y])
     scores = np.asarray(net2.fit_batched(xs, ys))  # must not raise
     assert scores.shape == (2,)
+
+
+def test_restore_dtype_mismatch_raises_clear_error(tmp_path):
+    """A rewritten npy header (same bytes VIEWED as another same-width
+    dtype) keeps the CRC identical — the manifest's recorded dtype is
+    the only thing that catches the silent reinterpretation, and the
+    error must say so."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    tree = {"w": jnp.arange(8.0, dtype=jnp.float32),
+            "b": jnp.ones((3,), jnp.float32)}
+    mgr.save_tree(tree, 1)
+    p = mgr.directory / "step_1" / "arrays.npz"
+    with np.load(p) as data:
+        arrays = {k: data[k] for k in data.files}
+    name = [k for k in arrays if k.endswith("w")][0]
+    arrays[name] = arrays[name].view(np.int32)   # same bytes, new dtype
+    np.savez(p, **arrays)
+
+    assert mgr.verify_step(1) is False           # verify catches it too
+    with pytest.raises(CheckpointCorruptError,
+                       match="dtype mismatch.*reinterpret"):
+        mgr.restore_tree(tree, step=1)
+
+
+def test_restore_dtype_mismatch_falls_back_to_older_step(tmp_path):
+    """step=None restore treats a dtype-tampered newest step like any
+    corrupt step: falls through to the previous verified one."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save_tree({"w": jnp.full((4,), 7.0, jnp.float32)}, 1)
+    mgr.save_tree({"w": jnp.full((4,), 9.0, jnp.float32)}, 2)
+    p = mgr.directory / "step_2" / "arrays.npz"
+    with np.load(p) as data:
+        arrays = {k: data[k].view(np.uint32) for k in data.files}
+    np.savez(p, **arrays)
+    out = mgr.restore_tree({"w": jnp.zeros((4,), jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.full((4,), 7.0, np.float32))
+
+
+def test_quantized_tensor_tree_checkpoint_roundtrip(tmp_path):
+    """QuantizedTensor trees round-trip through save_tree/restore_tree
+    bit-exactly (int8 values AND float32 scales), with the manifest
+    covering both leaves."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.quant.core import QuantizedTensor, quantize
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (6, 10))
+    tree = {"blocks": {"Wq": quantize(w, axis=-2)},
+            "lnf": jnp.ones((6,), jnp.float32)}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save_tree(tree, 3)
+
+    man = json.loads(
+        (mgr.directory / "step_3" / "manifest.json").read_text())
+    qnames = [n for n in man["arrays"] if "Wq" in n]
+    assert len(qnames) == 2, qnames              # .values + .scales
+    dtypes = sorted(man["arrays"][n]["dtype"] for n in qnames)
+    assert dtypes == ["float32", "int8"]
+
+    template = {"blocks": {"Wq": QuantizedTensor(
+        jnp.zeros((6, 10), jnp.int8), jnp.zeros((1, 10)), "int8")},
+        "lnf": jnp.zeros((6,), jnp.float32)}
+    out = mgr.restore_tree(template, step=3)
+    got = out["blocks"]["Wq"]
+    assert isinstance(got, QuantizedTensor) and got.mode == "int8"
+    np.testing.assert_array_equal(np.asarray(got.values),
+                                  np.asarray(tree["blocks"]["Wq"].values))
+    np.testing.assert_array_equal(np.asarray(got.scales),
+                                  np.asarray(tree["blocks"]["Wq"].scales))

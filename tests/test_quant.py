@@ -1,0 +1,417 @@
+"""Quantized inference subsystem (ISSUE-5): accuracy + integration.
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- quantize/dequantize round-trip error stays inside the symmetric
+  absmax bound (half a quantization step per element, per channel);
+- a quantized tree is a DROP-IN params argument: forward /
+  forward_hidden / generate run unchanged, with bounded
+  max-logit-divergence vs float32 on a tiny transformer;
+- int8-KV continuous decode is token-faithful vs the float KV path
+  (sharpened-logit harness: quantization noise must not flip greedy
+  argmax when logit gaps dominate the error bound);
+- `quantize=None` stays BIT-IDENTICAL to the pre-quantization engine
+  (the regression gate: the refactor cannot perturb the default path);
+- the engine's HBM accounting (param_bytes / kv_bytes_per_slot)
+  records the >= 40% reduction the ISSUE's acceptance bar demands;
+- fp8 degrades to int8 on CPU (`resolve_mode`) and the subsystem
+  imports cleanly without fp8 support;
+- hot reload re-quantizes: a float checkpoint restored into a
+  quantized engine comes back as a quantized tree.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   generate, forward,
+                                                   init_cache,
+                                                   init_params, prefill)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.quant.core import (QuantizedTensor, dequantize,
+                                           fake_quant, fp8_supported,
+                                           quantize, quantized_matmul,
+                                           resolve_mode)
+from deeplearning4j_tpu.quant.kv import (init_quant_slot_state,
+                                         quantize_rows,
+                                         slot_pool_bytes)
+from deeplearning4j_tpu.quant.model import (dequantize_params,
+                                            max_logit_divergence,
+                                            param_bytes,
+                                            quantize_params)
+from deeplearning4j_tpu.serving import EngineConfig, InferenceEngine
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def sharp_params(params):
+    """Sharpened-logit harness: scaling Wout multiplies every logit
+    GAP, so greedy argmax has margin >> the quantization error bound
+    and token-fidelity tests assert exact equality instead of a
+    flaky match fraction."""
+    p = dict(params)
+    p["Wout"] = params["Wout"] * 4.0
+    return p
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+# ---------------------------------------------------------------------------
+# core: round-trip error bounds, pytree behavior, capability fallback
+# ---------------------------------------------------------------------------
+
+def test_quantize_roundtrip_error_bound():
+    """Symmetric absmax int8: |x - deq(q(x))| <= scale/2 elementwise,
+    where scale is the CHANNEL's own step — per-channel scaling keeps
+    small-range channels accurate next to big-range ones."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 48))
+    x = x * (1.0 + 99.0 * (jnp.arange(48) == 7))    # one hot channel
+    qt = quantize(x, axis=-2)
+    err = jnp.abs(dequantize(qt) - x)
+    assert float(jnp.max(err - qt.scales / 2.0)) <= 1e-6
+    # the hot channel must not have stretched its neighbors' grids
+    cold = jnp.max(err[:, :7])
+    assert float(cold) <= float(jnp.max(jnp.abs(x[:, :7]))) / 254 + 1e-6
+
+
+def test_fake_quant_and_quantized_matmul():
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+    w = jax.random.normal(jax.random.PRNGKey(3), (16, 4))
+    qt = quantize(w, axis=-2)
+    np.testing.assert_allclose(np.asarray(fake_quant(w)),
+                               np.asarray(dequantize(qt)), atol=0)
+    ref = x @ dequantize(qt, x.dtype)
+    np.testing.assert_allclose(np.asarray(quantized_matmul(x, qt)),
+                               np.asarray(ref), atol=0)
+    # plain arrays pass through quantized_matmul unchanged
+    np.testing.assert_allclose(np.asarray(quantized_matmul(x, w)),
+                               np.asarray(x @ w), atol=1e-6)
+
+
+def test_quantized_tensor_pytree_and_indexing():
+    w = jax.random.normal(jax.random.PRNGKey(4), (3, 8, 5))
+    qt = quantize(w, axis=-2)
+    assert qt.shape == (3, 8, 5) and qt.scales.shape == (3, 1, 5)
+    leaves = jax.tree_util.tree_leaves(qt)
+    assert [l.shape for l in leaves] == [(3, 8, 5), (3, 1, 5)]
+    sl = qt[1]
+    assert isinstance(sl, QuantizedTensor)
+    assert sl.shape == (8, 5) and sl.scales.shape == (1, 5)
+    # scan over the leading axis slices values+scales in lockstep
+    def body(c, q):
+        return c + jnp.sum(q.astype(jnp.float32)), None
+    tot, _ = jax.lax.scan(body, 0.0, qt)
+    np.testing.assert_allclose(float(tot),
+                               float(jnp.sum(dequantize(qt))),
+                               rtol=1e-5)
+
+
+def test_fp8_resolves_to_int8_on_cpu():
+    """The capability check: CPU has no hardware fp8, so "fp8"
+    degrades to int8 everywhere (core, params, engine) instead of
+    failing or limping through emulation."""
+    if fp8_supported():
+        pytest.skip("backend has fp8; fallback not exercised")
+    assert resolve_mode("fp8") == "int8"
+    assert resolve_mode("int8") == "int8"
+    assert resolve_mode(None) is None
+    w = jax.random.normal(jax.random.PRNGKey(5), (8, 4))
+    assert quantize(w, mode="fp8").values.dtype == jnp.int8
+    with pytest.raises(ValueError, match="unknown quantization mode"):
+        resolve_mode("int4")
+
+
+def test_quant_import_smoke_subprocess():
+    """Graft-entry-style smoke: a FRESH interpreter (no conftest
+    bootstrap) imports the quant subsystem cleanly and resolves modes
+    without optional fp8 support — the driver-invocation-shaped
+    guard. XLA_FLAGS is stripped (conftest mutates it in this
+    process); the child self-bootstraps a CPU mesh the same way
+    dryrun_multichip does."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import _force_virtual_cpu_mesh; "
+         "_force_virtual_cpu_mesh(2); "
+         "import deeplearning4j_tpu.quant as q; "
+         "assert q.resolve_mode('int8') == 'int8'; "
+         "assert q.resolve_mode('fp8') in ('int8', 'fp8'); "
+         "print('QUANT_OK')"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "QUANT_OK" in proc.stdout
+
+
+def test_quant_subsystem_imports_cleanly_without_fp8():
+    """Smoke (in-process): the package import must not require
+    optional fp8 support — public API present, modes resolvable."""
+    import deeplearning4j_tpu.quant as q
+    for name in ("QuantizedTensor", "quantize", "dequantize",
+                 "fake_quant", "quantized_matmul", "resolve_mode",
+                 "fp8_supported", "quantize_params", "param_bytes",
+                 "init_quant_slot_state", "quantize_rows",
+                 "slot_pool_bytes"):
+        assert hasattr(q, name), name
+    assert q.resolve_mode("fp8") in ("int8", "fp8")
+
+
+# ---------------------------------------------------------------------------
+# model trees: structure, accuracy, drop-in forward
+# ---------------------------------------------------------------------------
+
+def test_quantize_params_structure(params):
+    qp = quantize_params(params)
+    assert isinstance(qp["embed"], QuantizedTensor)
+    assert isinstance(qp["Wout"], QuantizedTensor)
+    for name in ("Wq", "Wk", "Wv", "Wo", "W1", "W2"):
+        assert isinstance(qp["blocks"][name], QuantizedTensor), name
+    # numerically fragile leaves stay floating-point, unquantized
+    for name in ("pos", "lnfg", "lnfb"):
+        assert not isinstance(qp[name], QuantizedTensor)
+        assert jnp.issubdtype(qp[name].dtype, jnp.floating)
+    for name in ("ln1g", "ln1b", "ln2g", "ln2b", "b1", "b2"):
+        assert not isinstance(qp["blocks"][name], QuantizedTensor)
+    # per-output-channel layout: stacked [L, in, out] -> [L, 1, out]
+    assert qp["blocks"]["Wq"].scales.shape == (CFG.n_layers, 1,
+                                               CFG.d_model)
+    assert qp["embed"].scales.shape == (CFG.vocab_size, 1)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_params(qp)
+    # dequantized tree approximates the original
+    dq = dequantize_params(qp)
+    err = jnp.max(jnp.abs(dq["blocks"]["Wq"]
+                          - params["blocks"]["Wq"]))
+    assert float(err) <= float(jnp.max(
+        qp["blocks"]["Wq"].scales)) / 2 + 1e-6
+    assert param_bytes(qp) < 0.5 * param_bytes(params)
+
+
+def test_quantized_forward_max_logit_divergence(params):
+    """A quantized tree is a drop-in `params` for forward(); the
+    max-logit divergence vs float32 stays under a stated bound on the
+    tiny harness (observed ~0.05; bound leaves slack for cross-version
+    numeric drift)."""
+    toks = jnp.asarray(np.stack([_prompt(16, s) for s in range(4)]))
+    qp = quantize_params(params)
+    div = max_logit_divergence(CFG, params, qp, toks)
+    assert div <= 0.25, div
+    # MoE config too: router stays float, experts dequantize on the fly
+    moe_cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                                n_layers=2, max_len=64, n_experts=4)
+    moe_params = init_params(moe_cfg, jax.random.PRNGKey(0))
+    moe_div = max_logit_divergence(moe_cfg, moe_params,
+                                   quantize_params(moe_params), toks)
+    assert moe_div <= 0.25, moe_div
+
+
+def test_quantized_generate_runs(params):
+    """Single-chip KV-cached sampling accepts a quantized tree."""
+    qp = quantize_params(params)
+    out = generate(CFG, qp, _prompt()[None], 6, jax.random.PRNGKey(0),
+                   temperature=0.0)
+    assert out.shape == (1, 14)
+    assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < CFG.vocab_size
+
+
+# ---------------------------------------------------------------------------
+# cache_dtype satellite: bf16 caches under f32 activations
+# ---------------------------------------------------------------------------
+
+def test_cache_dtype_passthrough(params):
+    ck, cv = init_cache(CFG, 2)
+    assert ck.dtype == jnp.float32          # default: activation dtype
+    ck, cv = init_cache(CFG, 2, cache_dtype=jnp.bfloat16)
+    assert ck.dtype == jnp.bfloat16 and cv.dtype == jnp.bfloat16
+    cfg_bf = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                               n_layers=2, max_len=64,
+                               cache_dtype="bfloat16")
+    assert cfg_bf.cache_jnp_dtype() == jnp.bfloat16
+    assert cfg_bf.activation_dtype() == jnp.float32
+    ck, _ = init_cache(cfg_bf, 2)
+    assert ck.dtype == jnp.bfloat16
+    # prefill writes land in the cache dtype; logits stay close to f32
+    pr = jnp.asarray(_prompt()[None])
+    logits32, caches32 = prefill(CFG, params, pr)
+    logits16, caches16 = prefill(cfg_bf, params, pr)
+    assert caches16[0].dtype == jnp.bfloat16
+    assert caches32[0].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(logits16),
+                               np.asarray(logits32), atol=0.1)
+
+
+def test_slot_pool_bytes_analytic_matches_measured(mesh1):
+    state = init_quant_slot_state(CFG, mesh1, 4, "int8")
+    measured = sum(int(a.nbytes) for a in state)
+    assert slot_pool_bytes(CFG, 4, kv_mode="int8", tp=1) == measured
+    from deeplearning4j_tpu.parallel.serving import init_slot_state
+    fstate = init_slot_state(CFG, mesh1, 4)
+    fmeasured = sum(int(a.nbytes) for a in fstate)
+    assert slot_pool_bytes(CFG, 4) == fmeasured
+    # the quantized pool is ~4x smaller (scales cost a little back)
+    assert measured < 0.35 * fmeasured
+
+
+def test_quantize_rows_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(6), (3, 5, 16))
+    q, s = quantize_rows(x, "int8")
+    assert q.dtype == jnp.int8 and s.shape == (3, 5)
+    err = jnp.abs(q.astype(jnp.float32) * s[..., None] - x)
+    assert float(jnp.max(err - s[..., None] / 2.0)) <= 1e-6
+    # zero rows quantize to zero with scale 1 (never divide by zero)
+    qz, sz = quantize_rows(jnp.zeros((2, 4)), "int8")
+    assert float(jnp.max(jnp.abs(qz.astype(jnp.float32)))) == 0.0
+    np.testing.assert_array_equal(np.asarray(sz), np.ones((2,)))
+
+
+# ---------------------------------------------------------------------------
+# engine integration: fidelity, regression, accounting, reload
+# ---------------------------------------------------------------------------
+
+def _engine(params, mesh, **kw):
+    cfgkw = dict(decode_chunk=2, max_new_tokens=12,
+                 backoff_base_s=0.0)
+    quant = {k: kw.pop(k) for k in ("quantize", "kv_quantize")
+             if k in kw}
+    cfgkw.update(kw)
+    return InferenceEngine(CFG, mesh, params, EngineConfig(**cfgkw),
+                           **quant)
+
+
+def test_engine_quantize_none_bit_identical(sharp_params, mesh1):
+    """THE regression gate: with quantization off, the engine's
+    continuous decode must stay bit-identical to single-chip
+    `generate` — the quant refactor cannot perturb the default path."""
+    eng = _engine(sharp_params, mesh1)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    ref = np.asarray(generate(CFG, sharp_params, _prompt()[None], 12,
+                              jax.random.PRNGKey(0), temperature=0.0))
+    np.testing.assert_array_equal(h.result(1), ref[0])
+
+
+def test_int8_kv_continuous_token_fidelity(sharp_params, mesh1):
+    """int8-KV continuous decode (float weights) is token-faithful vs
+    the float-KV path on the sharpened harness: per-row absmax error
+    (<= 1/254 relative) is far inside the greedy argmax margin, so the
+    full continuation must match EXACTLY."""
+    ref_eng = _engine(sharp_params, mesh1)
+    kv_eng = _engine(sharp_params, mesh1, kv_quantize="int8")
+    outs = {}
+    for name, eng in (("float", ref_eng), ("int8kv", kv_eng)):
+        hs = [eng.submit(_prompt(6, s)) for s in range(3)]
+        eng.run_pending()
+        outs[name] = [h.result(1) for h in hs]
+    for a, b in zip(outs["float"], outs["int8kv"]):
+        np.testing.assert_array_equal(a, b)
+    hq = kv_eng.health()
+    assert hq["kv_quantize"] == "int8"
+    # the quantized pool really is the one allocated
+    assert len(kv_eng._slot_state) == 6
+    assert kv_eng._slot_state[0].dtype == jnp.int8
+
+
+def test_engine_int8_weights_and_kv_completes(params, mesh1):
+    """The full 2x2 corner (int8 weights x int8 KV) serves mixed
+    traffic to completion with in-bounds tokens and >= 40% HBM
+    reduction on BOTH accounting axes (the ISSUE acceptance bar)."""
+    feng = _engine(params, mesh1)
+    qeng = _engine(params, mesh1, quantize="int8", kv_quantize="int8")
+    hs = [qeng.submit(_prompt(t0, s))
+          for s, t0 in enumerate((4, 8, 12))]
+    qeng.run_pending()
+    for h in hs:
+        out = h.result(1)
+        assert out.shape[0] >= 4 + 12 - 8
+        assert int(out.min()) >= 0 and int(out.max()) < CFG.vocab_size
+    fh, qh = feng.health(), qeng.health()
+    assert qh["quantize"] == "int8" and qh["kv_quantize"] == "int8"
+    assert qh["param_bytes"] <= 0.6 * fh["param_bytes"]
+    assert qh["kv_bytes_per_slot"] <= 0.6 * fh["kv_bytes_per_slot"]
+    assert qh["kv_pool_bytes"] <= 0.6 * fh["kv_pool_bytes"]
+    # the same numbers surface as pull gauges in the registry
+    g = qeng.registry.get("serving_param_bytes")
+    assert g is not None
+    assert int(g.value) == qh["param_bytes"]
+
+
+def test_quantized_engine_hot_reload_requantizes(params, mesh1,
+                                                 tmp_path):
+    """reload_weights on a quantized engine restores the FLOAT
+    checkpoint against the float template, requantizes, and keeps
+    serving quantized — quantize-on-hot-reload."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    new_params = init_params(CFG, jax.random.PRNGKey(7))
+    mgr.save_tree(new_params, 5)
+
+    eng = _engine(params, mesh1, quantize="int8")
+    assert eng.reload_weights(mgr) == 5
+    assert eng.health()["weights_step"] == 5
+    assert isinstance(eng._params["Wout"], QuantizedTensor)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    out = h.result(1)
+    # served tokens come from the RELOADED weights: they match the
+    # quantized-from-scratch tree of the new params
+    ref_eng = _engine(new_params, mesh1, quantize="int8")
+    h2 = ref_eng.submit(_prompt())
+    ref_eng.run_pending()
+    np.testing.assert_array_equal(out, h2.result(1))
+
+
+# ---------------------------------------------------------------------------
+# the larger accuracy sweep stays out of tier-1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_accuracy_sweep_larger_model():
+    """Divergence statistics at a serving-shaped geometry: int8
+    weights keep max-logit-divergence small relative to the logit
+    scale across seeds, and int8-KV greedy decode stays faithful."""
+    cfg = TransformerConfig(vocab_size=128, d_model=128, n_heads=8,
+                            n_layers=4, max_len=128)
+    for seed in range(3):
+        p = init_params(cfg, jax.random.PRNGKey(seed))
+        toks = jnp.asarray(
+            np.stack([(np.arange(64) * (s + 3)) % 128
+                      for s in range(4)]).astype(np.int32))
+        qp = quantize_params(p)
+        lf = forward(cfg, p, toks).astype(jnp.float32)
+        div = max_logit_divergence(cfg, p, qp, toks)
+        scale = float(jnp.max(jnp.abs(lf)))
+        assert div <= 0.1 * max(scale, 1.0), (seed, div, scale)
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    p = dict(p, Wout=p["Wout"] * 4.0)
+    ref = np.asarray(generate(cfg, p, ((np.arange(16) * 3) % 128)[None],
+                              32, jax.random.PRNGKey(0),
+                              temperature=0.0))[0]
+    eng = InferenceEngine(cfg, mesh, p,
+                          EngineConfig(decode_chunk=4,
+                                       max_new_tokens=32),
+                          kv_quantize="int8")
+    h = eng.submit((np.arange(16) * 3) % 128)
+    eng.run_pending()
+    np.testing.assert_array_equal(h.result(1), ref)
