@@ -590,6 +590,132 @@ def bench_engine_continuous(reps: int = 2, *, n_requests: int = 28,
                       1e-9), 2)}
 
 
+def bench_engine_slo(reps: int = 2, *, n_requests: int = 96,
+                     mean_interarrival_s: float = 0.002,
+                     seed: int = 0) -> dict:
+    """Flight recorder + SLO layer overhead (ISSUE-6 acceptance:
+    ≤ 2% tokens/sec vs the NULL recorder) — and the SLO report itself.
+
+    One mixed-length Poisson trace (70% short 8-token / 30% long
+    32-token requests, every one carrying a generous deadline so
+    goodput is meaningful) drives two CONTINUOUS engines that differ
+    ONLY in the recorder injection: the default live FlightRecorder +
+    SLOTracker vs `recorder=NULL_RECORDER` (every trace/SLO call a
+    no-op; both arms keep a live private registry, so the delta
+    isolates the NEW subsystem from the PR-2-measured metrics cost).
+
+    Two measurement phases, one trace:
+
+    - **overhead A-B** (the ≤2% bound): the trace's requests replay as
+      a saturating burst — submissions in trace order, then the
+      tick loop runs the pool dry. No arrival-clock sleeps inside the
+      timed region: burst replays are pure engine work, so the
+      interleaved best-of (engine_decode_metrics' design) measures
+      the recorder, not this container's sleep-granularity jitter
+      (timed-arrival replays were ±4% run-to-run on the SAME arm).
+    - **SLO characterization**: one arrival-timed replay of the same
+      trace through the RECORDED engine produces the windowed report
+      (ttft/tpot/e2e/queue-age percentiles, goodput) that rides in the
+      output JSON — the first driver-captured SLO row, the measurement
+      substrate the ROADMAP's trace-replay harness builds on. Queueing
+      numbers come from here, where arrivals are real."""
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.observability import NULL_RECORDER
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
+                            n_layers=3, max_len=128)
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(seed)
+    events, t = [], 0.0
+    for _ in range(n_requests):
+        t += float(rng.exponential(mean_interarrival_s))
+        if rng.random() < 0.7:
+            plen, nt = int(rng.integers(6, 17)), 8
+        else:
+            plen, nt = int(rng.integers(33, 65)), 32
+        events.append((t, rng.integers(0, cfg.vocab_size,
+                                       plen).astype(np.int32), nt))
+    total_new = sum(nt for _, _, nt in events)
+    econf = EngineConfig(max_batch_size=8, max_queue=4 * n_requests,
+                         max_new_tokens=32, decode_chunk=8,
+                         degrade_queue_depth=10 ** 6)
+
+    def make_engine(recorded: bool):
+        return InferenceEngine(
+            cfg, mesh, params, econf,
+            **({} if recorded else {"recorder": NULL_RECORDER}))
+
+    def burst(recorded: bool) -> float:
+        eng = make_engine(recorded)
+        t0 = _t.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=nt, deadline_s=60.0,
+                         on_deadline="partial")
+              for _, p, nt in events]
+        eng.run_pending()
+        assert all(h.done() for h in hs)
+        return _t.perf_counter() - t0
+
+    def timed_replay():
+        eng = make_engine(True)
+        pending, i = [], 0
+        t0 = _t.perf_counter()
+        while i < len(events) or pending:
+            now = _t.perf_counter() - t0
+            while i < len(events) and events[i][0] <= now:
+                _, prompt, nt = events[i]
+                pending.append(eng.submit(prompt, max_new_tokens=nt,
+                                          deadline_s=60.0,
+                                          on_deadline="partial"))
+                i += 1
+            worked = eng.tick()
+            pending = [h for h in pending if not h.done()]
+            if not worked and i < len(events):
+                _t.sleep(max(0.0, min(
+                    0.002, events[i][0] - (_t.perf_counter() - t0))))
+        return eng
+
+    burst(False)                           # warm: compile every bucket
+    burst(True)
+    bare = rec = float("inf")
+    # interleaved best-of with a floor of 6 rounds: single ~0.5 s
+    # bursts jitter ±10% on this container (measured), so the per-arm
+    # min needs several samples before it reflects the recorder
+    # instead of the scheduler — at 6+ rounds the min-based estimate
+    # reproducibly lands within ±1% of the 12-round answer (~0%)
+    for _ in range(max(6, 3 * reps)):
+        bare = min(bare, burst(False))
+        rec = min(rec, burst(True))
+
+    eng_rec = timed_replay()               # SLO characterization
+    rep = eng_rec.slo_report()
+    assert rep["window"] == n_requests     # every request accounted
+    tl = eng_rec.timeline()                # and the export holds up
+    assert tl["traceEvents"]
+
+    return {"config": "engine_slo",
+            "value": round(total_new / rec, 1),
+            "unit": "tokens/sec",
+            "bare_tokens_per_sec": round(total_new / bare, 1),
+            "recorder_overhead_pct": round(100 * (rec - bare) / bare,
+                                           2),
+            "ttft_p50_ms": rep["ttft_p50_ms"],
+            "ttft_p99_ms": rep["ttft_p99_ms"],
+            "tpot_p99_ms": rep["tpot_p99_ms"],
+            "e2e_p99_ms": rep["e2e_p99_ms"],
+            "queue_age_p99_ms": rep["queue_age_p99_ms"],
+            "goodput": rep["goodput"]}
+
+
 def bench_ckpt_async(reps: int = 2, *, saves: int = 5,
                      fits_per_save: int = 3, hidden: int = 1024) -> dict:
     """Sync vs async checkpoint stall at a fixed geometry (ISSUE-3
@@ -795,6 +921,7 @@ BENCHES = {"transformer": bench_transformer,
            "engine_decode": bench_engine_decode,
            "engine_decode_metrics": bench_engine_decode_metrics,
            "engine_continuous": bench_engine_continuous,
+           "engine_slo": bench_engine_slo,
            "ckpt_async": bench_ckpt_async,
            "quant_decode": bench_quant_decode,
            "word2vec": bench_word2vec}

@@ -15,8 +15,18 @@ one substrate they all publish into:
   `jax.profiler.TraceAnnotation` so spans land in XLA profiles.
 - `export` — Prometheus text exposition + JSON snapshot, served by the
   stdlib `MetricsServer` (`/metrics`, `/healthz`, `/readyz` with
-  pluggable health callables) and mountable on the training dashboard
+  pluggable health callables, plus `/debugz`, `/slo`,
+  `/timeline.json` when the serving introspection callables are
+  wired) and mountable on the training dashboard
   (`ui.server.UIServer.attach_metrics`).
+- `events` — the per-request flight recorder (ISSUE-6): a bounded
+  thread-safe ring of typed lifecycle events plus `RequestTrace`
+  (exposed as `RequestHandle.trace`); `NULL_RECORDER` disables by
+  injection.
+- `slo` — `SLOTracker`: TTFT / TPOT / e2e / queue-age histograms and
+  goodput derived from the traces, with a windowed `report()`.
+- `timeline` — Chrome/Perfetto `trace_event` JSON export of the
+  recorder: one lane per serving slot plus a queue lane.
 
 Publishers: `serving.InferenceEngine` (queue/batch/shed/quarantine/
 retry/breaker/decode-latency; `health()` is registry-backed),
@@ -34,3 +44,10 @@ from deeplearning4j_tpu.observability.tracing import (  # noqa: F401
 from deeplearning4j_tpu.observability.export import (  # noqa: F401
     CONTENT_TYPE_LATEST, MetricsServer, json_snapshot, probe_response,
     prometheus_text)
+from deeplearning4j_tpu.observability.events import (  # noqa: F401
+    EVENT_KINDS, Event, FlightRecorder, NULL_RECORDER, NULL_TRACE,
+    NullRecorder, RequestTrace, TERMINAL_KINDS)
+from deeplearning4j_tpu.observability.slo import (  # noqa: F401
+    NULL_SLO, SLOTracker, TPOT_BUCKETS)
+from deeplearning4j_tpu.observability.timeline import (  # noqa: F401
+    timeline_json, trace_events)

@@ -1,0 +1,259 @@
+"""Per-request flight recorder: typed lifecycle events in a ring buffer.
+
+The metrics layer (observability/metrics.py) answers *how much* —
+counts, rates, latency distributions — but when ONE request is slow or
+shed, aggregates explain nothing. The flight recorder is the other
+half (ISSUE-6): every request carries a `RequestTrace` of typed,
+monotonically-timestamped lifecycle events
+(``submit → queued → admitted{slot,bucket} → prefill_done →
+decode_chunk{tokens}* → finished`` on the happy path; ``retry``,
+``preempted``, ``quarantined``, ``shed{reason}`` on the others), and a
+`FlightRecorder` keeps the last N events of the whole engine in a
+bounded thread-safe ring — the raw material for `/debugz`, the SLO
+layer (observability/slo.py), and the Perfetto timeline export
+(observability/timeline.py).
+
+Design constraints, mirroring the metrics substrate:
+
+- **Near-zero hot-path cost.** Recording one event is a perf_counter
+  read, a tuple construction, and two GIL-atomic appends (~1 µs); the
+  engine adds a handful per request per chunk against
+  milliseconds-to-seconds of compiled decode. `NULL_RECORDER` /
+  `NULL_TRACE` mirror `NULL_REGISTRY`: disabling is injection, not
+  if-guards — the "off" arm of the `engine_slo` benchmark.
+- **Bounded memory.** The global ring is a `deque(maxlen=capacity)`;
+  per-request traces are bounded by the request's own lifetime
+  (≤ max_new_tokens/chunk decode events) and die with the handle.
+- **Monotonic timestamps.** `time.perf_counter`, never `time.time` —
+  event deltas survive wall-clock steps; exports re-base to t=0.
+- **Typed kinds.** An unknown kind raises: two subsystems silently
+  inventing dialects is the drift this catches (the same reason
+  `MetricsRegistry` hard-errors on kind mismatch).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, List, NamedTuple, Optional, Tuple
+
+_now = time.perf_counter
+
+#: The request-lifecycle event vocabulary (docs/observability.md has
+#: the per-kind payload schema). Engine code MUST use these exact
+#: names; `RequestTrace.add` rejects anything else.
+EVENT_KINDS = frozenset({
+    "submit",        # handle created, admission checks passed
+    "queued",        # appended to the bounded admission queue
+    "admitted",      # seated: {slot, bucket} (continuous) /
+    #                  {batch_size} (batch mode) / {scratch: True}
+    #                  (solo isolation re-run)
+    "prefill_done",  # prompt prefilled, first token committed {tokens}
+    "decode_chunk",  # one decode chunk committed {tokens, slot}
+    "preempted",     # evicted from its slot {reason: isolation|reload}
+    "retry",         # a compiled call containing it failed and is
+    #                  being retried {step, attempt, prefill}
+    "quarantined",   # terminal: failed persistently after solo retries
+    "finished",      # terminal: completed {tokens, partial}
+    "shed",          # terminal: rejected/abandoned {reason}
+})
+
+#: Terminal kinds — exactly one of these ends a complete trace.
+TERMINAL_KINDS = frozenset({"finished", "shed", "quarantined"})
+
+
+class Event(NamedTuple):
+    """One lifecycle event: monotonic timestamp, kind, request id, and
+    a small JSON-serializable payload dict."""
+    ts: float
+    kind: str
+    rid: int
+    data: dict
+
+    def as_dict(self) -> dict:
+        return {"ts": self.ts, "kind": self.kind, "rid": self.rid,
+                **self.data}
+
+
+class RequestTrace:
+    """The per-request event list, exposed as `RequestHandle.trace`.
+
+    `add()` stamps the event once and appends it to BOTH this trace
+    and the owning recorder's ring, so the per-request view and the
+    engine-wide view can never disagree."""
+
+    __slots__ = ("rid", "_recorder", "_events", "_lock")
+
+    def __init__(self, rid: int, recorder: "FlightRecorder" = None):
+        self.rid = int(rid)
+        self._recorder = recorder
+        self._events: List[Event] = []
+        self._lock = threading.Lock()
+
+    def add(self, kind: str, **data) -> Event:
+        if kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {kind!r}; "
+                             f"valid: {sorted(EVENT_KINDS)}")
+        rec = self._recorder
+        ev = Event(rec.now() if rec is not None else _now(),
+                   kind, self.rid, data)
+        with self._lock:
+            self._events.append(ev)
+        if rec is not None:
+            rec._push(ev)
+        return ev
+
+    @property
+    def events(self) -> Tuple[Event, ...]:
+        with self._lock:
+            return tuple(self._events)
+
+    def kinds(self) -> List[str]:
+        return [e.kind for e in self.events]
+
+    def first_ts(self, kind: str) -> Optional[float]:
+        for e in self.events:
+            if e.kind == kind:
+                return e.ts
+        return None
+
+    def last_ts(self, kind: str) -> Optional[float]:
+        ts = None
+        for e in self.events:
+            if e.kind == kind:
+                ts = e.ts
+        return ts
+
+    def complete(self) -> bool:
+        """True when the trace reached a terminal event."""
+        evs = self.events
+        return bool(evs) and evs[-1].kind in TERMINAL_KINDS
+
+    def as_dicts(self) -> List[dict]:
+        return [e.as_dict() for e in self.events]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of lifecycle events plus the
+    `RequestTrace` factory. One recorder per engine (the engine's
+    `recorder=` kwarg), or share one across engines the way a
+    registry is shared."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 4096,
+                 clock: Callable[[], float] = _now):
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        return self._clock()
+
+    def start_trace(self, rid: int) -> RequestTrace:
+        return RequestTrace(rid, self)
+
+    def record(self, kind: str, rid: int = 0, **data) -> Event:
+        """Ring-only event (no per-request trace) — engine-scope
+        happenings that belong to no single request."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {kind!r}")
+        ev = Event(self.now(), kind, int(rid), data)
+        self._push(ev)
+        return ev
+
+    def _push(self, ev: Event) -> None:
+        with self._lock:
+            self._ring.append(ev)
+
+    def recent(self, n: Optional[int] = None,
+               kind: Optional[str] = None,
+               rid: Optional[int] = None) -> List[Event]:
+        """The last ``n`` ring events (oldest first), optionally
+        filtered by kind and/or request id."""
+        with self._lock:
+            evs: Iterable[Event] = tuple(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e.kind == kind]
+        if rid is not None:
+            evs = [e for e in evs if e.rid == rid]
+        evs = list(evs)
+        return evs[-n:] if n is not None else evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_NULL_EVENT = Event(0.0, "shed", 0, {})
+
+
+class NullTrace:
+    """No-op trace: `add` costs one call and returns a constant."""
+
+    __slots__ = ()
+    rid = 0
+    events: Tuple[Event, ...] = ()
+
+    def add(self, kind: str, **data) -> Event:
+        return _NULL_EVENT
+
+    def kinds(self) -> list:
+        return []
+
+    def first_ts(self, kind: str) -> None:
+        return None
+
+    def last_ts(self, kind: str) -> None:
+        return None
+
+    def complete(self) -> bool:
+        return False
+
+    def as_dicts(self) -> list:
+        return []
+
+    def __len__(self) -> int:
+        return 0
+
+
+NULL_TRACE = NullTrace()
+
+
+class NullRecorder:
+    """Recorder whose traces record nothing — the flight recorder can
+    be disabled by injection (mirroring `NULL_REGISTRY`) instead of by
+    `if` guards at every engine call site."""
+
+    enabled = False
+    capacity = 0
+
+    def now(self) -> float:
+        return _now()
+
+    def start_trace(self, rid: int) -> NullTrace:
+        return NULL_TRACE
+
+    def record(self, kind: str, rid: int = 0, **data) -> Event:
+        return _NULL_EVENT
+
+    def recent(self, n=None, kind=None, rid=None) -> list:
+        return []
+
+    def clear(self) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+
+NULL_RECORDER = NullRecorder()

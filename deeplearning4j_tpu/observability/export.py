@@ -11,6 +11,17 @@ by pluggable callables — wire `InferenceEngine.health` / `.ready`
 straight in. The same three endpoints also mount on the training
 dashboard (`ui/server.UIServer.attach_metrics`), so one port can serve
 charts AND scrapes.
+
+Serving introspection (ISSUE-6): three more pluggable JSON endpoints —
+`/debugz` (`debug=engine.debugz`: slot table, queue ages, breaker
+state, recent flight-recorder events), `/slo`
+(`slo=engine.slo_report`: the windowed TTFT/TPOT/goodput report), and
+`/timeline.json` (`timeline=engine.timeline`: Chrome/Perfetto
+trace_event export, one lane per slot plus the queue lane). Each 404s
+when its callable isn't wired. A scraper that hangs up mid-response
+(half-closed socket, curl ctrl-C) is swallowed in `_send` — client
+disconnects must never traceback-spam or destabilize the exporter's
+daemon thread.
 """
 from __future__ import annotations
 
@@ -132,16 +143,47 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     registry = None                  # injected via subclass attrs
     health_fn: Optional[Callable] = None
     ready_fn: Optional[Callable] = None
+    debug_fn: Optional[Callable] = None
+    slo_fn: Optional[Callable] = None
+    timeline_fn: Optional[Callable] = None
 
     def log_message(self, *args) -> None:   # silence request logging
         pass
 
     def _send(self, code: int, body: bytes, ctype: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        # a client that hung up mid-scrape (half-closed socket, curl
+        # ctrl-C) raises on the write; that is the CLIENT's problem —
+        # swallowing it here keeps the daemon thread from spewing
+        # tracebacks via socketserver.handle_error and keeps the
+        # exporter serving the next scrape
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            try:
+                self.close_connection = True
+            except Exception:
+                pass
+
+    def _send_callable_json(self, fn: Optional[Callable]) -> None:
+        """One pluggable JSON endpoint: 404 when unwired, 500 (with
+        the error in the body) when the callable raises — an
+        introspection endpoint must never kill the exporter."""
+        if fn is None:
+            self._send(404, b'{"error": "not wired"}',
+                       "application/json")
+            return
+        try:
+            body = json.dumps(fn()).encode()
+        except Exception as e:
+            self._send(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode(),
+                "application/json")
+            return
+        self._send(200, body, "application/json")
 
     def do_GET(self) -> None:
         # class-attribute access: plain-function callables stored on
@@ -163,6 +205,12 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             code, body = probe_response(cls.ready_fn or cls.health_fn)
             self._send(code, json.dumps(body).encode(),
                        "application/json")
+        elif path == "/debugz":
+            self._send_callable_json(cls.debug_fn)
+        elif path == "/slo":
+            self._send_callable_json(cls.slo_fn)
+        elif path == "/timeline.json":
+            self._send_callable_json(cls.timeline_fn)
         else:
             self._send(404, b'{"error": "not found"}',
                        "application/json")
@@ -178,16 +226,29 @@ class MetricsServer:
 
     `port=0` binds an ephemeral port (read it back from `.port`).
     The server thread is a daemon; `stop()` shuts it down cleanly.
+
+    Serving introspection (optional callables; each endpoint 404s
+    when unwired):
+
+    >>> srv = MetricsServer(engine.registry, health=engine.health,
+    ...                     ready=engine.ready, debug=engine.debugz,
+    ...                     slo=engine.slo_report,
+    ...                     timeline=engine.timeline)
+    >>> # curl .../debugz  .../slo  .../timeline.json
     """
 
     def __init__(self, registry=None, port: int = 0,
                  health: Optional[Callable] = None,
-                 ready: Optional[Callable] = None):
+                 ready: Optional[Callable] = None,
+                 debug: Optional[Callable] = None,
+                 slo: Optional[Callable] = None,
+                 timeline: Optional[Callable] = None):
         self.registry = (registry if registry is not None
                          else default_registry())
         handler = type("BoundMetricsHandler", (_MetricsHandler,),
                        {"registry": self.registry, "health_fn": health,
-                        "ready_fn": ready})
+                        "ready_fn": ready, "debug_fn": debug,
+                        "slo_fn": slo, "timeline_fn": timeline})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -21,6 +21,11 @@ the layer between callers and the compiled decode step:
   the slot pool as int8 rows + per-row scales — ~4x fewer at-rest
   bytes on both axes (`deeplearning4j_tpu/quant/`,
   docs/quantization.md).
+- Flight recorder + SLO layer (round 11): `RequestHandle.trace` is a
+  typed lifecycle event record, `engine.slo` derives TTFT/TPOT/
+  e2e/queue-age/goodput, and `debugz()`/`slo_report()`/`timeline()`
+  back the `/debugz`, `/slo`, `/timeline.json` exporter endpoints
+  (`observability/events|slo|timeline.py`, docs/observability.md).
 
 Lifecycle and thresholds: docs/serving.md.
 """

@@ -83,6 +83,27 @@ dict surface is unchanged while `GET /metrics` (observability.export)
 serves the identical numbers. Pull-model gauges (`set_function`) keep
 the hot decode path free of scrape-time work.
 
+Flight recorder + SLO layer (round 11, ISSUE-6): every request
+carries a `RequestTrace` of typed lifecycle events
+(``submit → queued → admitted{slot,bucket} → prefill_done →
+decode_chunk{tokens}* → finished`` — plus ``retry``, ``preempted``,
+``quarantined``, ``shed{reason}``) on `RequestHandle.trace`, recorded
+into a bounded ring (`engine.recorder`,
+observability/events.FlightRecorder) — so when ONE request is slow or
+shed, its trace explains why, not just the aggregate counters. An
+`SLOTracker` (`engine.slo`) derives TTFT / TPOT (inter-token) / e2e /
+queue-age histograms and goodput from the traces in BOTH scheduling
+modes, with a windowed `slo_report()`. Introspection surfaces:
+`debugz()` (slot table + queue ages + breaker + recent events),
+`slo_report()`, and `timeline()` (Chrome/Perfetto trace_event JSON,
+one lane per slot plus the queue lane) — wire them into
+`observability.MetricsServer(debug=..., slo=..., timeline=...)` for
+`/debugz`, `/slo`, `/timeline.json`. Recording defaults ON with a
+live registry and mirrors it off: `registry=NULL_REGISTRY` (or
+`recorder=observability.NULL_RECORDER`) makes every trace call a
+no-op — the `engine_slo` benchmark's bare arm (overhead bound ≤ 2%,
+BASELINE.md).
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -101,8 +122,12 @@ from typing import Callable, Iterable, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.models.transformer import TransformerConfig
+from deeplearning4j_tpu.observability.events import (FlightRecorder,
+                                                     NULL_RECORDER,
+                                                     NULL_TRACE)
 from deeplearning4j_tpu.observability.metrics import (
-    DECODE_LATENCY_BUCKETS, MetricsRegistry)
+    DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
+from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
 from deeplearning4j_tpu.parallel.serving import (init_slot_state,
                                                  make_continuous_decode,
                                                  make_continuous_prefill,
@@ -201,6 +226,11 @@ class RequestHandle:
         self._generated: List[np.ndarray] = []
         self._done = threading.Event()
         self._in_flight = False          # continuous-mode accounting
+        # flight recorder (ISSUE-6): the engine swaps in a live
+        # RequestTrace at submit; NULL_TRACE keeps direct
+        # constructions (and disabled recording) zero-cost
+        self.trace = NULL_TRACE
+        self._on_terminal: Optional[Callable] = None
 
     @property
     def generated(self) -> np.ndarray:
@@ -226,6 +256,15 @@ class RequestHandle:
                 error: Optional[BaseException] = None) -> None:
         self.status = status
         self.error = error
+        # the ONE terminal transition point: record the terminal trace
+        # event + SLO accounting BEFORE waking result() waiters, so a
+        # caller observing done() always sees a complete trace
+        cb = self._on_terminal
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:    # observability must not kill serving
+                log.exception("terminal trace hook failed")
         self._done.set()
 
 
@@ -297,7 +336,8 @@ class InferenceEngine:
                  clock: Callable[[], float] = time.monotonic,
                  registry=None,
                  quantize: Optional[str] = None,
-                 kv_quantize: Optional[str] = None):
+                 kv_quantize: Optional[str] = None,
+                 recorder=None, slo=None):
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or EngineConfig()
@@ -367,6 +407,20 @@ class InferenceEngine:
         self.registry = (registry if registry is not None
                          else MetricsRegistry())
         self._init_metrics(self.registry)
+        # flight recorder + SLO layer (ISSUE-6): on by default with a
+        # live registry, and mirroring NULL_REGISTRY off — pass
+        # recorder=observability.NULL_RECORDER (or a NULL registry) to
+        # make every trace/SLO call a no-op, or inject a shared
+        # FlightRecorder/SLOTracker the way a registry is shared
+        if recorder is None:
+            recorder = (NULL_RECORDER
+                        if isinstance(self.registry, NullRegistry)
+                        else FlightRecorder())
+        self.recorder = recorder
+        if slo is None:
+            slo = (NULL_SLO if not recorder.enabled
+                   else SLOTracker(registry=self.registry))
+        self.slo = slo
 
     def _init_metrics(self, r) -> None:
         self._m_completed = r.counter(
@@ -528,9 +582,33 @@ class InferenceEngine:
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
                 on_deadline)
+            handle.trace = self.recorder.start_trace(handle.rid)
+            handle._on_terminal = self._on_terminal
+            handle.trace.add(
+                "submit", prompt_tokens=int(prompt.shape[0]),
+                max_new_tokens=int(eff),
+                deadline_s=(float(deadline_s)
+                            if deadline_s is not None else None))
             self._queue.append(handle)
+            handle.trace.add("queued", depth=len(self._queue))
             self._cv.notify()
         return handle
+
+    def _on_terminal(self, r: RequestHandle) -> None:
+        """RequestHandle._finish hook: terminal trace event + SLO
+        accounting — runs exactly once, whatever path finished the
+        request (complete / deadline shed / partial / quarantine)."""
+        if r.status == RequestStatus.COMPLETED:
+            r.trace.add("finished",
+                        tokens=int(sum(a.shape[0]
+                                       for a in r._generated)),
+                        partial=bool(r.deadline_exceeded))
+        elif r.status == RequestStatus.SHED:
+            r.trace.add("shed", reason=("deadline" if r.deadline_exceeded
+                                        else "overload"))
+        elif r.status == RequestStatus.QUARANTINED:
+            r.trace.add("quarantined")
+        self.slo.finished(r.trace)
 
     # ------------------------------------------------------------------
     # driving: synchronous drain or background worker
@@ -636,6 +714,8 @@ class InferenceEngine:
         self._m_batch_size.observe(len(batch))
         for r in batch:
             r.status = RequestStatus.RUNNING
+            r.trace.add("admitted", batch_size=len(batch))
+            self.slo.admitted(r.trace)
         return batch
 
     def _process_batch(self, batch: List[RequestHandle]) -> None:
@@ -676,14 +756,13 @@ class InferenceEngine:
                 [np.concatenate([r.prompt, r.generated])
                  for r in active]).astype(np.int32)
             try:
-                toks = self._invoke(params, prompts, n,
-                                    [r.rid for r in active])
+                toks = self._invoke(params, prompts, n, active)
             except _BatchDecodeFailed as e:
                 self._isolate(active, params, e)
                 return
             for i, r in enumerate(active):
                 need = min(n, r.max_new_tokens - done)
-                r._generated.append(toks[i, :need])
+                self._commit_tokens(r, toks[i, :need], "decode_chunk")
                 if r.generated.shape[0] >= r.max_new_tokens:
                     self._complete(r)
             self._shed_expired(batch)
@@ -708,6 +787,20 @@ class InferenceEngine:
     def _complete(self, r: RequestHandle) -> None:
         self._m_completed.inc()
         r._finish(RequestStatus.COMPLETED)
+
+    def _commit_tokens(self, r: RequestHandle, toks: np.ndarray,
+                       kind: str, **data) -> None:
+        """The ONE place generated tokens land on a handle: appends
+        the chunk, records the trace event (`prefill_done` /
+        `decode_chunk`), and — on the request's FIRST generated token,
+        in either scheduling mode — feeds TTFT to the SLO tracker
+        (batch mode's first chunk is its first-token moment; without
+        this, batch-mode TTFT would simply not exist)."""
+        first = not r._generated
+        r._generated.append(toks)
+        ev = r.trace.add(kind, tokens=int(toks.shape[0]), **data)
+        if first:
+            self.slo.first_token(r.trace, ev.ts)
 
     # ------------------------------------------------------------------
     # continuous batching: slot-pool scheduling
@@ -767,6 +860,10 @@ class InferenceEngine:
                 r.status = RequestStatus.RUNNING
                 r._in_flight = True
                 self._m_in_flight.inc()
+                r.trace.add("admitted", slot=i, bucket=int(
+                    self._bucket_len(r.prompt.shape[0]
+                                     + r.generated.shape[0])))
+                self.slo.admitted(r.trace)
                 admitted.append((i, r))
         return admitted
 
@@ -840,7 +937,7 @@ class InferenceEngine:
             o = fn(params, *state, prompts, plen, key)
             return tuple(o[:n_state]), np.asarray(o[n_state])
 
-        return self._guarded(call, [r.rid for _, r in entries],
+        return self._guarded(call, [r for _, r in entries],
                              self._m_prefill_seconds, prefill=True)
 
     def _call_chunk(self, params, state, entries):
@@ -866,7 +963,7 @@ class InferenceEngine:
             o = fn(params, *state, active, rem, key)
             return tuple(o[:n_state]), np.asarray(o[n_state])
 
-        return self._guarded(call, [r.rid for _, r in entries],
+        return self._guarded(call, [r for _, r in entries],
                              self._m_step_seconds)
 
     def _prefill_slots(self, admitted, params) -> None:
@@ -891,7 +988,8 @@ class InferenceEngine:
             with self._lock:
                 if self._slots[i] is not r:   # preempted by a reload
                     continue
-            r._generated.append(np.asarray([first[i]], np.int32))
+            self._commit_tokens(r, np.asarray([first[i]], np.int32),
+                                "prefill_done", slot=i)
             if r.generated.shape[0] >= r.max_new_tokens:
                 self._complete(r)
         self._reap()
@@ -906,7 +1004,8 @@ class InferenceEngine:
                     continue                  # uncommitted tokens drop
             need = min(self._chunk,
                        r.max_new_tokens - r.generated.shape[0])
-            r._generated.append(toks[i, :need].astype(np.int32))
+            self._commit_tokens(r, toks[i, :need].astype(np.int32),
+                                "decode_chunk", slot=i)
             if r.generated.shape[0] >= r.max_new_tokens:
                 self._complete(r)
 
@@ -947,6 +1046,7 @@ class InferenceEngine:
                     self._leave_flight(r)
                 continue
             self._m_preempted.inc()
+            r.trace.add("preempted", reason="isolation")
             try:
                 self._run_isolated(r)
             except _BatchDecodeFailed as e:
@@ -969,8 +1069,13 @@ class InferenceEngine:
         params = self._params
         state = init_slot_state(self.cfg, self.mesh, self._num_slots,
                                 kv_mode=self._kv_mode)
+        r.trace.add("admitted", slot=0, scratch=True, bucket=int(
+            self._bucket_len(r.prompt.shape[0]
+                             + r.generated.shape[0])))
+        self.slo.admitted(r.trace)
         state, first = self._call_prefill(params, state, [(0, r)])
-        r._generated.append(np.asarray([first[0]], np.int32))
+        self._commit_tokens(r, np.asarray([first[0]], np.int32),
+                            "prefill_done", scratch=True)
         while True:
             self._shed_expired([r])
             if r.status != RequestStatus.RUNNING:
@@ -981,7 +1086,8 @@ class InferenceEngine:
             state, toks = self._call_chunk(params, state, [(0, r)])
             need = min(self._chunk,
                        r.max_new_tokens - r.generated.shape[0])
-            r._generated.append(toks[0, :need].astype(np.int32))
+            self._commit_tokens(r, toks[0, :need].astype(np.int32),
+                                "decode_chunk", scratch=True)
 
     def _evict_all_locked(self) -> int:
         """Weight-reload preemption (continuous mode; caller holds the
@@ -1000,6 +1106,7 @@ class InferenceEngine:
             self._slots[i] = None
             r.status = RequestStatus.QUEUED
             self._leave_flight(r)
+            r.trace.add("preempted", reason="reload")
             self._queue.appendleft(r)
             n += 1
         return n
@@ -1007,15 +1114,17 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # the guarded decode step
     # ------------------------------------------------------------------
-    def _guarded(self, call, rids: List[int], hist,
+    def _guarded(self, call, reqs: List[RequestHandle], hist,
                  prefill: bool = False):
         """One compiled-call guard shared by every decode path:
         fault-injection hook (the injector sees the request ids of ALL
         co-resident work), latency histogram, retry with exponential
-        backoff, breaker accounting. The step counter indexes
-        COMPLETED calls — prefills and chunks share it — so a failed
-        attempt retries the same index (ServingFaultInjector
-        contract). Raises _BatchDecodeFailed after max_retries."""
+        backoff (every co-resident trace gets the `retry` event),
+        breaker accounting. The step counter indexes COMPLETED calls —
+        prefills and chunks share it — so a failed attempt retries the
+        same index (ServingFaultInjector contract). Raises
+        _BatchDecodeFailed after max_retries."""
+        rids = [r.rid for r in reqs]
         attempt = 0
         while True:
             try:
@@ -1037,6 +1146,9 @@ class InferenceEngine:
                 if attempt > self.config.max_retries:
                     raise _BatchDecodeFailed(str(e)) from e
                 self._m_retries.inc()
+                for r in reqs:
+                    r.trace.add("retry", step=self._step_counter,
+                                attempt=attempt, prefill=prefill)
                 delay = min(self.config.backoff_base_s
                             * (2 ** (attempt - 1)),
                             self.config.backoff_max_s)
@@ -1048,7 +1160,7 @@ class InferenceEngine:
                     time.sleep(delay)
 
     def _invoke(self, params, prompts: np.ndarray, n: int,
-                rids: List[int]) -> np.ndarray:
+                reqs: List[RequestHandle]) -> np.ndarray:
         """One compiled batch-mode decode call (batch padded to a
         'data' multiple), retried via _guarded. Returns [B_real, n]
         new tokens. Raises _BatchDecodeFailed after max_retries."""
@@ -1073,7 +1185,7 @@ class InferenceEngine:
         def call():
             return np.asarray(fn(params, jnp.asarray(prompts), key))
 
-        out = self._guarded(call, rids, self._m_step_seconds)
+        out = self._guarded(call, reqs, self._m_step_seconds)
         return out[:b, prompts.shape[1]:]
 
     def _isolate(self, active: List[RequestHandle], params,
@@ -1109,8 +1221,8 @@ class InferenceEngine:
                 n = min(self.config.decode_chunk, n)
             prompts = np.concatenate([r.prompt, r.generated])[None]
             toks = self._invoke(params, prompts.astype(np.int32), n,
-                                [r.rid])
-            r._generated.append(toks[0])
+                                [r])
+            self._commit_tokens(r, toks[0], "decode_chunk", solo=True)
 
     # ------------------------------------------------------------------
     # circuit breaker / degradation
@@ -1150,6 +1262,63 @@ class InferenceEngine:
     def _degraded_locked(self) -> bool:
         return (len(self._queue) >= self.config.degrade_queue_depth
                 or self._breaker != "closed")
+
+    # ------------------------------------------------------------------
+    # introspection: /debugz, /slo, /timeline.json bodies (ISSUE-6)
+    # ------------------------------------------------------------------
+    def debugz(self, recent: int = 100) -> dict:
+        """The operator's "why is it slow RIGHT NOW" snapshot: the
+        live slot table (who is seated where, for how long), queue
+        entries with their ages, breaker/degradation state, and the
+        recorder's recent lifecycle events — wire into
+        `MetricsServer(debug=engine.debugz)` for `GET /debugz`."""
+        now = self.recorder.now()
+
+        def age(r):
+            t = r.trace.first_ts("submit")
+            return round(now - t, 6) if t is not None else None
+
+        with self._lock:
+            slots = [{"slot": i, "rid": r.rid, "status": r.status,
+                      "generated": int(sum(a.shape[0]
+                                           for a in r._generated)),
+                      "max_new_tokens": r.max_new_tokens,
+                      "age_s": age(r)}
+                     for i, r in enumerate(self._slots)
+                     if r is not None]
+            queue = [{"rid": r.rid, "queue_age_s": age(r)}
+                     for r in self._queue]
+            breaker = self._breaker
+            degraded = self._degraded_locked()
+        return {"mode": self.config.mode,
+                "num_slots": self._num_slots,
+                "slots_occupied": len(slots),
+                "slots": slots,
+                "queue_depth": len(queue),
+                "queue": queue,
+                "breaker": breaker,
+                "degraded": degraded,
+                "weights_step": self._weights_step,
+                "recorder_events": len(self.recorder),
+                "recent_events": [e.as_dict() for e in
+                                  self.recorder.recent(recent)]}
+
+    def slo_report(self) -> dict:
+        """Windowed SLO report (observability/slo.py): TTFT / TPOT /
+        e2e / queue-age percentiles + goodput — `GET /slo`'s body and
+        the engine_slo benchmark's output."""
+        return self.slo.report()
+
+    def timeline(self, n: Optional[int] = None) -> dict:
+        """Chrome/Perfetto trace_event JSON over the recorder's recent
+        events: one lane per slot plus the queue lane — load
+        `GET /timeline.json` in https://ui.perfetto.dev and the slot
+        schedule (gaps, preemption storms, lane-pinning requests) is
+        visible instead of inferred."""
+        from deeplearning4j_tpu.observability.timeline import \
+            timeline_json
+        return timeline_json(self.recorder, num_slots=self._num_slots,
+                             n=n)
 
     # ------------------------------------------------------------------
     # health / readiness / weights

@@ -14,6 +14,12 @@ training series, an AsyncDataSetIterator's prefetch gauges), a
 `MetricsServer` exports it, and the demo ends by fetching and
 printing a real curl-able `/metrics` sample.
 
+ISSUE-6 addendum: the same exporter now also serves `/debugz`, `/slo`
+and `/timeline.json` — the demo prints the quarantined request's
+flight-recorder trace (retry -> preempted -> quarantined, the
+per-request "why"), the windowed TTFT/TPOT/goodput SLO report, and
+where to load the Perfetto slot timeline.
+
 On a TPU slice this uses all chips; elsewhere:
   JAX_PLATFORMS=cpu python examples/fault_tolerant_serving.py
 """
@@ -76,9 +82,12 @@ def main() -> None:
     eng.set_listeners(PerformanceListener(frequency=1, report=False,
                                           registry=registry))
     exporter = obs.MetricsServer(registry, port=0, health=eng.health,
-                                 ready=eng.ready)
+                                 ready=eng.ready, debug=eng.debugz,
+                                 slo=eng.slo_report,
+                                 timeline=eng.timeline)
     print(f"[metrics] exporter at {exporter.url}/metrics "
-          "(healthz/readyz wired to the engine)")
+          "(healthz/readyz/debugz/slo/timeline.json wired to the "
+          "engine)")
 
     # 1. transient fault: retried, completes
     h = eng.submit(prompt)
@@ -96,6 +105,10 @@ def main() -> None:
     except RequestQuarantined as e:
         print(f"[quarantine] {e}")
     print(f"[quarantine] peer status={good.status}")
+    # the flight recorder kept the per-request forensics: the
+    # quarantined request's own lifecycle, ready for /debugz
+    print(f"[trace] bad request lifecycle: {bad.trace.kinds()}")
+    print(f"[trace] peer lifecycle:        {good.trace.kinds()}")
 
     # 3. deadline shed mid-decode (injected host stall)
     inj.delay_at[eng._step_counter + 1] = 0.1
@@ -151,6 +164,26 @@ def main() -> None:
     print(f"[metrics] GET /metrics -> {len(lines)} lines; sample:")
     for line in sample:
         print(f"  {line}")
+
+    # 8. the serving introspection endpoints (ISSUE-6): the windowed
+    # SLO report and the Perfetto-loadable slot timeline
+    import json
+    rep = json.loads(urlopen(f"{exporter.url}/slo",
+                             timeout=5).read().decode())
+    print(f"[slo] window={rep['window']} goodput={rep['goodput']:.2f} "
+          f"ttft_p50={rep['ttft_p50_ms']}ms "
+          f"ttft_p99={rep['ttft_p99_ms']}ms "
+          f"tpot_p99={rep['tpot_p99_ms']}ms")
+    tl = json.loads(urlopen(f"{exporter.url}/timeline.json",
+                            timeout=5).read().decode())
+    print(f"[timeline] GET /timeline.json -> "
+          f"{len(tl['traceEvents'])} trace events (load in "
+          "https://ui.perfetto.dev: one lane per slot + queue lane)")
+    dbg = json.loads(urlopen(f"{exporter.url}/debugz",
+                             timeout=5).read().decode())
+    print(f"[debugz] breaker={dbg['breaker']} "
+          f"queue_depth={dbg['queue_depth']} "
+          f"recent_events={dbg['recorder_events']}")
     exporter.stop()
 
 

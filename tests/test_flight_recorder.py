@@ -1,0 +1,466 @@
+"""Flight recorder + SLO layer + timeline export (ISSUE-6 suite).
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- a served request's `RequestHandle.trace` is a COMPLETE typed
+  lifecycle record (submit → queued → admitted{slot,bucket} →
+  prefill_done → decode_chunk{tokens}* → finished) in both scheduling
+  modes, with monotone timestamps;
+- fault injection leaves forensic traces: a poisoned request's trace
+  reads retry → … → quarantined, while co-resident survivors read
+  preempted → re-admitted (scratch) → finished; reload preemption
+  reads preempted{reason=reload} → re-admitted;
+- the SLO layer derives TTFT / TPOT / e2e / queue-age / goodput from
+  the traces (exact values under an injected clock) and publishes
+  registry histograms + a windowed report() — TTFT and queue-age in
+  BATCH mode too, not just continuous;
+- `/timeline.json` parses as valid Chrome trace_event JSON with one
+  lane per slot plus a queue lane; `/debugz` and `/slo` serve the live
+  introspection dicts;
+- NULL_RECORDER / NULL_REGISTRY disable everything by injection with
+  identical decode results.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.observability import (FlightRecorder,
+                                              MetricsRegistry,
+                                              MetricsServer,
+                                              NULL_RECORDER,
+                                              NULL_TRACE, SLOTracker,
+                                              prometheus_text,
+                                              timeline_json)
+from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestStatus)
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=6, backoff_base_s=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# trace completeness
+# ---------------------------------------------------------------------------
+
+def test_trace_complete_lifecycle_continuous(params, mesh1):
+    """Happy path, continuous mode: the exact event sequence with the
+    typed payloads — slot + bucket on admission, one prefill_done
+    carrying the first token, ~budget/chunk decode_chunk events, a
+    finished terminal — and non-decreasing monotonic timestamps."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    h = eng.submit(_prompt())
+    assert h.trace.kinds() == ["submit", "queued"]
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    kinds = h.trace.kinds()
+    assert kinds[:3] == ["submit", "queued", "admitted"]
+    assert kinds[3] == "prefill_done"
+    assert kinds[-1] == "finished" and h.trace.complete()
+    # 6 new tokens at chunk 2: 1 from prefill + 3 chunks (last partial)
+    assert kinds.count("decode_chunk") == 3
+    evs = h.trace.events
+    by_kind = {e.kind: e for e in evs}
+    assert by_kind["submit"].data["prompt_tokens"] == 8
+    assert by_kind["submit"].data["max_new_tokens"] == 6
+    assert by_kind["admitted"].data["slot"] == 0
+    assert by_kind["admitted"].data["bucket"] == 16   # 8 rounds up
+    assert by_kind["prefill_done"].data["tokens"] == 1
+    assert by_kind["finished"].data["tokens"] == 6
+    assert not by_kind["finished"].data["partial"]
+    ts = [e.ts for e in evs]
+    assert ts == sorted(ts)
+    # the engine ring saw the same request's events
+    assert [e.kind for e in eng.recorder.recent(rid=h.rid)] == kinds
+
+
+def test_trace_and_ttft_in_batch_mode(params, mesh1):
+    """Batch mode (ISSUE-6 satellite): the trace is complete there
+    too, and the first decode chunk IS the first-token moment — so
+    serving_ttft_seconds and serving_queue_age_seconds get observed
+    in BOTH modes, not just continuous."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(mode="batch", decode_chunk=2))
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    kinds = h.trace.kinds()
+    assert kinds[:3] == ["submit", "queued", "admitted"]
+    assert h.trace.events[2].data == {"batch_size": 1}
+    assert kinds.count("decode_chunk") == 3           # 6 tokens / 2
+    assert kinds[-1] == "finished"
+    for name in ("serving_ttft_seconds", "serving_queue_age_seconds",
+                 "serving_e2e_seconds"):
+        hist = eng.registry.get(name)
+        assert hist is not None, name
+        assert hist._unlabeled().snapshot()[2] == 1, name
+    # TPOT defined (6 tokens across 3 chunk events)
+    assert eng.registry.get(
+        "serving_tpot_seconds")._unlabeled().snapshot()[2] == 1
+
+
+def test_deadline_shed_trace_and_slo_outcome(params, mesh1):
+    """An already-expired request sheds at admission: trace ends
+    shed{reason=deadline}, and the SLO window books the outcome (so
+    goodput < 1)."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    ok = eng.submit(_prompt(8, 1))
+    doomed = eng.submit(_prompt(8, 2), deadline_s=-0.001)
+    eng.run_pending()
+    assert ok.status == RequestStatus.COMPLETED
+    assert doomed.status == RequestStatus.SHED
+    assert doomed.trace.kinds() == ["submit", "queued", "shed"]
+    assert doomed.trace.events[-1].data["reason"] == "deadline"
+    rep = eng.slo_report()
+    assert rep["window"] == 2
+    assert rep["outcomes"] == {"ok": 1, "late": 0, "shed": 1,
+                               "quarantined": 0}
+    assert rep["goodput"] == 0.5
+    assert eng.registry.get("serving_goodput_ratio").value == 0.5
+
+
+# ---------------------------------------------------------------------------
+# satellite: fault-injection forensics
+# ---------------------------------------------------------------------------
+
+def test_quarantine_and_survivor_traces_under_poison(params, mesh1):
+    """ServingFaultInjector poison in a 3-resident pool: the
+    quarantined request's trace contains retry → quarantined (in that
+    order), and each co-resident survivor's trace contains preempted →
+    re-admitted (on the scratch pool) → finished."""
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_retries=1), fault_injector=inj)
+    a = eng.submit(_prompt(8, 1))
+    bad = eng.submit(_prompt(12, 2))
+    b = eng.submit(_prompt(10, 3))
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+
+    assert bad.status == RequestStatus.QUARANTINED
+    kinds = bad.trace.kinds()
+    assert "retry" in kinds and kinds[-1] == "quarantined"
+    assert kinds.index("retry") < kinds.index("quarantined")
+    # poisoned request was evicted from the pool before its solo run
+    assert "preempted" in kinds
+
+    for surv in (a, b):
+        kinds = surv.trace.kinds()
+        assert surv.status == RequestStatus.COMPLETED
+        i_pre = kinds.index("preempted")
+        readmits = [j for j, k in enumerate(kinds)
+                    if k == "admitted" and j > i_pre]
+        assert readmits, f"no re-admission after preemption: {kinds}"
+        ev = surv.trace.events[readmits[0]]
+        assert ev.data.get("scratch") is True      # solo scratch pool
+        assert kinds[-1] == "finished"
+        assert surv.trace.events[i_pre].data["reason"] == "isolation"
+
+
+def test_prefill_fault_retry_is_traced(params, mesh1):
+    """A transient admission-prefill fault leaves a retry event with
+    prefill=True on every request seated in that admission round."""
+    inj = ServingFaultInjector(prefill_fail_at=[0])
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    retries = [e for e in h.trace.events if e.kind == "retry"]
+    assert len(retries) == 1
+    assert retries[0].data["prefill"] is True
+    assert retries[0].data["step"] == 0
+
+
+def test_reload_preemption_trace(tmp_path, params, mesh1):
+    """Hot reload mid-stream: the in-flight request's trace reads
+    preempted{reason=reload} → re-admitted (fresh slot, requeued at
+    the front) → finished."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=10))
+    h = eng.submit(_prompt())
+    eng.tick()
+    assert eng.reload_weights(mgr, step=1) == 1
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    kinds = h.trace.kinds()
+    i_pre = kinds.index("preempted")
+    assert h.trace.events[i_pre].data["reason"] == "reload"
+    assert "admitted" in kinds[i_pre:]
+    assert kinds[-1] == "finished"
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker: exact values under an injected clock
+# ---------------------------------------------------------------------------
+
+def test_slo_tracker_deterministic_values():
+    """TTFT / TPOT / e2e / queue-age / goodput computed from traces
+    with controlled timestamps — the definitions, verified exactly."""
+    clk = {"t": 0.0}
+    rec = FlightRecorder(clock=lambda: clk["t"])
+    reg = MetricsRegistry()
+    slo = SLOTracker(registry=reg, window=8)
+
+    tr = rec.start_trace(1)
+    tr.add("submit")                       # t=0
+    tr.add("queued")
+    clk["t"] = 1.0
+    tr.add("admitted", slot=0, bucket=16)
+    slo.admitted(tr)                       # queue age = 1.0
+    clk["t"] = 1.25
+    ev = tr.add("prefill_done", tokens=1)
+    slo.first_token(tr, ev.ts)             # ttft = 1.25
+    clk["t"] = 2.75
+    tr.add("decode_chunk", tokens=3)       # 4 tokens over 1.5s
+    clk["t"] = 3.0
+    tr.add("finished", tokens=4, partial=False)
+    slo.finished(tr)                       # e2e = 3.0, tpot = 0.5
+
+    tr2 = rec.start_trace(2)
+    tr2.add("submit")
+    clk["t"] = 3.5
+    tr2.add("shed", reason="deadline")
+    slo.finished(tr2)
+
+    rep = slo.report()
+    assert rep["window"] == 2
+    assert rep["goodput"] == 0.5 and slo.goodput() == 0.5
+    assert rep["ttft_p50_ms"] == 1250.0
+    assert rep["tpot_p50_ms"] == 500.0
+    assert rep["queue_age_p50_ms"] == 1000.0
+    # e2e values: 3.0 (trace 1) and 0.5 (trace 2, submit 3.0→shed 3.5)
+    assert rep["e2e_p50_ms"] == 500.0      # nearest-rank: lower of 2
+    assert rep["e2e_p99_ms"] == 3000.0
+    assert rep["outcomes"]["shed"] == 1
+
+    # the same numbers landed in the registry histograms
+    assert reg.get("serving_ttft_seconds")._unlabeled().snapshot() \
+        [1] == pytest.approx(1.25)
+    assert reg.get("serving_tpot_seconds")._unlabeled().snapshot() \
+        [1] == pytest.approx(0.5)
+    assert reg.get("serving_queue_age_seconds")._unlabeled() \
+        .snapshot()[2] == 1
+    assert reg.get("serving_slo_requests").labels("ok").value == 1
+    assert reg.get("serving_slo_requests").labels("shed").value == 1
+    assert reg.get("serving_goodput_ratio").value == 0.5
+
+    text = prometheus_text(reg)
+    assert "serving_ttft_seconds_bucket" in text
+    assert "serving_goodput_ratio 0.5" in text
+
+
+def test_slo_queue_age_counts_reinsertion_wait():
+    """A preempted request's second wait (preempted → re-admitted) is
+    a real queue wait: admitted() measures from the LAST preemption,
+    not from submit."""
+    clk = {"t": 0.0}
+    rec = FlightRecorder(clock=lambda: clk["t"])
+    reg = MetricsRegistry()
+    slo = SLOTracker(registry=reg)
+    tr = rec.start_trace(1)
+    tr.add("submit")
+    clk["t"] = 1.0
+    tr.add("admitted", slot=0)
+    slo.admitted(tr)                       # wait 1.0
+    clk["t"] = 5.0
+    tr.add("preempted", reason="reload")
+    clk["t"] = 5.25
+    tr.add("admitted", slot=1)
+    slo.admitted(tr)                       # wait 0.25, NOT 5.25
+    cum, total, count = reg.get(
+        "serving_queue_age_seconds")._unlabeled().snapshot()
+    assert count == 2 and total == pytest.approx(1.25)
+
+
+# ---------------------------------------------------------------------------
+# timeline export
+# ---------------------------------------------------------------------------
+
+def test_timeline_is_valid_trace_event_json(params, mesh1):
+    """eng.timeline() round-trips through JSON and carries the
+    Chrome/Perfetto trace_event structure: thread_name metadata naming
+    ONE LANE PER SLOT plus the queue lane, complete ('X') spans with
+    non-negative durations, and instant decode events."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=4))
+    hs = [eng.submit(_prompt(8, i)) for i in range(3)]
+    eng.run_pending()
+    assert all(h.done() for h in hs)
+
+    tl = json.loads(json.dumps(eng.timeline()))
+    assert tl["displayTimeUnit"] == "ms"
+    evs = tl["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "queue" in lanes
+    assert {f"slot {i}" for i in range(eng._num_slots)} <= lanes
+
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(e["dur"] >= 0 and e["ts"] >= 0 for e in xs)
+    # every request shows a queue wait AND a slot residency
+    for h in hs:
+        mine = [e for e in xs if e["args"].get("rid") == h.rid]
+        assert any(e["tid"] == 0 for e in mine)       # queue lane
+        assert any(e["tid"] >= 1 for e in mine)       # a slot lane
+    assert any(e["ph"] == "i" and e["name"].startswith("decode_chunk")
+               for e in evs)
+
+    # standalone export over raw events agrees
+    tl2 = timeline_json(eng.recorder, num_slots=eng._num_slots)
+    assert len(tl2["traceEvents"]) == len(evs)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+def test_debugz_slo_timeline_endpoints(params, mesh1):
+    """MetricsServer(debug=, slo=, timeline=) serves the three
+    introspection endpoints; a server without them 404s."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    hs = [eng.submit(_prompt(8, i)) for i in range(2)]
+    eng.run_pending()
+    srv = MetricsServer(eng.registry, port=0, health=eng.health,
+                        ready=eng.ready, debug=eng.debugz,
+                        slo=eng.slo_report, timeline=eng.timeline)
+    try:
+        code, body = _get(srv.url + "/debugz")
+        dbg = json.loads(body)
+        assert code == 200
+        assert dbg["mode"] == "continuous" and dbg["slots"] == []
+        assert dbg["queue_depth"] == 0 and dbg["breaker"] == "closed"
+        kinds = [e["kind"] for e in dbg["recent_events"]]
+        assert kinds.count("finished") == 2
+        assert {e["rid"] for e in dbg["recent_events"]} == \
+            {h.rid for h in hs}
+
+        code, body = _get(srv.url + "/slo")
+        rep = json.loads(body)
+        assert code == 200 and rep["window"] == 2
+        assert rep["goodput"] == 1.0 and rep["ttft_p50_ms"] > 0
+
+        code, body = _get(srv.url + "/timeline.json")
+        assert code == 200
+        assert json.loads(body)["traceEvents"]
+
+        code, text = _get(srv.url + "/metrics")   # still a scraper
+        assert code == 200 and "serving_ttft_seconds_bucket" in text
+    finally:
+        srv.stop()
+
+    bare = MetricsServer(eng.registry, port=0)
+    try:
+        for path in ("/debugz", "/slo", "/timeline.json"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(bare.url + path)
+            assert e.value.code == 404
+    finally:
+        bare.stop()
+
+
+def test_debugz_shows_live_slots_and_queue(params, mesh1):
+    """Mid-flight debugz: seated request in the slot table with its
+    progress, waiting request in the queue with an age."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=1, max_new_tokens=10))
+    seated = eng.submit(_prompt(8, 1))
+    waiting = eng.submit(_prompt(8, 2))
+    eng.tick()                             # seat 1 (pool of 1), decode
+    dbg = eng.debugz()
+    assert [s["rid"] for s in dbg["slots"]] == [seated.rid]
+    assert dbg["slots"][0]["status"] == "running"
+    assert 0 < dbg["slots"][0]["generated"] < 10
+    assert dbg["slots"][0]["age_s"] > 0
+    assert [q["rid"] for q in dbg["queue"]] == [waiting.rid]
+    assert dbg["queue"][0]["queue_age_s"] > 0
+    eng.run_pending()
+
+
+# ---------------------------------------------------------------------------
+# disable-by-injection + ring bounds
+# ---------------------------------------------------------------------------
+
+def test_null_recorder_disabled_by_injection(params, mesh1):
+    """registry=NULL_REGISTRY (or recorder=NULL_RECORDER) turns every
+    trace/SLO call into a no-op — and decode results are identical to
+    the recorded engine's."""
+    from deeplearning4j_tpu.observability import NULL_REGISTRY
+    eng_off = InferenceEngine(CFG, mesh1, params, _config(),
+                              registry=NULL_REGISTRY)
+    assert eng_off.recorder is NULL_RECORDER
+    h = eng_off.submit(_prompt())
+    assert h.trace is NULL_TRACE
+    eng_off.run_pending()
+    assert h.trace.kinds() == [] and len(eng_off.recorder) == 0
+    assert eng_off.slo_report() == {}
+    dbg = eng_off.debugz()                 # still answers, no events
+    assert dbg["recent_events"] == [] and dbg["queue_depth"] == 0
+
+    eng_on = InferenceEngine(CFG, mesh1, params, _config())
+    h_on = eng_on.submit(_prompt())
+    eng_on.run_pending()
+    np.testing.assert_array_equal(h.result(0), h_on.result(0))
+
+    # explicit recorder injection beats the registry default
+    eng_mix = InferenceEngine(CFG, mesh1, params, _config(),
+                              recorder=NULL_RECORDER)
+    hm = eng_mix.submit(_prompt())
+    eng_mix.run_pending()
+    assert hm.trace is NULL_TRACE and eng_mix.slo_report() == {}
+
+
+def test_recorder_ring_bounded_and_typed():
+    rec = FlightRecorder(capacity=4)
+    tr = rec.start_trace(7)
+    for _ in range(3):
+        tr.add("submit")
+        tr.add("queued")
+    assert len(rec) == 4                   # ring dropped the oldest
+    assert len(tr) == 6                    # the trace kept its own
+    assert [e.kind for e in rec.recent(2)] == ["submit", "queued"]
+    assert all(e.rid == 7 for e in rec.recent())
+    with pytest.raises(ValueError, match="unknown event kind"):
+        tr.add("exploded")
+    with pytest.raises(ValueError, match="unknown event kind"):
+        rec.record("exploded")

@@ -1,0 +1,154 @@
+"""Metrics-naming lint (ISSUE-6 satellite): convention drift guard.
+
+Scrapes a LIVE instrumented engine over HTTP (real traffic: completed,
+deadline-shed, and retried requests, so every serving series family
+has samples) and asserts the naming conventions documented in
+docs/observability.md hold for every exposed series:
+
+- names and label names are snake_case (no camelCase, dashes, or
+  leading digits);
+- counters expose with the `_total` suffix, and nothing BUT counters
+  uses it;
+- duration histograms end `_seconds` (their samples end
+  `_bucket`/`_sum`/`_count`); byte-valued series end `_bytes`;
+- gauges may be unitless (state enums, depths, flags) but must not
+  masquerade as counters or carry units they don't have.
+
+A future PR adding `serving_AdmissionWait` or a `latency` histogram
+without a unit fails HERE, not in some downstream Grafana board.
+Deliberately-unitless distributions are a named allowlist, so adding
+one is an explicit decision in this file's diff.
+"""
+import re
+import urllib.request
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.observability import MetricsServer
+from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import EngineConfig, InferenceEngine
+
+SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+SAMPLE = re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? \S+$')
+LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="')
+
+# unit suffixes a histogram base name may carry
+HIST_UNITS = ("_seconds", "_bytes")
+# distributions that are deliberately unitless (counts per event, not
+# measurements): extending this list is an explicit reviewed decision
+UNITLESS_HISTOGRAMS = {"serving_batch_size"}
+
+
+@pytest.fixture(scope="module")
+def scrape():
+    """One live scrape over real traffic covering every series family:
+    completions, a deadline shed, a retried fault, SLO observations."""
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    inj = ServingFaultInjector(fail_at=[1])
+    eng = InferenceEngine(
+        cfg, mesh, params,
+        EngineConfig(decode_chunk=2, max_new_tokens=6,
+                     backoff_base_s=0.0),
+        fault_injector=inj)
+    prompt = np.arange(8, dtype=np.int32)
+    eng.submit(prompt)
+    eng.submit(prompt, deadline_s=-0.001)          # sheds
+    eng.run_pending()
+
+    srv = MetricsServer(eng.registry, port=0, health=eng.health)
+    try:
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        srv.stop()
+    return text
+
+
+def _types(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            out[name] = kind
+    return out
+
+
+def test_scrape_covers_every_engine_family(scrape):
+    """The lint is only as strong as its corpus: assert the scrape
+    really contains counters, gauges, duration histograms, the
+    unitless-histogram exception, and the new SLO series."""
+    types = _types(scrape)
+    assert "serving_requests_completed_total" in types
+    assert "serving_requests_shed_total" in types
+    assert "serving_decode_step_seconds" in types
+    assert "serving_batch_size" in types
+    assert "serving_queue_depth" in types
+    assert "serving_param_bytes" in types
+    assert "serving_ttft_seconds" in types
+    assert "serving_queue_age_seconds" in types
+    assert "serving_slo_requests_total" in types
+    assert "serving_goodput_ratio" in types
+    assert set(types.values()) == {"counter", "gauge", "histogram"}
+
+
+def test_every_series_snake_case_with_unit_suffix(scrape):
+    types = _types(scrape)
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        if kind == "counter":
+            assert name.endswith("_total"), \
+                f"{name}: counters must expose with _total"
+        else:
+            assert not name.endswith("_total"), \
+                f"{name}: _total is reserved for counters"
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), \
+                (f"{name}: histograms need a unit suffix "
+                 f"{HIST_UNITS} (or an explicit allowlist entry)")
+        if kind == "gauge":
+            # unitless gauges are fine; histogram-sample suffixes are
+            # not (a gauge named *_bucket would collide with scrapers'
+            # histogram reassembly)
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+
+
+def test_every_sample_belongs_to_a_typed_family(scrape):
+    """Each non-comment exposition line must be its family's name or a
+    histogram sample (_bucket/_sum/_count) of a TYPE'd histogram —
+    nothing sneaks series past the TYPE headers; label names are
+    snake_case."""
+    types = _types(scrape)
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in scrape.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name = m.group(1)
+        assert name in types or name in hist_samples, \
+            f"{name}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+
+def test_lint_rejects_known_bad_names():
+    """The rules themselves catch the drift they exist for."""
+    for bad in ("servingTTFT", "serving-ttft", "2fast"):
+        assert not SNAKE.match(bad)
+    # a histogram without a unit fails the rule unless allowlisted
+    name = "serving_admission_wait"
+    assert not (name.endswith(HIST_UNITS)
+                or name in UNITLESS_HISTOGRAMS)

@@ -249,6 +249,42 @@ def test_metrics_server_endpoints():
         srv.stop()
 
 
+def test_half_closed_scrape_does_not_kill_exporter(capfd):
+    """ISSUE-6 satellite: a scraper that hangs up mid-response (curl
+    ctrl-C, half-closed socket) must be swallowed in `_send` — no
+    traceback spew from the daemon thread, and the exporter keeps
+    serving the next scrape."""
+    import socket
+    import struct
+    import time
+
+    r = MetricsRegistry()
+    r.counter("served", "").inc(4)
+    # bulk the body past the socket buffer so the server's write is
+    # still in flight when the client resets the connection
+    filler = r.counter("filler", "", labelnames=("i",))
+    for i in range(4000):
+        filler.labels(str(i)).inc()
+    srv = MetricsServer(r, port=0)
+    try:
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5)
+            s.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+            # SO_LINGER(on, 0): close sends RST immediately — the
+            # server-side write hits ECONNRESET/EPIPE mid-body
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+            s.close()
+        time.sleep(0.2)
+        code, text = _get(srv.url + "/metrics")    # exporter alive
+        assert code == 200
+        assert _parse_prom(text)["served_total"][0][1] == "4"
+    finally:
+        srv.stop()
+    assert "Traceback" not in capfd.readouterr().err
+
+
 def test_ui_server_mounts_metrics():
     from deeplearning4j_tpu.ui.server import UIServer
     r = MetricsRegistry()
