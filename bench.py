@@ -177,7 +177,8 @@ def flagship_lines(which: str) -> None:
     if which != "transformer":
         names += ["vgg16", "lstm", "word2vec", "engine_decode",
                   "engine_decode_metrics", "engine_continuous",
-                  "engine_slo", "ckpt_async", "quant_decode"]
+                  "engine_slo", "ckpt_async", "quant_decode",
+                  "kv_paged"]
     for n in names:
         elapsed = time.monotonic() - _T0
         reps = 1 if elapsed > 0.6 * budget else 2

@@ -290,6 +290,17 @@ def slot_cache_shape(cfg: TransformerConfig, num_slots: int,
             cfg.d_model)
 
 
+def page_pool_shape(cfg: TransformerConfig, num_pages: int,
+                    page_size: int) -> Tuple[int, int, int, int]:
+    """Canonical PAGED KV-pool geometry [L, num_pages, page_size, D]:
+    slot_cache_shape's per-slot [S] budget rows refactored into a
+    shared pool of page_size-token pages addressed through per-slot
+    block tables (parallel/serving.py paged section). Heads stay
+    flattened (D = H*Dh) for the same tiling reasons; physical page 0
+    is the reserved scratch page masked writes are routed to."""
+    return (cfg.n_layers, num_pages, page_size, cfg.d_model)
+
+
 def init_cache(cfg: TransformerConfig, batch: int,
                max_len: Optional[int] = None,
                cache_dtype=None) -> Tuple[Array, Array]:

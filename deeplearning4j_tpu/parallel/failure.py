@@ -174,6 +174,14 @@ class ServingFaultInjector(FaultInjector):
         its decode chunks share one step counter; this knob targets
         only the prefill calls, so tests can poison an admission
         without touching co-resident decoding slots).
+      - ``corrupt_page_at``: ``{step: request_id}`` — before the
+        compiled call holding that step index, the PAGED engine
+        scribbles garbage over the physical KV page the named
+        request's next token will be written to. Because the engine's
+        copy-on-write guard makes every write target privately owned,
+        the poison lands on the WRITER's page only: a reader sharing
+        the same prefix must keep producing its clean-run tokens —
+        the shared-page-isolation proof (tests/test_serving_paged.py).
 
     Continuous batching: the engine reports the request ids of ALL
     co-resident slots at every call, so ``poison_requests`` models a
@@ -186,7 +194,8 @@ class ServingFaultInjector(FaultInjector):
                  persistent: bool = False,
                  poison_requests: Iterable[int] = (),
                  delay_at: Optional[dict] = None,
-                 prefill_fail_at: Iterable[int] = ()):
+                 prefill_fail_at: Iterable[int] = (),
+                 corrupt_page_at: Optional[dict] = None):
         super().__init__(fail_at, persistent=persistent)
         self.poison_requests = set(int(r) for r in poison_requests)
         self.delay_at = {int(k): float(v)
@@ -194,6 +203,17 @@ class ServingFaultInjector(FaultInjector):
         self.delays_injected = 0
         self.prefill_fail_at = set(int(i) for i in prefill_fail_at)
         self.prefills_failed = 0
+        self.corrupt_page_at = {int(k): int(v)
+                                for k, v in (corrupt_page_at
+                                             or {}).items()}
+        self.pages_corrupted = 0
+
+    def check_corrupt_page(self, step: int) -> Optional[int]:
+        """One-shot: the request id whose next-write page the paged
+        engine should poison before the call at ``step``, else None.
+        The counter bumps when the engine confirms the poke landed
+        (the request might have left its slot by then)."""
+        return self.corrupt_page_at.pop(int(step), None)
 
     def on_decode_step(self, step: int,
                        request_ids: Iterable[int] = ()) -> None:

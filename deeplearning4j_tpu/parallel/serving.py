@@ -772,6 +772,493 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
     return jax.jit(sharded)
 
 
+# ---------------------------------------------------------------------------
+# paged slot KV cache: fixed page pool + per-slot block tables (ISSUE-7)
+# ---------------------------------------------------------------------------
+#
+# The contiguous pool above reserves every slot's full [S] token budget
+# up front. The paged layout instead keeps ONE pool of
+# `page_size`-token pages — [L, NP, page_size, D], heads over 'model'
+# — addressed through a per-slot block table ([Ns, max_pages] int32 of
+# physical page indices, HOST-owned and passed as runtime data, so the
+# bucket-keyed compiled-program caches stay warm: remapping a page is
+# an index edit, never a recompile). Physical page 0 is a reserved
+# SCRATCH page: masked/inactive writes are routed there so the scatter
+# shape stays static with no duplicate-index hazard on live pages
+# (scratch content is never attended — the position mask covers it).
+#
+# Sharding: the page pool is the one structure slots SHARE, so the
+# slot axis cannot shard over 'data' without cross-rank page
+# ownership; paged programs therefore require a data=1 (tensor-
+# parallel-only) serving mesh — the multi-host fleet work (ROADMAP)
+# is where data-axis scaling of paged serving lands. Heads/MLP shard
+# over 'model' exactly as the contiguous path; quantized-KV scale
+# planes [L, NP, page_size, tp] keep quant/kv.py's per-model-rank
+# layout.
+#
+# Token-exactness obligations (tests/test_serving_paged.py):
+# - decode mirrors _local_block_decode_slotted(_q) with the gathered
+#   page view standing in for the contiguous cache plane — same
+#   values at the same logical positions, same einsum/softmax
+#   numerics, so greedy decode is byte-identical to the contiguous
+#   engine.
+# - prefill is TWO-PIECE: the suffix (tokens not covered by a prefix-
+#   cache hit) attends itself in float exactly as
+#   _local_block_prefill's dot_product_attention does, PLUS the
+#   gathered cache view masked to the shared prefix. With no hit the
+#   cache piece is fully masked (exact zeros), reproducing the
+#   contiguous prefill bit for bit — including int8-KV mode, where
+#   contiguous prefill also attends float and quantizes on store.
+#   With a hit, float-KV mode reads back the identical f32 rows the
+#   shared prefill wrote, so outputs still match the contiguous run;
+#   int8-KV prefix hits re-read the prefix through its quantization
+#   (same error envelope as int8 decode — documented approximation).
+
+_PAGE_POOL_SPEC = P(None, None, None, "model")    # [L, NP, ps, D]
+_PAGE_SCALE_SPEC = P(None, None, None, "model")   # [L, NP, ps, tp]
+_PAGE_VEC_SPEC = P(None)                          # per-slot scalars
+_PAGE_BT_SPEC = P(None, None)                     # [Ns, max_pages]
+
+
+def _check_paged_mesh(cfg: TransformerConfig, mesh: Mesh, top_k: int,
+                      top_p: float, page_size: int, num_pages: int,
+                      max_pages: int):
+    """Paged-program validation: contiguous checks + data=1 (pages are
+    shared across slots; a sharded slot axis would need cross-rank
+    page ownership). Returns tp."""
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    if dp != 1:
+        raise ValueError(
+            f"paged KV serving requires a data=1 mesh (got data={dp}): "
+            "pages are shared across slots, which a 'data'-sharded "
+            "slot axis cannot address")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is the "
+                         f"reserved scratch page), got {num_pages}")
+    if max_pages * page_size < cfg.max_len:
+        raise ValueError(
+            f"block table of {max_pages} pages x {page_size} tokens "
+            f"cannot address max_len={cfg.max_len}")
+    return tp
+
+
+def init_paged_state(cfg: TransformerConfig, mesh: Mesh,
+                     num_slots: int, page_size: int, num_pages: int,
+                     kv_mode=None, cache_dtype=None):
+    """Allocate the persistent PAGED pool state on the serving mesh:
+    (kp, vp, pos, tok) with kp/vp [L, num_pages, page_size, D] (heads
+    over 'model'), or the 6-tuple (kp, vp, kscale, vscale, pos, tok)
+    when ``kv_mode`` selects the quantized pool (quant/kv.py). The
+    block table is NOT part of the device state: it is host-owned
+    runtime data (the engine passes it per call), so page remapping —
+    prefix sharing, copy-on-write, free-list recycling — never touches
+    a compiled program's geometry."""
+    from deeplearning4j_tpu.models.transformer import page_pool_shape
+    _, kv_mode = _resolve_quant(None, kv_mode)
+    if kv_mode is not None:
+        from deeplearning4j_tpu.quant.kv import init_paged_quant_state
+        return init_paged_quant_state(cfg, mesh, num_slots, page_size,
+                                      num_pages, kv_mode)
+    dt = (cache_dtype if cache_dtype is not None
+          else cfg.cache_jnp_dtype())
+    shape = page_pool_shape(cfg, num_pages, page_size)
+    kv_sh = NamedSharding(mesh, _PAGE_POOL_SPEC)
+    vec_sh = NamedSharding(mesh, _PAGE_VEC_SPEC)
+    kp = jax.device_put(jnp.zeros(shape, dt), kv_sh)
+    vp = jax.device_put(jnp.zeros(shape, dt), kv_sh)
+    pos = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    tok = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    return kp, vp, pos, tok
+
+
+def _gather_pages(plane, bt, ns: int, s_view: int):
+    """[NP, ps, D_loc] plane -> the block-table-ordered logical view
+    [Ns, s_view, D_loc]: unallocated table entries read the scratch
+    page; the caller's position mask keeps them out of attention."""
+    g = plane[bt]                       # [Ns, mp, ps, D_loc]
+    return g.reshape(ns, s_view, g.shape[-1])
+
+
+def _local_block_decode_paged(h, p, kp, vp, bt, layer: int, pos, act,
+                              cfg: TransformerConfig, tp: int, dp: int,
+                              page_size: int):
+    """One TP block, one new position per slot, PAGED storage: the K/V
+    row lands at (bt[slot, pos//ps], pos%ps) — inactive slots write the
+    scratch page — and attention runs over the gathered logical view.
+    Deliberately mirrors _local_block_decode_slotted's math (the
+    gathered view holds the same values at the same logical positions),
+    so paged greedy decode is byte-identical to the contiguous pool."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    ns = h.shape[0]
+    mp = bt.shape[1]
+    s_view = mp * page_size
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    q = jnp.matmul(x[:, 0], p["Wq"].astype(x.dtype)) \
+        .reshape(ns, h_loc, cfg.d_head)
+    k = jnp.matmul(x[:, 0], p["Wk"].astype(x.dtype))      # [Ns, D_loc]
+    v = jnp.matmul(x[:, 0], p["Wv"].astype(x.dtype))
+    rows = jnp.arange(ns)
+    wp = jnp.clip(pos, 0, s_view - 1)
+    lp = jnp.clip(wp // page_size, 0, mp - 1)
+    pg = jnp.where(act, bt[rows, lp], 0)     # inactive -> scratch
+    off = wp % page_size
+    kp = kp.at[layer, pg, off].set(k.astype(kp.dtype))
+    vp = vp.at[layer, pg, off].set(v.astype(vp.dtype))
+    kh = _gather_pages(kp[layer], bt, ns, s_view) \
+        .reshape(ns, s_view, h_loc, cfg.d_head)
+    vh = _gather_pages(vp[layer], bt, ns, s_view) \
+        .reshape(ns, s_view, h_loc, cfg.d_head)
+    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
+        * (1.0 / (cfg.d_head ** 0.5))
+    sc = jnp.where(jnp.arange(s_view)[None, None, :]
+                   <= wp[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhs,bshd->bhd", pr.astype(q.dtype), vh)
+    h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
+                               p["Wo"].astype(h.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    h = _local_mlp(h, x, p, cfg, dp, g_model)
+    return h, kp, vp
+
+
+def _local_block_decode_paged_q(h, p, kp, vp, ksc, vsc, bt, layer: int,
+                                pos, act, cfg: TransformerConfig,
+                                tp: int, dp: int, page_size: int,
+                                kv_mode: str):
+    """Quantized-KV paged decode block: quantize-on-write into the
+    int8/fp8 page pool + parallel scale planes, scales folded into
+    scores/probabilities exactly as _local_block_decode_slotted_q."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    from deeplearning4j_tpu.quant.kv import quantize_rows
+    g_model = _g_sync("model")
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    ns = h.shape[0]
+    mp = bt.shape[1]
+    s_view = mp * page_size
+    x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+    q = jnp.matmul(x[:, 0], p["Wq"].astype(x.dtype)) \
+        .reshape(ns, h_loc, cfg.d_head)
+    k = jnp.matmul(x[:, 0], p["Wk"].astype(x.dtype))      # [Ns, D_loc]
+    v = jnp.matmul(x[:, 0], p["Wv"].astype(x.dtype))
+    rows = jnp.arange(ns)
+    wp = jnp.clip(pos, 0, s_view - 1)
+    lp = jnp.clip(wp // page_size, 0, mp - 1)
+    pg = jnp.where(act, bt[rows, lp], 0)     # inactive -> scratch
+    off = wp % page_size
+    kq, ksr = quantize_rows(k, kv_mode)
+    vq, vsr = quantize_rows(v, kv_mode)
+    kp = kp.at[layer, pg, off].set(kq)
+    vp = vp.at[layer, pg, off].set(vq)
+    ksc = ksc.at[layer, pg, off, 0].set(ksr)
+    vsc = vsc.at[layer, pg, off, 0].set(vsr)
+    kh = _gather_pages(kp[layer].astype(jnp.float32), bt, ns, s_view) \
+        .reshape(ns, s_view, h_loc, cfg.d_head)
+    vh = _gather_pages(vp[layer].astype(jnp.float32), bt, ns, s_view) \
+        .reshape(ns, s_view, h_loc, cfg.d_head)
+    ksg = _gather_pages(ksc[layer], bt, ns, s_view)[..., 0]
+    vsg = _gather_pages(vsc[layer], bt, ns, s_view)[..., 0]
+    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
+        * ksg[:, None, :] * (1.0 / (cfg.d_head ** 0.5))
+    sc = jnp.where(jnp.arange(s_view)[None, None, :]
+                   <= wp[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhs,bshd->bhd", pr * vsg[:, None, :], vh)
+    a = a.astype(q.dtype)
+    h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
+                               p["Wo"].astype(h.dtype)))
+    x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+    h = _local_mlp(h, x, p, cfg, dp, g_model)
+    return h, kp, vp, ksc, vsc
+
+
+def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
+                       bucket_len: int, num_slots: int, page_size: int,
+                       max_pages: int, num_pages: int,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, quantized=None,
+                       kv_mode=None):
+    """Compiled PAGED admission prefill: (params, kp, vp, pos, tok,
+    bt [Ns, max_pages], suffix [Ns, Tb], slen [Ns], start [Ns], key)
+    -> (kp, vp, pos, tok, first [Ns]).
+
+    ``suffix`` holds each admitted slot's NOT-YET-CACHED token tail
+    (the full prefix when there is no prefix-cache hit), right-padded
+    to the suffix bucket Tb; ``start[i]`` is the number of prefix
+    tokens whose K/V the host already mapped into the slot's block
+    table (a radix-cache hit — prefill RESUMES from that boundary, so
+    shared system prompts share the prefill compute, not just the
+    bytes). Suffix K/V rows are written to the slot's pages at
+    absolute positions start+t; attention per suffix query t is the
+    cached prefix (gathered pages, masked to s < start) plus causal
+    float self-attention within the suffix — exactly
+    _local_block_prefill's numerics when start == 0 (the cache piece
+    contributes exact zeros), which is what keeps the paged engine
+    token-identical to the contiguous one, int8-KV included. Slots
+    with slen == 0 pass through untouched.
+
+    ``kv_mode`` switches to the quantized page pool — the state grows
+    scale planes ((params, kp, vp, ksc, vsc, pos, tok, bt, suffix,
+    slen, start, key) -> (..., first)) and suffix rows quantize on
+    write while the suffix still attends itself in float (mirroring
+    the contiguous quant prefill, which also stores quantized but
+    attends the float activations)."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    tp = _check_paged_mesh(cfg, mesh, top_k, top_p, page_size,
+                           num_pages, max_pages)
+    dp = 1
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
+    if not 0 < bucket_len <= cfg.max_len:
+        raise ValueError(f"bucket_len {bucket_len} out of "
+                         f"(0, {cfg.max_len}]")
+    specs = _serving_specs(cfg, quantized)
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    s_view = max_pages * page_size
+    scale = cfg.d_head ** -0.5
+
+    def body(params, kp, vp, ksc, vsc, bt, suffix, slen, start, key):
+        dt = cfg.activation_dtype()
+        acc = jnp.promote_types(dt, jnp.float32)
+        ns, tb = suffix.shape
+        admit = slen > 0
+        absp = start[:, None] + jnp.arange(tb)[None, :]   # [Ns, Tb]
+        valid = jnp.arange(tb)[None, :] < slen[:, None]
+        pe = params["pos"].astype(dt)[
+            jnp.clip(absp, 0, cfg.max_len - 1)]
+        h = params["embed"].astype(dt)[suffix] + pe
+        # write targets: pad/unadmitted rows -> scratch page 0
+        lp = jnp.clip(absp // page_size, 0, max_pages - 1)
+        pg = jnp.where(valid, jnp.take_along_axis(bt, lp, axis=1), 0)
+        off = absp % page_size
+        mvalid = valid if cfg.n_experts > 0 else None
+        causal = (jnp.arange(tb)[None, :]
+                  <= jnp.arange(tb)[:, None])             # [Tb, Tb]
+        pmask = (jnp.arange(s_view)[None, None, None, :]
+                 < start[:, None, None, None])            # [Ns,1,1,S]
+        for layer in range(cfg.n_layers):
+            p = {kk: vv[layer] for kk, vv in params["blocks"].items()}
+            x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+            q = jnp.matmul(x, p["Wq"].astype(x.dtype)) \
+                .reshape(ns, tb, h_loc, cfg.d_head)
+            k = jnp.matmul(x, p["Wk"].astype(x.dtype))    # [Ns,Tb,Dl]
+            v = jnp.matmul(x, p["Wv"].astype(x.dtype))
+            # store the suffix rows (quantize-on-write in kv_mode)
+            if kv_mode is None:
+                kp = kp.at[layer, pg, off].set(k.astype(kp.dtype))
+                vp = vp.at[layer, pg, off].set(v.astype(vp.dtype))
+            else:
+                from deeplearning4j_tpu.quant.kv import quantize_rows
+                kq, ksr = quantize_rows(k, kv_mode)
+                vq, vsr = quantize_rows(v, kv_mode)
+                kp = kp.at[layer, pg, off].set(kq)
+                vp = vp.at[layer, pg, off].set(vq)
+                ksc = ksc.at[layer, pg, off, 0].set(ksr)
+                vsc = vsc.at[layer, pg, off, 0].set(vsr)
+            kv4 = k.reshape(ns, tb, h_loc, cfg.d_head)
+            vv4 = v.reshape(ns, tb, h_loc, cfg.d_head)
+            # piece 2: float causal self-attention within the suffix —
+            # bitwise dot_product_attention(q, k, v, causal=True)
+            sc2 = jnp.einsum("bthd,bshd->bhts", q, kv4,
+                             preferred_element_type=acc) * scale
+            sc2 = jnp.where(causal[None, None], sc2, NEG_INF)
+            # piece 1: the cached prefix, gathered from the pages and
+            # masked to s < start (fully masked when there is no hit)
+            if kv_mode is None:
+                kh = _gather_pages(kp[layer], bt, ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                vh = _gather_pages(vp[layer], bt, ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                sc1 = jnp.einsum("bthd,bshd->bhts", q, kh,
+                                 preferred_element_type=acc) * scale
+            else:
+                kh = _gather_pages(kp[layer].astype(jnp.float32), bt,
+                                   ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                vh = _gather_pages(vp[layer].astype(jnp.float32), bt,
+                                   ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                ksg = _gather_pages(ksc[layer], bt, ns, s_view)[..., 0]
+                vsg = _gather_pages(vsc[layer], bt, ns, s_view)[..., 0]
+                sc1 = jnp.einsum("bthd,bshd->bhts",
+                                 q.astype(jnp.float32), kh) \
+                    * ksg[:, None, None, :] * scale
+            sc1 = jnp.where(pmask, sc1, NEG_INF)
+            # one softmax over [prefix-view | suffix] keys (logical
+            # order preserved: prefix positions first), then the two
+            # value pieces recombine — exact zeros where masked
+            w = jax.nn.softmax(
+                jnp.concatenate([sc1.astype(acc), sc2], axis=-1),
+                axis=-1)
+            w1, w2 = w[..., :s_view], w[..., s_view:]
+            if kv_mode is None:
+                a1 = jnp.einsum("bhts,bshd->bthd", w1.astype(vh.dtype),
+                                vh)
+            else:
+                a1 = jnp.einsum("bhts,bshd->bthd",
+                                w1 * vsg[:, None, None, :], vh) \
+                    .astype(v.dtype)
+            a2 = jnp.einsum("bhts,bshd->bthd", w2.astype(v.dtype), vv4)
+            a = (a1 + a2).reshape(ns, tb, d_loc)
+            h = h + _g_sync("model")(
+                jnp.matmul(a, p["Wo"].astype(a.dtype)))
+            x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+            h = _local_mlp(h, x, p, cfg, dp, _g_sync("model"),
+                           valid=mvalid)
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        last = h[jnp.arange(ns), jnp.clip(slen - 1, 0, tb - 1)]
+        logits = jnp.matmul(last, params["Wout"].astype(last.dtype))
+        plen = start + slen
+        first = _sample_slots(logits, plen, key, dp, temperature,
+                              top_k, top_p)
+        return admit, plen, first, kp, vp, ksc, vsc
+
+    def finish(admit, plen, first, pos, tok):
+        pos = jnp.where(admit, plen.astype(pos.dtype), pos)
+        tok = jnp.where(admit, first, tok)
+        return pos, tok, jnp.where(admit, first,
+                                   jnp.asarray(-1, jnp.int32))
+
+    if kv_mode is None:
+        def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
+                key):
+            admit, plen, first, kp, vp, _, _ = body(
+                params, kp, vp, None, None, bt, suffix, slen, start,
+                key)
+            pos, tok, first = finish(admit, plen, first, pos, tok)
+            return kp, vp, pos, tok, first
+
+        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+    else:
+        def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix, slen,
+                start, key):
+            admit, plen, first, kp, vp, ksc, vsc = body(
+                params, kp, vp, ksc, vsc, bt, suffix, slen, start, key)
+            pos, tok, first = finish(admit, plen, first, pos, tok)
+            return kp, vp, ksc, vsc, pos, tok, first
+
+        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                     _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
+    return jax.jit(sharded)
+
+
+def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,
+                      num_slots: int, page_size: int, max_pages: int,
+                      num_pages: int, temperature: float = 0.0,
+                      top_k: int = 0, top_p: float = 1.0,
+                      quantized=None, kv_mode=None):
+    """Compiled PAGED decode chunk: (params, kp, vp, pos, tok,
+    bt [Ns, max_pages], active [Ns], rem [Ns], key) -> (kp, vp, pos,
+    tok, toks [Ns, chunk]). Contract identical to
+    make_continuous_decode — active/rem/pos AND the block table are
+    runtime data, one compiled program per (chunk, num_slots,
+    page geometry) — with K/V rows landing in block-table pages
+    instead of contiguous slot rows. ``kv_mode`` adds the scale
+    planes to the state exactly as the contiguous quant path."""
+    tp = _check_paged_mesh(cfg, mesh, top_k, top_p, page_size,
+                           num_pages, max_pages)
+    dp = 1
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    specs = _serving_specs(cfg, quantized)
+
+    def sample_and_advance(params, h, act, pos, tok, rem, key):
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
+        nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
+                            top_k, top_p)
+        tok = jnp.where(act, nxt, tok)
+        emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
+        pos = jnp.where(act, pos + 1, pos)
+        rem = jnp.where(act, rem - 1, rem)
+        return pos, tok, rem, emit
+
+    def embed_step(params, pos, tok):
+        dt = cfg.activation_dtype()
+        emb = params["embed"].astype(dt)[tok]
+        pv = params["pos"].astype(dt)[
+            jnp.clip(pos, 0, cfg.max_len - 1)]
+        return (emb + pv)[:, None, :]
+
+    if kv_mode is None:
+        def run(params, kp, vp, pos, tok, bt, active, rem, key):
+            def step(carry, _):
+                kp, vp, pos, tok, rem = carry
+                act = active & (rem > 0)
+                h = embed_step(params, pos, tok)
+                for layer in range(cfg.n_layers):
+                    p_l = {kk: vv[layer]
+                           for kk, vv in params["blocks"].items()}
+                    h, kp, vp = _local_block_decode_paged(
+                        h, p_l, kp, vp, bt, layer, pos, act, cfg, tp,
+                        dp, page_size)
+                pos, tok, rem, emit = sample_and_advance(
+                    params, h, act, pos, tok, rem, key)
+                return (kp, vp, pos, tok, rem), emit
+
+            (kp, vp, pos, tok, _), toks = lax.scan(
+                step, (kp, vp, pos, tok, rem), None, length=chunk)
+            return kp, vp, pos, tok, jnp.swapaxes(toks, 0, 1)
+
+        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
+                     _PAGE_VEC_SPEC, P(None, None))
+    else:
+        def run(params, kp, vp, ksc, vsc, pos, tok, bt, active, rem,
+                key):
+            def step(carry, _):
+                kp, vp, ksc, vsc, pos, tok, rem = carry
+                act = active & (rem > 0)
+                h = embed_step(params, pos, tok)
+                for layer in range(cfg.n_layers):
+                    p_l = {kk: vv[layer]
+                           for kk, vv in params["blocks"].items()}
+                    h, kp, vp, ksc, vsc = _local_block_decode_paged_q(
+                        h, p_l, kp, vp, ksc, vsc, bt, layer, pos, act,
+                        cfg, tp, dp, page_size, kv_mode)
+                pos, tok, rem, emit = sample_and_advance(
+                    params, h, act, pos, tok, rem, key)
+                return (kp, vp, ksc, vsc, pos, tok, rem), emit
+
+            (kp, vp, ksc, vsc, pos, tok, _), toks = lax.scan(
+                step, (kp, vp, ksc, vsc, pos, tok, rem), None,
+                length=chunk)
+            return (kp, vp, ksc, vsc, pos, tok,
+                    jnp.swapaxes(toks, 0, 1))
+
+        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                     _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None))
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
+    return jax.jit(sharded)
+
+
 def serving_param_specs(cfg: TransformerConfig):
     """Megatron layout with serving-specific MoE placement: the
     training specs shard EXPERTS over 'data' (expert parallelism for

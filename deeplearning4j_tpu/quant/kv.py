@@ -105,6 +105,65 @@ def init_quant_slot_state(cfg, mesh: Mesh, num_slots: int,
     return ck, cv, ksc, vsc, pos, tok
 
 
+def init_paged_quant_state(cfg, mesh: Mesh, num_slots: int,
+                           page_size: int, num_pages: int,
+                           kv_mode: str = "int8"):
+    """Allocate the quantized PAGED pool state: (kp, vp) int8/fp8
+    [L, num_pages, page_size, D] + (kscale, vscale) float32
+    [L, num_pages, page_size, tp] + per-slot (pos, tok) — the paged
+    analog of `init_quant_slot_state`, consumed by the ``kv_mode=``
+    variants of `parallel.serving.make_paged_{prefill,decode}`. The
+    per-row scale layout is unchanged — one scale per written K/V row
+    per model-rank — it just lives at (page, offset) instead of
+    (slot, position), which is exactly why the int8 pool composes with
+    paging: a page's rows carry their scales with them through any
+    block-table remap, share, or copy-on-write."""
+    from deeplearning4j_tpu.models.transformer import page_pool_shape
+    kv_mode = resolve_mode(kv_mode)
+    if kv_mode is None:
+        raise ValueError("init_paged_quant_state needs kv_mode "
+                         "('int8'/'fp8')")
+    tp = mesh.shape["model"]
+    shape = page_pool_shape(cfg, num_pages, page_size)
+    sshape = shape[:3] + (tp,)
+    qdt = kv_cache_dtype(kv_mode)
+    kv_sh = NamedSharding(mesh, _KV_SPEC)
+    sc_sh = NamedSharding(mesh, _SCALE_SPEC)
+    vec_sh = NamedSharding(mesh, P(None))
+    kp = jax.device_put(jnp.zeros(shape, qdt), kv_sh)
+    vp = jax.device_put(jnp.zeros(shape, qdt), kv_sh)
+    ksc = jax.device_put(jnp.ones(sshape, jnp.float32), sc_sh)
+    vsc = jax.device_put(jnp.ones(sshape, jnp.float32), sc_sh)
+    pos = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    tok = jax.device_put(jnp.zeros((num_slots,), jnp.int32), vec_sh)
+    return kp, vp, ksc, vsc, pos, tok
+
+
+def paged_pool_bytes(cfg, num_slots: int, page_size: int,
+                     num_pages: int, max_pages: int,
+                     kv_mode: Optional[str] = None, tp: int = 1,
+                     cache_dtype=None) -> int:
+    """Analytic at-rest bytes of one PAGED pool (page caches + scales
+    + block tables + per-slot vectors) — the paged branch of the
+    `serving_kv_pool_bytes` gauge. The headline capacity lever: the
+    pool is sized by ``num_pages`` (actual working set + shared
+    prefixes), not ``num_slots * max_len`` (every slot's worst
+    case)."""
+    L = cfg.n_layers
+    d = cfg.d_model
+    if kv_mode is not None:
+        item = jnp.dtype(kv_cache_dtype(kv_mode)).itemsize
+        scales = 2 * L * num_pages * page_size * tp * 4
+    else:
+        dt = cache_dtype if cache_dtype is not None \
+            else cfg.cache_jnp_dtype()
+        item = jnp.dtype(dt).itemsize
+        scales = 0
+    pool = 2 * L * num_pages * page_size * d * item
+    bt = num_slots * max_pages * 4
+    return pool + scales + bt + 2 * num_slots * 4
+
+
 def slot_pool_bytes(cfg, num_slots: int,
                     kv_mode: Optional[str] = None, tp: int = 1,
                     cache_dtype=None) -> int:

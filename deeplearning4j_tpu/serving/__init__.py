@@ -21,6 +21,13 @@ the layer between callers and the compiled decode step:
   the slot pool as int8 rows + per-row scales — ~4x fewer at-rest
   bytes on both axes (`deeplearning4j_tpu/quant/`,
   docs/quantization.md).
+- Paged KV + radix prefix sharing (round 12): `EngineConfig(
+  paged=True, page_size=, kv_pages=, prefix_cache=)` pages the slot
+  pool behind host-owned block tables and maps cached token prefixes
+  (refcounted, copy-on-write) into new admissions — co-tenant traffic
+  sharing a system prompt shares the KV bytes AND the prefill
+  compute, token-exact vs the contiguous pool
+  (`serving/paging.py`, docs/serving.md "Paged KV & prefix sharing").
 - Flight recorder + SLO layer (round 11): `RequestHandle.trace` is a
   typed lifecycle event record, `engine.slo` derives TTFT/TPOT/
   e2e/queue-age/goodput, and `debugz()`/`slo_report()`/`timeline()`

@@ -104,6 +104,29 @@ live registry and mirrors it off: `registry=NULL_REGISTRY` (or
 no-op — the `engine_slo` benchmark's bare arm (overhead bound ≤ 2%,
 BASELINE.md).
 
+Paged KV + radix prefix sharing (round 12, ISSUE-7,
+`EngineConfig(paged=True, page_size=, kv_pages=, prefix_cache=)`):
+continuous-mode slot storage becomes a fixed pool of page_size-token
+pages behind host-owned per-slot block tables
+(parallel/serving.py paged section; data=1 meshes). A radix/trie
+prefix cache (serving/paging.py) maps the longest cached token-prefix
+chain into each admission's block table — refcounted, copy-on-write
+before any divergent write — so co-tenant traffic sharing a system
+prompt shares the KV bytes AND the prefill compute (prefill resumes
+from the matched boundary; `admitted` trace events carry
+`prefix_hit_tokens`). Freed slots return pages to the free list;
+unreferenced cache entries evict LRU; exhausted pools BLOCK admission
+instead of corrupting residents; quarantine/preemption release only
+the quarantined slot's references, never a sharer's pages; hot reload
+flushes the cache (cached KV encodes the old weights). Both float and
+int8 KV pools page identically (quant/kv.py per-row scales travel
+with their page). The contiguous path stays the default and the
+regression baseline. Observability: `serving_kv_pages_{free,used}`
+gauges, `serving_prefix_cache_{hits,misses,evictions}_total` +
+`serving_prefix_shared_tokens_total` counters, block tables +
+prefix-cache stats in `debugz()`. See docs/serving.md "Paged KV &
+prefix sharing".
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -128,11 +151,17 @@ from deeplearning4j_tpu.observability.events import (FlightRecorder,
 from deeplearning4j_tpu.observability.metrics import (
     DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
 from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
-from deeplearning4j_tpu.parallel.serving import (init_slot_state,
+from deeplearning4j_tpu.parallel.serving import (init_paged_state,
+                                                 init_slot_state,
                                                  make_continuous_decode,
                                                  make_continuous_prefill,
+                                                 make_paged_decode,
+                                                 make_paged_prefill,
                                                  make_parallel_generate,
                                                  shard_serving_params)
+from deeplearning4j_tpu.serving.paging import (PageAllocator,
+                                               RadixPrefixCache,
+                                               pages_for)
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -208,6 +237,21 @@ class EngineConfig:
     # through quant.core.resolve_mode, so "fp8" lands on int8 off-TPU.
     quantize: Optional[str] = None
     kv_quantize: Optional[str] = None
+    # paged slot KV cache + radix prefix sharing (ISSUE-7, continuous
+    # mode only, data=1 mesh). ``paged`` switches slot storage from
+    # per-slot contiguous [S] rows to a fixed pool of ``page_size``-
+    # token pages behind per-slot block tables; ``kv_pages`` sizes the
+    # pool (0 = full provisioning: num_slots * ceil(max_len/page_size)
+    # + 1 scratch — set it LOWER to realize the capacity win, the
+    # free list + prefix-cache LRU eviction absorb the pressure and
+    # admission blocks, never corrupts, when truly out).
+    # ``prefix_cache`` adds the radix prefix cache: admissions sharing
+    # a cached token prefix map the shared pages into their block
+    # table and prefill resumes from the matched boundary.
+    paged: bool = False
+    page_size: int = 16
+    kv_pages: int = 0                # 0 = full provisioning
+    prefix_cache: bool = True        # only meaningful with paged=True
 
 
 class RequestHandle:
@@ -321,6 +365,77 @@ def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
                                   kv_mode=kv_mode)
 
 
+@lru_cache(maxsize=64)
+def _compiled_paged_prefill(cfg_fields: tuple, mesh, bucket_len: int,
+                            num_slots: int, page_size: int,
+                            max_pages: int, num_pages: int,
+                            temperature: float, top_k: int,
+                            top_p: float, quantized=None,
+                            kv_mode=None):
+    """Compiled-program cache for the PAGED admission prefill, keyed
+    on the SUFFIX bucket plus the (static) page-pool geometry: block
+    tables, hit boundaries, and admission patterns are runtime data,
+    so steady-state traffic — hits and misses alike — stays inside a
+    closed set of entries (the paged no-recompile guard counts this
+    cache)."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_prefill(cfg, mesh, bucket_len, num_slots,
+                              page_size, max_pages, num_pages,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, quantized=quantized,
+                              kv_mode=kv_mode)
+
+
+@lru_cache(maxsize=64)
+def _compiled_paged_decode(cfg_fields: tuple, mesh, chunk: int,
+                           num_slots: int, page_size: int,
+                           max_pages: int, num_pages: int,
+                           temperature: float, top_k: int,
+                           top_p: float, quantized=None, kv_mode=None):
+    """ONE paged decode program per engine geometry — occupancy,
+    budgets, and the whole block table are runtime data."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_decode(cfg, mesh, chunk, num_slots, page_size,
+                             max_pages, num_pages,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, quantized=quantized,
+                             kv_mode=kv_mode)
+
+
+@lru_cache(maxsize=8)
+def _compiled_page_copy(n_pool_arrays: int):
+    """Copy one physical page (all layers, values + scales) — the
+    copy-on-write materializer. One tiny fixed-shape program per pool
+    arity (2 float / 4 quantized); page indices are runtime data."""
+    import jax
+
+    def copy(src, dst, *pool):
+        return tuple(a.at[:, dst].set(a[:, src]) for a in pool)
+
+    return jax.jit(copy)
+
+
+@lru_cache(maxsize=8)
+def _compiled_page_poison(n_pool_arrays: int):
+    """Scribble a deterministic out-of-distribution pattern over one
+    physical page's K/V values (scales untouched) — backs the
+    ServingFaultInjector.corrupt_page_at knob."""
+    import jax
+    import jax.numpy as jnp
+
+    def poison(pg, *pool):
+        out = []
+        for i, a in enumerate(pool):
+            if i < 2:      # kp, vp — scale planes keep their values
+                bad = jnp.asarray(97 if a.dtype == jnp.int8 else 1e3,
+                                  a.dtype)
+                a = a.at[:, pg].set(bad)
+            out.append(a)
+        return tuple(out)
+
+    return jax.jit(poison)
+
+
 class InferenceEngine:
     """Bounded-queue, deadline-aware, fault-tolerant front end for the
     sharded generate path. See module docstring for semantics; see
@@ -378,6 +493,37 @@ class InferenceEngine:
             [None] * self._num_slots
         self._slot_state = None
         self._key = None
+        # paged slot KV + radix prefix sharing (ISSUE-7): page indices
+        # are host-owned — the allocator/radix cache here, the block
+        # table as a numpy array passed to every compiled call — so
+        # sharing, COW, and recycling never change compiled geometry
+        self._paged = bool(self.config.paged)
+        if self._paged:
+            if not self._continuous:
+                raise ValueError(
+                    "paged KV requires mode='continuous' (batch mode "
+                    "has no persistent slot state to page)")
+            if mesh.shape["data"] != 1:
+                raise ValueError(
+                    "paged KV requires a data=1 serving mesh: pages "
+                    "are shared across slots (see parallel/serving.py)")
+            self._page_size = int(self.config.page_size)
+            if self._page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            self._max_pages = pages_for(cfg.max_len, self._page_size)
+            self._num_pages = (int(self.config.kv_pages)
+                               or self._num_slots * self._max_pages + 1)
+            self._allocator = PageAllocator(self._num_pages,
+                                            self._page_size)
+            self._prefix_cache = (
+                RadixPrefixCache(self._page_size, self._allocator)
+                if self.config.prefix_cache else None)
+            self._bt = np.zeros((self._num_slots, self._max_pages),
+                                np.int32)
+            self._slot_pages: List[List[int]] = \
+                [[] for _ in range(self._num_slots)]
+        else:
+            self._prefix_cache = None
         self._params = shard_serving_params(params, cfg, mesh)
         self._injector = fault_injector
         self._clock = clock
@@ -495,6 +641,30 @@ class InferenceEngine:
             "serving_prefill_seconds",
             "Wall time of one compiled admission-prefill call",
             buckets=DECODE_LATENCY_BUCKETS)
+        # paged KV + prefix sharing (ISSUE-7): registered only on
+        # paged engines, so unpaged scrapes are byte-unchanged
+        if self._paged:
+            r.gauge("serving_kv_pages_free",
+                    "Allocatable pages on the KV free list"
+                    ).set_function(
+                lambda: float(self._allocator.pages_free))
+            r.gauge("serving_kv_pages_used",
+                    "KV pages referenced by slots or the prefix cache"
+                    ).set_function(
+                lambda: float(self._allocator.pages_used))
+            self._m_prefix_hits = r.counter(
+                "serving_prefix_cache_hits",
+                "Admissions whose prefix matched a cached page chain")
+            self._m_prefix_misses = r.counter(
+                "serving_prefix_cache_misses",
+                "Admissions with no cached prefix to share")
+            self._m_prefix_evictions = r.counter(
+                "serving_prefix_cache_evictions",
+                "Cached prefix pages reclaimed by LRU eviction")
+            self._m_prefix_shared_tokens = r.counter(
+                "serving_prefix_shared_tokens",
+                "Prompt tokens whose prefill compute AND KV bytes "
+                "were served from the radix prefix cache")
 
     # ------------------------------------------------------------------
     # HBM accounting (quant subsystem; backs the serving_param_bytes /
@@ -507,11 +677,22 @@ class InferenceEngine:
         return param_bytes(self._params)
 
     def kv_pool_bytes(self) -> int:
-        """At-rest bytes of the slot-pool KV state: measured when the
+        """At-rest bytes of the slot-pool KV state (paged engines:
+        page pool + scale planes + block tables): measured when the
         lazily-allocated pool exists, analytic otherwise (so operators
         can size pools before traffic arrives)."""
         if self._slot_state is not None:
-            return int(sum(int(a.nbytes) for a in self._slot_state))
+            meas = int(sum(int(a.nbytes) for a in self._slot_state))
+            if self._paged:
+                meas += int(self._bt.nbytes)
+            return meas
+        if self._paged:
+            from deeplearning4j_tpu.quant.kv import paged_pool_bytes
+            return paged_pool_bytes(self.cfg, self._num_slots,
+                                    self._page_size, self._num_pages,
+                                    self._max_pages,
+                                    kv_mode=self._kv_mode,
+                                    tp=self.mesh.shape["model"])
         from deeplearning4j_tpu.quant.kv import slot_pool_bytes
         return slot_pool_bytes(self.cfg, self._num_slots,
                                kv_mode=self._kv_mode,
@@ -578,6 +759,16 @@ class InferenceEngine:
                 raise ValueError(
                     f"prompt {prompt.shape[0]} + {eff} new tokens "
                     f"exceeds max_len={self.cfg.max_len}")
+            if self._paged:
+                need = pages_for(prompt.shape[0] + eff,
+                                 self._page_size)
+                if need > self._allocator.usable_pages:
+                    raise ValueError(
+                        f"request needs {need} KV pages but the pool "
+                        f"has {self._allocator.usable_pages} "
+                        f"(kv_pages={self._num_pages}, page_size="
+                        f"{self._page_size}) — it could never be "
+                        "admitted")
             handle = RequestHandle(
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
@@ -845,7 +1036,12 @@ class InferenceEngine:
     def _fill_slots(self) -> List[tuple]:
         """Admission at a chunk boundary: seat queued requests into
         free slots (deadline-expired ones are shed or completed
-        partial instead of seated). Returns [(slot, handle)]."""
+        partial instead of seated). Paged engines additionally map the
+        longest cached token prefix into the slot's block table and
+        allocate private pages for the rest — when the free list (plus
+        LRU eviction) cannot cover it, admission BLOCKS (the request
+        returns to the queue head) rather than corrupting resident
+        pages. Returns [(slot, handle)]."""
         admitted = []
         with self._lock:
             free = [i for i in range(self._num_slots)
@@ -855,17 +1051,206 @@ class InferenceEngine:
                 self._shed_expired([r])
                 if r.done():
                     continue
-                i = free.pop(0)
+                i = free[0]
+                hit = 0
+                if self._paged:
+                    seated = self._seat_paged(i, r)
+                    if seated is None:
+                        # pool exhausted: block (requeue at the head)
+                        # — unless _seat_paged already shed a request
+                        # that could never fit
+                        if not r.done():
+                            self._queue.appendleft(r)
+                        break
+                    hit = seated
+                free.pop(0)
                 self._slots[i] = r
                 r.status = RequestStatus.RUNNING
                 r._in_flight = True
                 self._m_in_flight.inc()
                 r.trace.add("admitted", slot=i, bucket=int(
                     self._bucket_len(r.prompt.shape[0]
-                                     + r.generated.shape[0])))
+                                     + r.generated.shape[0] - hit)),
+                    prefix_hit_tokens=int(hit))
                 self.slo.admitted(r.trace)
                 admitted.append((i, r))
         return admitted
+
+    # ------------------------------------------------------------------
+    # paged KV: host page bookkeeping (all under self._lock)
+    # ------------------------------------------------------------------
+    def _alloc_page(self) -> Optional[int]:
+        """One private page, LRU-evicting unreferenced prefix-cache
+        entries when the free list runs dry."""
+        p = self._allocator.alloc()
+        if p is None and self._prefix_cache is not None:
+            freed = self._prefix_cache.evict(1)
+            if freed:
+                self._m_prefix_evictions.inc(freed)
+                p = self._allocator.alloc()
+        return p
+
+    def _seat_paged(self, i: int, r: RequestHandle) -> Optional[int]:
+        """Build slot ``i``'s block table for request ``r``: map the
+        longest cached prefix chain (refcount bumped per sharer),
+        allocate private pages for the suffix + full decode budget,
+        and copy-on-write the boundary page when a full-prefix hit
+        forces re-computing the last token inside a shared page.
+        Returns the prefix-hit token count, or None when the pool
+        cannot cover the request (admission must block). A blocked
+        request that could NEVER fit (nothing left to evict, no slot
+        holding pages) is shed instead — waiting would deadlock."""
+        self._ensure_state()
+        prefix = np.concatenate([r.prompt, r.generated]).astype(np.int32)
+        plen = int(prefix.shape[0])
+        total = plen + (r.max_new_tokens - int(r.generated.shape[0]))
+        need = pages_for(total, self._page_size)
+        ps = self._page_size
+        matched: List[int] = []
+        if self._prefix_cache is not None:
+            matched = self._prefix_cache.match(prefix)
+        m = len(matched) * ps
+        cow_src = None
+        if m >= plen:                 # full-prefix hit: recompute the
+            m = plen - 1              # last token — COW its page
+            cow_src = matched[-1]
+            matched = matched[:-1]
+        # claim the shared chain first so eviction can't reap it while
+        # we allocate the private tail
+        for p in matched:
+            self._allocator.incref(p)
+        if cow_src is not None:
+            self._allocator.incref(cow_src)
+        fresh: List[int] = []
+        for _ in range(need - len(matched)):
+            p = self._alloc_page()
+            if p is None:
+                for q in fresh:
+                    self._allocator.decref(q)
+                for q in matched:
+                    self._allocator.decref(q)
+                if cow_src is not None:
+                    self._allocator.decref(cow_src)
+                if not any(pgs for pgs in self._slot_pages):
+                    # nothing else holds pages and eviction is dry:
+                    # blocking would deadlock — shed with a typed error
+                    self._m_shed_overload.inc()
+                    r._finish(RequestStatus.SHED, OverloadError(
+                        f"request {r.rid} needs {need} KV pages; the "
+                        f"pool cannot free enough "
+                        f"({self._allocator.pages_free} free)"))
+                return None
+            fresh.append(p)
+        pages = matched + fresh
+        if cow_src is not None:
+            # materialize the divergent copy BEFORE any write lands:
+            # the shared page keeps serving its other readers
+            self._copy_page(cow_src, pages[len(matched)])
+            self._allocator.decref(cow_src)
+        self._slot_pages[i] = pages
+        self._bt[i, :] = 0
+        self._bt[i, :len(pages)] = pages
+        r._page_start = m
+        if self._prefix_cache is not None:
+            if m > 0:
+                self._m_prefix_hits.inc()
+                self._m_prefix_shared_tokens.inc(m)
+            else:
+                self._m_prefix_misses.inc()
+        return m
+
+    def _pool_arrays(self):
+        """The page-indexed leading arrays of the slot state (kp, vp
+        [+ kscale, vscale]) — pos/tok trail them."""
+        return self._slot_state[:-2], self._slot_state[-2:]
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        pool, rest = self._pool_arrays()
+        out = _compiled_page_copy(len(pool))(
+            np.int32(src), np.int32(dst), *pool)
+        self._slot_state = (*out, *rest)
+
+    def _poison_page(self, pg: int) -> None:
+        pool, rest = self._pool_arrays()
+        out = _compiled_page_poison(len(pool))(np.int32(pg), *pool)
+        self._slot_state = (*out, *rest)
+
+    def _release_slot_pages(self, i: int) -> None:
+        for p in self._slot_pages[i]:
+            self._allocator.decref(p)
+        self._slot_pages[i] = []
+        self._bt[i, :] = 0
+
+    def _free_slot(self, i: int) -> None:
+        """The ONE place a slot is vacated: paged engines return the
+        slot's pages to the refcount pool (pages the prefix cache or a
+        co-resident slot still references live on — quarantining a
+        sharer can never free a reader's pages)."""
+        self._slots[i] = None
+        if self._paged:
+            self._release_slot_pages(i)
+
+    def _write_range(self, r: RequestHandle,
+                     prefill: bool) -> tuple:
+        """The logical [lo, hi) positions the next compiled call will
+        write for ``r``: the un-cached prefix tail for a prefill, the
+        next decode chunk otherwise (a generated token's K/V row is
+        written when the token is FED, so decoding writes start at
+        committed-length - 1)."""
+        plen = int(r.prompt.shape[0] + r.generated.shape[0])
+        if prefill:
+            return getattr(r, "_page_start", 0), plen
+        lo = plen - 1
+        return lo, min(lo + self._chunk,
+                       int(r.prompt.shape[0]) + r.max_new_tokens)
+
+    def _ensure_writable(self, entries, prefill: bool) -> None:
+        """Copy-on-write guard before every compiled call that writes:
+        any physical page backing an entry's write range that is still
+        SHARED (refcount > 1) is copied to a fresh private page first.
+        Admission already privatizes the ranges it can foresee, so
+        this is the invariant's backstop — no write ever lands on a
+        page another slot or the prefix cache references."""
+        ps = self._page_size
+        for i, r in entries:
+            lo, hi = self._write_range(r, prefill)
+            if hi <= lo:
+                continue
+            for lp in range(lo // ps, (hi - 1) // ps + 1):
+                if lp >= len(self._slot_pages[i]):
+                    continue
+                p = self._slot_pages[i][lp]
+                if self._allocator.refcount(p) > 1:
+                    fresh = self._alloc_page()
+                    if fresh is None:
+                        raise RuntimeError(
+                            f"copy-on-write for slot {i} page {lp}: "
+                            "page pool exhausted")
+                    self._copy_page(p, fresh)
+                    self._allocator.decref(p)
+                    self._slot_pages[i][lp] = fresh
+                    self._bt[i, lp] = fresh
+
+    def _maybe_corrupt_page(self, entries, prefill: bool) -> None:
+        """ServingFaultInjector.corrupt_page_at hook: poison the named
+        request's next-write page (post-COW, so provably private) —
+        the shared-page isolation proof."""
+        inj = self._injector
+        if inj is None or not hasattr(inj, "check_corrupt_page"):
+            return
+        rid = inj.check_corrupt_page(self._step_counter)
+        if rid is None:
+            return
+        for i, r in entries:
+            if r.rid == rid and self._slot_pages[i]:
+                lp = self._write_range(r, prefill)[0] // self._page_size
+                lp = min(lp, len(self._slot_pages[i]) - 1)
+                self._poison_page(self._slot_pages[i][lp])
+                inj.pages_corrupted += 1
+                log.warning("injected corruption: request %d slot %d "
+                            "page %d poisoned", rid, i,
+                            self._slot_pages[i][lp])
+                return
 
     def _occupied(self) -> List[tuple]:
         with self._lock:
@@ -874,9 +1259,15 @@ class InferenceEngine:
 
     def _ensure_state(self) -> None:
         if self._slot_state is None:
-            self._slot_state = init_slot_state(
-                self.cfg, self.mesh, self._num_slots,
-                kv_mode=self._kv_mode)
+            if self._paged:
+                self._slot_state = init_paged_state(
+                    self.cfg, self.mesh, self._num_slots,
+                    self._page_size, self._num_pages,
+                    kv_mode=self._kv_mode)
+            else:
+                self._slot_state = init_slot_state(
+                    self.cfg, self.mesh, self._num_slots,
+                    kv_mode=self._kv_mode)
 
     def _quant_kwargs(self) -> dict:
         """Compiled-program cache key extension: only present when a
@@ -966,6 +1357,93 @@ class InferenceEngine:
         return self._guarded(call, [r for _, r in entries],
                              self._m_step_seconds)
 
+    def _call_prefill_paged(self, params, state, entries):
+        """Paged admission prefill: each entry's NOT-YET-CACHED suffix
+        (committed prefix minus its prefix-cache hit), right-padded to
+        the SUFFIX bucket — a full-prefix hit therefore prefills a
+        1-token suffix instead of the whole prompt. The block table
+        rides as runtime data. Returns (state', first_tokens)."""
+        with self._lock:
+            self._ensure_writable(entries, prefill=True)
+            self._maybe_corrupt_page(entries, prefill=True)
+            bt = self._bt.copy()
+            state = self._slot_state
+        suffix_map = {}
+        for i, r in entries:
+            pre = np.concatenate([r.prompt, r.generated]
+                                 ).astype(np.int32)
+            start = int(getattr(r, "_page_start", 0))
+            suffix_map[i] = (start, pre[start:])
+        tb = self._bucket_len(max(s.shape[0]
+                                  for _, s in suffix_map.values()))
+        suffix = np.zeros((self._num_slots, tb), np.int32)
+        slen = np.zeros((self._num_slots,), np.int32)
+        start = np.zeros((self._num_slots,), np.int32)
+        for i, (st, tail) in suffix_map.items():
+            suffix[i, :tail.shape[0]] = tail
+            slen[i] = tail.shape[0]
+            start[i] = st
+        fn = _compiled_paged_prefill(
+            astuple(self.cfg), self.mesh, int(tb), self._num_slots,
+            self._page_size, self._max_pages, self._num_pages,
+            float(self.config.temperature), int(self.config.top_k),
+            float(self.config.top_p), **self._quant_kwargs())
+        key = self._root_key()
+        n_state = len(state)
+
+        def call():
+            o = fn(params, *state, bt, suffix, slen, start, key)
+            return tuple(o[:n_state]), np.asarray(o[n_state])
+
+        return self._guarded(call, [r for _, r in entries],
+                             self._m_prefill_seconds, prefill=True)
+
+    def _call_chunk_paged(self, params, state, entries):
+        """Paged decode chunk: contiguous contract + the block table
+        as runtime data. Returns (state', toks [Ns, chunk])."""
+        with self._lock:
+            self._ensure_writable(entries, prefill=False)
+            self._maybe_corrupt_page(entries, prefill=False)
+            bt = self._bt.copy()
+            state = self._slot_state
+        active = np.zeros((self._num_slots,), bool)
+        rem = np.zeros((self._num_slots,), np.int32)
+        for i, r in entries:
+            active[i] = True
+            rem[i] = r.max_new_tokens - r.generated.shape[0]
+        fn = _compiled_paged_decode(
+            astuple(self.cfg), self.mesh, self._chunk,
+            self._num_slots, self._page_size, self._max_pages,
+            self._num_pages, float(self.config.temperature),
+            int(self.config.top_k), float(self.config.top_p),
+            **self._quant_kwargs())
+        key = self._root_key()
+        n_state = len(state)
+
+        def call():
+            o = fn(params, *state, bt, active, rem, key)
+            return tuple(o[:n_state]), np.asarray(o[n_state])
+
+        return self._guarded(call, [r for _, r in entries],
+                             self._m_step_seconds)
+
+    def _cache_prefilled(self, entries) -> None:
+        """After a successful paged prefill: insert each admitted
+        request's FULL prompt pages into the radix cache (the cache
+        becomes a co-owner via refcount), so the next tenant sharing
+        the prefix maps them instead of recomputing. Decode pages are
+        never inserted — they are the slot's private, still-mutating
+        tail."""
+        if self._prefix_cache is None:
+            return
+        with self._lock:
+            for i, r in entries:
+                if self._slots[i] is not r or not self._slot_pages[i]:
+                    continue
+                self._prefix_cache.insert(
+                    np.asarray(r.prompt, np.int32),
+                    self._slot_pages[i])
+
     def _prefill_slots(self, admitted, params) -> None:
         """Admission prefill on the LIVE pool; appends each admitted
         request's first generated token. On persistent failure the
@@ -973,17 +1451,19 @@ class InferenceEngine:
         untouched — the failed call produced no new state) and the
         _BatchDecodeFailed propagates to slot isolation."""
         self._ensure_state()
+        call = (self._call_prefill_paged if self._paged
+                else self._call_prefill)
         try:
-            state, first = self._call_prefill(params,
-                                              self._slot_state,
-                                              admitted)
+            state, first = call(params, self._slot_state, admitted)
         except _BatchDecodeFailed:
             with self._lock:
                 for i, r in admitted:
                     if self._slots[i] is r:
-                        self._slots[i] = None
+                        self._free_slot(i)
             raise
         self._slot_state = state
+        if self._paged:
+            self._cache_prefilled(admitted)
         for i, r in admitted:
             with self._lock:
                 if self._slots[i] is not r:   # preempted by a reload
@@ -995,8 +1475,9 @@ class InferenceEngine:
         self._reap()
 
     def _decode_chunk_slots(self, occupied, params) -> None:
-        state, toks = self._call_chunk(params, self._slot_state,
-                                       occupied)
+        call = (self._call_chunk_paged if self._paged
+                else self._call_chunk)
+        state, toks = call(params, self._slot_state, occupied)
         self._slot_state = state
         for i, r in occupied:
             with self._lock:
@@ -1017,7 +1498,7 @@ class InferenceEngine:
         with self._lock:
             for i, r in enumerate(self._slots):
                 if r is not None and r.done():
-                    self._slots[i] = None
+                    self._free_slot(i)
                     self._leave_flight(r)
 
     def _leave_flight(self, r: RequestHandle) -> None:
@@ -1039,7 +1520,7 @@ class InferenceEngine:
             implicated = set(id(r) for r in requests)
             for i, r in enumerate(self._slots):
                 if r is not None and id(r) in implicated:
-                    self._slots[i] = None
+                    self._free_slot(i)
         for r in requests:
             if r.status != RequestStatus.RUNNING:
                 if r.done():
@@ -1103,7 +1584,7 @@ class InferenceEngine:
             r = self._slots[i]
             if r is None:
                 continue
-            self._slots[i] = None
+            self._free_slot(i)
             r.status = RequestStatus.QUEUED
             self._leave_flight(r)
             r.trace.add("preempted", reason="reload")
@@ -1290,18 +1771,37 @@ class InferenceEngine:
                      for r in self._queue]
             breaker = self._breaker
             degraded = self._degraded_locked()
-        return {"mode": self.config.mode,
-                "num_slots": self._num_slots,
-                "slots_occupied": len(slots),
-                "slots": slots,
-                "queue_depth": len(queue),
-                "queue": queue,
-                "breaker": breaker,
-                "degraded": degraded,
-                "weights_step": self._weights_step,
-                "recorder_events": len(self.recorder),
-                "recent_events": [e.as_dict() for e in
-                                  self.recorder.recent(recent)]}
+        out = {"mode": self.config.mode,
+               "num_slots": self._num_slots,
+               "slots_occupied": len(slots),
+               "slots": slots,
+               "queue_depth": len(queue),
+               "queue": queue,
+               "breaker": breaker,
+               "degraded": degraded,
+               "weights_step": self._weights_step,
+               "recorder_events": len(self.recorder),
+               "recent_events": [e.as_dict() for e in
+                                 self.recorder.recent(recent)]}
+        if self._paged:
+            with self._lock:
+                out["paged"] = {
+                    "page_size": self._page_size,
+                    "num_pages": self._num_pages,
+                    "pages_free": self._allocator.pages_free,
+                    "pages_used": self._allocator.pages_used,
+                    "block_tables": {
+                        i: list(map(int, pgs))
+                        for i, pgs in enumerate(self._slot_pages)
+                        if pgs},
+                    "prefix_cache": (
+                        {**self._prefix_cache.stats(),
+                         "hits": int(self._m_prefix_hits.value),
+                         "misses": int(self._m_prefix_misses.value),
+                         "shared_tokens": int(
+                             self._m_prefix_shared_tokens.value)}
+                        if self._prefix_cache is not None else None)}
+        return out
 
     def slo_report(self) -> dict:
         """Windowed SLO report (observability/slo.py): TTFT / TPOT /
@@ -1335,6 +1835,7 @@ class InferenceEngine:
                     "weights_step": self._weights_step,
                     "quantize": self._qmode,
                     "kv_quantize": self._kv_mode,
+                    "paged": self._paged,
                     **dict(self.stats)}
 
     def ready(self) -> bool:
@@ -1402,6 +1903,16 @@ class InferenceEngine:
                 # front, committed tokens preserved) so they re-prefill
                 # under the new tree; new admissions see it immediately
                 preempted = self._evict_all_locked()
+                # paged: the prefix cache's K/V pages ALSO encode the
+                # old weights — a post-reload hit would graft stale KV
+                # under new weights. Flush; every cached page returns
+                # to the free list (all slots were just evicted).
+                if self._prefix_cache is not None:
+                    flushed = self._prefix_cache.flush()
+                    if flushed:
+                        self._m_prefix_evictions.inc(flushed)
+                        log.info("weight reload flushed %d prefix-"
+                                 "cache entries", flushed)
             if preempted:
                 self._m_preempted.inc(preempted)
                 log.info("weight reload preempted %d in-flight "

@@ -1,0 +1,225 @@
+"""Host-side KV paging: refcounted page allocator + radix prefix cache.
+
+The device side (parallel/serving.py paged section) stores slot KV in
+a fixed pool of ``page_size``-token pages addressed through per-slot
+block tables. THIS module owns the indices: which physical page backs
+which logical page of which slot, who else references it, and which
+cached prefix chains can map straight into a new slot's table.
+
+- `PageAllocator` — free list + per-page refcounts over physical
+  pages 1..num_pages-1 (page 0 is the device scratch page, never
+  handed out). A page is WRITABLE only while its refcount is exactly 1
+  (one owner); the engine's copy-on-write guard enforces that before
+  every compiled call that writes.
+- `RadixPrefixCache` — a trie over token sequences at PAGE
+  granularity: each node is one full page of tokens keyed by its
+  token tuple under its parent, holding the physical page whose K/V
+  rows those tokens produced. On admission the longest cached chain
+  matching the new request's prefix maps those pages into the slot's
+  block table (refcount bumped per sharer), so co-tenant traffic
+  sharing a system prompt shares both the KV bytes and — because
+  prefill resumes from the matched boundary — the prefill compute.
+  Only FULL pages are cached (a partial page's tail would be
+  overwritten by the sharer — that is what the engine's COW copy is
+  for, when a full-prefix match forces re-computing the last token
+  inside a shared page). Eviction is LRU over leaf nodes whose page
+  nobody but the cache references; interior nodes become evictable as
+  their children go. `flush()` drops everything — hot weight reload
+  must call it, because cached K/V encodes the weights that wrote it.
+
+Thread-safety: both classes are driven only under the engine lock
+(admission, reap, reload all already serialize on it), so they stay
+lock-free themselves.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Physical page index reserved as the device scratch target for
+#: masked/inactive writes — never allocated, never attended.
+SCRATCH_PAGE = 0
+
+
+class PageAllocator:
+    """Free-list allocator with refcounts over pages 1..num_pages-1."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is "
+                             f"scratch), got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._ref = np.zeros(num_pages, np.int32)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def alloc(self) -> Optional[int]:
+        """One fresh page with refcount 1, or None when exhausted."""
+        if not self._free:
+            return None
+        p = self._free.pop()
+        self._ref[p] = 1
+        return p
+
+    def incref(self, page: int) -> None:
+        if page == SCRATCH_PAGE:
+            raise ValueError("scratch page cannot be referenced")
+        if self._ref[page] <= 0:
+            raise ValueError(f"incref on free page {page}")
+        self._ref[page] += 1
+
+    def decref(self, page: int) -> None:
+        if self._ref[page] <= 0:
+            raise ValueError(f"decref on free page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+
+class _Node:
+    __slots__ = ("key", "page", "parent", "children", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.key = key                    # tuple of page_size tokens
+        self.page = page                  # physical page index
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Page-granular radix/trie prefix cache over token sequences."""
+
+    def __init__(self, page_size: int, allocator: PageAllocator):
+        self.page_size = int(page_size)
+        self.alloc = allocator
+        self._root = _Node((), SCRATCH_PAGE, None)
+        self._tick = 0
+        self._nodes = 0
+        # lifetime stats (the engine mirrors them into counters)
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def _chunks(self, tokens: Sequence[int]):
+        ps = self.page_size
+        for j in range(len(tokens) // ps):
+            yield tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached page chain prefixing ``tokens`` — the
+        physical pages, in logical order. Touches the chain for LRU
+        recency. The caller owns claiming (incref) what it uses."""
+        self._tick += 1
+        node, pages = self._root, []
+        for key in self._chunks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = self._tick
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def insert(self, tokens: Sequence[int],
+               pages: Sequence[int]) -> int:
+        """Record ``tokens``' full-page chain backed by ``pages``
+        (the owning slot's block-table pages, logical order). New
+        nodes incref their page — the cache becomes a co-owner, which
+        is what keeps a freed slot's prefix resident for the next
+        tenant. Chunks already cached keep their existing page (a twin
+        admitted in the same round just doesn't dedupe). Returns the
+        number of pages newly adopted."""
+        self._tick += 1
+        node, adopted = self._root, 0
+        for j, key in enumerate(self._chunks(tokens)):
+            child = node.children.get(key)
+            if child is None:
+                page = int(pages[j])
+                self.alloc.incref(page)
+                child = _Node(key, page, node)
+                child.last_used = self._tick
+                node.children[key] = child
+                self._nodes += 1
+                adopted += 1
+            else:
+                child.last_used = self._tick
+            node = child
+        return adopted
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages by dropping LRU leaf entries
+        whose page only the cache references (refcount 1 — pages a
+        live slot shares are never touched). Returns pages freed."""
+        freed = 0
+        while freed < n_pages:
+            victim = None
+            for node in self._iter_leaves():
+                if self.alloc.refcount(node.page) != 1:
+                    continue
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+            if victim is None:
+                break
+            self._drop(victim)
+            freed += 1
+            self.evictions += 1
+        return freed
+
+    def _iter_leaves(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                yield n
+
+    def _drop(self, node: _Node) -> None:
+        del node.parent.children[node.key]
+        self._nodes -= 1
+        self.alloc.decref(node.page)
+
+    def flush(self) -> int:
+        """Drop EVERY entry (decref all cached pages) — the hot-reload
+        path: cached K/V encodes the old weights. Returns entries
+        dropped."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.alloc.decref(n.page)
+            dropped += 1
+        self._root.children.clear()
+        self._nodes = 0
+        return dropped
+
+    def stats(self) -> dict:
+        return {"entries": self._nodes,
+                "page_size": self.page_size,
+                "evictions": self.evictions}
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Logical pages needed to hold ``tokens`` positions."""
+    return -(-int(tokens) // int(page_size))
