@@ -464,6 +464,31 @@ def _filter_logits(logits: Array, top_k: int, top_p: float) -> Array:
     return logits
 
 
+def sample_at_positions(logits: Array, posidx: Array, key,
+                        temperature: float, top_k: int,
+                        top_p: float) -> Array:
+    """POSITION-KEYED sampling on [N, V] logits: row i draws from
+    ``fold_in(key, posidx[i])`` after the standard temperature /
+    top-k / top-p filters (greedy ignores the key entirely). The token
+    at sequence index j is a deterministic function of (key, j, the
+    logits at j) — independent of batch/slot placement, chunk
+    boundaries, or HOW MANY positions are scored per call — which is
+    what makes retries, solo isolation, preempt-resume, and
+    speculative verify-then-commit reproduce continuations exactly:
+    the serving decode paths (parallel/serving._sample_slots) and the
+    speculative verify pass (which scores K+1 positions at once and
+    must emit the very tokens sequential decode would) all sample
+    through this one function."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filt = _filter_logits(logits.astype(jnp.float32) / temperature,
+                          top_k, top_p)
+    keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(
+        posidx.astype(jnp.int32))
+    return jax.vmap(jax.random.categorical)(keys, filt) \
+        .astype(jnp.int32)
+
+
 @_ft.lru_cache(maxsize=64)
 def _generate_jit(cfg: TransformerConfig, max_new_tokens: int,
                   temperature: float, top_k: int = 0,

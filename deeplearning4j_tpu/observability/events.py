@@ -50,6 +50,11 @@ EVENT_KINDS = frozenset({
     #                  (solo isolation re-run)
     "prefill_done",  # prompt prefilled, first token committed {tokens}
     "decode_chunk",  # one decode chunk committed {tokens, slot}
+    #                  (speculative engines add {drafted, accepted})
+    "draft_rejected",  # a speculative round's drafts were ALL
+    #                  rejected by verification {step, drafted,
+    #                  poisoned} — the forensic marker for injected
+    #                  draft poisoning and for adaptive-K backoff
     "preempted",     # evicted from its slot {reason: isolation|reload}
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}

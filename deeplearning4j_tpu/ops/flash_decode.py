@@ -92,29 +92,63 @@ def _largest_divisor(n: int, cap: int) -> int:
 
 def reference_decode_attention(q: Array, k_cache: Array, v_cache: Array,
                                pos, n_heads: int,
-                               scale: Optional[float] = None) -> Array:
+                               scale: Optional[float] = None,
+                               k_scale: Optional[Array] = None,
+                               v_scale: Optional[Array] = None) -> Array:
     """jnp reference: q [B, H, Dh] at position ``pos`` attends cache
-    rows 0..pos (inclusive) of k/v [B, S, D=H*Dh]. Returns [B, H, Dh]."""
+    rows 0..pos (inclusive) of k/v [B, S, D=H*Dh]. Returns [B, H, Dh].
+
+    ``pos`` may be a scalar (every batch row at the same prefix — the
+    fused-generate path) or a [B] vector (each row masked to ITS OWN
+    filled prefix — the slotted/paged per-slot decode).
+
+    ``k_scale``/``v_scale`` ([B, S] float32, quantized-KV pools,
+    quant/kv.py): per-row dequantization scales folded into the scores
+    and probabilities — ``(q·k_int)·kscale_s`` then
+    ``(p·vscale_s)·v_int`` — exactly the slot-pool quantized-attention
+    algebra, with the SAME multiplication order (scale-of-row before
+    1/sqrt(d)) so fusing the call sites stays bit-identical. Scaled
+    calls promote the cache to f32 (int8/fp8 storage) and return in
+    ``q.dtype``."""
     b, s, d = k_cache.shape
     h = n_heads
     dh = d // h
     if scale is None:
         scale = 1.0 / (dh ** 0.5)
-    kh = k_cache.reshape(b, s, h, dh)
-    vh = v_cache.reshape(b, s, h, dh)
-    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) * scale
-    sc = jnp.where(jnp.arange(s)[None, None, :] <= pos, sc, NEG_INF)
+    pos = jnp.asarray(pos)
+    bound = pos[:, None, None] if pos.ndim else pos
+    if k_scale is None:
+        kh = k_cache.reshape(b, s, h, dh)
+        vh = v_cache.reshape(b, s, h, dh)
+        sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
+            * scale
+        sc = jnp.where(jnp.arange(s)[None, None, :] <= bound, sc,
+                       NEG_INF)
+        p = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhs,bshd->bhd", p.astype(q.dtype), vh)
+    kh = k_cache.astype(jnp.float32).reshape(b, s, h, dh)
+    vh = v_cache.astype(jnp.float32).reshape(b, s, h, dh)
+    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
+        * k_scale[:, None, :] * scale
+    sc = jnp.where(jnp.arange(s)[None, None, :] <= bound, sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
-    return jnp.einsum("bhs,bshd->bhd", p.astype(q.dtype), vh)
+    a = jnp.einsum("bhs,bshd->bhd", p * v_scale[:, None, :], vh)
+    return a.astype(q.dtype)
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, scale: float, h: int, bs: int,
-                   n_blocks: int):
+def _decode_kernel(blk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
+                   l_scr, acc_scr, *, scale: float, h: int, bs: int,
+                   bb: int, n_blocks: int):
     import jax.experimental.pallas as pl
 
+    i = pl.program_id(0)
     j = pl.program_id(1)
-    last = pos_ref[0] // bs
+    # per-batch-block prefix bound (max over the block's rows): the DMA
+    # clamp and the compute skip both use it, while the per-ROW mask
+    # below uses each row's own pos — the slotted pools' per-slot
+    # prefixes ride the same kernel as the fused path's shared scalar
+    # (which arrives here broadcast to a constant [B] vector).
+    last = blk_ref[i] // bs
 
     @pl.when(j == 0)
     def _init():
@@ -148,7 +182,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                               dtype=jnp.float32))          # [bb, bs]
         s = jnp.stack(sc, axis=-1) * scale                 # [bb, bs, H]
         ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
-        s = jnp.where(ki <= pos_ref[0], s, NEG_INF)
+        rows_pos = pl.load(pos_ref, (pl.dslice(i * bb, bb),))  # [bb]
+        s = jnp.where(ki <= rows_pos[:, None, None], s, NEG_INF)
         m_prev = m_scr[...]                                # [bb, H]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         corr = jnp.exp(m_prev - m_new)
@@ -198,11 +233,22 @@ def decode_attention_available(q: Array, k_cache: Array) -> bool:
 
 def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
                      n_heads: int, scale: Optional[float] = None,
-                     layer: int = 0) -> Array:
+                     layer: int = 0, k_scale: Optional[Array] = None,
+                     v_scale: Optional[Array] = None) -> Array:
     """Dispatching decode attention: q [B, H, Dh] at position ``pos``
     (cache row ``pos`` already written) attends rows 0..pos of the
     flattened-head caches. Returns [B, H, Dh]. ``pos`` may be traced
-    (it is, inside generate's sampling scan).
+    (it is, inside generate's sampling scan), and may be a [B] VECTOR
+    — each row masked (and, on the kernel path, DMA-bounded per batch
+    block) to its own filled prefix, which is what lets the slotted /
+    paged per-slot decode and the speculative verify share this one
+    primitive with the fused path.
+
+    ``k_scale``/``v_scale`` ([B, S]): quantized-KV per-row scales,
+    folded into scores/probabilities (reference_decode_attention);
+    scaled calls currently always take the jnp path (the kernel reads
+    float caches only — int8 cache blocks + scale DMA is follow-up
+    work, see docs/quantization.md).
 
     Caches may be [B, S, D] or the model's stacked [L, B, S, D] with a
     static ``layer``. Pass the STACKED buffer on the kernel path: XLA
@@ -211,11 +257,13 @@ def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
     decode shape) per layer per step — measured ~9ms of the round-3
     12ms step. The kernel instead picks the layer plane in the
     BlockSpec index_map, so only the blocks it DMAs are ever read."""
-    if not decode_attention_available(q, k_cache):
+    if k_scale is not None or not decode_attention_available(q, k_cache):
         if k_cache.ndim == 4:
             k_cache, v_cache = k_cache[layer], v_cache[layer]
         return reference_decode_attention(q, k_cache, v_cache, pos,
-                                          n_heads, scale)
+                                          n_heads, scale,
+                                          k_scale=k_scale,
+                                          v_scale=v_scale)
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -253,31 +301,37 @@ def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
         b, max(1, blk_bytes // max(1, bs * d * itemsize)))
     n_blocks = s // bs
     kernel = functools.partial(_decode_kernel, scale=float(scale), h=h,
-                               bs=bs, n_blocks=n_blocks)
+                               bs=bs, bb=bb, n_blocks=n_blocks)
+    # two prefetched scalars: the per-ROW prefix positions (the
+    # in-kernel mask) and their per-batch-block maxima (the DMA clamp
+    # — a block's K/V read must cover its furthest row). A scalar pos
+    # broadcasts to a constant vector, reproducing the old behavior.
+    pos_rows = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    pos_blk = jnp.max(pos_rows.reshape(b // bb, bb), axis=1)
 
     if stacked:
         kv_block = (1, bb, bs, d)
 
-        def kv_map(i, j, pos_ref):
-            return (layer, i, jnp.minimum(j, pos_ref[0] // bs), 0)
+        def kv_map(i, j, blk_ref, pos_ref):
+            return (layer, i, jnp.minimum(j, blk_ref[i] // bs), 0)
     else:
         kv_block = (bb, bs, d)
 
-        def kv_map(i, j, pos_ref):
-            return (i, jnp.minimum(j, pos_ref[0] // bs), 0)
+        def kv_map(i, j, blk_ref, pos_ref):
+            return (i, jnp.minimum(j, blk_ref[i] // bs), 0)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(b // bb, n_blocks),
             in_specs=[
-                pl.BlockSpec((bb, h, dh), lambda i, j, p: (i, 0, 0)),
+                pl.BlockSpec((bb, h, dh), lambda i, j, *_: (i, 0, 0)),
                 pl.BlockSpec(kv_block, kv_map),
                 pl.BlockSpec(kv_block, kv_map),
             ],
             out_specs=pl.BlockSpec((bb, h, dh),
-                                   lambda i, j, p: (i, 0, 0)),
+                                   lambda i, j, *_: (i, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((bb, h), jnp.float32),
                 pltpu.VMEM((bb, h), jnp.float32),
@@ -286,5 +340,5 @@ def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
         interpret=os.environ.get("DL4JTPU_FLASH") == "interpret",
-    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k_cache, v_cache)
+    )(pos_blk, pos_rows, q, k_cache, v_cache)
     return out

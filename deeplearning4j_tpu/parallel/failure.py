@@ -182,6 +182,15 @@ class ServingFaultInjector(FaultInjector):
         the poison lands on the WRITER's page only: a reader sharing
         the same prefix must keep producing its clean-run tokens —
         the shared-page-isolation proof (tests/test_serving_paged.py).
+      - ``draft_poison_at``: ``{step: request_id}`` — the SPECULATIVE
+        engine derails the named request's draft proposals for the
+        round at that step index ((d+1) mod V on device — guaranteed
+        to differ from the drafter's own tokens, so verification must
+        reject them all). The contract under test: a poisoned draft
+        pass can never corrupt committed KV — the round degrades to
+        one committed (target-verified) token, the slot's trace gains
+        a ``draft_rejected`` event, and the adaptive-K controller
+        falls back to K=1 (tests/test_serving_spec.py).
 
     Continuous batching: the engine reports the request ids of ALL
     co-resident slots at every call, so ``poison_requests`` models a
@@ -195,7 +204,8 @@ class ServingFaultInjector(FaultInjector):
                  poison_requests: Iterable[int] = (),
                  delay_at: Optional[dict] = None,
                  prefill_fail_at: Iterable[int] = (),
-                 corrupt_page_at: Optional[dict] = None):
+                 corrupt_page_at: Optional[dict] = None,
+                 draft_poison_at: Optional[dict] = None):
         super().__init__(fail_at, persistent=persistent)
         self.poison_requests = set(int(r) for r in poison_requests)
         self.delay_at = {int(k): float(v)
@@ -207,6 +217,10 @@ class ServingFaultInjector(FaultInjector):
                                 for k, v in (corrupt_page_at
                                              or {}).items()}
         self.pages_corrupted = 0
+        self.draft_poison_at = {int(k): int(v)
+                                for k, v in (draft_poison_at
+                                             or {}).items()}
+        self.drafts_poisoned = 0
 
     def check_corrupt_page(self, step: int) -> Optional[int]:
         """One-shot: the request id whose next-write page the paged
@@ -214,6 +228,13 @@ class ServingFaultInjector(FaultInjector):
         The counter bumps when the engine confirms the poke landed
         (the request might have left its slot by then)."""
         return self.corrupt_page_at.pop(int(step), None)
+
+    def check_draft_poison(self, step: int) -> Optional[int]:
+        """One-shot: the request id whose draft proposals the
+        speculative round at ``step`` should derail, else None. The
+        counter bumps when the engine confirms the poison landed on a
+        seated slot."""
+        return self.draft_poison_at.pop(int(step), None)
 
     def on_decode_step(self, step: int,
                        request_ids: Iterable[int] = ()) -> None:

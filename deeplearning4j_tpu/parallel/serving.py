@@ -82,7 +82,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from deeplearning4j_tpu.models.transformer import (TransformerConfig,
-                                                   _filter_logits)
+                                                   _filter_logits,
+                                                   sample_at_positions)
 from deeplearning4j_tpu.nn.layers.attention import (dot_product_attention,
                                                     layer_norm)
 from deeplearning4j_tpu.parallel.megatron import (_g_sync, param_specs,
@@ -390,17 +391,14 @@ def _sample_slots(logits, posidx, key, dp: int, temperature: float,
     """Per-slot sampling on [Ns, V] logits: the token generated at
     sequence index ``posidx[i]`` draws from fold_in(key, posidx[i]) —
     position-keyed, slot-placement-independent, so retries, solo
-    isolation, and preempt-resume reproduce the same continuation.
-    Greedy (temperature<=0) ignores the key entirely."""
-    if temperature <= 0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if dp > 1:
+    isolation, preempt-resume, AND speculative verification reproduce
+    the same continuation (models/transformer.sample_at_positions owns
+    the core; this wrapper adds the data-rank key fold). Greedy
+    (temperature<=0) ignores the key entirely."""
+    if temperature > 0 and dp > 1:
         key = jax.random.fold_in(key, lax.axis_index("data"))
-    filt = _filter_logits(logits.astype(jnp.float32) / temperature,
-                          top_k, top_p)
-    keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(
-        posidx.astype(jnp.int32))
-    return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+    return sample_at_positions(logits, posidx, key, temperature,
+                               top_k, top_p)
 
 
 def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
@@ -411,10 +409,12 @@ def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
     act [Ns] (inactive slots neither write their cache row nor advance).
     The K/V row write is a per-slot scatter at (layer, slot, pos[slot]);
     attention masks each slot to its own filled prefix 0..pos[slot] —
-    the per-slot generalization of _local_block_decode, with
-    reference_decode_attention's exact masking/softmax numerics so a
-    slotted greedy decode is token-identical to the fused path."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    the per-slot generalization of _local_block_decode, sharing
+    `ops/flash_decode.decode_attention` (vector-pos form) with the
+    fused path so the slotted decode rides the same tuned primitive:
+    jnp reference semantics off-TPU (token-identical to the fused
+    path), the split-K kernel with per-slot DMA bounds on it."""
+    from deeplearning4j_tpu.ops.flash_decode import decode_attention
     g_model = _g_sync("model")
     h_loc = cfg.n_heads // tp
     d_loc = h_loc * cfg.d_head
@@ -435,14 +435,8 @@ def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
                      cv_all[layer, rows, wp])
     ck_all = ck_all.at[layer, rows, wp].set(k_wr)
     cv_all = cv_all.at[layer, rows, wp].set(v_wr)
-    kh = ck_all[layer].reshape(ns, s_max, h_loc, cfg.d_head)
-    vh = cv_all[layer].reshape(ns, s_max, h_loc, cfg.d_head)
-    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
-        * (1.0 / (cfg.d_head ** 0.5))
-    sc = jnp.where(jnp.arange(s_max)[None, None, :]
-                   <= wp[:, None, None], sc, NEG_INF)
-    pr = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bhs,bshd->bhd", pr.astype(q.dtype), vh)
+    a = decode_attention(q, ck_all, cv_all, wp, n_heads=h_loc,
+                         layer=layer)                    # [Ns, hl, Dh]
     h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
                                p["Wo"].astype(h.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -462,9 +456,12 @@ def _local_block_decode_slotted_q(h, p, ck_all, cv_all, ksc, vsc,
     score row (``(q·k_int)·kscale_s``) and the V scale into the
     probability row (``(p·vscale_s)·v_int``) — algebraically the
     dequantized attention, touching [Ns, S] scale vectors instead of
-    [Ns, S, D] panels. Masking/softmax numerics match the float path
-    exactly (same NEG_INF mask, f32 softmax)."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    [Ns, S, D] panels. The fold now lives in
+    `ops/flash_decode.decode_attention(k_scale=, v_scale=)` — one
+    primitive for float, quantized, slotted, paged, and speculative-
+    verify decode — with identical numerics (same NEG_INF mask, f32
+    softmax, scale-before-1/sqrt(d) multiplication order)."""
+    from deeplearning4j_tpu.ops.flash_decode import decode_attention
     from deeplearning4j_tpu.quant.kv import quantize_rows
     g_model = _g_sync("model")
     h_loc = cfg.n_heads // tp
@@ -490,19 +487,9 @@ def _local_block_decode_slotted_q(h, p, ck_all, cv_all, ksc, vsc,
     cv_all = cv_all.at[layer, rows, wp].set(v_wr)
     ksc = ksc.at[layer, rows, wp, 0].set(ks_wr)
     vsc = vsc.at[layer, rows, wp, 0].set(vs_wr)
-    kh = ck_all[layer].astype(jnp.float32) \
-        .reshape(ns, s_max, h_loc, cfg.d_head)
-    vh = cv_all[layer].astype(jnp.float32) \
-        .reshape(ns, s_max, h_loc, cfg.d_head)
-    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
-        * ksc[layer, :, :, 0][:, None, :] \
-        * (1.0 / (cfg.d_head ** 0.5))
-    sc = jnp.where(jnp.arange(s_max)[None, None, :]
-                   <= wp[:, None, None], sc, NEG_INF)
-    pr = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bhs,bshd->bhd",
-                   pr * vsc[layer, :, :, 0][:, None, :], vh)
-    a = a.astype(q.dtype)
+    a = decode_attention(q, ck_all, cv_all, wp, n_heads=h_loc,
+                         layer=layer, k_scale=ksc[layer, :, :, 0],
+                         v_scale=vsc[layer, :, :, 0])
     h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
                                p["Wo"].astype(h.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -888,9 +875,12 @@ def _local_block_decode_paged(h, p, kp, vp, bt, layer: int, pos, act,
     row lands at (bt[slot, pos//ps], pos%ps) — inactive slots write the
     scratch page — and attention runs over the gathered logical view.
     Deliberately mirrors _local_block_decode_slotted's math (the
-    gathered view holds the same values at the same logical positions),
-    so paged greedy decode is byte-identical to the contiguous pool."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    gathered view holds the same values at the same logical positions,
+    and attention goes through the same
+    `ops/flash_decode.decode_attention` primitive over the gathered
+    view), so paged greedy decode is byte-identical to the contiguous
+    pool."""
+    from deeplearning4j_tpu.ops.flash_decode import decode_attention
     g_model = _g_sync("model")
     h_loc = cfg.n_heads // tp
     d_loc = h_loc * cfg.d_head
@@ -909,16 +899,9 @@ def _local_block_decode_paged(h, p, kp, vp, bt, layer: int, pos, act,
     off = wp % page_size
     kp = kp.at[layer, pg, off].set(k.astype(kp.dtype))
     vp = vp.at[layer, pg, off].set(v.astype(vp.dtype))
-    kh = _gather_pages(kp[layer], bt, ns, s_view) \
-        .reshape(ns, s_view, h_loc, cfg.d_head)
-    vh = _gather_pages(vp[layer], bt, ns, s_view) \
-        .reshape(ns, s_view, h_loc, cfg.d_head)
-    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
-        * (1.0 / (cfg.d_head ** 0.5))
-    sc = jnp.where(jnp.arange(s_view)[None, None, :]
-                   <= wp[:, None, None], sc, NEG_INF)
-    pr = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bhs,bshd->bhd", pr.astype(q.dtype), vh)
+    kh = _gather_pages(kp[layer], bt, ns, s_view)    # [Ns, S_view, Dl]
+    vh = _gather_pages(vp[layer], bt, ns, s_view)
+    a = decode_attention(q, kh, vh, wp, n_heads=h_loc)
     h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
                                p["Wo"].astype(h.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -932,8 +915,10 @@ def _local_block_decode_paged_q(h, p, kp, vp, ksc, vsc, bt, layer: int,
                                 kv_mode: str):
     """Quantized-KV paged decode block: quantize-on-write into the
     int8/fp8 page pool + parallel scale planes, scales folded into
-    scores/probabilities exactly as _local_block_decode_slotted_q."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    scores/probabilities through the same
+    `decode_attention(k_scale=, v_scale=)` call as
+    _local_block_decode_slotted_q."""
+    from deeplearning4j_tpu.ops.flash_decode import decode_attention
     from deeplearning4j_tpu.quant.kv import quantize_rows
     g_model = _g_sync("model")
     h_loc = cfg.n_heads // tp
@@ -957,19 +942,12 @@ def _local_block_decode_paged_q(h, p, kp, vp, ksc, vsc, bt, layer: int,
     vp = vp.at[layer, pg, off].set(vq)
     ksc = ksc.at[layer, pg, off, 0].set(ksr)
     vsc = vsc.at[layer, pg, off, 0].set(vsr)
-    kh = _gather_pages(kp[layer].astype(jnp.float32), bt, ns, s_view) \
-        .reshape(ns, s_view, h_loc, cfg.d_head)
-    vh = _gather_pages(vp[layer].astype(jnp.float32), bt, ns, s_view) \
-        .reshape(ns, s_view, h_loc, cfg.d_head)
+    kh = _gather_pages(kp[layer].astype(jnp.float32), bt, ns, s_view)
+    vh = _gather_pages(vp[layer].astype(jnp.float32), bt, ns, s_view)
     ksg = _gather_pages(ksc[layer], bt, ns, s_view)[..., 0]
     vsg = _gather_pages(vsc[layer], bt, ns, s_view)[..., 0]
-    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
-        * ksg[:, None, :] * (1.0 / (cfg.d_head ** 0.5))
-    sc = jnp.where(jnp.arange(s_view)[None, None, :]
-                   <= wp[:, None, None], sc, NEG_INF)
-    pr = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bhs,bshd->bhd", pr * vsg[:, None, :], vh)
-    a = a.astype(q.dtype)
+    a = decode_attention(q, kh, vh, wp, n_heads=h_loc, k_scale=ksg,
+                         v_scale=vsg)
     h = h + g_model(jnp.matmul(a.reshape(ns, 1, d_loc),
                                p["Wo"].astype(h.dtype)))
     x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -1253,6 +1231,542 @@ def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,
         out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
                      _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
                      _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None))
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
+    return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: draft K tokens, verify them in ONE target pass
+# (ISSUE-8)
+# ---------------------------------------------------------------------------
+#
+# Decode is the engine's memory-bound tail: every sequential step pays
+# the full weight + KV-prefix bandwidth to emit ONE token per slot.
+# A speculative round instead (1) runs K cheap DRAFT steps — the
+# int8-quantized weight tree, the model itself ("self"), or an
+# early-exit truncation to the first `draft_layers` blocks — proposing
+# d_1..d_K per active slot, then (2) runs ONE target-model VERIFY pass
+# scoring all K+1 window positions [pending, d_1..d_K] at once, and
+# (3) commits the longest accepted prefix plus the target's own token
+# at the first divergence (rejection-resampling degenerates to "take
+# the target's token" under position-keyed sampling — see below). The
+# target pays one pass of bandwidth for up to K+1 committed tokens.
+#
+# EXACTNESS — stronger than the classic rejection-sampling guarantee:
+# the committed token at sequence index j is ALWAYS
+# sample(fold_in(key, j), target logits at j) — the verify pass scores
+# every window position with the target model and samples it through
+# the SAME position-keyed schedule sequential decode uses
+# (models/transformer.sample_at_positions), accepting a draft only
+# when it EQUALS that sample. By induction every committed token is
+# bit-identical to what the non-speculative engine emits at the same
+# position under the same seed — greedy AND temperature/top-k/top-p
+# sampled, float AND int8 KV, contiguous AND paged — which trivially
+# implies the distributional (rejection-sampling) guarantee, and makes
+# rollback free: a slot that accepts 3 of 5 drafts simply IS a
+# non-speculative slot at its new position.
+#
+# CACHE SAFETY: draft steps write draft-weight K/V rows at positions
+# pos..pos+K-1 (through the ordinary slotted/paged block fns), but the
+# verify pass REWRITES rows pos..pos+K with target-weight K/V before
+# attending them, so the cache holds pure target K/V for every
+# committed position. Rows past the committed prefix (rejected
+# drafts) hold target K/V for tokens that never landed — they sit at
+# indices >= the new pending position, are never attended (every
+# attention mask here is s <= current position), and are overwritten
+# in order as real tokens arrive: the same monotone-overwrite argument
+# bucket-pad rows rely on. Paged pools route writes past a slot's
+# block table (or inactive slots) to the reserved scratch page, and
+# the engine's copy-on-write guard privatizes the whole K+1 write
+# span before the call — a speculative write can never land on a page
+# another slot or the prefix cache references.
+#
+# SHAPES: one fixed-shape program per (K, num_slots, kv_mode[, page
+# geometry]) riding the engine's bucket-keyed compile caches;
+# active/rem/poison and per-slot accept counts are runtime data, so
+# acceptance variance never recompiles. ``poison`` [Ns] derails the
+# drafts on-device ((d+1) mod V — guaranteed != the model's own
+# proposal) for deterministic fault-injection
+# (ServingFaultInjector.draft_poison_at): verification rejects every
+# poisoned draft and the round degrades to one committed token,
+# proving a poisoned draft pass cannot corrupt committed KV.
+#
+# MoE configs are rejected: the expert-capacity cap is a function of
+# the tokens-per-call count, so a K+1-token verify pass would bind
+# capacity differently than sequential decode and break the
+# token-exactness contract (same reason bucket-padded MoE prefill is a
+# documented divergence).
+
+
+def _embed_pending(params, cfg: TransformerConfig, pos, tok):
+    """Embed each slot's pending token at its own position — the
+    shared first step of every sequential decode/draft step."""
+    dt = cfg.activation_dtype()
+    emb = params["embed"].astype(dt)[tok]
+    pv = params["pos"].astype(dt)[jnp.clip(pos, 0, cfg.max_len - 1)]
+    return (emb + pv)[:, None, :]
+
+
+def _check_spec(cfg: TransformerConfig, spec_k: int, draft_layers: int):
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "speculative decoding does not support MoE configs: the "
+            "expert-capacity cap depends on the tokens-per-call count, "
+            "so a K+1-token verify pass would drop differently than "
+            "sequential decode and break token-exactness")
+    nd = draft_layers if draft_layers > 0 else cfg.n_layers
+    if not 0 < nd <= cfg.n_layers:
+        raise ValueError(f"draft_layers {draft_layers} out of "
+                         f"(0, {cfg.n_layers}]")
+    return nd
+
+
+def _spec_accept_commit(spec_k: int, drafts, tgt, pos, tok, rem, act):
+    """Accept the longest draft prefix matching the target's
+    position-keyed samples, commit it plus the target's token at the
+    first divergence (or the bonus token after K accepts), capped by
+    the slot's remaining budget. Returns (pos', tok', rem', emit
+    [Ns, K+1] with -1 past each slot's commit count, ncommit, drafted,
+    accepted)."""
+    k1 = spec_k + 1
+    ns = tok.shape[0]
+    rows = jnp.arange(ns)
+    zero = jnp.asarray(0, jnp.int32)
+    match = (drafts == tgt[:, :spec_k]) & act[:, None]
+    acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                  axis=1)                                   # [Ns] 0..K
+    c = jnp.where(act, jnp.minimum(acc + 1, rem), zero)
+    emit = jnp.where(jnp.arange(k1)[None, :] < c[:, None], tgt,
+                     jnp.asarray(-1, jnp.int32))
+    last = tgt[rows, jnp.clip(c - 1, 0, spec_k)]
+    tok = jnp.where(act, last, tok)
+    pos = jnp.where(act, pos + c, pos)
+    rem = jnp.where(act, rem - c, rem)
+    drafted = jnp.where(act, jnp.asarray(spec_k, jnp.int32), zero)
+    accepted = jnp.maximum(c - 1, 0)
+    return pos, tok, rem, emit, c, drafted, accepted
+
+
+def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
+                            spec_k: int, num_slots: int,
+                            temperature: float = 0.0, top_k: int = 0,
+                            top_p: float = 1.0, quantized=None,
+                            kv_mode=None, draft_quantized=None,
+                            draft_layers: int = 0):
+    """Compiled speculative decode round over the CONTIGUOUS slot
+    pool: (params, draft_params, ck, cv[, kscale, vscale], pos, tok,
+    active [Ns], rem [Ns], poison [Ns], key) -> (state', toks
+    [Ns, K+1], ncommit [Ns], drafted [Ns], accepted [Ns]).
+
+    One round advances every active slot 1..K+1 tokens: K draft steps
+    with ``draft_params`` (optionally truncated to the first
+    ``draft_layers`` blocks — early-exit self-drafting reads/writes
+    exactly the layers the target shares, so its shallow K/V rows are
+    the target's own) propose the window, one target pass verifies all
+    K+1 positions, and the longest accepted prefix + the correction
+    token commit (section comment above has the exactness and cache-
+    safety arguments). ``toks[i, :ncommit[i]]`` are the committed
+    tokens (-1 beyond); ``drafted``/``accepted`` feed the engine's
+    acceptance metrics and adaptive-K controller as runtime data.
+    ``quantized``/``draft_quantized`` mark the respective param trees;
+    ``kv_mode`` selects the quantized slot pool exactly as
+    make_continuous_decode."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
+    draft_quantized, _ = _resolve_quant(draft_quantized, None)
+    nd = _check_spec(cfg, spec_k, draft_layers)
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    specs = _serving_specs(cfg, quantized)
+    dspecs = _serving_specs(cfg, draft_quantized)
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    k1 = spec_k + 1
+    scale = cfg.d_head ** -0.5
+
+    def draft_phase(dparams, st, pos, tok, act, key):
+        """K sequential draft steps through the ordinary slotted block
+        fns (draft K/V rows land in the live cache; verify rewrites
+        them with target K/V before any of them is attended)."""
+        def dstep(carry, _):
+            st, dpos, dtok = carry
+            h = _embed_pending(dparams, cfg, dpos, dtok)
+            for layer in range(nd):
+                p_l = {kk: vv[layer]
+                       for kk, vv in dparams["blocks"].items()}
+                if kv_mode is None:
+                    h, ck, cv = _local_block_decode_slotted(
+                        h, p_l, st[0], st[1], layer, dpos, act, cfg,
+                        tp, dp)
+                    st = (ck, cv)
+                else:
+                    h, ck, cv, ksc, vsc = _local_block_decode_slotted_q(
+                        h, p_l, *st, layer, dpos, act, cfg, tp, dp,
+                        kv_mode)
+                    st = (ck, cv, ksc, vsc)
+            h = layer_norm(h, dparams["lnfg"], dparams["lnfb"],
+                           cfg.eps)
+            logits = jnp.matmul(h[:, 0],
+                                dparams["Wout"].astype(h.dtype))
+            nxt = _sample_slots(logits, dpos + 1, key, dp, temperature,
+                                top_k, top_p)
+            dtok = jnp.where(act, nxt, dtok)
+            dpos = jnp.where(act, dpos + 1, dpos)
+            return (st, dpos, dtok), nxt
+
+        (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
+                                      length=spec_k)
+        return st, jnp.swapaxes(drafts, 0, 1)            # [Ns, K]
+
+    def verify_phase(params, st, pos, tok, act, drafts, key):
+        """ONE target pass over the K+1-token window [pending,
+        d_1..d_K]: per-layer it rewrites the window's cache rows with
+        target K/V, then attends each window position to s <= pos+j —
+        element-for-element the slotted sequential decode's numerics
+        (same einsum contraction, NEG_INF mask, f32 softmax, scale
+        folds), batched over the window instead of scanned, which is
+        the whole bandwidth win."""
+        g_model = _g_sync("model")
+        ns = tok.shape[0]
+        rows = jnp.arange(ns)
+        dt = cfg.activation_dtype()
+        if kv_mode is None:
+            ck, cv = st
+        else:
+            ck, cv, ksc, vsc = st
+        s_max = ck.shape[2]
+        win = jnp.concatenate([tok[:, None], drafts], axis=1)
+        posw = pos[:, None] + jnp.arange(k1, dtype=pos.dtype)[None, :]
+        wp = jnp.clip(posw, 0, s_max - 1)
+        h = (params["embed"].astype(dt)[win]
+             + params["pos"].astype(dt)[
+                 jnp.clip(posw, 0, cfg.max_len - 1)])
+        for layer in range(cfg.n_layers):
+            p = {kk: vv[layer] for kk, vv in params["blocks"].items()}
+            x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+            q = jnp.matmul(x, p["Wq"].astype(x.dtype)) \
+                .reshape(ns, k1, h_loc, cfg.d_head)
+            kw = jnp.matmul(x, p["Wk"].astype(x.dtype))  # [Ns,K1,Dl]
+            vw = jnp.matmul(x, p["Wv"].astype(x.dtype))
+            # window-row rewrite: inactive slots rewrite their current
+            # rows with themselves (the static-scatter trick);
+            # positions past the cache drop (mode="drop" — they can
+            # only be beyond the slot's budget, never committed)
+            if kv_mode is None:
+                k_wr = jnp.where(act[:, None, None],
+                                 kw.astype(ck.dtype),
+                                 ck[layer][rows[:, None], wp])
+                v_wr = jnp.where(act[:, None, None],
+                                 vw.astype(cv.dtype),
+                                 cv[layer][rows[:, None], wp])
+                ck = ck.at[layer, rows[:, None], posw].set(
+                    k_wr, mode="drop")
+                cv = cv.at[layer, rows[:, None], posw].set(
+                    v_wr, mode="drop")
+                kh = ck[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+                vh = cv[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+                sc = jnp.einsum("bthd,bshd->bhts", q, kh) \
+                    .astype(jnp.float32) * scale
+                sc = jnp.where(jnp.arange(s_max)[None, None, None, :]
+                               <= wp[:, None, :, None], sc, NEG_INF)
+                pr = jax.nn.softmax(sc, axis=-1)
+                a = jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype),
+                               vh)
+            else:
+                from deeplearning4j_tpu.quant.kv import quantize_rows
+                kq, ksr = quantize_rows(kw, kv_mode)
+                vq, vsr = quantize_rows(vw, kv_mode)
+                k_wr = jnp.where(act[:, None, None], kq,
+                                 ck[layer][rows[:, None], wp])
+                v_wr = jnp.where(act[:, None, None], vq,
+                                 cv[layer][rows[:, None], wp])
+                ks_wr = jnp.where(act[:, None], ksr,
+                                  ksc[layer][rows[:, None], wp, 0])
+                vs_wr = jnp.where(act[:, None], vsr,
+                                  vsc[layer][rows[:, None], wp, 0])
+                ck = ck.at[layer, rows[:, None], posw].set(
+                    k_wr, mode="drop")
+                cv = cv.at[layer, rows[:, None], posw].set(
+                    v_wr, mode="drop")
+                ksc = ksc.at[layer, rows[:, None], posw, 0].set(
+                    ks_wr, mode="drop")
+                vsc = vsc.at[layer, rows[:, None], posw, 0].set(
+                    vs_wr, mode="drop")
+                kh = ck[layer].astype(jnp.float32) \
+                    .reshape(ns, s_max, h_loc, cfg.d_head)
+                vh = cv[layer].astype(jnp.float32) \
+                    .reshape(ns, s_max, h_loc, cfg.d_head)
+                sc = jnp.einsum("bthd,bshd->bhts",
+                                q.astype(jnp.float32), kh) \
+                    * ksc[layer, :, :, 0][:, None, None, :] * scale
+                sc = jnp.where(jnp.arange(s_max)[None, None, None, :]
+                               <= wp[:, None, :, None], sc, NEG_INF)
+                pr = jax.nn.softmax(sc, axis=-1)
+                a = jnp.einsum("bhts,bshd->bthd",
+                               pr * vsc[layer, :, :, 0][:, None,
+                                                        None, :],
+                               vh).astype(x.dtype)
+            h = h + g_model(jnp.matmul(a.reshape(ns, k1, d_loc),
+                                       p["Wo"].astype(h.dtype)))
+            x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+            h = _local_mlp(h, x, p, cfg, dp, g_model)
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(h, params["Wout"].astype(h.dtype))
+        tgt = _sample_slots(
+            logits.reshape(ns * k1, logits.shape[-1]),
+            (posw + 1).reshape(-1), key, dp, temperature, top_k,
+            top_p).reshape(ns, k1)
+        st = (ck, cv) if kv_mode is None else (ck, cv, ksc, vsc)
+        return st, tgt
+
+    def body(params, dparams, st, pos, tok, active, rem, poison, key):
+        act = active & (rem > 0)
+        st, drafts = draft_phase(dparams, st, pos, tok, act, key)
+        # deterministic draft poisoning (runtime data): (d+1) mod V is
+        # guaranteed to differ from the model's own proposal, so
+        # verification MUST reject — the fault-injection proof that a
+        # bad draft pass cannot corrupt committed state
+        drafts = jnp.where(poison[:, None],
+                           (drafts + 1) % cfg.vocab_size, drafts)
+        st, tgt = verify_phase(params, st, pos, tok, act, drafts, key)
+        pos, tok, rem, emit, c, drafted, accepted = \
+            _spec_accept_commit(spec_k, drafts, tgt, pos, tok, rem,
+                                act)
+        return st, pos, tok, emit, c, drafted, accepted
+
+    if kv_mode is None:
+        def run(params, dparams, ck, cv, pos, tok, active, rem,
+                poison, key):
+            st, pos, tok, emit, c, drafted, accepted = body(
+                params, dparams, (ck, cv), pos, tok, active, rem,
+                poison, key)
+            return (*st, pos, tok, emit, c, drafted, accepted)
+
+        in_specs = (specs, dspecs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+    else:
+        def run(params, dparams, ck, cv, ksc, vsc, pos, tok, active,
+                rem, poison, key):
+            st, pos, tok, emit, c, drafted, accepted = body(
+                params, dparams, (ck, cv, ksc, vsc), pos, tok, active,
+                rem, poison, key)
+            return (*st, pos, tok, emit, c, drafted, accepted)
+
+        in_specs = (specs, dspecs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
+    return jax.jit(sharded)
+
+
+def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
+                                  spec_k: int, num_slots: int,
+                                  page_size: int, max_pages: int,
+                                  num_pages: int,
+                                  temperature: float = 0.0,
+                                  top_k: int = 0, top_p: float = 1.0,
+                                  quantized=None, kv_mode=None,
+                                  draft_quantized=None,
+                                  draft_layers: int = 0):
+    """Paged-pool speculative round: make_speculative_decode's
+    contract with the block table as runtime data — (params,
+    draft_params, kp, vp[, kscale, vscale], pos, tok, bt, active, rem,
+    poison, key) -> (state', toks, ncommit, drafted, accepted).
+    Draft steps go through _local_block_decode_paged(_q); the verify
+    window's K/V rows land at (bt[slot, pos_j // ps], pos_j % ps),
+    with inactive slots and positions past the slot's mapped pages
+    routed to the scratch page (never attended). The engine's
+    copy-on-write guard privatizes the whole window's pages before
+    the call, so speculative writes are COW-safe by construction."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    tp = _check_paged_mesh(cfg, mesh, top_k, top_p, page_size,
+                           num_pages, max_pages)
+    dp = 1
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
+    draft_quantized, _ = _resolve_quant(draft_quantized, None)
+    nd = _check_spec(cfg, spec_k, draft_layers)
+    specs = _serving_specs(cfg, quantized)
+    dspecs = _serving_specs(cfg, draft_quantized)
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    k1 = spec_k + 1
+    s_view = max_pages * page_size
+    scale = cfg.d_head ** -0.5
+
+    def draft_phase(dparams, st, bt, pos, tok, act, key):
+        def dstep(carry, _):
+            st, dpos, dtok = carry
+            h = _embed_pending(dparams, cfg, dpos, dtok)
+            for layer in range(nd):
+                p_l = {kk: vv[layer]
+                       for kk, vv in dparams["blocks"].items()}
+                if kv_mode is None:
+                    h, kp, vp = _local_block_decode_paged(
+                        h, p_l, st[0], st[1], bt, layer, dpos, act,
+                        cfg, tp, dp, page_size)
+                    st = (kp, vp)
+                else:
+                    h, kp, vp, ksc, vsc = _local_block_decode_paged_q(
+                        h, p_l, *st, bt, layer, dpos, act, cfg, tp,
+                        dp, page_size, kv_mode)
+                    st = (kp, vp, ksc, vsc)
+            h = layer_norm(h, dparams["lnfg"], dparams["lnfb"],
+                           cfg.eps)
+            logits = jnp.matmul(h[:, 0],
+                                dparams["Wout"].astype(h.dtype))
+            nxt = _sample_slots(logits, dpos + 1, key, dp, temperature,
+                                top_k, top_p)
+            dtok = jnp.where(act, nxt, dtok)
+            dpos = jnp.where(act, dpos + 1, dpos)
+            return (st, dpos, dtok), nxt
+
+        (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
+                                      length=spec_k)
+        return st, jnp.swapaxes(drafts, 0, 1)
+
+    def verify_phase(params, st, bt, pos, tok, act, drafts, key):
+        g_model = _g_sync("model")
+        ns = tok.shape[0]
+        mp = bt.shape[1]
+        dt = cfg.activation_dtype()
+        if kv_mode is None:
+            kp, vp = st
+        else:
+            kp, vp, ksc, vsc = st
+        win = jnp.concatenate([tok[:, None], drafts], axis=1)
+        posw = pos[:, None] + jnp.arange(k1, dtype=pos.dtype)[None, :]
+        wp = jnp.clip(posw, 0, s_view - 1)
+        # write routing: inactive slots and positions past the block
+        # table land on the scratch page (page 0), like the paged
+        # decode/prefill write paths
+        lp = jnp.clip(posw // page_size, 0, mp - 1)
+        pgw = jnp.where(act[:, None] & (posw < s_view),
+                        jnp.take_along_axis(bt, lp, axis=1), 0)
+        offw = posw % page_size
+        h = (params["embed"].astype(dt)[win]
+             + params["pos"].astype(dt)[
+                 jnp.clip(posw, 0, cfg.max_len - 1)])
+        for layer in range(cfg.n_layers):
+            p = {kk: vv[layer] for kk, vv in params["blocks"].items()}
+            x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+            q = jnp.matmul(x, p["Wq"].astype(x.dtype)) \
+                .reshape(ns, k1, h_loc, cfg.d_head)
+            kw = jnp.matmul(x, p["Wk"].astype(x.dtype))
+            vw = jnp.matmul(x, p["Wv"].astype(x.dtype))
+            if kv_mode is None:
+                kp = kp.at[layer, pgw, offw].set(kw.astype(kp.dtype))
+                vp = vp.at[layer, pgw, offw].set(vw.astype(vp.dtype))
+                kh = _gather_pages(kp[layer], bt, ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                vh = _gather_pages(vp[layer], bt, ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                sc = jnp.einsum("bthd,bshd->bhts", q, kh) \
+                    .astype(jnp.float32) * scale
+                sc = jnp.where(jnp.arange(s_view)[None, None, None, :]
+                               <= wp[:, None, :, None], sc, NEG_INF)
+                pr = jax.nn.softmax(sc, axis=-1)
+                a = jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype),
+                               vh)
+            else:
+                from deeplearning4j_tpu.quant.kv import quantize_rows
+                kq, ksr = quantize_rows(kw, kv_mode)
+                vq, vsr = quantize_rows(vw, kv_mode)
+                kp = kp.at[layer, pgw, offw].set(kq)
+                vp = vp.at[layer, pgw, offw].set(vq)
+                ksc = ksc.at[layer, pgw, offw, 0].set(ksr)
+                vsc = vsc.at[layer, pgw, offw, 0].set(vsr)
+                kh = _gather_pages(kp[layer].astype(jnp.float32), bt,
+                                   ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                vh = _gather_pages(vp[layer].astype(jnp.float32), bt,
+                                   ns, s_view) \
+                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                ksg = _gather_pages(ksc[layer], bt, ns, s_view)[..., 0]
+                vsg = _gather_pages(vsc[layer], bt, ns, s_view)[..., 0]
+                sc = jnp.einsum("bthd,bshd->bhts",
+                                q.astype(jnp.float32), kh) \
+                    * ksg[:, None, None, :] * scale
+                sc = jnp.where(jnp.arange(s_view)[None, None, None, :]
+                               <= wp[:, None, :, None], sc, NEG_INF)
+                pr = jax.nn.softmax(sc, axis=-1)
+                a = jnp.einsum("bhts,bshd->bthd",
+                               pr * vsg[:, None, None, :], vh) \
+                    .astype(x.dtype)
+            h = h + g_model(jnp.matmul(a.reshape(ns, k1, d_loc),
+                                       p["Wo"].astype(h.dtype)))
+            x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+            h = _local_mlp(h, x, p, cfg, dp, g_model)
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        logits = jnp.matmul(h, params["Wout"].astype(h.dtype))
+        tgt = _sample_slots(
+            logits.reshape(ns * k1, logits.shape[-1]),
+            (posw + 1).reshape(-1), key, dp, temperature, top_k,
+            top_p).reshape(ns, k1)
+        st = (kp, vp) if kv_mode is None else (kp, vp, ksc, vsc)
+        return st, tgt
+
+    def body(params, dparams, st, pos, tok, bt, active, rem, poison,
+             key):
+        act = active & (rem > 0)
+        st, drafts = draft_phase(dparams, st, bt, pos, tok, act, key)
+        drafts = jnp.where(poison[:, None],
+                           (drafts + 1) % cfg.vocab_size, drafts)
+        st, tgt = verify_phase(params, st, bt, pos, tok, act, drafts,
+                               key)
+        pos, tok, rem, emit, c, drafted, accepted = \
+            _spec_accept_commit(spec_k, drafts, tgt, pos, tok, rem,
+                                act)
+        return st, pos, tok, emit, c, drafted, accepted
+
+    if kv_mode is None:
+        def run(params, dparams, kp, vp, pos, tok, bt, active, rem,
+                poison, key):
+            st, pos, tok, emit, c, drafted, accepted = body(
+                params, dparams, (kp, vp), pos, tok, bt, active, rem,
+                poison, key)
+            return (*st, pos, tok, emit, c, drafted, accepted)
+
+        in_specs = (specs, dspecs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                    P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
+                     _PAGE_VEC_SPEC, P(None, None), _PAGE_VEC_SPEC,
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+    else:
+        def run(params, dparams, kp, vp, ksc, vsc, pos, tok, bt,
+                active, rem, poison, key):
+            st, pos, tok, emit, c, drafted, accepted = body(
+                params, dparams, (kp, vp, ksc, vsc), pos, tok, bt,
+                active, rem, poison, key)
+            return (*st, pos, tok, emit, c, drafted, accepted)
+
+        in_specs = (specs, dspecs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                    P())
+        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                     _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)

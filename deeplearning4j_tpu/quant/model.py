@@ -133,6 +133,58 @@ def shard_quantized_serving_params(params_q: Dict[str, Any], cfg,
         params_q, specs_q)
 
 
+def draft_tree(params: Dict[str, Any], draft: str, cfg, mesh: Mesh,
+               base_mode: Optional[str] = None):
+    """Build the DRAFT param tree for self-speculative decoding
+    (serving/engine.py `EngineConfig(draft=)`), from the engine's
+    live serving tree. Returns (draft_params, draft_quantized,
+    draft_layers):
+
+    - ``"int8"`` (the default drafter) — the int8-quantized weight
+      tree: quantize the live float tree on the mesh (scales shard
+      with their channels via `shard_quantized_serving_params`). When
+      the engine is ALREADY weight-quantized the live tree IS the
+      cheap drafter — it is shared, not re-quantized (requantizing
+      quantized values would compound error), so draft == target and
+      greedy acceptance is 100% by construction.
+    - ``"self"`` — the target tree itself (zero extra HBM; acceptance
+      is 100% at any temperature — the exactness-test drafter, and
+      the honest baseline for measuring pure verify-batching wins).
+    - ``"layers:N"`` — early-exit self-drafting: the SAME tree run
+      through only its first N blocks + the final norm/output head.
+      Shallow layers' K/V are bit-identical to the target's own, so
+      draft cache writes cost nothing to correctness; draft step cost
+      scales ~N/L.
+    """
+    draft = str(draft)
+    if draft == "self":
+        return params, base_mode, 0
+    if draft.startswith("layers:"):
+        try:
+            n = int(draft.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(f"malformed draft spec {draft!r}: "
+                             "expected 'layers:<int>'")
+        if not 0 < n <= cfg.n_layers:
+            raise ValueError(f"draft layers {n} out of "
+                             f"(0, {cfg.n_layers}]")
+        return params, base_mode, n
+    try:
+        mode = resolve_mode(draft)
+    except ValueError:
+        mode = None
+    if mode is None:
+        raise ValueError(f"unknown draft spec {draft!r}: expected "
+                         "'int8'/'fp8', 'self', or 'layers:N'")
+    if base_mode is not None:
+        # the engine's weights are already quantized — they ARE the
+        # cheap drafter; share the tree
+        return params, base_mode, 0
+    qp = quantize_params(params, mode=mode)
+    return (shard_quantized_serving_params(qp, cfg, mesh, mode=mode),
+            mode, 0)
+
+
 def param_bytes(tree) -> int:
     """At-rest bytes of a param tree (quantized or float): the sum of
     every leaf's nbytes — QuantizedTensor nodes contribute values AND

@@ -127,6 +127,25 @@ gauges, `serving_prefix_cache_{hits,misses,evictions}_total` +
 prefix-cache stats in `debugz()`. See docs/serving.md "Paged KV &
 prefix sharing".
 
+Speculative decoding (round 13, ISSUE-8, `EngineConfig(spec_decode=,
+spec_k=, draft=, spec_adaptive=)`; continuous mode, dense configs):
+each decode chunk becomes a speculative ROUND — K draft-model steps
+(int8-quantized tree by default, or the target itself / an early-exit
+truncation) propose tokens per slot, ONE target pass verifies all K+1
+window positions, and the longest accepted prefix + the target's
+correction token commit. Position-keyed sampling makes verification
+deterministic, so the speculative engine is TOKEN-EXACT vs the
+non-speculative one at any temperature, float/int8 KV, contiguous or
+paged (speculative writes are COW-privatized; rejected rows sit past
+the committed position and are never attended). Per-slot acceptance
+EMAs drive an adaptive K over a closed compiled-program set, with a
+plain-decode fallback + re-probe so adversarial traffic converges to
+plain throughput. `decode_chunk` trace events carry
+`drafted=`/`accepted=`, `draft_rejected` marks all-rejected rounds,
+and `serving_spec_*` metrics cover totals/ratio/current-K. The
+`draft_poison_at` injector knob proves a poisoned draft pass cannot
+corrupt committed KV. See docs/serving.md "Speculative decoding".
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -151,14 +170,11 @@ from deeplearning4j_tpu.observability.events import (FlightRecorder,
 from deeplearning4j_tpu.observability.metrics import (
     DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
 from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
-from deeplearning4j_tpu.parallel.serving import (init_paged_state,
-                                                 init_slot_state,
-                                                 make_continuous_decode,
-                                                 make_continuous_prefill,
-                                                 make_paged_decode,
-                                                 make_paged_prefill,
-                                                 make_parallel_generate,
-                                                 shard_serving_params)
+from deeplearning4j_tpu.parallel.serving import (
+    init_paged_state, init_slot_state, make_continuous_decode,
+    make_continuous_prefill, make_paged_decode, make_paged_prefill,
+    make_paged_speculative_decode, make_parallel_generate,
+    make_speculative_decode, shard_serving_params)
 from deeplearning4j_tpu.serving.paging import (PageAllocator,
                                                RadixPrefixCache,
                                                pages_for)
@@ -252,6 +268,27 @@ class EngineConfig:
     page_size: int = 16
     kv_pages: int = 0                # 0 = full provisioning
     prefix_cache: bool = True        # only meaningful with paged=True
+    # speculative decoding (ISSUE-8, continuous mode, dense configs).
+    # ``spec_decode`` replaces each slot's decode chunk with a
+    # speculative ROUND: K draft-model steps propose tokens, ONE
+    # target pass verifies all K+1 window positions and commits the
+    # longest accepted prefix + the correction token — token-EXACT vs
+    # the non-speculative engine at any temperature (position-keyed
+    # sampling makes verification deterministic; docs/serving.md).
+    # ``spec_k`` is the max draft length; the adaptive controller
+    # walks K over {spec_k, spec_k/2, ..., 1} (a closed set riding the
+    # compiled-program caches) from the pool's acceptance EMA, and
+    # falls back to PLAIN decode for a cooldown when even K=1 doesn't
+    # pay — adversarial traffic never underperforms plain decode by
+    # more than the probe overhead. ``draft`` picks the drafter:
+    # "int8" (default: the int8-quantized weight tree — free when the
+    # engine is already weight-quantized), "self" (the target tree —
+    # 100% acceptance, the exactness/bench baseline), or "layers:N"
+    # (early-exit through the first N blocks — cheapest draft FLOPs).
+    spec_decode: bool = False
+    spec_k: int = 4
+    draft: str = "int8"
+    spec_adaptive: bool = True       # False pins K at spec_k
 
 
 class RequestHandle:
@@ -402,6 +439,45 @@ def _compiled_paged_decode(cfg_fields: tuple, mesh, chunk: int,
                              kv_mode=kv_mode)
 
 
+@lru_cache(maxsize=64)
+def _compiled_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
+                          num_slots: int, temperature: float,
+                          top_k: int, top_p: float, quantized=None,
+                          kv_mode=None, draft_quantized=None,
+                          draft_layers: int = 0):
+    """Compiled-program cache for the speculative round: one entry per
+    (K, num_slots, quant modes, drafter shape). The adaptive
+    controller only ever visits K in {spec_k, spec_k/2, .., 1}, so
+    steady-state acceptance variance walks a CLOSED set of entries —
+    never a recompile."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_speculative_decode(cfg, mesh, spec_k, num_slots,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p,
+                                   quantized=quantized,
+                                   kv_mode=kv_mode,
+                                   draft_quantized=draft_quantized,
+                                   draft_layers=draft_layers)
+
+
+@lru_cache(maxsize=64)
+def _compiled_paged_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
+                                num_slots: int, page_size: int,
+                                max_pages: int, num_pages: int,
+                                temperature: float, top_k: int,
+                                top_p: float, quantized=None,
+                                kv_mode=None, draft_quantized=None,
+                                draft_layers: int = 0):
+    """Paged twin of _compiled_spec_decode (block tables, acceptance,
+    and poison masks are all runtime data)."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_speculative_decode(
+        cfg, mesh, spec_k, num_slots, page_size, max_pages, num_pages,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        quantized=quantized, kv_mode=kv_mode,
+        draft_quantized=draft_quantized, draft_layers=draft_layers)
+
+
 @lru_cache(maxsize=8)
 def _compiled_page_copy(n_pool_arrays: int):
     """Copy one physical page (all layers, values + scales) — the
@@ -525,6 +601,34 @@ class InferenceEngine:
         else:
             self._prefix_cache = None
         self._params = shard_serving_params(params, cfg, mesh)
+        # speculative decoding (ISSUE-8): draft K tokens per slot with
+        # a cheap drafter, verify them in ONE target pass, commit the
+        # longest accepted prefix — token-exact vs plain decode. The
+        # drafter tree is derived from the LIVE params (and re-derived
+        # on every hot reload); acceptance state drives the adaptive-K
+        # controller (_spec_update).
+        self._spec = bool(self.config.spec_decode)
+        self._draft_params = None
+        if self._spec:
+            if not self._continuous:
+                raise ValueError(
+                    "spec_decode requires mode='continuous' (batch "
+                    "mode has no persistent slot state to verify "
+                    "against)")
+            if cfg.n_experts > 0:
+                raise ValueError(
+                    "spec_decode does not support MoE configs (the "
+                    "verify pass's token count changes the expert-"
+                    "capacity cap — see parallel/serving.py)")
+            self._spec_k = int(self.config.spec_k)
+            if self._spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got "
+                                 f"{self._spec_k}")
+            self._rebuild_draft()
+            self._spec_cur_k = self._spec_k
+            self._spec_plain = 0          # plain-decode cooldown ticks
+            self._accept_ema = [1.0] * self._num_slots
+            self._accept_pool = 1.0       # engine-wide acceptance EMA
         self._injector = fault_injector
         self._clock = clock
         self._lock = threading.RLock()
@@ -665,6 +769,27 @@ class InferenceEngine:
                 "serving_prefix_shared_tokens",
                 "Prompt tokens whose prefill compute AND KV bytes "
                 "were served from the radix prefix cache")
+        # speculative decoding (ISSUE-8): registered only on spec
+        # engines, so non-speculative scrapes are byte-unchanged
+        if self._spec:
+            self._m_spec_drafted = r.counter(
+                "serving_spec_drafted_tokens",
+                "Draft tokens proposed by speculative decode rounds")
+            self._m_spec_accepted = r.counter(
+                "serving_spec_accepted_tokens",
+                "Draft tokens accepted by target-model verification")
+            r.gauge("serving_spec_acceptance_ratio",
+                    "Cumulative accepted/drafted draft-token ratio"
+                    ).set_function(lambda: (
+                        float(self._m_spec_accepted.value)
+                        / max(1.0,
+                              float(self._m_spec_drafted.value))))
+            r.gauge("serving_spec_k",
+                    "Adaptive draft length in use (0 while the "
+                    "controller has fallen back to plain decode)"
+                    ).set_function(lambda: float(
+                        0 if self._spec_plain > 0
+                        else self._spec_cur_k))
 
     # ------------------------------------------------------------------
     # HBM accounting (quant subsystem; backs the serving_param_bytes /
@@ -1065,6 +1190,12 @@ class InferenceEngine:
                     hit = seated
                 free.pop(0)
                 self._slots[i] = r
+                if self._spec:
+                    # seat with the engine's CURRENT belief, not blind
+                    # optimism: under adversarial traffic a stream of
+                    # fresh admissions must not drag the pool EMA back
+                    # up and re-trigger expensive high-K rounds
+                    self._accept_ema[i] = self._accept_pool
                 r.status = RequestStatus.RUNNING
                 r._in_flight = True
                 self._m_in_flight.inc()
@@ -1201,7 +1332,13 @@ class InferenceEngine:
         if prefill:
             return getattr(r, "_page_start", 0), plen
         lo = plen - 1
-        return lo, min(lo + self._chunk,
+        span = self._chunk
+        if self._spec and self._spec_plain == 0:
+            # a speculative round writes the whole K+1-token verify
+            # window (rejected rows included) — the COW guard must
+            # privatize every page it can touch
+            span = self._spec_cur_k + 1
+        return lo, min(lo + span,
                        int(r.prompt.shape[0]) + r.max_new_tokens)
 
     def _ensure_writable(self, entries, prefill: bool) -> None:
@@ -1475,6 +1612,9 @@ class InferenceEngine:
         self._reap()
 
     def _decode_chunk_slots(self, occupied, params) -> None:
+        if self._spec and self._spec_tick():
+            self._decode_spec_slots(occupied, params)
+            return
         call = (self._call_chunk_paged if self._paged
                 else self._call_chunk)
         state, toks = call(params, self._slot_state, occupied)
@@ -1489,6 +1629,194 @@ class InferenceEngine:
                                 "decode_chunk", slot=i)
             if r.generated.shape[0] >= r.max_new_tokens:
                 self._complete(r)
+
+    # ------------------------------------------------------------------
+    # speculative decoding (ISSUE-8)
+    # ------------------------------------------------------------------
+    def _rebuild_draft(self) -> None:
+        """(Re)derive the drafter tree from the live serving params —
+        at construction and after every hot reload (a drafter built
+        from stale weights would tank acceptance AND, worse, silently
+        look healthy)."""
+        from deeplearning4j_tpu.quant.model import draft_tree
+        (self._draft_params, self._draft_qmode,
+         self._draft_layers) = draft_tree(self._params,
+                                          self.config.draft, self.cfg,
+                                          self.mesh,
+                                          base_mode=self._qmode)
+
+    def _spec_tick(self) -> bool:
+        """Whether THIS tick decodes speculatively; advances the
+        plain-decode cooldown the controller imposes when even K=1
+        doesn't pay, probing with K=1 when it expires."""
+        if self._spec_plain > 0:
+            self._spec_plain -= 1
+            if self._spec_plain == 0:
+                self._spec_cur_k = 1
+            return False
+        return True
+
+    def _decode_spec_slots(self, occupied, params) -> None:
+        """One speculative round over the occupied slots: commit each
+        slot's accepted prefix + correction token (1..K+1 tokens), feed
+        acceptance to the metrics and the adaptive-K controller, and
+        stamp `decode_chunk{drafted, accepted}` (plus `draft_rejected`
+        on all-rejected rounds) into the flight recorder."""
+        call = (self._call_spec_paged if self._paged
+                else self._call_spec)
+        state, toks, nc, drafted, accepted, poison = call(
+            params, self._slot_state, occupied)
+        self._slot_state = state
+        for i, r in occupied:
+            with self._lock:
+                if self._slots[i] is not r:   # preempted by a reload
+                    continue
+            d_i, a_i = int(drafted[i]), int(accepted[i])
+            self._m_spec_drafted.inc(d_i)
+            self._m_spec_accepted.inc(a_i)
+            if d_i and a_i == 0:
+                r.trace.add("draft_rejected",
+                            step=self._step_counter - 1, drafted=d_i,
+                            poisoned=bool(poison[i]))
+            need = min(int(nc[i]),
+                       r.max_new_tokens - r.generated.shape[0])
+            self._commit_tokens(r, toks[i, :need].astype(np.int32),
+                                "decode_chunk", slot=i, drafted=d_i,
+                                accepted=a_i)
+            if r.generated.shape[0] >= r.max_new_tokens:
+                self._complete(r)
+        self._spec_update(occupied, drafted, accepted, poison)
+
+    def _spec_poison(self, entries) -> np.ndarray:
+        """ServingFaultInjector.draft_poison_at hook: mark the named
+        request's slot so the compiled round derails its drafts on
+        device (runtime data — no recompile)."""
+        poison = np.zeros((self._num_slots,), bool)
+        inj = self._injector
+        if inj is None or not hasattr(inj, "check_draft_poison"):
+            return poison
+        rid = inj.check_draft_poison(self._step_counter)
+        if rid is None:
+            return poison
+        for i, r in entries:
+            if r.rid == rid:
+                poison[i] = True
+                inj.drafts_poisoned += 1
+                log.warning("injected draft poison: request %d "
+                            "(slot %d) at step %d", rid, i,
+                            self._step_counter)
+        return poison
+
+    def _call_spec(self, params, state, entries):
+        """One guarded speculative round over the CONTIGUOUS pool.
+        Returns (state', toks [Ns, K+1], ncommit, drafted, accepted,
+        poison)."""
+        active = np.zeros((self._num_slots,), bool)
+        rem = np.zeros((self._num_slots,), np.int32)
+        for i, r in entries:
+            active[i] = True
+            rem[i] = r.max_new_tokens - r.generated.shape[0]
+        poison = self._spec_poison(entries)
+        fn = _compiled_spec_decode(astuple(self.cfg), self.mesh,
+                                   self._spec_cur_k, self._num_slots,
+                                   float(self.config.temperature),
+                                   int(self.config.top_k),
+                                   float(self.config.top_p),
+                                   draft_quantized=self._draft_qmode,
+                                   draft_layers=self._draft_layers,
+                                   **self._quant_kwargs())
+        key = self._root_key()
+        n_state = len(state)
+        dparams = self._draft_params
+
+        def call():
+            o = fn(params, dparams, *state, active, rem, poison, key)
+            return (tuple(o[:n_state]),
+                    *(np.asarray(x) for x in o[n_state:n_state + 4]))
+
+        state, toks, nc, drafted, accepted = self._guarded(
+            call, [r for _, r in entries], self._m_step_seconds)
+        return state, toks, nc, drafted, accepted, poison
+
+    def _call_spec_paged(self, params, state, entries):
+        """Paged speculative round: the copy-on-write guard privatizes
+        the whole K+1-row write window before the call (speculative
+        writes must never land on a shared page), then the block table
+        rides as runtime data."""
+        with self._lock:
+            self._ensure_writable(entries, prefill=False)
+            self._maybe_corrupt_page(entries, prefill=False)
+            bt = self._bt.copy()
+            state = self._slot_state
+        active = np.zeros((self._num_slots,), bool)
+        rem = np.zeros((self._num_slots,), np.int32)
+        for i, r in entries:
+            active[i] = True
+            rem[i] = r.max_new_tokens - r.generated.shape[0]
+        poison = self._spec_poison(entries)
+        fn = _compiled_paged_spec_decode(
+            astuple(self.cfg), self.mesh, self._spec_cur_k,
+            self._num_slots, self._page_size, self._max_pages,
+            self._num_pages, float(self.config.temperature),
+            int(self.config.top_k), float(self.config.top_p),
+            draft_quantized=self._draft_qmode,
+            draft_layers=self._draft_layers, **self._quant_kwargs())
+        key = self._root_key()
+        n_state = len(state)
+        dparams = self._draft_params
+
+        def call():
+            o = fn(params, dparams, *state, bt, active, rem, poison,
+                   key)
+            return (tuple(o[:n_state]),
+                    *(np.asarray(x) for x in o[n_state:n_state + 4]))
+
+        state, toks, nc, drafted, accepted = self._guarded(
+            call, [r for _, r in entries], self._m_step_seconds)
+        return state, toks, nc, drafted, accepted, poison
+
+    def _spec_update(self, occupied, drafted, accepted,
+                     poison) -> None:
+        """Adaptive-K controller: per-slot acceptance EMAs (surfaced
+        in debugz) drive one global K over the closed set {spec_k,
+        spec_k/2, .., 1} — halve when the pool's acceptance stops
+        paying, double back when it recovers, and drop to PLAIN decode
+        for a cooldown when even K=1 is a loss, so adversarial
+        (low-acceptance) traffic converges to plain-decode throughput
+        instead of underperforming it. A poisoned round bypasses the
+        EMA and falls straight back to K=1."""
+        if bool(np.asarray(poison).any()):
+            self._spec_cur_k = 1
+            return
+        if not self.config.spec_adaptive:
+            return
+        sampled = [i for i, _ in occupied if drafted[i] > 0]
+        if not sampled:
+            return
+        for i in sampled:
+            ratio = float(accepted[i]) / float(drafted[i])
+            # pessimistic-fast, optimistic-slow: a drop takes effect
+            # IMMEDIATELY (every round at an oversized K is wasted
+            # draft compute), recovery averages in over rounds
+            self._accept_ema[i] = min(
+                ratio, 0.5 * self._accept_ema[i] + 0.5 * ratio)
+        pool = float(np.mean([self._accept_ema[i] for i in sampled]))
+        self._accept_pool = pool
+        k = self._spec_cur_k
+        if pool < 0.2:
+            # not paying at all: collapse straight to K=1, and from
+            # K=1 to plain decode for a cooldown (then re-probe at 1).
+            # The cooldown is long relative to a chunk: a probe tick
+            # commits ~1 token where a plain chunk tick commits
+            # `chunk`, so probe frequency IS the adversarial floor
+            if k == 1:
+                self._spec_plain = 24
+            else:
+                self._spec_cur_k = 1
+        elif pool < 0.45 and k > 1:
+            self._spec_cur_k = max(1, k // 2)
+        elif pool > 0.8 and k < self._spec_k:
+            self._spec_cur_k = min(self._spec_k, k * 2)
 
     def _reap(self, shed: bool = False) -> None:
         """Free slots whose request reached a terminal state; with
@@ -1801,6 +2129,19 @@ class InferenceEngine:
                          "shared_tokens": int(
                              self._m_prefix_shared_tokens.value)}
                         if self._prefix_cache is not None else None)}
+        if self._spec:
+            out["spec"] = {
+                "spec_k": self._spec_k,
+                "k": (0 if self._spec_plain > 0
+                      else self._spec_cur_k),
+                "plain_cooldown": self._spec_plain,
+                "draft": self.config.draft,
+                "draft_layers": self._draft_layers,
+                "accept_ema": {i: round(self._accept_ema[i], 3)
+                               for i, r in enumerate(self._slots)
+                               if r is not None},
+                "drafted": int(self._m_spec_drafted.value),
+                "accepted": int(self._m_spec_accepted.value)}
         return out
 
     def slo_report(self) -> dict:
@@ -1836,6 +2177,7 @@ class InferenceEngine:
                     "quantize": self._qmode,
                     "kv_quantize": self._kv_mode,
                     "paged": self._paged,
+                    "spec_decode": self._spec,
                     **dict(self.stats)}
 
     def ready(self) -> bool:
@@ -1913,6 +2255,10 @@ class InferenceEngine:
                         self._m_prefix_evictions.inc(flushed)
                         log.info("weight reload flushed %d prefix-"
                                  "cache entries", flushed)
+            if self._spec:
+                # the drafter encodes the OLD weights: re-derive it
+                # from the freshly loaded tree (re-quantize / re-share)
+                self._rebuild_draft()
             if preempted:
                 self._m_preempted.inc(preempted)
                 log.info("weight reload preempted %d in-flight "

@@ -89,6 +89,91 @@ def test_fallback_when_unavailable(monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.parametrize("stacked", [False, True])
+def test_kernel_vector_pos_matches_per_row_reference(interpret_mode,
+                                                     stacked):
+    """PER-ROW positions (the slotted/paged call sites: every slot is
+    at its OWN prefix) must equal the per-row scalar reference — on
+    the kernel path, where the second prefetched scalar bounds each
+    batch block's DMA at its furthest row."""
+    b, h, dh, s = 4, 4, 16, 512
+    pos = np.array([3, 255, 256, 500], np.int32)
+    if stacked:
+        L = 2
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(kq, (b, h, dh), jnp.float32)
+        k = jax.random.normal(kk, (L, b, s, h * dh), jnp.float32)
+        v = jax.random.normal(kv, (L, b, s, h * dh), jnp.float32)
+        out = decode_attention(q, k, v, jnp.asarray(pos), n_heads=h,
+                               layer=1)
+        k, v = k[1], v[1]
+    else:
+        q, k, v = _mk(b, h, dh, s, jnp.float32, seed=10)
+        out = decode_attention(q, k, v, jnp.asarray(pos), n_heads=h)
+    for i in range(b):
+        ref = reference_decode_attention(q[i:i + 1], k[i:i + 1],
+                                         v[i:i + 1], int(pos[i]),
+                                         n_heads=h)
+        np.testing.assert_allclose(np.asarray(out[i:i + 1]),
+                                   np.asarray(ref), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_vector_pos_reference_matches_slotted_formula():
+    """The fused slotted decode call site (parallel/serving.py
+    `_local_block_decode_slotted`) replaced a hand-rolled masked
+    softmax with decode_attention(pos_vector) — the PORTED parity
+    assertion: both formulations bit-agree on the jnp path."""
+    b, h, dh, s = 3, 4, 16, 96
+    q, k, v = _mk(b, h, dh, s, jnp.float32, seed=5)
+    pos = jnp.asarray([0, 40, 95], jnp.int32)
+    out = decode_attention(q, k, v, pos, n_heads=h)
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    kh = k.reshape(b, s, h, dh)
+    vh = v.reshape(b, s, h, dh)
+    sc = jnp.einsum("bhd,bshd->bhs", q, kh).astype(jnp.float32) \
+        * (1.0 / dh ** 0.5)
+    sc = jnp.where(jnp.arange(s)[None, None, :]
+                   <= pos[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    want = jnp.einsum("bhs,bshd->bhd", pr.astype(q.dtype), vh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_scale_folded_reference_matches_quant_formula():
+    """The quantized call sites (`_local_block_decode_slotted_q` /
+    `_local_block_decode_paged_q`) fold per-row K/V scales through
+    decode_attention(k_scale=, v_scale=) — ported parity vs the
+    hand-rolled quantized attention they replaced, INCLUDING the
+    multiplication order (row scale before 1/sqrt(d)), which is what
+    keeps the fusion bit-identical."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    from deeplearning4j_tpu.quant.kv import quantize_rows
+    b, h, dh, s = 2, 4, 16, 64
+    _, kf, vf = _mk(b, h, dh, s, jnp.float32, seed=6)
+    q = jax.random.normal(jax.random.PRNGKey(9), (b, h, dh),
+                          jnp.float32)
+    kq, ks = quantize_rows(kf, "int8")
+    vq, vs = quantize_rows(vf, "int8")
+    pos = jnp.asarray([17, 63], jnp.int32)
+    out = decode_attention(q, kq, vq, pos, n_heads=h, k_scale=ks,
+                           v_scale=vs)
+    kh = kq.astype(jnp.float32).reshape(b, s, h, dh)
+    vh = vq.astype(jnp.float32).reshape(b, s, h, dh)
+    sc = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32), kh) \
+        * ks[:, None, :] * (1.0 / dh ** 0.5)
+    sc = jnp.where(jnp.arange(s)[None, None, :]
+                   <= pos[:, None, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    want = jnp.einsum("bhs,bshd->bhd", pr * vs[:, None, :],
+                      vh).astype(q.dtype)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    # and the dequantized result is close to the float attention
+    ref = reference_decode_attention(q, kf, vf, 63, n_heads=h)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[1]),
+                               rtol=0.05, atol=0.05)
+
+
 def test_reference_matches_block_decode_semantics():
     """reference_decode_attention == the shared attention core's jnp
     path at q-length 1 (what _block_decode used before the kernel):

@@ -144,6 +144,37 @@ def test_every_sample_belongs_to_a_typed_family(scrape):
             assert SNAKE.match(lab), f"label {lab!r} not snake_case"
 
 
+def test_spec_series_pass_the_lint():
+    """The speculative-decoding series (ISSUE-8:
+    serving_spec_{drafted,accepted}_tokens_total counters,
+    serving_spec_{acceptance_ratio,k} gauges) register only on spec
+    engines — scrape one and run the same naming rules over the whole
+    exposition."""
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    eng = InferenceEngine(
+        cfg, mesh, params,
+        EngineConfig(max_new_tokens=6, spec_decode=True, spec_k=2,
+                     draft="self"))
+    eng.submit(np.arange(8, dtype=np.int32))
+    eng.run_pending()
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    text = prometheus_text(eng.registry)
+    types = _types(text)
+    assert types["serving_spec_drafted_tokens_total"] == "counter"
+    assert types["serving_spec_accepted_tokens_total"] == "counter"
+    assert types["serving_spec_acceptance_ratio"] == "gauge"
+    assert types["serving_spec_k"] == "gauge"
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):

@@ -182,6 +182,36 @@ def test_no_recompile_within_bucket(params, mesh1):
     assert _compiled_decode_chunk.cache_info().currsize == dc0
 
 
+def test_spec_off_bit_identical_with_unchanged_cache_keys(params,
+                                                          mesh1):
+    """REGRESSION (ISSUE-8 satellite): with spec_decode off the engine
+    must be bit-identical to the pre-speculation engine AND its
+    compiled-program cache keys must be unchanged — re-invoking the
+    prefill/decode caches with the PR-7 (legacy) signature has to HIT
+    the entries this engine just created, proving no new kwarg leaked
+    into the spec-off key."""
+    from dataclasses import astuple
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    want = np.asarray(generate(CFG, params, _prompt()[None], 6,
+                               key=jax.random.PRNGKey(0),
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(h.result(0), want)
+    assert eng.health()["spec_decode"] is False
+    pf = _compiled_prefill.cache_info()
+    dc = _compiled_decode_chunk.cache_info()
+    # the legacy call shape (no quant/spec kwargs) must hit
+    _compiled_prefill(astuple(CFG), mesh1, 16, eng._num_slots, 0.0,
+                      0, 1.0)
+    _compiled_decode_chunk(astuple(CFG), mesh1, 2, eng._num_slots,
+                           0.0, 0, 1.0)
+    assert _compiled_prefill.cache_info().currsize == pf.currsize
+    assert _compiled_decode_chunk.cache_info().currsize == dc.currsize
+    assert _compiled_prefill.cache_info().hits > pf.hits
+    assert _compiled_decode_chunk.cache_info().hits > dc.hits
+
+
 # ---------------------------------------------------------------------------
 # slot lifecycle: no head-of-line blocking
 # ---------------------------------------------------------------------------

@@ -231,6 +231,37 @@ def test_paged_no_recompile_within_bucket(params, mesh1):
     assert _compiled_paged_decode.cache_info().currsize == dc0
 
 
+def test_paged_spec_off_bit_identical_with_unchanged_cache_keys(
+        params, mesh1):
+    """REGRESSION (ISSUE-8 satellite, paged twin of the continuous
+    guard): a spec-off paged engine stays bit-identical to the PR-7
+    paged engine and its compiled-program cache keys are unchanged —
+    the legacy-signature call must HIT the entries it just created."""
+    from dataclasses import astuple
+    cfg = _config(max_new_tokens=4, decode_chunk=2)
+    eng = InferenceEngine(CFG, mesh1, params, cfg)
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    ref = InferenceEngine(
+        CFG, mesh1, params,
+        EngineConfig(max_new_tokens=4, decode_chunk=2))
+    hr = ref.submit(_prompt())
+    ref.run_pending()
+    np.testing.assert_array_equal(h.result(0), hr.result(0))
+    pf = _compiled_paged_prefill.cache_info()
+    dc = _compiled_paged_decode.cache_info()
+    _compiled_paged_prefill(astuple(CFG), mesh1, 16, eng._num_slots,
+                            PS, eng._max_pages, eng._num_pages, 0.0,
+                            0, 1.0)
+    _compiled_paged_decode(astuple(CFG), mesh1, 2, eng._num_slots,
+                           PS, eng._max_pages, eng._num_pages, 0.0,
+                           0, 1.0)
+    assert _compiled_paged_prefill.cache_info().currsize == pf.currsize
+    assert _compiled_paged_decode.cache_info().currsize == dc.currsize
+    assert _compiled_paged_prefill.cache_info().hits > pf.hits
+    assert _compiled_paged_decode.cache_info().hits > dc.hits
+
+
 # ---------------------------------------------------------------------------
 # prefix sharing: hits skip prefill, share bytes
 # ---------------------------------------------------------------------------

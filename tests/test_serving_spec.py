@@ -1,0 +1,416 @@
+"""Speculative decoding on the continuous engine (ISSUE-8).
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- EXACTNESS, stronger than the classic rejection-sampling bound: the
+  speculative engine is TOKEN-IDENTICAL to the non-speculative engine
+  on the same seed — greedy AND temperature/top-k sampled, float AND
+  int8 KV, contiguous AND paged, for every drafter ("self", "int8",
+  early-exit "layers:N"). Position-keyed sampling makes verification
+  deterministic (accept a draft iff it equals the target's own
+  position-keyed sample), so bit-identity — and therefore the
+  rejection-sampling distributional guarantee — holds by construction.
+- acceptance math: a draft identical to the target (draft="self")
+  accepts 100% of its proposals at any temperature; budget caps
+  truncate commits without breaking exactness.
+- a POISONED draft pass can never corrupt committed KV: verification
+  rejects every derailed draft, the round degrades to one committed
+  token, `draft_rejected{poisoned}` forensics land in the flight
+  recorder, and the adaptive-K controller falls back to K=1.
+- adaptive K walks a CLOSED set of compiled programs (no steady-state
+  recompiles) and converges to plain decode on adversarial
+  (low-acceptance) traffic.
+- paged pools: speculative writes are COW-privatized — a mid-draft
+  rejection on a slot whose window spans a SHARED boundary page never
+  perturbs the sharer's tokens.
+"""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   generate, init_params)
+from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestStatus)
+from deeplearning4j_tpu.serving.engine import (_compiled_paged_spec_decode,
+                                               _compiled_spec_decode)
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    # max_new_tokens=11: after the prefill token, rem=10 = 2 * (K+1)
+    # at the default spec_k=4 — full-acceptance runs never truncate a
+    # round on the budget, so accepted == drafted is assertable
+    base = dict(max_new_tokens=11, backoff_base_s=0.0,
+                spec_decode=True, spec_k=4, draft="self")
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _run(params, mesh, econf, prompts, max_new=11):
+    eng = InferenceEngine(CFG, mesh, params, econf)
+    hs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run_pending()
+    return eng, [h.result(0) for h in hs]
+
+
+def _spec_counters(eng):
+    d = eng.registry.get("serving_spec_drafted_tokens")._unlabeled()
+    a = eng.registry.get("serving_spec_accepted_tokens")._unlabeled()
+    return int(d.value), int(a.value)
+
+
+# ---------------------------------------------------------------------------
+# exactness + acceptance math
+# ---------------------------------------------------------------------------
+
+def test_greedy_self_draft_exact_with_full_acceptance(params, mesh1):
+    """draft == target (draft='self'), greedy: every proposal matches
+    the target's argmax, so acceptance is 100% and the output equals
+    both the plain engine and single-chip generate byte for byte."""
+    eng, got = _run(params, mesh1, _config(), [_prompt()])
+    want = np.asarray(generate(CFG, params, _prompt()[None], 11,
+                               key=jax.random.PRNGKey(0),
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(got[0], want)
+    drafted, accepted = _spec_counters(eng)
+    assert drafted == accepted == 8      # 2 rounds x K=4, none capped
+
+
+@pytest.mark.parametrize("draft", ["int8", "layers:1"])
+def test_greedy_imperfect_drafters_stay_exact(params, mesh1, draft):
+    """An int8-quantized or early-exit drafter proposes WRONG tokens
+    some of the time — verification corrects every divergence, so the
+    committed stream is still bit-identical to plain decode (the
+    drafter only moves the acceptance rate, never the tokens)."""
+    _, want = _run(params, mesh1,
+                   EngineConfig(max_new_tokens=11, decode_chunk=2),
+                   [_prompt(8, s) for s in range(3)])
+    eng, got = _run(params, mesh1, _config(draft=draft),
+                    [_prompt(8, s) for s in range(3)])
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    drafted, accepted = _spec_counters(eng)
+    assert 0 <= accepted <= drafted and drafted > 0
+
+
+def test_sampled_spec_matches_nonspec_bit_exactly(params, mesh1):
+    """Temperature + top-k sampling: the committed token at index j is
+    ALWAYS sample(fold_in(key, j), target logits at j), so the
+    speculative stream is bit-identical to the non-speculative one —
+    which implies the rejection-sampling guarantee (the committed
+    distribution IS the target distribution) in the strongest form.
+    The early-exit drafter keeps acceptance partial, so mid-window
+    rejection + resampling is genuinely exercised across seeds."""
+    for seed in (0, 1, 2):
+        prompts = [_prompt(8, seed), _prompt(10, seed + 5)]
+        _, want = _run(params, mesh1,
+                       EngineConfig(max_new_tokens=11, decode_chunk=2,
+                                    temperature=0.9, top_k=5,
+                                    seed=seed), prompts)
+        eng, got = _run(params, mesh1,
+                        _config(draft="layers:1", temperature=0.9,
+                                top_k=5, seed=seed), prompts)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+
+def test_budget_cap_truncates_commit_not_exactness(params, mesh1):
+    """max_new_tokens not divisible by K+1: the final round commits
+    only the remaining budget (rem caps the accepted prefix) and the
+    result still equals the plain engine's, at exactly the budget."""
+    _, want = _run(params, mesh1,
+                   EngineConfig(max_new_tokens=9, decode_chunk=2),
+                   [_prompt()], max_new=9)
+    _, got = _run(params, mesh1, _config(max_new_tokens=9),
+                  [_prompt()], max_new=9)
+    np.testing.assert_array_equal(got[0], want[0])
+    assert got[0].shape[0] == 8 + 9
+
+
+def test_spec_int8_kv_and_quantized_weights_exact(params, mesh1):
+    """Quant stack composition: int8 KV slot pool and int8 weights
+    under speculation equal their non-speculative twins (the drafter
+    IS the quantized tree when weights are quantized — zero extra
+    HBM)."""
+    for quant_kw in ({"kv_quantize": "int8"},
+                     {"quantize": "int8", "kv_quantize": "int8"}):
+        _, want = _run(params, mesh1,
+                       EngineConfig(max_new_tokens=11, decode_chunk=2,
+                                    **quant_kw), [_prompt()])
+        _, got = _run(params, mesh1,
+                      _config(draft="int8", **quant_kw), [_prompt()])
+        np.testing.assert_array_equal(got[0], want[0])
+
+
+def test_spec_on_data_model_mesh(params, devices8):
+    """Speculative decode on a (data=2, model=2) mesh equals the 1x1
+    run — slot sharding and the TP psum ride the same program."""
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    mesh1 = make_mesh(MeshSpec(data=1, model=1))
+    prompts = [_prompt(8, s) for s in range(3)]
+    _, want = _run(params, mesh1, _config(), prompts)
+    _, got = _run(params, mesh, _config(), prompts)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+# ---------------------------------------------------------------------------
+# paged: COW safety of speculative writes
+# ---------------------------------------------------------------------------
+
+def test_paged_spec_exact_with_prefix_hits(params, mesh1):
+    """Paged + prefix cache + speculation: a second tenant hitting the
+    cached system prompt maps the shared pages, and BOTH tenants'
+    speculative streams equal the plain paged engine's."""
+    sysp = (np.arange(16, dtype=np.int32) * 5) % CFG.vocab_size
+    pa = np.concatenate([sysp, np.array([1, 2], np.int32)])
+    pb = np.concatenate([sysp, np.array([3, 4], np.int32)])
+
+    def staggered(econf):
+        eng = InferenceEngine(CFG, mesh1, params, econf)
+        ha = eng.submit(pa, max_new_tokens=8)
+        eng.tick()                       # A prefills + seeds the cache
+        hb = eng.submit(pb, max_new_tokens=8)
+        eng.run_pending()
+        return eng, ha.result(0), hb.result(0)
+
+    base = dict(max_new_tokens=8, paged=True, page_size=8,
+                max_batch_size=2)
+    _, wa, wb = staggered(EngineConfig(decode_chunk=2, **base))
+    eng, ga, gb = staggered(_config(spec_k=3, **base))
+    np.testing.assert_array_equal(ga, wa)
+    np.testing.assert_array_equal(gb, wb)
+    hits = eng.registry.get(
+        "serving_prefix_cache_hits")._unlabeled().value
+    assert hits >= 1
+
+
+def test_paged_cow_boundary_survives_mid_draft_rejection(params,
+                                                         mesh1):
+    """SATELLITE: the COW boundary page survives a mid-draft
+    rejection. Tenant B fully hits tenant A's cached prompt (the
+    boundary page is COW-copied at admission), then B's FIRST
+    speculative round is draft-poisoned — every draft rejected, one
+    corrected token committed, speculative garbage rows written and
+    rolled over. A co-resident tenant C sharing the same prefix then
+    admits and must reproduce its clean-run tokens exactly: the
+    shared pages were never perturbed."""
+    sysp = (np.arange(24, dtype=np.int32) * 7) % CFG.vocab_size
+    base = dict(max_new_tokens=8, paged=True, page_size=8,
+                max_batch_size=2)
+
+    def run(inj=None):
+        eng = InferenceEngine(
+            CFG, mesh1, params, _config(spec_k=3, **base),
+            fault_injector=inj)
+        ha = eng.submit(sysp, max_new_tokens=8)
+        eng.tick()                       # A caches the shared prompt
+        hb = eng.submit(sysp, max_new_tokens=8)   # full-prefix hit
+        eng.tick()
+        hc = eng.submit(np.concatenate(
+            [sysp[:16], np.array([9], np.int32)]), max_new_tokens=8)
+        eng.run_pending()
+        return eng, ha.result(0), hb.result(0), hc.result(0)
+
+    _, wa, wb, wc = run()
+    # poison B's first speculative round: B admits at step 2 (A's
+    # prefill=0, A's first chunk=1), so its round is step 3
+    inj = ServingFaultInjector(draft_poison_at={3: 2})
+    eng, ga, gb, gc = run(inj)
+    assert inj.drafts_poisoned == 1
+    np.testing.assert_array_equal(ga, wa)
+    np.testing.assert_array_equal(gb, wb)
+    np.testing.assert_array_equal(gc, wc)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: poisoned drafts
+# ---------------------------------------------------------------------------
+
+def test_draft_poison_never_corrupts_committed_kv(params, mesh1):
+    """SATELLITE: a poisoned draft pass must never corrupt committed
+    KV. The round's drafts are derailed on device, verification
+    rejects them ALL, exactly one (target-verified) token commits,
+    and the continuation stays byte-identical to the clean run —
+    with draft_rejected{poisoned} forensics in the flight recorder
+    and the controller falling back to K=1."""
+    _, want = _run(params, mesh1, _config(), [_prompt()])
+    inj = ServingFaultInjector(draft_poison_at={1: 1})
+    eng = InferenceEngine(CFG, mesh1, params, _config(),
+                          fault_injector=inj)
+    h = eng.submit(_prompt())
+    eng.tick()            # prefill (step 0) + the poisoned round (1)
+    assert inj.drafts_poisoned == 1
+    ev = [e for e in h.trace.events if e.kind == "draft_rejected"]
+    assert len(ev) == 1
+    assert ev[0].data["poisoned"] is True and ev[0].data["drafted"] == 4
+    # the poisoned round committed exactly the correction token, and
+    # the controller dropped to K=1 for the next round
+    chunk = [e for e in h.trace.events if e.kind == "decode_chunk"][0]
+    assert chunk.data["accepted"] == 0 and chunk.data["tokens"] == 1
+    assert eng.debugz()["spec"]["k"] == 1
+    eng.run_pending()
+    np.testing.assert_array_equal(h.result(0), want[0])
+
+
+def test_adaptive_k_converges_to_plain_on_adversarial_traffic(
+        params, mesh1):
+    """Persistently poisoned drafts (the worst adversarial regime:
+    acceptance pinned at 0): the controller walks K down to 1, then
+    falls back to PLAIN decode for a cooldown — and the tokens still
+    equal the clean run's. After the cooldown a probe round at K=1
+    resumes speculation."""
+    _, want = _run(params, mesh1,
+                   EngineConfig(max_new_tokens=11, decode_chunk=2),
+                   [_prompt()])
+    inj = ServingFaultInjector(
+        draft_poison_at={s: 1 for s in range(1, 40)})
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=11, spec_k=4),
+                          fault_injector=inj)
+    h = eng.submit(_prompt(), max_new_tokens=11)
+    eng.run_pending()
+    np.testing.assert_array_equal(h.result(0), want[0])
+    spec = eng.debugz()["spec"]
+    assert spec["k"] <= 1                 # backed off (or plain: 0)
+    # a fresh request on an un-poisoned engine probes back up
+    inj.draft_poison_at.clear()
+    h2 = eng.submit(_prompt(8, 3), max_new_tokens=11)
+    eng.run_pending()
+    assert h2.status == RequestStatus.COMPLETED
+    assert eng.debugz()["spec"]["k"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# compile-cache discipline + metrics
+# ---------------------------------------------------------------------------
+
+def test_adaptive_k_walks_a_closed_program_set(params, mesh1):
+    """Acceptance variance must never recompile: the controller only
+    visits K in {spec_k, spec_k/2, .., 1}, so a second traffic wave
+    adds ZERO spec-program cache entries."""
+    base = _compiled_spec_decode.cache_info().currsize
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(draft="layers:1", spec_k=4))
+    for s in range(3):
+        eng.submit(_prompt(8, s))
+    eng.run_pending()                     # walks K down as it rejects
+    n0 = _compiled_spec_decode.cache_info().currsize
+    for s in range(3, 8):
+        eng.submit(_prompt(8 + s % 4, s))
+    eng.run_pending()
+    assert _compiled_spec_decode.cache_info().currsize == n0
+    assert n0 - base <= 3                 # {4, 2, 1} at spec_k=4
+
+
+def test_spec_metrics_published_and_lint_clean(params, mesh1):
+    """serving_spec_{drafted,accepted}_tokens_total counters and the
+    serving_spec_{acceptance_ratio,k} gauges publish into the engine
+    registry, render in the Prometheus exposition, and honor the
+    naming conventions test_metrics_naming.py lints (snake_case,
+    _total on counters only, unitless gauges)."""
+    import re
+
+    from deeplearning4j_tpu.observability.export import prometheus_text
+
+    eng, _ = _run(params, mesh1, _config(), [_prompt()])
+    text = prometheus_text(eng.registry)
+    assert "serving_spec_drafted_tokens_total 8" in text
+    assert "serving_spec_accepted_tokens_total 8" in text
+    assert "serving_spec_acceptance_ratio 1" in text
+    assert "serving_spec_k 4" in text
+    types = dict(
+        line.split(" ", 3)[2:] for line in text.splitlines()
+        if line.startswith("# TYPE "))
+    assert types["serving_spec_drafted_tokens_total"] == "counter"
+    assert types["serving_spec_accepted_tokens_total"] == "counter"
+    assert types["serving_spec_acceptance_ratio"] == "gauge"
+    assert types["serving_spec_k"] == "gauge"
+    snake = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name, kind in types.items():
+        assert snake.match(name)
+        assert (kind == "counter") == name.endswith("_total")
+
+
+def test_spec_off_keeps_registry_and_health_unchanged(params, mesh1):
+    """A spec-off engine registers NO serving_spec_* series and its
+    health dict merely gains the spec_decode=False flag."""
+    eng, _ = _run(params, mesh1,
+                  EngineConfig(max_new_tokens=11, decode_chunk=2),
+                  [_prompt()])
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    assert "serving_spec" not in prometheus_text(eng.registry)
+    assert eng.health()["spec_decode"] is False
+    assert "spec" not in eng.debugz()
+
+
+# ---------------------------------------------------------------------------
+# interaction: hot reload re-derives the drafter
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_rebuilds_draft_tree(tmp_path, params, mesh1):
+    """After a weight reload the drafter is re-derived from the NEW
+    tree (a stale drafter would silently tank acceptance): the
+    speculative engine's post-reload tokens equal a plain engine's
+    post-reload tokens."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    params2 = jax.tree_util.tree_map(lambda a: a * 0.5, params)
+    mgr.save_tree(params2, 2)
+
+    eng = InferenceEngine(CFG, mesh1, params, _config(draft="int8"))
+    old_draft = eng._draft_params
+    assert eng.reload_weights(mgr, step=2) == 2
+    assert eng._draft_params is not old_draft
+    h = eng.submit(_prompt())
+    eng.run_pending()
+
+    ref = InferenceEngine(CFG, mesh1, params2,
+                          EngineConfig(max_new_tokens=11,
+                                       decode_chunk=2))
+    hr = ref.submit(_prompt())
+    ref.run_pending()
+    np.testing.assert_array_equal(h.result(0), hr.result(0))
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def test_spec_validation_errors(params, mesh1):
+    with pytest.raises(ValueError, match="continuous"):
+        InferenceEngine(CFG, mesh1, params,
+                        _config(mode="batch"))
+    with pytest.raises(ValueError, match="spec_k"):
+        InferenceEngine(CFG, mesh1, params, _config(spec_k=0))
+    with pytest.raises(ValueError, match="draft"):
+        InferenceEngine(CFG, mesh1, params, _config(draft="layers:9"))
+    with pytest.raises(ValueError, match="draft spec"):
+        InferenceEngine(CFG, mesh1, params, _config(draft="turbo"))
+    moe = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64, n_experts=2)
+    with pytest.raises(ValueError, match="MoE"):
+        InferenceEngine(moe, mesh1,
+                        init_params(moe, jax.random.PRNGKey(0)),
+                        _config())
