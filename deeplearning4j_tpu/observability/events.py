@@ -56,6 +56,12 @@ EVENT_KINDS = frozenset({
     #                  poisoned} — the forensic marker for injected
     #                  draft poisoning and for adaptive-K backoff
     "preempted",     # evicted from its slot {reason: isolation|reload}
+    "dispatched",    # fleet router: handed to a replica {replica,
+    #                  hedge} — the router-hop span opener (ISSUE-9)
+    "failover",      # fleet router: re-dispatched onto a survivor
+    #                  after a replica loss {from, to, committed}
+    "hedge",         # fleet router: hedged pair resolved {winner,
+    #                  loser, outcome: primary_won|hedge_won}
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

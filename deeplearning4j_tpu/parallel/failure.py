@@ -268,6 +268,89 @@ class ServingFaultInjector(FaultInjector):
         self.on_decode_step(step, request_ids)
 
 
+class FleetFaultInjector:
+    """Fleet-level deterministic fault injection (ISSUE-9) — the
+    router-hook analog of `ServingFaultInjector`: `serving/fleet.py`'s
+    `Router` consults it at the start of every scheduling tick (and at
+    every probe), so replica-loss scenarios that would need a real
+    crashed host replay deterministically on the CPU backend
+    (tests/test_serving_fleet.py).
+
+    Knobs (router-TICK indexed where time matters):
+
+    - ``kill_at``: ``{tick: replica_id}`` — the replica crashes at the
+      start of that router tick. In-process replicas are marked dead
+      (their engine, and every in-flight request's device state, is
+      abandoned exactly as a crashed process would abandon it);
+      subprocess replicas take a real SIGKILL. The router's contract
+      under test: every in-flight request fails over to a survivor
+      from its committed prefix — at most one retried dispatch, zero
+      lost requests.
+    - ``hang_at``: ``{tick: replica_id}`` — the replica stops making
+      progress while staying alive and (in-process) answering probes:
+      the wedged-grant failure mode a liveness probe cannot see.
+      Subprocess replicas are SIGSTOPped (probes time out too). The
+      router's no-progress detector must declare it hung and fail
+      over.
+    - ``slow_at``: ``{tick: (replica_id, seconds)}`` — from that tick
+      on, every scheduling step of the replica stalls ``seconds``
+      (in-process replicas only): the gray-failure mode hedged
+      dispatch exists for.
+    - ``fail_probe``: ``{replica_id: n}`` — the replica's next ``n``
+      probes fail (the router must take it out of rotation WITHOUT
+      killing it, and return it when probes recover).
+    """
+
+    def __init__(self, kill_at: Optional[dict] = None,
+                 hang_at: Optional[dict] = None,
+                 slow_at: Optional[dict] = None,
+                 fail_probe: Optional[dict] = None):
+        self.kill_at = {int(k): int(v)
+                        for k, v in (kill_at or {}).items()}
+        self.hang_at = {int(k): int(v)
+                        for k, v in (hang_at or {}).items()}
+        self.slow_at = {int(k): (int(v[0]), float(v[1]))
+                        for k, v in (slow_at or {}).items()}
+        self.fail_probe = {int(k): int(v)
+                           for k, v in (fail_probe or {}).items()}
+        self.kills_injected = 0
+        self.hangs_injected = 0
+        self.slows_injected = 0
+        self.probe_failures_injected = 0
+
+    def check_kill(self, tick: int) -> Optional[int]:
+        """One-shot: the replica id to crash at ``tick``, else None."""
+        rid = self.kill_at.pop(int(tick), None)
+        if rid is not None:
+            self.kills_injected += 1
+        return rid
+
+    def check_hang(self, tick: int) -> Optional[int]:
+        """One-shot: the replica id to wedge at ``tick``, else None."""
+        rid = self.hang_at.pop(int(tick), None)
+        if rid is not None:
+            self.hangs_injected += 1
+        return rid
+
+    def check_slow(self, tick: int) -> Optional[tuple]:
+        """One-shot: ``(replica_id, seconds)`` to slow from ``tick``
+        on, else None."""
+        v = self.slow_at.pop(int(tick), None)
+        if v is not None:
+            self.slows_injected += 1
+        return v
+
+    def check_probe(self, replica_id: int) -> bool:
+        """True when this probe of ``replica_id`` should fail
+        (decrements that replica's remaining failure budget)."""
+        n = self.fail_probe.get(int(replica_id), 0)
+        if n > 0:
+            self.fail_probe[int(replica_id)] = n - 1
+            self.probe_failures_injected += 1
+            return True
+        return False
+
+
 class PreemptionHandler:
     """Graceful-stop coordination for SIGTERM/SIGINT preemptions.
 

@@ -34,8 +34,24 @@ the layer between callers and the compiled decode step:
   back the `/debugz`, `/slo`, `/timeline.json` exporter endpoints
   (`observability/events|slo|timeline.py`, docs/observability.md).
 
+- Replicated serving fleet (round 14, ISSUE-9): `serving/fleet.py`'s
+  `Router` fronts N engine replicas (in-process by default,
+  subprocess via `SubprocessReplica` for crash realism) with
+  health-aware least-occupancy dispatch over the `/healthz`/`/readyz`
+  probe semantics, deadline-aware failover that resumes a dead
+  replica's in-flight requests from their committed prefix
+  token-exactly, hedged dispatch with first-winner-cancels, graceful
+  drain + rolling weight reload with zero dropped requests, and
+  supervised replica restart under a consecutive-crash budget —
+  deterministically testable via `parallel.failure.FleetFaultInjector`
+  (tests/test_serving_fleet.py, docs/serving.md "Replicated fleet").
+
 Lifecycle and thresholds: docs/serving.md.
 """
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
-    DeadlineExceeded, EngineConfig, InferenceEngine, OverloadError,
-    RequestHandle, RequestQuarantined, RequestStatus)
+    DeadlineExceeded, EngineConfig, EngineDraining, EngineStopped,
+    InferenceEngine, OverloadError, RequestCancelled, RequestHandle,
+    RequestQuarantined, RequestStatus)
+from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig, FleetHandle, InProcessReplica, ReplicaState, Router,
+    SubprocessReplica)

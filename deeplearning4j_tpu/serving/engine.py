@@ -191,8 +191,29 @@ class OverloadError(RuntimeError):
     """Admission rejected: queue full or circuit breaker open."""
 
 
+class EngineStopped(RuntimeError):
+    """Admission rejected: the engine has been stopped. Raised by
+    `submit()` IMMEDIATELY (ISSUE-9 satellite) — a request enqueued
+    after `stop()` would sit on the bounded queue forever with nothing
+    left to drain it, so the caller hangs in `result()` instead of
+    learning the engine is gone."""
+
+
+class EngineDraining(RuntimeError):
+    """Admission rejected: the engine is draining. `drain()` closes
+    admissions the moment it is called (readiness flips not-ready at
+    the same instant) while resident requests finish; `resume()`
+    reopens them — the rolling-weight-reload dance."""
+
+
 class DeadlineExceeded(RuntimeError):
     """Request shed because its deadline passed before completion."""
+
+
+class RequestCancelled(RuntimeError):
+    """Request cancelled by the caller via `engine.cancel()` — e.g. a
+    hedged fleet dispatch whose twin finished first (serving/fleet.py
+    first-winner-cancels)."""
 
 
 class RequestQuarantined(RuntimeError):
@@ -304,6 +325,7 @@ class RequestHandle:
         self.status = RequestStatus.QUEUED
         self.error: Optional[BaseException] = None
         self.deadline_exceeded = False
+        self._cancelled = False
         self._generated: List[np.ndarray] = []
         self._done = threading.Event()
         self._in_flight = False          # continuous-mode accounting
@@ -636,6 +658,7 @@ class InferenceEngine:
         self._queue: deque = deque()
         self._rids = itertools.count(1)
         self._accepting = True
+        self._draining = False
         self._stop_flag = False
         self._thread: Optional[threading.Thread] = None
         self._listeners: list = []
@@ -680,6 +703,7 @@ class InferenceEngine:
                          labelnames=("reason",))
         self._m_shed_overload = shed.labels("overload")
         self._m_shed_deadline = shed.labels("deadline")
+        self._m_shed_cancelled = shed.labels("cancelled")
         self._m_quarantined = r.counter(
             "serving_requests_quarantined",
             "Requests that failed persistently after solo retries")
@@ -861,8 +885,18 @@ class InferenceEngine:
             raise ValueError("prompt must be a non-empty 1-D token array")
         now = self._clock()
         with self._lock:
+            # typed, IMMEDIATE rejection (ISSUE-9 satellite): a submit
+            # raced against stop()/drain() used to land on the bounded
+            # queue with nothing left to drain it — the caller then
+            # hangs in result() forever. Stopped and draining engines
+            # refuse admission synchronously instead.
             if not self._accepting:
-                raise RuntimeError("engine is stopped")
+                raise EngineStopped(
+                    "engine is stopped: submit() would never be served")
+            if self._draining:
+                raise EngineDraining(
+                    "engine is draining: admissions are closed until "
+                    "resume()")
             self._tick_breaker(now)
             if self._breaker == "open":
                 self._m_shed_overload.inc()
@@ -920,8 +954,10 @@ class InferenceEngine:
                                        for a in r._generated)),
                         partial=bool(r.deadline_exceeded))
         elif r.status == RequestStatus.SHED:
-            r.trace.add("shed", reason=("deadline" if r.deadline_exceeded
-                                        else "overload"))
+            r.trace.add("shed", reason=(
+                "cancelled" if r._cancelled
+                else "deadline" if r.deadline_exceeded
+                else "overload"))
         elif r.status == RequestStatus.QUARANTINED:
             r.trace.add("quarantined")
         self.slo.finished(r.trace)
@@ -977,6 +1013,73 @@ class InferenceEngine:
         if drain:
             self.run_pending()
         self._accepting = False
+
+    # ------------------------------------------------------------------
+    # graceful drain / cancel (ISSUE-9: the fleet router's per-replica
+    # hooks — but just as useful standalone)
+    # ------------------------------------------------------------------
+    def drain(self, wait: bool = True,
+              timeout: Optional[float] = None) -> "InferenceEngine":
+        """Close admissions IMMEDIATELY — `submit()` raises
+        `EngineDraining` and `ready()` (hence `/readyz`) reports
+        not-ready from this instant, NOT from when the last resident
+        finishes — while queued and resident requests keep decoding to
+        completion. With ``wait`` the call blocks until the engine is
+        drained (driving the work on the caller thread when no worker
+        thread is running). `resume()` reopens admissions; the rolling
+        weight-reload dance is ``drain() → reload_weights() →
+        resume()`` (serving/fleet.py does it fleet-wide)."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        if wait:
+            if self._thread is None:
+                self.run_pending()
+            else:
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                while not self.drained():
+                    if (deadline is not None
+                            and time.monotonic() > deadline):
+                        raise TimeoutError(
+                            f"engine did not drain within {timeout}s")
+                    time.sleep(0.002)
+        return self
+
+    def drained(self) -> bool:
+        """True when no request is queued or resident."""
+        with self._lock:
+            return (not self._queue
+                    and all(s is None for s in self._slots))
+
+    def draining(self) -> bool:
+        return self._draining
+
+    def resume(self) -> None:
+        """Reopen admissions after a `drain()` (no-op when stopped)."""
+        with self._cv:
+            self._draining = False
+            self._cv.notify_all()
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Best-effort cancel: a queued request is shed immediately, an
+        in-flight one at its next chunk boundary (the slot frees at the
+        following reap). Terminal handles are untouched (returns
+        False). The shed is typed `RequestCancelled` and counted under
+        ``serving_requests_shed_total{reason="cancelled"}`` — the
+        fleet router's first-winner-cancels hedging relies on this."""
+        with self._lock:
+            if handle.done():
+                return False
+            handle._cancelled = True
+            try:
+                self._queue.remove(handle)
+            except ValueError:
+                return True      # in-flight: chunk boundary sheds it
+        self._m_shed_cancelled.inc()
+        handle._finish(RequestStatus.SHED, RequestCancelled(
+            f"request {handle.rid} cancelled while queued"))
+        return True
 
     def _worker(self) -> None:
         while True:
@@ -1086,8 +1189,18 @@ class InferenceEngine:
     def _shed_expired(self, batch: Sequence[RequestHandle]) -> None:
         now = self._clock()
         for r in batch:
-            if (r.status in (RequestStatus.RUNNING, RequestStatus.QUEUED)
-                    and r.deadline_at is not None
+            if r.status not in (RequestStatus.RUNNING,
+                                RequestStatus.QUEUED):
+                continue
+            if r._cancelled:
+                # caller-cancelled (engine.cancel): shed at the chunk
+                # boundary, slot freed at the next reap
+                self._m_shed_cancelled.inc()
+                r._finish(RequestStatus.SHED, RequestCancelled(
+                    f"request {r.rid} cancelled with "
+                    f"{r.generated.shape[0]} tokens decoded"))
+                continue
+            if (r.deadline_at is not None
                     and now > r.deadline_at):
                 r.deadline_exceeded = True
                 if r.on_deadline == "partial":
@@ -2170,7 +2283,9 @@ class InferenceEngine:
             return {"ready": self.ready(),
                     "breaker": self._breaker,
                     "degraded": self._degraded_locked(),
+                    "draining": self._draining,
                     "queue_depth": len(self._queue),
+                    "num_slots": self._num_slots,
                     "slots_occupied": sum(s is not None
                                           for s in self._slots),
                     "weights_step": self._weights_step,
@@ -2183,7 +2298,11 @@ class InferenceEngine:
     def ready(self) -> bool:
         with self._lock:
             self._tick_breaker(self._clock())
-            return self._accepting and self._breaker != "open"
+            # draining flips readiness the MOMENT drain begins (ISSUE-9
+            # satellite): a rolling-reload load balancer must stop
+            # routing here before the last resident finishes, not after
+            return (self._accepting and not self._draining
+                    and self._breaker != "open")
 
     def reload_weights(self, source, step: Optional[int] = None) -> int:
         """Hot-swap serving weights from a CheckpointManager (or a

@@ -1,0 +1,1661 @@
+"""Replicated serving fleet: a health-aware router over N engines.
+
+The single `InferenceEngine` is a hardened process (retry, isolation,
+quarantine, breaker, drain) — but one process is one blast radius.
+ISSUE-9 adds the fleet layer the ROADMAP's multi-host item calls for:
+a `Router` that fronts N engine replicas and makes the FLEET as
+fault-tolerant as the single engine already is — a replica crash,
+hang, or slowdown costs at most one retried request, never an outage.
+
+Replicas
+--------
+- `InProcessReplica` (default): one `InferenceEngine` per replica in
+  this process, driven by the router's scheduling tick. Deterministic,
+  fast, and what the fault-injection suite uses. "Crash" abandons the
+  engine exactly as a dead process would abandon it (device state,
+  in-flight handles and all); optional per-replica `MetricsServer`s
+  make the probe path the real HTTP one.
+- `SubprocessReplica`: a real separate process
+  (`serving/fleet_worker.py`, extending the process boundary
+  tests/test_multihost.py established) hosting an engine plus its
+  `MetricsServer`. The router probes over real HTTP
+  (`/healthz`/`/readyz`) and dispatches over a JSON-lines pipe; the
+  worker streams per-request progress so the router always knows each
+  request's committed prefix. Crash realism: SIGKILL; hang realism:
+  SIGSTOP.
+
+Routing policy
+--------------
+Admission is router-owned: replicas only ever see work they have slot
+capacity for, so the router queue is the ONE queue (queue-age
+histograms and hedging read it directly). Each tick:
+
+1. **Probes** — every replica's `/healthz` semantics (direct call or
+   HTTP) feed an active health view; consecutive probe failures take a
+   replica out of rotation WITHOUT killing it (in-flight work
+   finishes), and a recovered probe returns it.
+2. **Passive signals** — per-replica error EMAs from dispatch
+   failures/crashes, plus a per-replica circuit breaker (consecutive
+   dispatch failures open it for a cooldown).
+3. **Dispatch** — least-occupancy, health-weighted: score =
+   outstanding/capacity + error-EMA penalty; lowest score wins.
+   Submit-time deadlines ride along as the REMAINING deadline, and a
+   request already past its deadline is shed typed `deadline` at the
+   router — a retried request can never resurrect past its deadline.
+4. **Failover** — a crashed (or hang-detected) replica's in-flight
+   requests are requeued at the queue FRONT and re-dispatched onto
+   survivors from their COMMITTED PREFIX (position-keyed sampling
+   makes the continuation token-exact vs an uninterrupted run); the
+   fleet trace gains `failover{from,to,committed}`.
+5. **Hedging** (optional) — a request whose queue age lands in the
+   slowest decile (or past `hedge_age_s`) is dispatched to TWO
+   replicas; the first terminal result wins and the loser is cancelled
+   (`engine.cancel` → shed `cancelled`), counted in
+   `serving_fleet_hedges_total{outcome}`.
+6. **Supervised restart** — a dead replica is restarted with
+   exponential backoff under a CONSECUTIVE-crash budget (the
+   durability subsystem's max_restarts semantics: the budget resets
+   once the replica completes work again); past the budget it stays
+   dead and the fleet serves on the survivors.
+
+`drain()` flips the router's `/readyz` the moment it is called, stops
+admission, and lets residents finish; `rolling_reload()` drains ONE
+replica at a time (the rest keep serving), hot-reloads its weights,
+and returns it to rotation — a fleet-wide weight rollout with zero
+dropped requests.
+
+Observability: `serving_fleet_replicas{state}` /
+`serving_fleet_queue_depth` gauges, `serving_fleet_failovers_total`,
+`serving_fleet_hedges_total{outcome}`, `serving_fleet_restarts_total`,
+`serving_fleet_probe_failures_total`,
+`serving_fleet_requests_{completed,shed}_total`,
+`serving_fleet_queue_age_seconds` / `serving_fleet_recovery_seconds`
+histograms, a `debugz()` fleet table, and router-hop
+`dispatched`/`failover`/`hedge` events on every fleet trace.
+
+Every behavior is deterministic on CPU via
+`parallel.failure.FleetFaultInjector` (kill-replica-at, hang-replica,
+slow-replica, fail-probe) — tests/test_serving_fleet.py.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.observability.events import (FlightRecorder,
+                                                     NULL_RECORDER,
+                                                     NULL_TRACE)
+from deeplearning4j_tpu.observability.metrics import (
+    DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
+from deeplearning4j_tpu.serving.engine import (DeadlineExceeded,
+                                               EngineDraining,
+                                               EngineStopped,
+                                               OverloadError,
+                                               RequestQuarantined,
+                                               RequestStatus)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ReplicaState:
+    READY = "ready"
+    DRAINING = "draining"
+    UNHEALTHY = "unhealthy"      # probes failing; in-flight may finish
+    RESTARTING = "restarting"    # dead, restart scheduled
+    DEAD = "dead"                # dead, crash budget exhausted
+
+    ALL = ("ready", "draining", "unhealthy", "restarting", "dead")
+
+
+class ReplicaCrashed(RuntimeError):
+    """A replica is dead (crashed, killed, or declared hung)."""
+
+
+@dataclass
+class FleetConfig:
+    """Router policy knobs (see module docstring for semantics)."""
+    max_queue: int = 256             # router admission bound
+    probe_every_ticks: int = 1       # probe cadence (scheduling ticks)
+    probe_failure_threshold: int = 1  # consecutive failures -> out
+    probe_timeout_s: float = 2.0     # HTTP probe timeout
+    error_ema_alpha: float = 0.3     # passive failure-signal decay
+    breaker_failure_threshold: int = 3   # consecutive dispatch errors
+    breaker_cooldown_s: float = 1.0
+    hang_ticks: int = 8              # no-progress ticks w/ in-flight
+    #                                  work before a replica is
+    #                                  declared hung (then crashed)
+    hang_min_s: float = 2.0          # AND at least this much wall (or
+    #                                  injected-clock) time without
+    #                                  progress — tick counts alone
+    #                                  would misfire on replicas whose
+    #                                  progress reports arrive async
+    #                                  (subprocess pipes)
+    hedge: bool = False              # hedged dispatch of slow-decile
+    hedge_age_s: Optional[float] = None  # absolute age trigger; None
+    #                                  uses the rolling p90 policy
+    hedge_quantile: float = 0.9      # "slowest decile"
+    hedge_min_age_s: float = 0.05    # never hedge younger than this
+    hedge_warmup: int = 20           # window samples before quantile
+    #                                  hedging activates
+    max_restarts: int = 3            # CONSECUTIVE crash budget/replica
+    restart_backoff_base_s: float = 0.05  # exponential: base*2^(n-1)
+    restart_backoff_max_s: float = 2.0
+
+
+class FleetHandle:
+    """Caller-facing future for one fleet-submitted prompt. Mirrors
+    `RequestHandle`'s surface (`result`/`done`/`generated`/`status`/
+    `error`/`trace`) — callers should not care whether they talk to an
+    engine or a fleet."""
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new: int,
+                 deadline_at: Optional[float], on_deadline: str):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new
+        self.deadline_at = deadline_at
+        self.on_deadline = on_deadline
+        self.status = RequestStatus.QUEUED
+        self.error: Optional[BaseException] = None
+        self.deadline_exceeded = False
+        self.trace = NULL_TRACE
+        self._committed = np.zeros((0,), np.int32)
+        self._failover_from: Optional[int] = None
+        self._queued_at = 0.0
+        self._failovers = 0
+        self._hedged = False
+        self._done = threading.Event()
+
+    @property
+    def generated(self) -> np.ndarray:
+        """Tokens COMMITTED at the router (authoritative once done;
+        mid-flight it trails the serving replica by up to the progress
+        cadence)."""
+        return self._committed
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"fleet request {self.rid} not done")
+        if self.error is not None:
+            raise self.error
+        return np.concatenate([self.prompt, self._committed])
+
+    def _finish(self, status: str,
+                error: Optional[BaseException] = None) -> None:
+        self.status = status
+        self.error = error
+        self._done.set()
+
+
+class _Hop:
+    """One dispatch of a fleet request onto one replica."""
+
+    __slots__ = ("fr", "replica_id", "inner", "base", "hedge",
+                 "dispatched_at")
+
+    def __init__(self, fr: FleetHandle, replica_id: int, inner,
+                 base: np.ndarray, hedge: bool, t: float):
+        self.fr = fr
+        self.replica_id = replica_id
+        self.inner = inner           # engine RequestHandle / proxy
+        self.base = base             # tokens committed before this hop
+        self.hedge = hedge
+        self.dispatched_at = t
+
+    def committed(self) -> np.ndarray:
+        """base + whatever this hop's replica has committed since."""
+        gen = np.asarray(self.inner.generated, np.int32)
+        if self.base.size == 0:
+            return gen
+        if gen.size == 0:
+            return self.base
+        return np.concatenate([self.base, gen])
+
+
+# ---------------------------------------------------------------------------
+# replicas
+# ---------------------------------------------------------------------------
+
+class InProcessReplica:
+    """One `InferenceEngine` in this process, driven by the router's
+    tick. ``factory`` builds the engine (and rebuilds it on restart —
+    the process-wide compiled-program caches make that cheap).
+    ``http_probes=True`` mounts a per-replica `MetricsServer` and
+    routes `probe()` through real HTTP `/healthz` semantics."""
+
+    kind = "inprocess"
+
+    def __init__(self, replica_id: int, factory: Callable[[], object],
+                 http_probes: bool = False):
+        self.id = int(replica_id)
+        self._factory = factory
+        self.engine = factory()
+        self._dead = False
+        self._hung = False
+        self._slow_s = 0.0
+        self._slow_phase = 0
+        self._http = bool(http_probes)
+        self._server = None
+        if self._http:
+            self._start_server()
+
+    def _start_server(self) -> None:
+        from deeplearning4j_tpu.observability.export import MetricsServer
+        self._server = MetricsServer(self.engine.registry, port=0,
+                                     health=self.engine.health,
+                                     ready=self.engine.ready,
+                                     debug=self.engine.debugz)
+
+    @property
+    def capacity(self) -> int:
+        return self.engine._num_slots
+
+    @property
+    def probe_url(self) -> Optional[str]:
+        return self._server.url if self._server is not None else None
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def busy(self) -> bool:
+        """True while the engine still holds queued or resident work —
+        including cancelled hedge losers awaiting their chunk-boundary
+        shed. The router keeps stepping busy replicas after the fleet
+        queue empties so residents always reach a terminal state."""
+        return not self._dead and not self._hung \
+            and not self.engine.drained()
+
+    def step(self) -> bool:
+        """One engine scheduling round. A hung replica stays alive but
+        makes no progress (the failure mode probes cannot see); a slow
+        one stalls first; a dead one raises."""
+        if self._dead:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        if self._hung:
+            return False
+        if self._slow_s > 0:
+            # gray failure with DIFFERENTIAL progress: co-driven
+            # replicas share the router's tick loop, so a plain sleep
+            # would slow the whole fleet in lockstep. A slow replica
+            # instead stalls a bounded slice of wall time (so queue
+            # ages really grow) AND advances its engine only every
+            # _SLOW_STRIDE-th round — fast replicas genuinely outpace
+            # it, which is what hedging exists to exploit.
+            time.sleep(min(self._slow_s, 0.05))
+            self._slow_phase += 1
+            if self._slow_phase % self._SLOW_STRIDE != 0:
+                return False
+        return self.engine.tick()
+
+    _SLOW_STRIDE = 4
+
+    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline):
+        if self._dead:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        return self.engine.submit(prompt,
+                                  max_new_tokens=max_new_tokens,
+                                  deadline_s=deadline_s,
+                                  on_deadline=on_deadline)
+
+    def cancel(self, inner) -> None:
+        if not self._dead:
+            self.engine.cancel(inner)
+
+    def probe(self) -> dict:
+        """Health snapshot with the `/healthz` contract ({"ready":
+        bool, ...}); raises when the replica cannot answer."""
+        if self._dead:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        if self._http:
+            return _http_probe(f"{self._server.url}/healthz",
+                               timeout=2.0)
+        return self.engine.health()
+
+    # -- fault-injection / supervision surface -------------------------
+    def kill(self) -> None:
+        """Simulated crash: the engine (and every in-flight request's
+        state) is abandoned the way a dead process abandons it; the
+        probe endpoint dies with it."""
+        self._dead = True
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def set_hung(self, flag: bool) -> None:
+        self._hung = bool(flag)
+
+    def set_slow(self, seconds: float) -> None:
+        self._slow_s = float(seconds)
+
+    def restart(self) -> None:
+        self.engine = self._factory()
+        self._dead = False
+        self._hung = False
+        if self._http:
+            self._start_server()
+
+    def drain(self, wait: bool = False) -> None:
+        self.engine.drain(wait=wait)
+
+    def resume(self) -> None:
+        self.engine.resume()
+
+    def reload(self, source, step: Optional[int] = None) -> int:
+        return self.engine.reload_weights(source, step=step)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if not self._dead:
+            try:
+                self.engine.stop(drain=False)
+            except Exception:
+                pass
+
+
+def _http_probe(url: str, timeout: float) -> dict:
+    """GET a probe endpoint; 503 bodies parse like 200 bodies (the
+    probe ANSWERED — "ready": False is information, not an error)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:      # 503 carries a body too
+        return json.loads(e.read().decode())
+
+
+class _ProxyHandle:
+    """Router-side stand-in for a subprocess replica's RequestHandle:
+    updated from the worker's streamed progress/done/error events so
+    the router always knows the request's committed prefix — the
+    failover substrate when the process is SIGKILLed."""
+
+    def __init__(self, lrid: int, prompt: np.ndarray, max_new: int):
+        self.rid = int(lrid)
+        self.prompt = prompt
+        self.max_new_tokens = max_new
+        self.status = RequestStatus.RUNNING
+        self.error: Optional[BaseException] = None
+        self.deadline_exceeded = False
+        self._cancelled = False
+        self._tokens = np.zeros((0,), np.int32)
+        self._done = threading.Event()
+
+    @property
+    def generated(self) -> np.ndarray:
+        return self._tokens
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _update(self, tokens: List[int]) -> None:
+        if len(tokens) > self._tokens.shape[0]:
+            self._tokens = np.asarray(tokens, np.int32)
+
+    def _finish(self, status: str, error=None,
+                tokens: Optional[List[int]] = None) -> None:
+        if tokens is not None:
+            self._update(tokens)
+        self.status = status
+        self.error = error
+        self._done.set()
+
+
+_ERR_TYPES = {"DeadlineExceeded": DeadlineExceeded,
+              "RequestQuarantined": RequestQuarantined,
+              "RequestCancelled": None,       # handled via status
+              "OverloadError": OverloadError,
+              "EngineDraining": EngineDraining,
+              "EngineStopped": EngineStopped}
+
+
+class SubprocessReplica:
+    """A real separate engine process (`serving/fleet_worker.py`):
+    JSON-lines command pipe in, streamed request events out, probes
+    over real HTTP. ``spec`` is the worker's config —
+    ``{"cfg": {TransformerConfig kwargs}, "engine": {EngineConfig
+    kwargs}, "params_seed": int}`` — the worker re-derives the weight
+    tree from the seed, so replicas are token-identical to an
+    in-process engine built the same way."""
+
+    kind = "subprocess"
+
+    def __init__(self, replica_id: int, spec: dict,
+                 startup_timeout_s: float = 180.0):
+        self.id = int(replica_id)
+        self._spec = dict(spec)
+        self._startup_timeout_s = float(startup_timeout_s)
+        self._lrids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._spawn()
+
+    # -- process lifecycle ---------------------------------------------
+    def _spawn(self) -> None:
+        self._handles: Dict[int, _ProxyHandle] = {}
+        self._acks: Dict[str, threading.Event] = {}
+        self._ack_payload: Dict[str, dict] = {}
+        self._eof = threading.Event()
+        self._hello = threading.Event()
+        self._port = None
+        self.capacity = 1
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + ([env["PYTHONPATH"]]
+                          if env.get("PYTHONPATH") else []))
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "deeplearning4j_tpu.serving.fleet_worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env, text=True)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True,
+                                        name=f"fleet-replica-{self.id}")
+        self._reader.start()
+        self._send(self._spec)
+        if not self._hello.wait(self._startup_timeout_s):
+            self.close()
+            raise TimeoutError(
+                f"subprocess replica {self.id} did not come up within "
+                f"{self._startup_timeout_s}s")
+
+    def _send(self, obj: dict) -> None:
+        try:
+            self._proc.stdin.write(json.dumps(obj) + "\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            raise ReplicaCrashed(
+                f"replica {self.id}: worker pipe is gone")
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._proc.stdout:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                self._on_event(ev)
+        except (ValueError, OSError):
+            pass
+        self._eof.set()
+
+    def _on_event(self, ev: dict) -> None:
+        kind = ev.get("ev")
+        if kind == "hello":
+            self._port = int(ev["port"])
+            self.capacity = int(ev.get("num_slots", 1))
+            self._hello.set()
+            return
+        if kind in ("reloaded", "drained", "resumed"):
+            self._ack_payload[kind] = ev
+            ack = self._acks.get(kind)
+            if ack is not None:
+                ack.set()
+            return
+        lrid = ev.get("rid")
+        with self._lock:
+            h = self._handles.get(lrid)
+        if h is None:
+            return
+        if kind == "progress":
+            h._update(ev.get("tokens", []))
+        elif kind == "done":
+            h.deadline_exceeded = bool(ev.get("partial", False))
+            h._finish(RequestStatus.COMPLETED,
+                      tokens=ev.get("tokens", []))
+        elif kind in ("error", "rejected"):
+            etype = ev.get("etype", "RuntimeError")
+            msg = ev.get("msg", "")
+            if etype == "DeadlineExceeded":
+                h.deadline_exceeded = True
+                h._finish(RequestStatus.SHED, DeadlineExceeded(msg),
+                          tokens=ev.get("tokens"))
+            elif etype == "RequestQuarantined":
+                h._finish(RequestStatus.QUARANTINED,
+                          RequestQuarantined(msg))
+            elif etype == "RequestCancelled":
+                h._cancelled = True
+                from deeplearning4j_tpu.serving.engine import \
+                    RequestCancelled
+                h._finish(RequestStatus.SHED, RequestCancelled(msg))
+            else:
+                exc = _ERR_TYPES.get(etype, RuntimeError) or RuntimeError
+                h._finish(RequestStatus.SHED, exc(msg))
+
+    # -- router-facing surface -----------------------------------------
+    @property
+    def probe_url(self) -> Optional[str]:
+        return (f"http://127.0.0.1:{self._port}"
+                if self._port is not None else None)
+
+    def alive(self) -> bool:
+        return (self._proc is not None and self._proc.poll() is None
+                and not self._eof.is_set())
+
+    def busy(self) -> bool:
+        return False             # the worker reaps its own residents
+
+    def step(self) -> bool:
+        return False             # the worker drives its own engine
+
+    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline):
+        if not self.alive():
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        lrid = next(self._lrids)
+        h = _ProxyHandle(lrid, np.asarray(prompt, np.int32),
+                         max_new_tokens)
+        with self._lock:
+            self._handles[lrid] = h
+        self._send({"op": "submit", "rid": lrid,
+                    "prompt": np.asarray(prompt).tolist(),
+                    "max_new_tokens": max_new_tokens,
+                    "deadline_s": deadline_s,
+                    "on_deadline": on_deadline})
+        return h
+
+    def cancel(self, inner) -> None:
+        if self.alive():
+            try:
+                self._send({"op": "cancel", "rid": inner.rid})
+            except ReplicaCrashed:
+                pass
+
+    def probe(self) -> dict:
+        if not self.alive() or self._port is None:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        return _http_probe(f"{self.probe_url}/healthz", timeout=2.0)
+
+    _ACK_OPS = {"reloaded": "reload", "drained": "drain",
+                "resumed": "resume"}
+
+    def _ack(self, ack_kind: str, timeout: float) -> dict:
+        ev = self._acks.setdefault(ack_kind, threading.Event())
+        ev.clear()
+        self._send({"op": self._ACK_OPS[ack_kind]})
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"replica {self.id}: no {ack_kind} ack within "
+                f"{timeout}s")
+        return self._ack_payload.get(ack_kind, {})
+
+    def drain(self, wait: bool = False, timeout: float = 60.0) -> None:
+        self._ack("drained", timeout)
+
+    def resume(self) -> None:
+        self._ack("resumed", 10.0)
+
+    def reload(self, source, step: Optional[int] = None,
+               timeout: float = 120.0) -> int:
+        ev = self._acks.setdefault("reloaded", threading.Event())
+        ev.clear()
+        self._send({"op": "reload", "dir": str(source), "step": step})
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"replica {self.id}: reload did not ack in {timeout}s")
+        payload = self._ack_payload.get("reloaded", {})
+        if "error" in payload:
+            raise RuntimeError(payload["error"])
+        return int(payload.get("step", -1))
+
+    # -- fault-injection / supervision surface -------------------------
+    def kill(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.kill()           # SIGKILL: crash realism
+            except OSError:
+                pass
+
+    def set_hung(self, flag: bool) -> None:
+        """True hang realism: SIGSTOP freezes the process (probes time
+        out, the pipe goes silent); SIGCONT resumes it."""
+        if self._proc is not None and self._proc.poll() is None:
+            os.kill(self._proc.pid,
+                    signal.SIGSTOP if flag else signal.SIGCONT)
+
+    def set_slow(self, seconds: float) -> None:
+        log.warning("slow injection is not supported on subprocess "
+                    "replicas; ignoring")
+
+    def restart(self) -> None:
+        self.close()
+        self._spawn()
+
+    def close(self) -> None:
+        p = self._proc
+        if p is None:
+            return
+        if p.poll() is None:
+            try:
+                self._send({"op": "stop"})
+            except ReplicaCrashed:
+                pass
+            try:
+                p.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        else:
+            p.wait()             # reap the zombie
+        for s in (p.stdin, p.stdout):
+            try:
+                if s is not None:
+                    s.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class _ReplicaCtl:
+    """Router-side bookkeeping for one replica."""
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.id = replica.id
+        self.draining = False
+        self.dead = False
+        self.unhealthy = False
+        self.ready = False           # last probe's readiness verdict
+        self.last_health: dict = {}
+        self.consec_probe_failures = 0
+        self.err_ema = 0.0
+        self.breaker_failures = 0
+        self.breaker_open_until = 0.0
+        self.no_progress = 0
+        self.last_progress_mark = (0, 0)
+        self.last_progress_t = 0.0
+        self.consec_crashes = 0
+        self.restarts = 0
+        self.killed_at: Optional[float] = None
+        self.next_restart_at: Optional[float] = None
+        self.outstanding: Dict[int, List[_Hop]] = {}
+
+    @property
+    def capacity(self) -> int:
+        return max(1, int(getattr(self.replica, "capacity", 1)))
+
+    def state(self) -> str:
+        if self.dead:
+            return (ReplicaState.RESTARTING
+                    if self.next_restart_at is not None
+                    else ReplicaState.DEAD)
+        if self.draining:
+            return ReplicaState.DRAINING
+        if self.unhealthy:
+            return ReplicaState.UNHEALTHY
+        return ReplicaState.READY
+
+    def n_outstanding(self) -> int:
+        return sum(len(hs) for hs in self.outstanding.values())
+
+
+class Router:
+    """Health-aware load balancer + supervisor over N engine replicas
+    (module docstring has the policy). Construct either from a list of
+    pre-built ``replicas`` (e.g. `SubprocessReplica`s) or from
+    ``cfg``/``mesh``/``params`` + ``num_replicas``, in which case the
+    router builds `InProcessReplica`s itself (every replica gets the
+    same seed/config, so which replica serves a request never changes
+    its tokens).
+
+    Drive it like the engine: synchronously — `submit()` then
+    `run_pending()`/`tick()` on the caller thread (what the
+    deterministic tests use) — or with `start()`/`stop()` for a
+    background scheduling thread."""
+
+    def __init__(self, replicas: Optional[List] = None, *,
+                 cfg=None, mesh=None, params=None,
+                 num_replicas: int = 2,
+                 engine_config=None,
+                 config: Optional[FleetConfig] = None,
+                 fault_injector=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None, recorder=None,
+                 http_probes: bool = False,
+                 engine_kwargs: Optional[dict] = None):
+        self.config = config or FleetConfig()
+        self._clock = clock
+        self._injector = fault_injector
+        self.cfg = cfg
+        if replicas is None:
+            if cfg is None or mesh is None or params is None:
+                raise ValueError("pass replicas=[...] or cfg+mesh+"
+                                 "params to build in-process replicas")
+            from deeplearning4j_tpu.serving.engine import (
+                EngineConfig, InferenceEngine)
+            engine_config = engine_config or EngineConfig()
+            ekw = dict(engine_kwargs or {})
+            ekw.setdefault("clock", clock)
+
+            def factory():
+                return InferenceEngine(cfg, mesh, params,
+                                       engine_config, **ekw)
+
+            replicas = [InProcessReplica(i, factory,
+                                         http_probes=http_probes)
+                        for i in range(num_replicas)]
+        self._ctls = [_ReplicaCtl(r) for r in replicas]
+        self._lock = threading.RLock()
+        self._queue: deque = deque()
+        self._rids = itertools.count(1)
+        self._ticks = 0
+        self._accepting = True
+        self._draining = False
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+        self._age_window: deque = deque(maxlen=256)
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self._init_metrics(self.registry)
+        if recorder is None:
+            recorder = (NULL_RECORDER
+                        if isinstance(self.registry, NullRegistry)
+                        else FlightRecorder())
+        self.recorder = recorder
+
+    # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+    def _init_metrics(self, r) -> None:
+        self._m_completed = r.counter(
+            "serving_fleet_requests_completed",
+            "Fleet requests fully decoded (across failovers/hedges)")
+        shed = r.counter(
+            "serving_fleet_requests_shed",
+            "Fleet requests rejected or abandoned, by reason",
+            labelnames=("reason",))
+        self._m_shed_deadline = shed.labels("deadline")
+        self._m_shed_overload = shed.labels("overload")
+        self._m_shed_outage = shed.labels("outage")
+        self._m_quarantined = r.counter(
+            "serving_fleet_requests_quarantined",
+            "Fleet requests quarantined by their serving replica")
+        self._m_dispatches = r.counter(
+            "serving_fleet_dispatches",
+            "Request dispatches onto replicas (hedges included)")
+        self._m_failovers = r.counter(
+            "serving_fleet_failovers",
+            "In-flight requests re-dispatched onto a survivor after a "
+            "replica crash or hang, resuming from their committed "
+            "prefix")
+        self._m_hedges = r.counter(
+            "serving_fleet_hedges",
+            "Hedged dispatch resolutions, by which copy won",
+            labelnames=("outcome",))
+        self._m_hedge_primary = self._m_hedges.labels("primary_won")
+        self._m_hedge_hedge = self._m_hedges.labels("hedge_won")
+        self._m_restarts = r.counter(
+            "serving_fleet_restarts",
+            "Supervised replica restarts after a crash")
+        self._m_probe_failures = r.counter(
+            "serving_fleet_probe_failures",
+            "Replica health probes that failed or timed out")
+        self._m_queue_age = r.histogram(
+            "serving_fleet_queue_age_seconds",
+            "Router-queue wait between (re-)enqueue and dispatch",
+            buckets=DECODE_LATENCY_BUCKETS)
+        self._m_recovery = r.histogram(
+            "serving_fleet_recovery_seconds",
+            "Wall time from replica loss to serving-ready again",
+            buckets=DECODE_LATENCY_BUCKETS)
+        g = r.gauge("serving_fleet_replicas",
+                    "Replicas by lifecycle state",
+                    labelnames=("state",))
+        for st in ReplicaState.ALL:
+            g.labels(st).set_function(
+                lambda s=st: float(sum(1 for c in self._ctls
+                                       if c.state() == s)))
+        r.gauge("serving_fleet_queue_depth",
+                "Requests waiting in the router queue").set_function(
+            lambda: float(len(self._queue)))
+        r.gauge("serving_fleet_in_flight_requests",
+                "Fleet requests currently dispatched to a replica"
+                ).set_function(
+            lambda: float(sum(c.n_outstanding() for c in self._ctls)))
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "completed": int(self._m_completed.value),
+            "shed_deadline": int(self._m_shed_deadline.value),
+            "shed_overload": int(self._m_shed_overload.value),
+            "shed_outage": int(self._m_shed_outage.value),
+            "quarantined": int(self._m_quarantined.value),
+            "dispatches": int(self._m_dispatches.value),
+            "failovers": int(self._m_failovers.value),
+            "hedges_primary_won": int(self._m_hedge_primary.value),
+            "hedges_hedge_won": int(self._m_hedge_hedge.value),
+            "restarts": int(self._m_restarts.value),
+            "probe_failures": int(self._m_probe_failures.value)}
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_deadline: str = "shed") -> FleetHandle:
+        """Admit one prompt to the fleet. The submit-time deadline is
+        stamped ABSOLUTE here and every later hop — dispatch, failover,
+        hedge — carries only the remaining budget, so no retry can
+        resurrect a request past its deadline."""
+        if on_deadline not in ("shed", "partial"):
+            raise ValueError(f"on_deadline must be 'shed' or "
+                             f"'partial', got {on_deadline!r}")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token "
+                             "array")
+        now = self._clock()
+        with self._lock:
+            if not self._accepting:
+                raise EngineStopped("fleet router is stopped")
+            if self._draining:
+                raise EngineDraining(
+                    "fleet router is draining: admissions are closed")
+            if len(self._queue) >= self.config.max_queue:
+                self._m_shed_overload.inc()
+                raise OverloadError(
+                    f"router queue full ({self.config.max_queue})")
+            eff = int(max_new_tokens) if max_new_tokens else None
+            if eff is not None and eff < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if eff is None:
+                eff = self._default_max_new()
+            if (self.cfg is not None
+                    and prompt.shape[0] + eff > self.cfg.max_len):
+                raise ValueError(
+                    f"prompt {prompt.shape[0]} + {eff} new tokens "
+                    f"exceeds max_len={self.cfg.max_len}")
+            fr = FleetHandle(
+                next(self._rids), prompt, eff,
+                now + deadline_s if deadline_s is not None else None,
+                on_deadline)
+            fr.trace = self.recorder.start_trace(fr.rid)
+            fr.trace.add("submit", prompt_tokens=int(prompt.shape[0]),
+                         max_new_tokens=int(eff),
+                         deadline_s=(float(deadline_s)
+                                     if deadline_s is not None
+                                     else None))
+            fr._queued_at = now
+            self._queue.append(fr)
+            fr.trace.add("queued", depth=len(self._queue))
+        return fr
+
+    def _default_max_new(self) -> int:
+        for ctl in self._ctls:
+            eng = getattr(ctl.replica, "engine", None)
+            if eng is not None:
+                return int(eng.config.max_new_tokens)
+        return 32
+
+    # ------------------------------------------------------------------
+    # driving
+    # ------------------------------------------------------------------
+    def pending(self) -> bool:
+        with self._lock:
+            return (bool(self._queue)
+                    or any(c.outstanding for c in self._ctls)
+                    or any(not c.dead and c.replica.busy()
+                           for c in self._ctls))
+
+    def run_pending(self, max_idle_ticks: int = 4000) -> int:
+        """Drive scheduling rounds on the caller thread until the queue
+        and every replica are drained. ``max_idle_ticks`` bounds
+        consecutive no-progress rounds (restart backoffs and hang
+        detection advance within it) — a wedged fleet sheds its work
+        typed instead of spinning forever."""
+        n = idle = 0
+        while self.pending():
+            if self.tick():
+                idle = 0
+            else:
+                idle += 1
+                if idle >= max_idle_ticks:
+                    self._shed_stuck("router made no progress "
+                                     f"in {max_idle_ticks} rounds")
+                    break
+                time.sleep(0.0005)
+            n += 1
+        return n
+
+    def tick(self) -> bool:
+        """One scheduling round: injected faults -> crash detection ->
+        restart supervision -> probes -> dispatch (failover/hedge
+        aware) -> replica steps -> harvest -> hang detection. Returns
+        whether the round made progress."""
+        now = self._clock()
+        tick = self._ticks
+        self._ticks += 1
+        self._apply_injections(tick)
+        progressed = self._detect_crashes(now)
+        progressed |= self._tick_restarts(now)
+        if tick % max(1, self.config.probe_every_ticks) == 0:
+            self._probe_all(now)
+        progressed |= self._dispatch(now) > 0
+        for ctl in self._ctls:
+            if ctl.dead or not ctl.replica.alive():
+                continue
+            try:
+                progressed |= bool(ctl.replica.step())
+            except ReplicaCrashed:
+                progressed |= self._on_replica_loss(ctl, "crash", now)
+            except Exception as e:       # a replica must never kill
+                log.exception("replica %d step failed", ctl.id)
+                self._passive_failure(ctl)
+                progressed |= self._on_replica_loss(
+                    ctl, f"step error: {e}", now)
+        progressed |= self._harvest(self._clock()) > 0
+        self._detect_hangs()
+        return progressed
+
+    def start(self) -> "Router":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop_flag = False
+            self._thread = threading.Thread(target=self._worker,
+                                            daemon=True,
+                                            name="fleet-router")
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if drain:
+            self.drain(wait=True)
+        self._stop_flag = True
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._accepting = False
+        self.close()
+
+    def close(self) -> None:
+        for ctl in self._ctls:
+            try:
+                ctl.replica.close()
+            except Exception:
+                pass
+
+    def _worker(self) -> None:
+        while not self._stop_flag:
+            if not self.tick():
+                time.sleep(0.001)
+
+    # ------------------------------------------------------------------
+    # drain / rolling reload
+    # ------------------------------------------------------------------
+    def drain(self, wait: bool = True,
+              timeout: Optional[float] = None) -> "Router":
+        """Fleet-wide graceful drain: the router's `/readyz` flips
+        not-ready and `submit()` raises `EngineDraining` from this
+        instant; queued and in-flight requests finish normally (the
+        queue keeps dispatching — residents are never shed). `resume()`
+        reopens admissions."""
+        self._draining = True
+        if wait:
+            self._await(lambda: not self.pending(), timeout)
+        return self
+
+    def resume(self) -> None:
+        self._draining = False
+
+    def rolling_reload(self, source, step: Optional[int] = None,
+                       timeout: Optional[float] = 120.0) -> List[int]:
+        """Zero-downtime weight rollout: ONE replica at a time is
+        drained out of rotation (the survivors keep serving the
+        queue), hot-reloads its weights, and returns to rotation.
+        Returns the checkpoint step each replica loaded."""
+        loaded = []
+        for ctl in self._ctls:
+            if ctl.dead:
+                continue
+            ctl.draining = True
+            try:
+                self._await(lambda: not ctl.outstanding, timeout)
+                loaded.append(int(ctl.replica.reload(source,
+                                                     step=step)))
+            finally:
+                ctl.draining = False
+        return loaded
+
+    def _await(self, cond: Callable[[], bool],
+               timeout: Optional[float]) -> None:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        idle = 0
+        while not cond():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("fleet wait timed out")
+            if self._thread is None:
+                if not self.tick():
+                    idle += 1
+                    time.sleep(0.0005)
+                    if idle > 4000 and not self.pending():
+                        break
+            else:
+                time.sleep(0.002)
+
+    # ------------------------------------------------------------------
+    # fault injection + supervision
+    # ------------------------------------------------------------------
+    def _ctl(self, replica_id: int) -> Optional[_ReplicaCtl]:
+        for c in self._ctls:
+            if c.id == int(replica_id):
+                return c
+        return None
+
+    def _apply_injections(self, tick: int) -> None:
+        inj = self._injector
+        if inj is None:
+            return
+        if hasattr(inj, "check_kill"):
+            rid = inj.check_kill(tick)
+            if rid is not None:
+                ctl = self._ctl(rid)
+                if ctl is not None and not ctl.dead:
+                    log.warning("injected kill: replica %d at tick %d",
+                                rid, tick)
+                    ctl.replica.kill()
+        if hasattr(inj, "check_hang"):
+            rid = inj.check_hang(tick)
+            if rid is not None:
+                ctl = self._ctl(rid)
+                if ctl is not None and not ctl.dead:
+                    log.warning("injected hang: replica %d at tick %d",
+                                rid, tick)
+                    ctl.replica.set_hung(True)
+        if hasattr(inj, "check_slow"):
+            v = inj.check_slow(tick)
+            if v is not None:
+                ctl = self._ctl(v[0])
+                if ctl is not None:
+                    log.warning("injected slowdown: replica %d "
+                                "+%.3fs/step", v[0], v[1])
+                    ctl.replica.set_slow(v[1])
+
+    def _detect_crashes(self, now: float) -> bool:
+        progressed = False
+        for ctl in self._ctls:
+            if not ctl.dead and not ctl.replica.alive():
+                progressed |= self._on_replica_loss(ctl, "crash", now)
+        return progressed
+
+    def _on_replica_loss(self, ctl: _ReplicaCtl, reason: str,
+                         now: float) -> bool:
+        """A replica is gone (crashed, killed, or declared hung): mark
+        it dead, schedule a supervised restart under the consecutive-
+        crash budget, and fail its in-flight requests over."""
+        if ctl.dead:
+            return False
+        ctl.dead = True
+        ctl.killed_at = now
+        ctl.consec_crashes += 1
+        ctl.ready = False
+        cfgf = self.config
+        if ctl.consec_crashes <= cfgf.max_restarts:
+            backoff = min(
+                cfgf.restart_backoff_base_s
+                * (2 ** (ctl.consec_crashes - 1)),
+                cfgf.restart_backoff_max_s)
+            ctl.next_restart_at = now + backoff
+            log.error("replica %d lost (%s); restart %d/%d in %.3fs",
+                      ctl.id, reason, ctl.consec_crashes,
+                      cfgf.max_restarts, backoff)
+        else:
+            ctl.next_restart_at = None
+            log.error("replica %d lost (%s); consecutive-crash budget "
+                      "exhausted (%d) — staying dead", ctl.id, reason,
+                      cfgf.max_restarts)
+        self._failover_outstanding(ctl, now)
+        return True
+
+    def _failover_outstanding(self, ctl: _ReplicaCtl,
+                              now: float) -> None:
+        """Requeue a dead replica's in-flight requests at the queue
+        FRONT, each resuming from its committed prefix. A request
+        whose hedge twin is still live just drops this hop (the hedge
+        IS the failover); one already past its deadline is shed typed
+        `deadline` — never resurrected."""
+        with self._lock:
+            hops_by_fr = list(ctl.outstanding.items())
+            ctl.outstanding = {}
+            for fr_rid, hops in hops_by_fr:
+                for hop in hops:
+                    fr = hop.fr
+                    if fr.done():
+                        continue
+                    inner = hop.inner
+                    if (inner.done()
+                            and inner.status == RequestStatus.COMPLETED):
+                        # the result survived the crash (it was already
+                        # on this side of the process boundary)
+                        self._resolve_success(fr, hop)
+                        continue
+                    if self._live_hops(fr, exclude=hop):
+                        continue       # hedge twin still serving it
+                    fr._committed = hop.committed()
+                    if fr._committed.shape[0] >= fr.max_new_tokens:
+                        self._resolve_success(fr, hop)
+                        continue
+                    if (fr.deadline_at is not None
+                            and now > fr.deadline_at):
+                        self._shed(fr, "deadline", DeadlineExceeded(
+                            f"fleet request {fr.rid} past deadline "
+                            f"with {fr._committed.shape[0]}/"
+                            f"{fr.max_new_tokens} tokens at replica "
+                            f"{ctl.id}'s loss"))
+                        continue
+                    fr._failover_from = ctl.id
+                    fr._failovers += 1
+                    fr.status = RequestStatus.QUEUED
+                    fr._queued_at = now
+                    self._m_failovers.inc()
+                    self._queue.appendleft(fr)
+
+    def _tick_restarts(self, now: float) -> bool:
+        progressed = False
+        for ctl in self._ctls:
+            if (not ctl.dead or ctl.next_restart_at is None
+                    or now < ctl.next_restart_at):
+                continue
+            try:
+                ctl.replica.restart()
+            except Exception as e:
+                ctl.consec_crashes += 1
+                if ctl.consec_crashes <= self.config.max_restarts:
+                    ctl.next_restart_at = now + min(
+                        self.config.restart_backoff_base_s
+                        * (2 ** (ctl.consec_crashes - 1)),
+                        self.config.restart_backoff_max_s)
+                    log.error("replica %d restart failed (%s); "
+                              "retrying", ctl.id, e)
+                else:
+                    ctl.next_restart_at = None
+                    log.error("replica %d restart failed (%s); budget "
+                              "exhausted", ctl.id, e)
+                continue
+            ctl.dead = False
+            ctl.unhealthy = False
+            ctl.next_restart_at = None
+            ctl.no_progress = 0
+            ctl.restarts += 1
+            ctl.breaker_failures = 0
+            ctl.breaker_open_until = 0.0
+            self._m_restarts.inc()
+            if ctl.killed_at is not None:
+                self._m_recovery.observe(max(0.0, now - ctl.killed_at))
+                ctl.killed_at = None
+            log.info("replica %d restarted (restart #%d)", ctl.id,
+                     ctl.restarts)
+            progressed = True
+        return progressed
+
+    def _probe_all(self, now: float) -> None:
+        inj = self._injector
+        for ctl in self._ctls:
+            if ctl.dead:
+                continue
+            try:
+                if (inj is not None and hasattr(inj, "check_probe")
+                        and inj.check_probe(ctl.id)):
+                    raise RuntimeError(
+                        f"injected probe failure for replica {ctl.id}")
+                h = ctl.replica.probe()
+            except ReplicaCrashed:
+                continue         # crash detection owns this case
+            except Exception:
+                self._m_probe_failures.inc()
+                ctl.consec_probe_failures += 1
+                if (ctl.consec_probe_failures
+                        >= self.config.probe_failure_threshold):
+                    if not ctl.unhealthy:
+                        log.warning("replica %d out of rotation "
+                                    "(%d consecutive probe failures)",
+                                    ctl.id, ctl.consec_probe_failures)
+                    ctl.unhealthy = True
+                    ctl.ready = False
+                continue
+            if ctl.unhealthy:
+                log.info("replica %d probe recovered; back in "
+                         "rotation", ctl.id)
+            ctl.consec_probe_failures = 0
+            ctl.unhealthy = False
+            ctl.last_health = h if isinstance(h, dict) else {}
+            ctl.ready = bool(ctl.last_health.get("ready", False))
+
+    def _detect_hangs(self) -> None:
+        """A replica with in-flight work that commits nothing for
+        ``hang_ticks`` consecutive rounds is declared hung — the
+        wedged-grant mode a liveness probe cannot see — and handled
+        exactly like a crash (in-flight fails over; supervised restart
+        replaces the wedged engine)."""
+        now = self._clock()
+        for ctl in self._ctls:
+            if ctl.dead or not ctl.outstanding:
+                ctl.no_progress = 0
+                continue
+            mark = (sum(int(np.asarray(h.inner.generated).shape[0])
+                        for hs in ctl.outstanding.values()
+                        for h in hs),
+                    sum(int(h.inner.done())
+                        for hs in ctl.outstanding.values()
+                        for h in hs))
+            if mark != ctl.last_progress_mark:
+                ctl.last_progress_mark = mark
+                ctl.last_progress_t = now
+                ctl.no_progress = 0
+                continue
+            ctl.no_progress += 1
+            if (ctl.no_progress >= self.config.hang_ticks
+                    and now - ctl.last_progress_t
+                    >= self.config.hang_min_s):
+                log.error("replica %d declared HUNG (%d rounds with "
+                          "in-flight work and zero progress)", ctl.id,
+                          ctl.no_progress)
+                try:
+                    ctl.replica.set_hung(False)   # un-freeze first so
+                except Exception:                 # kill() can land
+                    pass
+                ctl.replica.kill()
+                self._on_replica_loss(ctl, "hang detected", now)
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def _dispatchable(self, ctl: _ReplicaCtl, now: float) -> bool:
+        return (not ctl.dead and not ctl.draining and not ctl.unhealthy
+                and ctl.ready and now >= ctl.breaker_open_until
+                and ctl.n_outstanding() < ctl.capacity
+                and ctl.replica.alive())
+
+    def _score(self, ctl: _ReplicaCtl) -> float:
+        """Least-occupancy, health-weighted: occupancy fraction plus
+        an error-EMA penalty — a replica that has been failing needs a
+        visibly emptier queue before it wins dispatches again."""
+        return (ctl.n_outstanding() / ctl.capacity
+                + 2.0 * ctl.err_ema)
+
+    def _pick(self, now: float,
+              exclude: Optional[int] = None) -> Optional[_ReplicaCtl]:
+        best, best_score = None, None
+        for ctl in self._ctls:
+            if ctl.id == exclude or not self._dispatchable(ctl, now):
+                continue
+            s = self._score(ctl)
+            if best_score is None or s < best_score:
+                best, best_score = ctl, s
+        return best
+
+    def _restartable(self) -> bool:
+        return any(c.dead and c.next_restart_at is not None
+                   for c in self._ctls)
+
+    def _dispatch(self, now: float) -> int:
+        n = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return n
+                fr = self._queue[0]
+                if fr.done():               # e.g. cancelled upstream
+                    self._queue.popleft()
+                    continue
+                if (fr.deadline_at is not None
+                        and now > fr.deadline_at):
+                    self._queue.popleft()
+                    self._shed(fr, "deadline", DeadlineExceeded(
+                        f"fleet request {fr.rid} past deadline before "
+                        "dispatch"))
+                    n += 1
+                    continue
+                ctl = self._pick(now)
+                if ctl is None:
+                    if (not self._restartable()
+                            and not any(not c.dead
+                                        for c in self._ctls)):
+                        # total outage, nothing will come back: fail
+                        # fast and typed instead of hanging callers
+                        self._queue.popleft()
+                        self._shed(fr, "outage", OverloadError(
+                            "fleet outage: every replica is dead and "
+                            "the restart budget is exhausted"))
+                        n += 1
+                        continue
+                    return n
+                self._queue.popleft()
+                age = max(0.0, now - fr._queued_at)
+                self._m_queue_age.observe(age)
+                self._age_window.append(age)
+                hedge_ctl = None
+                if self._should_hedge(fr, age):
+                    hedge_ctl = self._pick(now, exclude=ctl.id)
+            ok = self._dispatch_to(fr, ctl, now, hedge=False)
+            if ok is None:
+                # replica-side rejection: the request is back at the
+                # queue head; stop dispatching this round so the next
+                # tick's probes/breaker see the failure first
+                return n
+            if ok and hedge_ctl is not None:
+                if self._dispatch_to(fr, hedge_ctl, now, hedge=True):
+                    fr._hedged = True
+            n += 1
+
+    def _should_hedge(self, fr: FleetHandle, age: float) -> bool:
+        cfgf = self.config
+        if not cfgf.hedge or fr._hedged:
+            return False
+        if cfgf.hedge_age_s is not None:
+            return age >= cfgf.hedge_age_s
+        if (age < cfgf.hedge_min_age_s
+                or len(self._age_window) < cfgf.hedge_warmup):
+            return False
+        window = sorted(self._age_window)
+        q = window[min(len(window) - 1,
+                       int(cfgf.hedge_quantile * (len(window) - 1)))]
+        return age >= q
+
+    def _dispatch_to(self, fr: FleetHandle, ctl: _ReplicaCtl,
+                     now: float, hedge: bool) -> Optional[bool]:
+        """Submit ``fr``'s remaining work to ``ctl``: the committed
+        prefix rides in the prompt, only the REMAINING token budget
+        and the REMAINING deadline cross the hop. Returns True on
+        dispatch, False when ``fr`` reached a terminal state instead,
+        and None when the replica rejected the submit (the request is
+        requeued at the head unless a live hop still serves it)."""
+        committed = fr._committed
+        prompt = (np.concatenate([fr.prompt, committed])
+                  if committed.size else fr.prompt)
+        remaining = fr.max_new_tokens - int(committed.shape[0])
+        if remaining <= 0:
+            self._resolve_success(fr, None)
+            return False
+        deadline_s = None
+        if fr.deadline_at is not None:
+            deadline_s = fr.deadline_at - now
+            if deadline_s <= 0:
+                self._shed(fr, "deadline", DeadlineExceeded(
+                    f"fleet request {fr.rid} past deadline at "
+                    "dispatch"))
+                return False
+        try:
+            inner = ctl.replica.submit(prompt.astype(np.int32),
+                                       remaining, deadline_s,
+                                       fr.on_deadline)
+        except (OverloadError, EngineDraining, EngineStopped,
+                ReplicaCrashed) as e:
+            # dispatch failure: passive signal + breaker; requeue at
+            # the front (next round tries elsewhere) — unless a live
+            # hop still serves the request (failed HEDGE attempt)
+            self._passive_failure(ctl)
+            log.warning("dispatch of request %d to replica %d "
+                        "rejected (%s)", fr.rid, ctl.id, e)
+            if self._live_hops(fr):
+                return False
+            with self._lock:
+                fr.status = RequestStatus.QUEUED
+                self._queue.appendleft(fr)
+            return None
+        except ValueError as e:
+            # validation errors are permanent — retrying them on
+            # another replica would loop forever
+            self._shed(fr, "overload", e)
+            return False
+        self._passive_success(ctl)
+        hop = _Hop(fr, ctl.id, inner, committed, hedge, now)
+        with self._lock:
+            ctl.outstanding.setdefault(fr.rid, []).append(hop)
+            ctl.last_progress_t = now    # a dispatch IS progress
+        fr.status = RequestStatus.RUNNING
+        self._m_dispatches.inc()
+        if fr._failover_from is not None:
+            fr.trace.add("failover", **{
+                "from": int(fr._failover_from), "to": ctl.id,
+                "committed": int(committed.shape[0])})
+            fr._failover_from = None
+        fr.trace.add("dispatched", replica=ctl.id, hedge=bool(hedge),
+                     committed=int(committed.shape[0]))
+        return True
+
+    def _passive_failure(self, ctl: _ReplicaCtl) -> None:
+        a = self.config.error_ema_alpha
+        ctl.err_ema = ctl.err_ema * (1 - a) + a
+        ctl.breaker_failures += 1
+        if ctl.breaker_failures >= self.config.breaker_failure_threshold:
+            ctl.breaker_open_until = (self._clock()
+                                      + self.config.breaker_cooldown_s)
+            log.warning("replica %d dispatch breaker open for %.1fs",
+                        ctl.id, self.config.breaker_cooldown_s)
+
+    def _passive_success(self, ctl: _ReplicaCtl) -> None:
+        ctl.err_ema *= (1 - self.config.error_ema_alpha)
+        ctl.breaker_failures = 0
+
+    # ------------------------------------------------------------------
+    # harvest
+    # ------------------------------------------------------------------
+    def _live_hops(self, fr: FleetHandle,
+                   exclude: Optional[_Hop] = None) -> List[_Hop]:
+        out = []
+        for ctl in self._ctls:
+            if ctl.dead:
+                continue
+            for hop in ctl.outstanding.get(fr.rid, ()):
+                if hop is not exclude and not hop.inner.done():
+                    out.append(hop)
+        return out
+
+    def _drop_hop(self, hop: _Hop) -> None:
+        ctl = self._ctl(hop.replica_id)
+        if ctl is None:
+            return
+        hops = ctl.outstanding.get(hop.fr.rid)
+        if hops and hop in hops:
+            hops.remove(hop)
+            if not hops:
+                ctl.outstanding.pop(hop.fr.rid, None)
+
+    def _harvest(self, now: float) -> int:
+        n = 0
+        with self._lock:
+            terminal = [(ctl, hop)
+                        for ctl in self._ctls
+                        for hops in list(ctl.outstanding.values())
+                        for hop in list(hops)
+                        if hop.inner.done()]
+        for ctl, hop in terminal:
+            fr = hop.fr
+            inner = hop.inner
+            with self._lock:
+                self._drop_hop(hop)
+            if fr.done():
+                continue         # a twin already resolved it
+            st = inner.status
+            if st == RequestStatus.COMPLETED:
+                self._resolve_success(fr, hop)
+                # a replica that completes work has proven itself:
+                # reset its consecutive-crash budget (durability
+                # subsystem semantics — spaced crashes don't kill it)
+                ctl.consec_crashes = 0
+                n += 1
+            elif st == RequestStatus.QUARANTINED:
+                self._cancel_twins(fr, None)
+                fr._committed = hop.committed()
+                self._m_quarantined.inc()
+                fr.trace.add("quarantined")
+                fr._finish(RequestStatus.QUARANTINED, inner.error)
+                n += 1
+            elif getattr(inner, "_cancelled", False):
+                n += 1           # a hedge loser we cancelled: drop
+            elif inner.deadline_exceeded:
+                self._cancel_twins(fr, None)
+                fr._committed = hop.committed()
+                fr.deadline_exceeded = True
+                self._shed(fr, "deadline",
+                           inner.error or DeadlineExceeded(
+                               f"fleet request {fr.rid} past deadline "
+                               "at its replica"))
+                n += 1
+            else:
+                # replica-side rejection (overload/drain race): one
+                # more chance on the rest of the fleet
+                self._passive_failure(ctl)
+                if self._live_hops(fr):
+                    continue
+                with self._lock:
+                    fr.status = RequestStatus.QUEUED
+                    fr._queued_at = now
+                    self._queue.appendleft(fr)
+                n += 1
+        return n
+
+    def _resolve_success(self, fr: FleetHandle,
+                         hop: Optional[_Hop]) -> None:
+        if fr.done():
+            return
+        if hop is not None:
+            fr._committed = hop.committed()
+            fr.deadline_exceeded = bool(hop.inner.deadline_exceeded)
+        winners = "hedge_won" if (hop is not None
+                                  and hop.hedge) else "primary_won"
+        if fr._hedged:
+            (self._m_hedge_hedge if winners == "hedge_won"
+             else self._m_hedge_primary).inc()
+        self._cancel_twins(fr, hop)
+        if fr._hedged and hop is not None:
+            fr.trace.add("hedge", winner=hop.replica_id,
+                         outcome=winners)
+        self._m_completed.inc()
+        fr.trace.add("finished",
+                     tokens=int(fr._committed.shape[0]),
+                     partial=bool(fr.deadline_exceeded))
+        fr._finish(RequestStatus.COMPLETED)
+
+    def _cancel_twins(self, fr: FleetHandle,
+                      winner: Optional[_Hop]) -> None:
+        """First-winner-cancels: every other live hop of ``fr`` is
+        cancelled at its replica and dropped."""
+        with self._lock:
+            losers = [(ctl, hop) for ctl in self._ctls
+                      for hop in list(ctl.outstanding.get(fr.rid, ()))
+                      if hop is not winner]
+            for ctl, hop in losers:
+                self._drop_hop(hop)
+        for ctl, hop in losers:
+            try:
+                ctl.replica.cancel(hop.inner)
+            except Exception:
+                pass
+
+    def _shed(self, fr: FleetHandle, reason: str,
+              err: BaseException) -> None:
+        self._cancel_twins(fr, None)
+        if reason == "deadline":
+            fr.deadline_exceeded = True
+            if fr.on_deadline == "partial":
+                # mirror the engine's partial contract at fleet level
+                self._m_completed.inc()
+                fr.trace.add("finished",
+                             tokens=int(fr._committed.shape[0]),
+                             partial=True)
+                fr._finish(RequestStatus.COMPLETED)
+                return
+            self._m_shed_deadline.inc()
+        elif reason == "outage":
+            self._m_shed_outage.inc()
+        else:
+            self._m_shed_overload.inc()
+        fr.trace.add("shed", reason=reason)
+        fr._finish(RequestStatus.SHED, err)
+
+    def _shed_stuck(self, why: str) -> None:
+        log.error("fleet stalled: %s — shedding pending work", why)
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+            for ctl in self._ctls:
+                for hops in ctl.outstanding.values():
+                    pending.extend(h.fr for h in hops)
+                ctl.outstanding = {}
+        for fr in pending:
+            if not fr.done():
+                self._shed(fr, "outage", OverloadError(
+                    f"fleet stalled: {why}"))
+
+    # ------------------------------------------------------------------
+    # health / introspection
+    # ------------------------------------------------------------------
+    def ready(self) -> bool:
+        """Router readiness: accepting, not draining, and at least one
+        replica is dispatchable-or-probing-ready. Wire into
+        `MetricsServer(ready=router.ready)` for the fleet `/readyz`."""
+        if not self._accepting or self._draining:
+            return False
+        return any(not c.dead and not c.draining and not c.unhealthy
+                   and c.ready for c in self._ctls)
+
+    def health(self) -> dict:
+        return {"ready": self.ready(),
+                "draining": self._draining,
+                "queue_depth": len(self._queue),
+                "replicas": {c.id: c.state() for c in self._ctls},
+                **self.stats}
+
+    def debugz(self, recent: int = 100) -> dict:
+        """The fleet table: per-replica state, occupancy, passive
+        signals, restart budget, plus the router queue and recent
+        router-hop events — `MetricsServer(debug=router.debugz)`."""
+        now = self._clock()
+        with self._lock:
+            replicas = [{
+                "replica": c.id,
+                "kind": getattr(c.replica, "kind", "?"),
+                "state": c.state(),
+                "ready": c.ready,
+                "capacity": c.capacity,
+                "outstanding": c.n_outstanding(),
+                "err_ema": round(c.err_ema, 4),
+                "consec_probe_failures": c.consec_probe_failures,
+                "consec_crashes": c.consec_crashes,
+                "restarts": c.restarts,
+                "probe_url": getattr(c.replica, "probe_url", None),
+                "occupancy": c.last_health.get("slots_occupied"),
+                "weights_step": c.last_health.get("weights_step"),
+            } for c in self._ctls]
+            queue = [{"rid": fr.rid,
+                      "queue_age_s": round(max(0.0,
+                                               now - fr._queued_at), 6),
+                      "failovers": fr._failovers}
+                     for fr in self._queue]
+        return {"replicas": replicas,
+                "queue_depth": len(queue),
+                "queue": queue,
+                "draining": self._draining,
+                "ticks": self._ticks,
+                "stats": self.stats,
+                "recent_events": [e.as_dict() for e in
+                                  self.recorder.recent(recent)]}

@@ -1,0 +1,172 @@
+"""Subprocess replica worker: one engine process behind a JSON pipe.
+
+`serving/fleet.py`'s `SubprocessReplica` spawns this module
+(``python -m deeplearning4j_tpu.serving.fleet_worker``) to put a REAL
+process boundary under the fleet's crash/hang scenarios — extending
+tests/test_multihost.py's pattern from training to serving. Protocol:
+
+- stdin, line 1: the replica spec —
+  ``{"cfg": {TransformerConfig kwargs}, "engine": {EngineConfig
+  kwargs}, "params_seed": int, "progress_interval_s": float}``.
+  Weights are re-derived from ``params_seed`` (deterministic init), so
+  every replica of a fleet is token-identical without shipping arrays
+  across the pipe.
+- stdout, line 1: ``{"ev": "hello", "port": <metrics port>, "pid":
+  ..., "num_slots": ...}`` — the port serves the engine's REAL
+  `/healthz`/`/readyz`/`/metrics`/`/debugz` endpoints
+  (observability.MetricsServer); the router probes them over HTTP.
+- stdin thereafter: one JSON command per line — ``submit`` / ``cancel``
+  / ``drain`` / ``resume`` / ``reload`` / ``stop``.
+- stdout thereafter: streamed request events — ``accepted`` /
+  ``rejected`` / ``progress`` (the committed tokens so far: the
+  router's failover substrate when this process is SIGKILLed) /
+  ``done`` / ``error`` — plus ``drained``/``resumed``/``reloaded``
+  acks.
+
+The engine runs its own background worker thread; a progress thread
+polls in-flight handles at ``progress_interval_s``. A SIGKILL at any
+point leaves the router holding each request's last progress snapshot,
+which is exactly the committed prefix failover resumes from.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _force_cpu() -> None:
+    """Never claim the TPU tunnel from a fleet worker (same recipe as
+    parallel/multihost.py's launcher driver)."""
+    import jax
+    try:
+        from jax._src import xla_bridge as xb
+        xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main() -> int:
+    _force_cpu()
+    spec = json.loads(sys.stdin.readline())
+
+    import numpy as np
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.observability.export import MetricsServer
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine)
+
+    cfg = TransformerConfig(**spec["cfg"])
+    params = init_params(cfg, jax.random.PRNGKey(
+        int(spec.get("params_seed", 0))))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    eng = InferenceEngine(cfg, mesh, params,
+                          EngineConfig(**spec.get("engine", {})))
+    srv = MetricsServer(eng.registry, port=0, health=eng.health,
+                        ready=eng.ready, debug=eng.debugz)
+
+    out_lock = threading.Lock()
+
+    def emit(obj: dict) -> None:
+        with out_lock:
+            sys.stdout.write(json.dumps(obj) + "\n")
+            sys.stdout.flush()
+
+    emit({"ev": "hello", "port": srv.port, "pid": os.getpid(),
+          "num_slots": eng._num_slots})
+
+    handles: dict = {}
+    h_lock = threading.Lock()
+    stop = threading.Event()
+
+    def progress_loop() -> None:
+        """Stream each in-flight request's committed tokens — the
+        router's failover substrate — and its terminal event."""
+        interval = float(spec.get("progress_interval_s", 0.02))
+        while not stop.wait(interval):
+            with h_lock:
+                items = list(handles.items())
+            for rid, h in items:
+                if h.done():
+                    with h_lock:
+                        handles.pop(rid, None)
+                    toks = h.generated.tolist()
+                    if h.error is None:
+                        emit({"ev": "done", "rid": rid, "tokens": toks,
+                              "partial": bool(h.deadline_exceeded)})
+                    else:
+                        emit({"ev": "error", "rid": rid,
+                              "etype": type(h.error).__name__,
+                              "msg": str(h.error), "tokens": toks})
+                else:
+                    emit({"ev": "progress", "rid": rid,
+                          "tokens": h.generated.tolist()})
+
+    threading.Thread(target=progress_loop, daemon=True,
+                     name="fleet-worker-progress").start()
+    eng.start()
+
+    for line in sys.stdin:
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            continue
+        op = cmd.get("op")
+        if op == "submit":
+            rid = cmd["rid"]
+            try:
+                h = eng.submit(
+                    np.asarray(cmd["prompt"], np.int32),
+                    max_new_tokens=cmd.get("max_new_tokens"),
+                    deadline_s=cmd.get("deadline_s"),
+                    on_deadline=cmd.get("on_deadline", "shed"))
+            except Exception as e:
+                emit({"ev": "rejected", "rid": rid,
+                      "etype": type(e).__name__, "msg": str(e)})
+                continue
+            with h_lock:
+                handles[rid] = h
+            emit({"ev": "accepted", "rid": rid})
+        elif op == "cancel":
+            with h_lock:
+                h = handles.get(cmd.get("rid"))
+            if h is not None:
+                eng.cancel(h)
+        elif op == "drain":
+            eng.drain(wait=True)
+            emit({"ev": "drained"})
+        elif op == "resume":
+            eng.resume()
+            emit({"ev": "resumed"})
+        elif op == "reload":
+            try:
+                step = eng.reload_weights(cmd["dir"],
+                                          step=cmd.get("step"))
+                emit({"ev": "reloaded", "step": int(step)})
+            except Exception as e:
+                emit({"ev": "reloaded", "step": -1,
+                      "error": f"{type(e).__name__}: {e}"})
+        elif op == "stop":
+            break
+    stop.set()
+    srv.stop()
+    try:
+        eng.stop(drain=False)
+    except Exception:
+        pass
+    emit({"ev": "bye"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Replicated serving fleet demo: kill a replica mid-trace, lose nothing.
+
+Builds a 3-replica in-process fleet behind the health-aware `Router`
+(deeplearning4j_tpu/serving/fleet.py), serves a mixed burst of
+requests, KILLS one replica while its requests are mid-decode, and
+shows:
+
+- every request still completes (failover resumes each one from its
+  committed prefix on a survivor — token-exact, as the fleet test
+  suite asserts bit-for-bit);
+- the fleet table (`/debugz` body) with the dead replica's supervised
+  restart and recovery time;
+- a rolling weight reload across the fleet with zero dropped
+  requests;
+- the `serving_fleet_*` Prometheus series a scraper would collect.
+
+Run: JAX_PLATFORMS=cpu python examples/fleet_serving.py
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from deeplearning4j_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig, init_params)
+from deeplearning4j_tpu.observability.export import (  # noqa: E402
+    MetricsServer, prometheus_text)
+from deeplearning4j_tpu.parallel.failure import (  # noqa: E402
+    FleetFaultInjector)
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: E402
+    MeshSpec, make_mesh)
+from deeplearning4j_tpu.serving import (  # noqa: E402
+    EngineConfig, FleetConfig, Router)
+from deeplearning4j_tpu.util.checkpointing import (  # noqa: E402
+    CheckpointManager)
+
+
+def main() -> None:
+    cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=4,
+                            n_layers=2, max_len=96)
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    # kill replica 1 at scheduling tick 4 — mid-decode for its slots;
+    # the supervised restart brings it back with a small backoff
+    injector = FleetFaultInjector(kill_at={4: 1})
+    router = Router(cfg=cfg, mesh=mesh, params=params, num_replicas=3,
+                    engine_config=EngineConfig(
+                        max_batch_size=4, max_new_tokens=24,
+                        decode_chunk=4, backoff_base_s=0.0),
+                    fault_injector=injector,
+                    config=FleetConfig(restart_backoff_base_s=0.05))
+    server = MetricsServer(router.registry, port=0,
+                           health=router.health, ready=router.ready,
+                           debug=router.debugz)
+
+    print(f"fleet of 3 replicas up; router metrics at {server.url}")
+    print("submitting 12 requests, then killing replica 1 "
+          "mid-trace...\n")
+    handles = [router.submit(
+        rng.integers(0, cfg.vocab_size,
+                     int(rng.integers(6, 20))).astype(np.int32),
+        max_new_tokens=24) for _ in range(12)]
+    t0 = time.perf_counter()
+    router.run_pending()
+    elapsed = time.perf_counter() - t0
+
+    done = sum(h.status == "completed" for h in handles)
+    st = router.stats
+    print(f"completed {done}/12 in {elapsed:.2f}s — "
+          f"{st['failovers']} failover(s), 0 lost")
+    for h in handles:
+        kinds = h.trace.kinds()
+        if "failover" in kinds:
+            ev = [e for e in h.trace.events if e.kind == "failover"][0]
+            print(f"  request {h.rid}: replica {ev.data['from']} died "
+                  f"with {ev.data['committed']} tokens committed -> "
+                  f"resumed on replica {ev.data['to']}; trace "
+                  f"{kinds}")
+
+    # let the supervised restart land, then show the fleet table
+    deadline = time.monotonic() + 10
+    while router.stats["restarts"] < 1 and time.monotonic() < deadline:
+        router.tick()
+        time.sleep(0.005)
+    print("\nfleet table (/debugz):")
+    for row in router.debugz()["replicas"]:
+        print(f"  replica {row['replica']}: {row['state']}, "
+              f"capacity {row['capacity']}, "
+              f"crashes {row['consec_crashes']}, "
+              f"restarts {row['restarts']}")
+    rec = router.registry.get("serving_fleet_recovery_seconds")
+    _, total, count = rec.labels().snapshot()
+    if count:
+        print(f"  recovery-to-ready: {total / count * 1e3:.0f} ms")
+
+    # rolling weight reload: one replica drains at a time, traffic
+    # keeps flowing, nothing is shed
+    ckpt_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_fleet_ckpt")
+    mgr = CheckpointManager(ckpt_dir, use_orbax=False)
+    mgr.save_tree(params, 42)
+    more = [router.submit(
+        rng.integers(0, cfg.vocab_size, 8).astype(np.int32),
+        max_new_tokens=8) for _ in range(6)]
+    loaded = router.rolling_reload(mgr)
+    router.run_pending()
+    print(f"\nrolling reload: every replica now on step {loaded}; "
+          f"{sum(h.status == 'completed' for h in more)}/6 requests "
+          "served through the rollout, 0 shed")
+
+    print("\nfleet scrape (serving_fleet_* series):")
+    for line in prometheus_text(router.registry).splitlines():
+        if line.startswith("serving_fleet") and "_bucket" not in line:
+            print(f"  {line}")
+
+    server.stop()
+    router.close()
+    import shutil
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

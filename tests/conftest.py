@@ -46,3 +46,9 @@ def rng():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests")
+    config.addinivalue_line(
+        "markers",
+        "multiproc: spawns real subprocess replicas (tier-1-eligible; "
+        "every blocking wait is hard-bounded and fixtures kill child "
+        "processes on teardown, so a wedged replica cannot hang the "
+        "suite)")

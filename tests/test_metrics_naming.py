@@ -175,6 +175,88 @@ def test_spec_series_pass_the_lint():
                     or name in UNITLESS_HISTOGRAMS), name
 
 
+def test_fleet_series_pass_the_lint():
+    """The fleet-router series (ISSUE-9: serving_fleet_replicas{state}
+    / serving_fleet_queue_depth gauges, serving_fleet_{failovers,
+    hedges,restarts,probe_failures,dispatches,requests_*}_total
+    counters, serving_fleet_{queue_age,recovery}_seconds histograms)
+    live in the ROUTER registry — scrape one over real fleet traffic
+    (a replica kill included, so failover/restart series have samples)
+    and run the same naming rules over the whole exposition."""
+    from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+    from deeplearning4j_tpu.serving import FleetConfig, Router
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    inj = FleetFaultInjector(kill_at={2: 0})
+    router = Router(cfg=cfg, mesh=mesh, params=params, num_replicas=2,
+                    engine_config=EngineConfig(
+                        decode_chunk=2, max_new_tokens=8,
+                        backoff_base_s=0.0, max_batch_size=2),
+                    fault_injector=inj,
+                    config=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        hs = [router.submit(prompt, max_new_tokens=8)
+              for _ in range(4)]
+        router.run_pending()
+        assert all(h.done() for h in hs)
+
+        srv = MetricsServer(router.registry, port=0,
+                            health=router.health, ready=router.ready,
+                            debug=router.debugz)
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+        finally:
+            srv.stop()
+    finally:
+        router.close()
+    types = _types(text)
+    # every ISSUE-9 family is present and correctly typed
+    assert types["serving_fleet_replicas"] == "gauge"
+    assert types["serving_fleet_queue_depth"] == "gauge"
+    assert types["serving_fleet_failovers_total"] == "counter"
+    assert types["serving_fleet_hedges_total"] == "counter"
+    assert types["serving_fleet_restarts_total"] == "counter"
+    assert types["serving_fleet_probe_failures_total"] == "counter"
+    assert types["serving_fleet_dispatches_total"] == "counter"
+    assert types["serving_fleet_requests_completed_total"] == "counter"
+    assert types["serving_fleet_requests_shed_total"] == "counter"
+    assert types["serving_fleet_requests_quarantined_total"] \
+        == "counter"
+    assert types["serving_fleet_queue_age_seconds"] == "histogram"
+    assert types["serving_fleet_recovery_seconds"] == "histogram"
+    assert types["serving_fleet_in_flight_requests"] == "gauge"
+    # the kill really exercised the failover series
+    assert "serving_fleet_failovers_total 0" not in text
+    # full-lint pass over the fleet exposition
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):

@@ -487,3 +487,112 @@ def test_injector_poison_matches_request_ids():
     inj.on_decode_step(0, request_ids=[1, 2])   # clean batch passes
     with pytest.raises(TrainingFailure, match="poisoned"):
         inj.on_decode_step(1, request_ids=[2, 7])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-9 satellites: typed stop/drain rejection, probe semantics, cancel
+# ---------------------------------------------------------------------------
+
+def test_submit_after_stop_raises_engine_stopped(params, mesh1):
+    """submit() after stop() must fail IMMEDIATELY and typed — the old
+    behavior risked enqueueing onto a bounded queue nobody will ever
+    drain, hanging the caller in result() forever."""
+    from deeplearning4j_tpu.serving import EngineStopped
+
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    h = eng.submit(_prompt())
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    eng.stop()
+    t0 = time.perf_counter()
+    with pytest.raises(EngineStopped):
+        eng.submit(_prompt())
+    assert time.perf_counter() - t0 < 1.0       # immediate, no hang
+    # EngineStopped subclasses RuntimeError: pre-ISSUE-9 callers that
+    # caught RuntimeError keep working
+    with pytest.raises(RuntimeError):
+        eng.submit(_prompt())
+
+
+def test_drain_rejects_typed_and_flips_readyz_immediately(params,
+                                                          mesh1):
+    """The drain contract, end to end: the instant drain() is called,
+    submit() raises EngineDraining and /readyz reports 503 — while the
+    RESIDENT requests are still decoding — then every resident
+    completes (zero shed) and resume() reopens admissions."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.observability import MetricsServer
+    from deeplearning4j_tpu.serving import EngineDraining
+
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    srv = MetricsServer(eng.registry, port=0, health=eng.health,
+                        ready=eng.ready)
+    try:
+        hs = [eng.submit(_prompt(8, i)) for i in range(2)]
+        eng.tick()                   # residents seated, mid-decode
+        assert eng.ready()
+        with urllib.request.urlopen(srv.url + "/readyz",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+        eng.drain(wait=False)
+        # not-ready the MOMENT drain begins: residents still running
+        assert not eng.drained()
+        assert not eng.ready()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        # /healthz echoes the full health dict: draining is visible
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert json.loads(ei.value.read())["draining"] is True
+        with pytest.raises(EngineDraining):
+            eng.submit(_prompt())
+        eng.run_pending()            # residents finish, nothing shed
+        assert eng.drained()
+        for h in hs:
+            assert h.status == RequestStatus.COMPLETED
+        assert eng.stats["shed_deadline"] == 0
+        assert eng.stats["shed_overload"] == 0
+        eng.resume()
+        assert eng.ready()
+        h = eng.submit(_prompt())
+        eng.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+    finally:
+        srv.stop()
+
+
+def test_cancel_queued_and_in_flight(params, mesh1):
+    """engine.cancel(): a queued request sheds immediately, an
+    in-flight one at its next chunk boundary — both typed
+    RequestCancelled and counted under shed{reason=cancelled} (the
+    fleet router's first-winner-cancels hedging contract)."""
+    from deeplearning4j_tpu.serving import RequestCancelled
+
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=1, num_slots=1,
+                                  max_new_tokens=6))
+    running = eng.submit(_prompt(8, 0))
+    queued = eng.submit(_prompt(8, 1))
+    eng.tick()                       # seats `running`, decodes chunk 1
+    assert running.status == RequestStatus.RUNNING
+    assert eng.cancel(queued) is True
+    assert queued.status == RequestStatus.SHED        # immediate
+    with pytest.raises(RequestCancelled):
+        queued.result(0)
+    assert eng.cancel(running) is True
+    eng.run_pending()                # chunk boundary sheds it
+    assert running.status == RequestStatus.SHED
+    with pytest.raises(RequestCancelled):
+        running.result(0)
+    assert running.generated.shape[0] < 6    # partial, then cut short
+    shed = eng.registry.get("serving_requests_shed")
+    assert int(shed.labels("cancelled").value) == 2
+    # terminal handles are left untouched
+    assert eng.cancel(queued) is False
+    # the cancelled sheds are traced with their reason
+    assert [e.data["reason"] for e in running.trace.events
+            if e.kind == "shed"] == ["cancelled"]

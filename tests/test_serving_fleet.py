@@ -1,0 +1,729 @@
+"""Replicated serving fleet: deterministic fault-injection suite.
+
+Every ISSUE-9 acceptance behavior, proven on the CPU backend with
+`FleetFaultInjector` (no real crashed hosts, no real overload):
+
+- replica crash / hang / slowdown each cost at most one retried
+  request and ZERO lost requests — never an outage;
+- failover continuations resume from the committed prefix and are
+  TOKEN-EXACT vs an uninterrupted single-engine run (position-keyed
+  sampling makes this assertable bit-for-bit);
+- hedged dispatch races two replicas, the first winner cancels the
+  loser, and both outcomes are counted;
+- drain flips readiness immediately and completes a rolling weight
+  reload with zero shed requests;
+- supervised restart brings crashed replicas back under an
+  exponential backoff + consecutive-crash budget, and a replica past
+  its budget stays dead while the fleet serves on;
+- submit-time deadlines propagate across failover/hedge hops, so a
+  retried request can never resurrect past its deadline (shed typed
+  `deadline` at the router).
+
+The `multiproc`-marked tests at the bottom put a REAL process
+boundary (serving/fleet_worker.py subprocesses, probed over real
+HTTP) under the same router: SIGKILL is the crash. They are
+tier-1-eligible but hard-bounded — every wait carries a timeout and
+the watchdog fixture kills child processes on teardown, so a wedged
+replica can never hang the suite.
+"""
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.observability.export import (MetricsServer,
+                                                     prometheus_text)
+from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (DeadlineExceeded, EngineConfig,
+                                        EngineDraining, FleetConfig,
+                                        InferenceEngine, OverloadError,
+                                        RequestStatus, Router,
+                                        SubprocessReplica)
+from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+#: Hard wall for anything that could block on a child process.
+HARD_TIMEOUT_S = 240.0
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _ec(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=12, backoff_base_s=0.0,
+                max_batch_size=2)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _router(params, mesh, n=2, inj=None, fleet=None, ec=None, **kw):
+    return Router(cfg=CFG, mesh=mesh, params=params, num_replicas=n,
+                  engine_config=ec or _ec(), fault_injector=inj,
+                  config=fleet or FleetConfig(
+                      restart_backoff_base_s=0.01), **kw)
+
+
+def _reference(params, mesh, prompts, max_new=12):
+    """Uninterrupted single-engine run — the token-exactness oracle."""
+    eng = InferenceEngine(CFG, mesh, params, _ec())
+    out = []
+    for p in prompts:
+        h = eng.submit(p, max_new_tokens=max_new)
+        eng.run_pending()
+        out.append(h.result(0))
+    return out
+
+
+class _Clock:
+    """Injected clock shared by the router and its engines."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# happy path + policy
+# ---------------------------------------------------------------------------
+
+def test_fleet_completes_token_exact(params, mesh1):
+    """N replicas built from one seed serve interchangeably: every
+    fleet result equals the single-engine run bit-for-bit."""
+    prompts = [_prompt(8, i) for i in range(5)]
+    want = _reference(params, mesh1, prompts)
+    r = _router(params, mesh1, n=3)
+    try:
+        hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+        r.run_pending()
+        for h, w in zip(hs, want):
+            np.testing.assert_array_equal(h.result(0), w)
+            assert h.status == RequestStatus.COMPLETED
+        assert r.stats["completed"] == 5
+        assert r.stats["failovers"] == 0
+    finally:
+        r.close()
+
+
+def test_least_occupancy_spreads_load(params, mesh1):
+    """With more concurrent requests than one replica's slots, the
+    least-occupancy policy must seat work on EVERY replica."""
+    r = _router(params, mesh1, n=2)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(4)]
+        r.tick()                     # probes + first dispatch round
+        d = r.debugz()
+        per_replica = {row["replica"]: row["outstanding"]
+                       for row in d["replicas"]}
+        assert all(v > 0 for v in per_replica.values()), per_replica
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+    finally:
+        r.close()
+
+
+def test_router_submit_validation(params, mesh1):
+    r = _router(params, mesh1, n=1)
+    try:
+        with pytest.raises(ValueError, match="on_deadline"):
+            r.submit(_prompt(), on_deadline="explode")
+        with pytest.raises(ValueError, match="1-D"):
+            r.submit(np.zeros((2, 4), np.int32))
+        with pytest.raises(ValueError, match="max_len"):
+            r.submit(np.zeros(CFG.max_len - 1, np.int32),
+                     max_new_tokens=12)
+    finally:
+        r.close()
+
+
+def test_fleet_queue_overload_sheds_typed(params, mesh1):
+    r = _router(params, mesh1, n=1,
+                fleet=FleetConfig(max_queue=2))
+    try:
+        r.submit(_prompt(8, 0), max_new_tokens=2)
+        r.submit(_prompt(8, 1), max_new_tokens=2)
+        with pytest.raises(OverloadError, match="queue full"):
+            r.submit(_prompt(8, 2), max_new_tokens=2)
+        r.run_pending()
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: kill / hang / slow — at most one retry, zero lost
+# ---------------------------------------------------------------------------
+
+def test_kill_replica_mid_decode_failover_token_exact(params, mesh1):
+    """A replica crash mid-decode: its in-flight requests fail over
+    to the survivor FROM THEIR COMMITTED PREFIX and finish
+    token-exactly vs an uninterrupted run — at most one retried
+    dispatch per request, zero lost."""
+    prompts = [_prompt(8, i) for i in range(4)]
+    want = _reference(params, mesh1, prompts)
+    inj = FleetFaultInjector(kill_at={2: 0})
+    r = _router(params, mesh1, n=2, inj=inj)
+    try:
+        hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+        r.run_pending()
+        assert inj.kills_injected == 1
+        assert r.stats["failovers"] >= 1
+        for h, w in zip(hs, want):
+            np.testing.assert_array_equal(h.result(0), w)
+        # at most ONE retried dispatch per request: a trace is either
+        # submit->dispatched->finished or has exactly one failover hop
+        for h in hs:
+            kinds = h.trace.kinds()
+            assert kinds.count("dispatched") <= 2
+            assert kinds.count("failover") <= 1
+            if "failover" in kinds:
+                ev = [e for e in h.trace.events
+                      if e.kind == "failover"][0]
+                assert ev.data["from"] == 0
+                assert ev.data["to"] == 1
+    finally:
+        r.close()
+
+
+def test_kill_zero_lost_requests(params, mesh1):
+    """Heavier trace, kill mid-stream: every single request reaches a
+    COMPLETED terminal state — zero lost, zero shed."""
+    inj = FleetFaultInjector(kill_at={3: 1})
+    r = _router(params, mesh1, n=3, inj=inj)
+    try:
+        hs = [r.submit(_prompt(8 + (i % 2) * 4, i), max_new_tokens=12)
+              for i in range(9)]
+        r.run_pending()
+        assert [h.status for h in hs] == [RequestStatus.COMPLETED] * 9
+        assert r.stats["shed_deadline"] == 0
+        assert r.stats["shed_overload"] == 0
+        assert r.stats["shed_outage"] == 0
+    finally:
+        r.close()
+
+
+def test_hang_replica_detected_and_failed_over(params, mesh1):
+    """A hung replica (alive, probing healthy, committing NOTHING) is
+    the failure liveness probes cannot see: the router's no-progress
+    detector declares it hung, fails its residents over token-exactly,
+    and restarts it."""
+    prompts = [_prompt(8, i) for i in range(4)]
+    want = _reference(params, mesh1, prompts)
+    inj = FleetFaultInjector(hang_at={2: 0})
+    r = _router(params, mesh1, n=2, inj=inj,
+                fleet=FleetConfig(hang_ticks=5, hang_min_s=0.0,
+                                  restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+        r.run_pending()
+        assert inj.hangs_injected == 1
+        assert r.stats["failovers"] >= 1
+        for h, w in zip(hs, want):
+            np.testing.assert_array_equal(h.result(0), w)
+    finally:
+        r.close()
+
+
+def test_slow_replica_hedged_first_winner_cancels(params, mesh1):
+    """A slow (gray-failing) replica: hedged requests dispatch to TWO
+    replicas, the fast copy wins and resolves the fleet handle
+    token-exactly, and the slow loser is CANCELLED at its engine (shed
+    reason=cancelled) — a slow replica costs a cancelled duplicate,
+    never a slow answer."""
+    prompts = [_prompt(8, i) for i in range(2)]
+    want = _reference(params, mesh1, prompts)
+    inj = FleetFaultInjector(slow_at={1: (0, 0.2)})
+    r = _router(params, mesh1, n=2, inj=inj,
+                fleet=FleetConfig(hedge=True, hedge_age_s=0.0,
+                                  restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+        r.run_pending()
+        st = r.stats
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        hedges = st["hedges_primary_won"] + st["hedges_hedge_won"]
+        assert hedges >= 1, st
+        for h, w in zip(hs, want):
+            np.testing.assert_array_equal(h.result(0), w)
+        # the loser really was cancelled engine-side
+        cancelled = sum(
+            int(ctl.replica.engine.registry
+                .get("serving_requests_shed")
+                .labels("cancelled").value)
+            for ctl in r._ctls)
+        assert cancelled >= 1
+        # hedged traces carry the dispatched{hedge=True} hop + outcome
+        hedged = [h for h in hs if any(
+            e.kind == "dispatched" and e.data.get("hedge")
+            for e in h.trace.events)]
+        assert hedged
+        assert any("hedge" in h.trace.kinds() for h in hedged)
+    finally:
+        r.close()
+
+
+def test_hedge_slow_decile_policy(params, mesh1):
+    """The default hedge trigger (no absolute hedge_age_s): only
+    queue-ages at or past the rolling p90, after warmup, and never
+    below hedge_min_age_s."""
+    r = _router(params, mesh1, n=2,
+                fleet=FleetConfig(hedge=True, hedge_min_age_s=0.05,
+                                  hedge_warmup=10, hedge_quantile=0.9))
+    try:
+        fr = r.submit(_prompt(8, 0), max_new_tokens=2)
+        # below warmup: never hedge
+        assert not r._should_hedge(fr, 10.0)
+        r._age_window.extend([0.001] * 18 + [1.0, 2.0])
+        # in the slowest decile and past min age -> hedge
+        assert r._should_hedge(fr, 1.5)
+        # fast-lane request -> no hedge
+        assert not r._should_hedge(fr, 0.0005)
+        # below the absolute floor even if the window is tiny
+        assert not r._should_hedge(fr, 0.01)
+        r.run_pending()
+    finally:
+        r.close()
+
+
+def test_probe_failure_rotation(params, mesh1):
+    """Failing probes take a replica OUT of rotation without killing
+    it; a recovered probe returns it. No requests are lost either
+    way."""
+    inj = FleetFaultInjector(fail_probe={0: 3})
+    r = _router(params, mesh1, n=2, inj=inj,
+                fleet=FleetConfig(probe_failure_threshold=1,
+                                  restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=4)
+              for i in range(2)]
+        r.tick()
+        d = r.debugz()
+        states = {row["replica"]: row["state"] for row in d["replicas"]}
+        assert states[0] == "unhealthy"
+        # everything dispatched so far went to the healthy replica
+        assert all(row["outstanding"] == 0 for row in d["replicas"]
+                   if row["replica"] == 0)
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        assert r.stats["probe_failures"] >= 1
+        # probes recover once the injected budget is spent -> back in
+        # rotation (pump rounds until the injector runs dry)
+        for _ in range(5):
+            r.tick()
+        d = r.debugz()
+        states = {row["replica"]: row["state"] for row in d["replicas"]}
+        assert states[0] == "ready"
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# drain / rolling reload
+# ---------------------------------------------------------------------------
+
+def test_fleet_drain_flips_ready_and_sheds_nothing(params, mesh1):
+    """drain(): readiness flips the INSTANT drain begins (before the
+    residents finish) and every admitted request still completes."""
+    r = _router(params, mesh1, n=2)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(4)]
+        r.tick()                     # residents seated, mid-decode
+        assert r.ready()
+        r.drain(wait=False)
+        assert not r.ready()         # BEFORE residents finished
+        with pytest.raises(EngineDraining):
+            r.submit(_prompt(8, 9), max_new_tokens=4)
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        assert r.stats["shed_overload"] == 0
+        r.resume()
+        h = r.submit(_prompt(8, 5), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+    finally:
+        r.close()
+
+
+def test_rolling_reload_zero_dropped(params, mesh1, tmp_path):
+    """Rolling weight rollout: one replica drains + reloads at a time
+    while the rest serve — zero shed requests, every replica on the
+    new step afterwards, and traffic keeps completing throughout."""
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 7)
+    r = _router(params, mesh1, n=2)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(6)]
+        r.tick()
+        loaded = r.rolling_reload(mgr, timeout=HARD_TIMEOUT_S)
+        assert loaded == [7, 7]
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        assert (r.stats["shed_overload"] + r.stats["shed_deadline"]
+                + r.stats["shed_outage"]) == 0
+        for ctl in r._ctls:
+            assert ctl.replica.engine._weights_step == 7
+        # post-reload traffic serves on the new weights
+        h = r.submit(_prompt(8, 7), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised restart
+# ---------------------------------------------------------------------------
+
+def test_supervised_restart_after_crash(params, mesh1):
+    """A crashed replica restarts (exponential backoff) and takes
+    traffic again; the recovery-time histogram records the outage."""
+    inj = FleetFaultInjector(kill_at={1: 0})
+    r = _router(params, mesh1, n=2, inj=inj,
+                fleet=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=4)
+              for i in range(2)]
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        deadline = time.monotonic() + HARD_TIMEOUT_S
+        while (r.stats["restarts"] < 1
+               and time.monotonic() < deadline):
+            r.tick()
+            time.sleep(0.002)
+        assert r.stats["restarts"] == 1
+        d = r.debugz()
+        assert {row["replica"]: row["state"]
+                for row in d["replicas"]}[0] == "ready"
+        # the restarted replica serves again (force it: drain twin)
+        r._ctls[1].draining = True
+        h = r.submit(_prompt(8, 5), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+        assert any(e.data.get("replica") == 0
+                   for e in h.trace.events if e.kind == "dispatched")
+        hist = r.registry.get("serving_fleet_recovery_seconds")
+        assert hist.labels().snapshot()[2] == 1   # one recovery sample
+    finally:
+        r.close()
+
+
+def test_consecutive_crash_budget_perma_dead(params, mesh1):
+    """A replica that keeps crashing exhausts its CONSECUTIVE-crash
+    budget and stays dead; the fleet keeps serving on the survivor."""
+    inj = FleetFaultInjector(kill_at={1: 0, 4: 0, 7: 0})
+    r = _router(params, mesh1, n=2, inj=inj,
+                fleet=FleetConfig(max_restarts=1,
+                                  restart_backoff_base_s=0.0))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(6)]
+        r.run_pending()
+        assert all(h.status == RequestStatus.COMPLETED for h in hs)
+        # pump a few more rounds: the second kill must NOT reschedule
+        for _ in range(10):
+            r.tick()
+        d = r.debugz()
+        row = [x for x in d["replicas"] if x["replica"] == 0][0]
+        assert row["state"] == "dead"
+        assert row["consec_crashes"] > 1
+        h = r.submit(_prompt(8, 9), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+    finally:
+        r.close()
+
+
+def test_fleet_outage_sheds_typed(params, mesh1):
+    """Every replica dead with the restart budget exhausted is a
+    TOTAL outage: queued requests shed typed (OverloadError) instead
+    of hanging their callers forever."""
+    inj = FleetFaultInjector(kill_at={1: 0})
+    r = _router(params, mesh1, n=1, inj=inj,
+                fleet=FleetConfig(max_restarts=0))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(3)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        shed = [h for h in hs if h.status == RequestStatus.SHED]
+        assert shed, "outage must shed, not hang"
+        for h in shed:
+            with pytest.raises(OverloadError, match="outage|dead"):
+                h.result(0)
+        assert r.stats["shed_outage"] >= 1
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation (ISSUE-9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_deadline_propagates_across_failover(params, mesh1):
+    """The submit-time deadline is absolute: a request whose replica
+    died must NOT be resurrected past its deadline by the failover
+    redispatch — it sheds typed `deadline` at the router."""
+    clk = _Clock()
+    inj = FleetFaultInjector(kill_at={1: 0})
+    r = _router(params, mesh1, n=2, inj=inj, clock=clk,
+                fleet=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        h = r.submit(_prompt(8, 0), max_new_tokens=12, deadline_s=10.0)
+        r.tick()                         # dispatched to replica 0
+        assert h.status == RequestStatus.RUNNING
+        clk.advance(11.0)                # deadline passes mid-flight
+        r.tick()                         # kill fires -> failover path
+        assert h.done()
+        assert h.status == RequestStatus.SHED
+        with pytest.raises(DeadlineExceeded):
+            h.result(0)
+        # exactly ONE dispatch ever happened: no post-deadline retry
+        assert h.trace.kinds().count("dispatched") == 1
+        assert [e.data["reason"] for e in h.trace.events
+                if e.kind == "shed"] == ["deadline"]
+        assert r.stats["shed_deadline"] == 1
+        r.run_pending()
+    finally:
+        r.close()
+
+
+def test_deadline_expired_before_dispatch_sheds_at_router(params,
+                                                          mesh1):
+    """A queued request past its deadline is shed at the router
+    WITHOUT ever being dispatched."""
+    clk = _Clock()
+    r = _router(params, mesh1, n=1, clock=clk)
+    try:
+        h = r.submit(_prompt(8, 0), max_new_tokens=4, deadline_s=5.0)
+        clk.advance(6.0)
+        r.run_pending()
+        assert h.status == RequestStatus.SHED
+        assert "dispatched" not in h.trace.kinds()
+        assert r.stats["shed_deadline"] == 1
+        assert r.stats["dispatches"] == 0
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_fleet_metrics_exposed(params, mesh1):
+    """Every serving_fleet_* series the ISSUE names is scrapeable
+    from the router registry after real fleet traffic (a kill + a
+    hedge + completions)."""
+    inj = FleetFaultInjector(kill_at={2: 0}, slow_at={1: (1, 0.1)})
+    r = _router(params, mesh1, n=3, inj=inj,
+                fleet=FleetConfig(hedge=True, hedge_age_s=0.02,
+                                  restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(6)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        text = prometheus_text(r.registry)
+        for series in ("serving_fleet_replicas",
+                       "serving_fleet_failovers_total",
+                       "serving_fleet_hedges_total",
+                       "serving_fleet_requests_completed_total",
+                       "serving_fleet_requests_shed_total",
+                       "serving_fleet_restarts_total",
+                       "serving_fleet_probe_failures_total",
+                       "serving_fleet_dispatches_total",
+                       "serving_fleet_queue_age_seconds_bucket",
+                       "serving_fleet_recovery_seconds_bucket",
+                       "serving_fleet_queue_depth",
+                       "serving_fleet_in_flight_requests"):
+            assert series in text, f"missing {series}"
+        assert 'serving_fleet_replicas{state="ready"}' in text
+    finally:
+        r.close()
+
+
+def test_fleet_debugz_and_http_endpoints(params, mesh1):
+    """The fleet table serves over the standard exporter: /debugz has
+    per-replica rows, /readyz tracks router readiness."""
+    r = _router(params, mesh1, n=2)
+    srv = MetricsServer(r.registry, port=0, health=r.health,
+                        ready=r.ready, debug=r.debugz)
+    try:
+        h = r.submit(_prompt(8, 0), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+        import json
+        with urllib.request.urlopen(srv.url + "/debugz",
+                                    timeout=10) as resp:
+            d = json.loads(resp.read())
+        assert {row["replica"] for row in d["replicas"]} == {0, 1}
+        assert d["stats"]["completed"] == 1
+        with urllib.request.urlopen(srv.url + "/readyz",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+        r.drain(wait=False)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        r.resume()
+    finally:
+        srv.stop()
+        r.close()
+
+
+def test_in_process_http_probes(params, mesh1):
+    """http_probes=True routes the router's probe path through each
+    replica's REAL MetricsServer /healthz — and a killed replica's
+    endpoint dies with it."""
+    r = _router(params, mesh1, n=2, http_probes=True)
+    try:
+        for ctl in r._ctls:
+            assert ctl.replica.probe_url is not None
+        h = r.submit(_prompt(8, 0), max_new_tokens=4)
+        r.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+        d = r.debugz()
+        assert all(row["probe_url"] for row in d["replicas"])
+        # kill -> probe endpoint gone -> crash detection marks it
+        r._ctls[0].replica.kill()
+        r.tick()
+        states = {row["replica"]: row["state"]
+                  for row in r.debugz()["replicas"]}
+        assert states[0] in ("restarting", "dead")
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# real process boundary (multiproc: subprocess replicas, SIGKILL crash)
+# ---------------------------------------------------------------------------
+
+SUB_SPEC = {
+    "cfg": dict(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                max_len=64),
+    "engine": dict(decode_chunk=2, max_new_tokens=12,
+                   backoff_base_s=0.0, max_batch_size=2),
+    "params_seed": 0,
+    "progress_interval_s": 0.01,
+}
+
+
+@pytest.fixture
+def fleet_watchdog():
+    """Hard per-test bound for subprocess fleets: registered replicas
+    are SIGKILLed when the watchdog fires (turning any would-be hang
+    into a fast, visible failure) and closed on teardown either way —
+    a wedged replica can never hang tier-1."""
+    replicas = []
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for rep in replicas:
+            try:
+                rep.kill()
+            except Exception:
+                pass
+
+    timer = threading.Timer(HARD_TIMEOUT_S, _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield replicas.append
+    finally:
+        timer.cancel()
+        for rep in replicas:
+            try:
+                rep.close()
+            except Exception:
+                pass
+    assert not fired.is_set(), \
+        f"fleet watchdog fired after {HARD_TIMEOUT_S}s"
+
+
+@pytest.mark.multiproc
+def test_subprocess_fleet_serves_and_probes_over_http(
+        params, mesh1, fleet_watchdog):
+    """Two REAL engine processes behind the router: probes go over
+    real HTTP to each worker's MetricsServer, results come back over
+    the pipe, and they equal an in-process engine token-for-token."""
+    reps = [SubprocessReplica(i, SUB_SPEC,
+                              startup_timeout_s=HARD_TIMEOUT_S)
+            for i in range(2)]
+    for rep in reps:
+        fleet_watchdog(rep)
+    r = Router(replicas=reps,
+               config=FleetConfig(max_restarts=0, hang_min_s=30.0))
+    prompts = [_prompt(8, i) for i in range(4)]
+    want = _reference(params, mesh1, prompts)
+    hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+    r.run_pending()
+    for h, w in zip(hs, want):
+        np.testing.assert_array_equal(h.result(0), w)
+    # the probe path really is HTTP against the worker process
+    body = reps[0].probe()
+    assert body["ready"] is True
+    assert body["num_slots"] == 2
+    d = r.debugz()
+    assert all(row["kind"] == "subprocess" for row in d["replicas"])
+    r.close()
+
+
+@pytest.mark.multiproc
+def test_subprocess_sigkill_failover_token_exact(
+        params, mesh1, fleet_watchdog):
+    """SIGKILL one worker process while its requests are in flight:
+    the router fails them over to the survivor from the last streamed
+    committed prefix, token-exact vs the uninterrupted run, losing
+    nothing."""
+    reps = [SubprocessReplica(i, SUB_SPEC,
+                              startup_timeout_s=HARD_TIMEOUT_S)
+            for i in range(2)]
+    for rep in reps:
+        fleet_watchdog(rep)
+    r = Router(replicas=reps,
+               config=FleetConfig(max_restarts=0, hang_min_s=30.0))
+    prompts = [_prompt(8, i) for i in range(4)]
+    want = _reference(params, mesh1, prompts)
+    hs = [r.submit(p, max_new_tokens=12) for p in prompts]
+    # dispatch, then kill replica 0 the moment it holds work
+    deadline = time.monotonic() + HARD_TIMEOUT_S
+    while time.monotonic() < deadline:
+        r.tick()
+        if any(row["replica"] == 0 and row["outstanding"] > 0
+               for row in r.debugz()["replicas"]):
+            break
+    reps[0].kill()
+    r.run_pending()
+    assert [h.status for h in hs] == [RequestStatus.COMPLETED] * 4
+    for h, w in zip(hs, want):
+        np.testing.assert_array_equal(h.result(0), w)
+    assert r.stats["failovers"] >= 1
+    states = {row["replica"]: row["state"]
+              for row in r.debugz()["replicas"]}
+    assert states[0] == "dead"       # max_restarts=0: stays down
+    r.close()
