@@ -47,10 +47,13 @@ EVENT_KINDS = frozenset({
     "queued",        # appended to the bounded admission queue
     "admitted",      # seated: {slot, bucket} (continuous) /
     #                  {batch_size} (batch mode) / {scratch: True}
-    #                  (solo isolation re-run)
+    #                  (solo isolation re-run); chunked-prefill
+    #                  engines add {prefill_chunk}
     "prefill_done",  # prompt prefilled, first token committed {tokens}
     "decode_chunk",  # one decode chunk committed {tokens, slot}
-    #                  (speculative engines add {drafted, accepted})
+    #                  (speculative engines add {drafted, accepted};
+    #                  chunked-prefill engines add {prefill_chunk} —
+    #                  prompt tokens co-scheduled in the same tick)
     "draft_rejected",  # a speculative round's drafts were ALL
     #                  rejected by verification {step, drafted,
     #                  poisoned} — the forensic marker for injected

@@ -182,6 +182,14 @@ class ServingFaultInjector(FaultInjector):
         the poison lands on the WRITER's page only: a reader sharing
         the same prefix must keep producing its clean-run tokens —
         the shared-page-isolation proof (tests/test_serving_paged.py).
+      - ``prefill_chunk_fail_at``: step indices at which a CHUNKED
+        prefill call (ISSUE-10: the token-budget scheduler's
+        mid-prompt prefill advance) fails — targets only the chunked
+        calls, so tests can kill a request MID-PREFILL while
+        co-resident decoding slots (and even the same engine's one-shot
+        scratch re-runs) stay healthy. ``prefill_fail_at`` also fires
+        on chunked calls (they ARE prefill calls); this knob is the
+        narrower one.
       - ``draft_poison_at``: ``{step: request_id}`` — the SPECULATIVE
         engine derails the named request's draft proposals for the
         round at that step index ((d+1) mod V on device — guaranteed
@@ -205,7 +213,8 @@ class ServingFaultInjector(FaultInjector):
                  delay_at: Optional[dict] = None,
                  prefill_fail_at: Iterable[int] = (),
                  corrupt_page_at: Optional[dict] = None,
-                 draft_poison_at: Optional[dict] = None):
+                 draft_poison_at: Optional[dict] = None,
+                 prefill_chunk_fail_at: Iterable[int] = ()):
         super().__init__(fail_at, persistent=persistent)
         self.poison_requests = set(int(r) for r in poison_requests)
         self.delay_at = {int(k): float(v)
@@ -213,6 +222,9 @@ class ServingFaultInjector(FaultInjector):
         self.delays_injected = 0
         self.prefill_fail_at = set(int(i) for i in prefill_fail_at)
         self.prefills_failed = 0
+        self.prefill_chunk_fail_at = set(
+            int(i) for i in prefill_chunk_fail_at)
+        self.prefill_chunks_failed = 0
         self.corrupt_page_at = {int(k): int(v)
                                 for k, v in (corrupt_page_at
                                              or {}).items()}
@@ -266,6 +278,23 @@ class ServingFaultInjector(FaultInjector):
             raise TrainingFailure(
                 f"injected prefill fault at step {step}")
         self.on_decode_step(step, request_ids)
+
+    def on_prefill_chunk(self, step: int,
+                         request_ids: Iterable[int] = ()) -> None:
+        """Chunked-prefill hook (ISSUE-10): the narrower
+        ``prefill_chunk_fail_at`` knob fires only on the token-budget
+        scheduler's mid-prompt prefill advances, then the call falls
+        through to the full prefill semantics (prefill_fail_at /
+        poison / fail_at / delay all still apply — a chunked call IS
+        a prefill call)."""
+        if int(step) in self.prefill_chunk_fail_at:
+            if not self.persistent:
+                self.prefill_chunk_fail_at.discard(int(step))
+            self.injected += 1
+            self.prefill_chunks_failed += 1
+            raise TrainingFailure(
+                f"injected prefill-chunk fault at step {step}")
+        self.on_prefill(step, request_ids)
 
 
 class FleetFaultInjector:

@@ -69,6 +69,32 @@ retried, solo-isolated, or preempted-and-resumed request reproduces
 its continuation exactly — the schedule depends on absolute position
 only, never on slot placement or chunk boundaries. (This differs from
 the fused path's chunk-shaped schedule; greedy decode is identical.)
+
+CHUNKED PREFILL (ISSUE-10): `make_continuous_prefill` runs a whole
+admission's prompt as ONE fused pass, so a single long prompt freezes
+every co-resident decoding slot for the full prefill — the TPOT-p99
+stall the engine's token-budget scheduler exists to bound.
+`make_chunked_prefill` (contiguous pool) and
+`make_paged_chunked_prefill` (paged pool) instead advance any subset
+of MID-PREFILL slots by up to `chunk_len` prompt tokens per call:
+ONE fixed-shape program per (chunk_len, num_slots[, page geometry])
+whose per-slot resume position (`start`), valid-token count (`clen`,
+partial chunks allowed — the scheduler spends its budget to the
+token), and final-chunk flag (`last`) are all runtime data. Each call
+writes the chunk's K/V rows at absolute positions start+t and attends
+two pieces — the already-written cached prefix masked to s < start,
+plus causal float self-attention within the chunk — which is exactly
+the paged prefix-hit resume path generalized to ARBITRARY chunk
+boundaries (start no longer has to be a prefix-cache page boundary).
+When `last` is set the call samples the slot's first generated token
+at sequence index start+clen through the same position-keyed schedule
+one-shot prefill uses, so chunked prefill is TOKEN-EXACT vs one-shot:
+chunk 1's causal self-attention reproduces the one-shot math for its
+positions, and every later chunk reads back the identical cached rows
+chunk k-1 wrote (float KV bit-for-bit; int8 KV re-reads the prefix
+through its quantization exactly as decode does — the same envelope
+the paged prefix-hit path documents). tests/test_serving_chunked.py
+holds the float/int8, fresh/prefix-hit, greedy/sampled proofs.
 """
 from __future__ import annotations
 
@@ -759,6 +785,209 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
     return jax.jit(sharded)
 
 
+def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
+                         chunk_len: int, num_slots: int,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 1.0, quantized=None,
+                         kv_mode=None):
+    """Compiled CHUNKED admission prefill over the contiguous slot
+    pool: (params, ck, cv, pos, tok, toks [Ns, C], clen [Ns],
+    start [Ns], last [Ns] bool, key) -> (ck, cv, pos, tok,
+    first [Ns]).
+
+    Advances every slot with clen[i] > 0 by its next clen (<= C)
+    prompt tokens: ``toks[i, :clen[i]]`` is the slice
+    prompt[start[i] : start[i]+clen[i]] of the slot's committed
+    prefix, its K/V rows are written at absolute positions start+t,
+    and pos[i] <- start[i]+clen[i]. Attention per chunk query t is
+    TWO-PIECE — the slot's already-written cache rows masked to
+    s < start (exact zeros on the first chunk) plus causal float
+    self-attention within the chunk, one softmax over the
+    concatenated scores — the paged prefix-hit resume generalized to
+    arbitrary chunk boundaries on the contiguous pool, reproducing
+    `_local_block_prefill`'s numerics when the chunks are replayed in
+    order. Slots with last[i] set additionally sample their first
+    generated token at sequence index start+clen (the same
+    position-keyed schedule one-shot prefill uses) into ``tok`` and
+    ``first``; mid-prompt chunks leave ``tok`` untouched and report
+    first = -1. start/clen/last are runtime DATA: one compiled
+    program per (chunk_len, num_slots) geometry serves every resume
+    position and partial-chunk budget with zero recompiles.
+
+    ``quantized``/``kv_mode`` follow make_continuous_prefill: the
+    quantized pool grows scale planes ((params, ck, cv, kscale,
+    vscale, pos, tok, toks, clen, start, last, key) -> (..., first))
+    and chunk rows quantize on write while the chunk still attends
+    itself in float (the cached prefix re-reads through its
+    quantization — the int8 decode envelope)."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
+    quantized, kv_mode = _resolve_quant(quantized, kv_mode)
+    if num_slots % dp:
+        raise ValueError(f"num_slots {num_slots} not divisible by "
+                         f"data axis {dp}")
+    if not 0 < chunk_len <= cfg.max_len:
+        raise ValueError(f"chunk_len {chunk_len} out of "
+                         f"(0, {cfg.max_len}]")
+    specs = _serving_specs(cfg, quantized)
+    h_loc = cfg.n_heads // tp
+    d_loc = h_loc * cfg.d_head
+    scale = cfg.d_head ** -0.5
+
+    def body(params, ck, cv, ksc, vsc, toks, clen, start, key):
+        dt = cfg.activation_dtype()
+        acc = jnp.promote_types(dt, jnp.float32)
+        ns, c = toks.shape
+        s_max = ck.shape[2]
+        adv = clen > 0
+        absp = start[:, None] + jnp.arange(c)[None, :]     # [ns, C]
+        valid = jnp.arange(c)[None, :] < clen[:, None]
+        rows = jnp.arange(ns)[:, None]
+        wp_g = jnp.clip(absp, 0, s_max - 1)   # in-bounds gather index
+        pe = params["pos"].astype(dt)[jnp.clip(absp, 0,
+                                               cfg.max_len - 1)]
+        h = params["embed"].astype(dt)[toks] + pe
+        mvalid = valid if cfg.n_experts > 0 else None
+        causal = (jnp.arange(c)[None, :]
+                  <= jnp.arange(c)[:, None])               # [C, C]
+        pmask = (jnp.arange(s_max)[None, None, None, :]
+                 < start[:, None, None, None])             # [ns,1,1,S]
+        for layer in range(cfg.n_layers):
+            p = {kk: vv[layer] for kk, vv in params["blocks"].items()}
+            x = layer_norm(h, p["ln1g"], p["ln1b"], cfg.eps)
+            q = jnp.matmul(x, p["Wq"].astype(x.dtype)) \
+                .reshape(ns, c, h_loc, cfg.d_head)
+            k = jnp.matmul(x, p["Wk"].astype(x.dtype))     # [ns,C,Dl]
+            v = jnp.matmul(x, p["Wv"].astype(x.dtype))
+            # write the chunk's rows at their absolute positions:
+            # invalid (pad) entries rewrite their current row with
+            # itself (the static-scatter trick) and positions past the
+            # pool drop — per-row indices are distinct, so there is no
+            # duplicate-index hazard on live rows
+            if kv_mode is None:
+                k_wr = jnp.where(valid[..., None], k.astype(ck.dtype),
+                                 ck[layer][rows, wp_g])
+                v_wr = jnp.where(valid[..., None], v.astype(cv.dtype),
+                                 cv[layer][rows, wp_g])
+                ck = ck.at[layer, rows, absp].set(k_wr, mode="drop")
+                cv = cv.at[layer, rows, absp].set(v_wr, mode="drop")
+            else:
+                from deeplearning4j_tpu.quant.kv import quantize_rows
+                kq, ksr = quantize_rows(k, kv_mode)
+                vq, vsr = quantize_rows(v, kv_mode)
+                k_wr = jnp.where(valid[..., None], kq,
+                                 ck[layer][rows, wp_g])
+                v_wr = jnp.where(valid[..., None], vq,
+                                 cv[layer][rows, wp_g])
+                ks_wr = jnp.where(valid, ksr,
+                                  ksc[layer][rows, wp_g, 0])
+                vs_wr = jnp.where(valid, vsr,
+                                  vsc[layer][rows, wp_g, 0])
+                ck = ck.at[layer, rows, absp].set(k_wr, mode="drop")
+                cv = cv.at[layer, rows, absp].set(v_wr, mode="drop")
+                ksc = ksc.at[layer, rows, absp, 0].set(ks_wr,
+                                                       mode="drop")
+                vsc = vsc.at[layer, rows, absp, 0].set(vs_wr,
+                                                       mode="drop")
+            kv4 = k.reshape(ns, c, h_loc, cfg.d_head)
+            vv4 = v.reshape(ns, c, h_loc, cfg.d_head)
+            # piece 2: float causal self-attention within the chunk —
+            # bitwise dot_product_attention(q, k, v, causal=True)
+            sc2 = jnp.einsum("bthd,bshd->bhts", q, kv4,
+                             preferred_element_type=acc) * scale
+            sc2 = jnp.where(causal[None, None], sc2, NEG_INF)
+            # piece 1: the slot's cached prefix, masked to s < start
+            # (fully masked — exact zeros — on the first chunk)
+            if kv_mode is None:
+                kh = ck[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+                vh = cv[layer].reshape(ns, s_max, h_loc, cfg.d_head)
+                sc1 = jnp.einsum("bthd,bshd->bhts", q, kh,
+                                 preferred_element_type=acc) * scale
+            else:
+                kh = ck[layer].astype(jnp.float32) \
+                    .reshape(ns, s_max, h_loc, cfg.d_head)
+                vh = cv[layer].astype(jnp.float32) \
+                    .reshape(ns, s_max, h_loc, cfg.d_head)
+                ksg = ksc[layer, :, :, 0]                  # [ns, S]
+                vsg = vsc[layer, :, :, 0]
+                sc1 = jnp.einsum("bthd,bshd->bhts",
+                                 q.astype(jnp.float32), kh) \
+                    * ksg[:, None, None, :] * scale
+            sc1 = jnp.where(pmask, sc1, NEG_INF)
+            # one softmax over [prefix | chunk] keys (logical order
+            # preserved), then the two value pieces recombine — the
+            # make_paged_prefill recombination on the contiguous pool
+            w = jax.nn.softmax(
+                jnp.concatenate([sc1.astype(acc), sc2], axis=-1),
+                axis=-1)
+            w1, w2 = w[..., :s_max], w[..., s_max:]
+            if kv_mode is None:
+                a1 = jnp.einsum("bhts,bshd->bthd",
+                                w1.astype(vh.dtype), vh)
+            else:
+                a1 = jnp.einsum("bhts,bshd->bthd",
+                                w1 * vsg[:, None, None, :], vh) \
+                    .astype(v.dtype)
+            a2 = jnp.einsum("bhts,bshd->bthd", w2.astype(v.dtype),
+                            vv4)
+            a = (a1 + a2).reshape(ns, c, d_loc)
+            h = h + _g_sync("model")(
+                jnp.matmul(a, p["Wo"].astype(a.dtype)))
+            x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
+            h = _local_mlp(h, x, p, cfg, dp, _g_sync("model"),
+                           valid=mvalid)
+        h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
+        lastrow = h[jnp.arange(ns), jnp.clip(clen - 1, 0, c - 1)]
+        logits = jnp.matmul(lastrow, params["Wout"].astype(
+            lastrow.dtype))
+        plen = start + clen
+        first = _sample_slots(logits, plen, key, dp, temperature,
+                              top_k, top_p)
+        return adv, plen, first, ck, cv, ksc, vsc
+
+    def finish(adv, lastf, plen, first, pos, tok):
+        take = adv & lastf
+        pos = jnp.where(adv, plen.astype(pos.dtype), pos)
+        tok = jnp.where(take, first, tok)
+        return pos, tok, jnp.where(take, first,
+                                   jnp.asarray(-1, jnp.int32))
+
+    if kv_mode is None:
+        def run(params, ck, cv, pos, tok, toks, clen, start, last,
+                key):
+            adv, plen, first, ck, cv, _, _ = body(
+                params, ck, cv, None, None, toks, clen, start, key)
+            pos, tok, first = finish(adv, last, plen, first, pos, tok)
+            return ck, cv, pos, tok, first
+
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+    else:
+        def run(params, ck, cv, ksc, vsc, pos, tok, toks, clen, start,
+                last, key):
+            adv, plen, first, ck, cv, ksc, vsc = body(
+                params, ck, cv, ksc, vsc, toks, clen, start, key)
+            pos, tok, first = finish(adv, last, plen, first, pos, tok)
+            return ck, cv, ksc, vsc, pos, tok, first
+
+        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
+                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                    P())
+        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=True)
+    return jax.jit(sharded)
+
+
 # ---------------------------------------------------------------------------
 # paged slot KV cache: fixed page pool + per-slot block tables (ISSUE-7)
 # ---------------------------------------------------------------------------
@@ -960,7 +1189,7 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
                        max_pages: int, num_pages: int,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, quantized=None,
-                       kv_mode=None):
+                       kv_mode=None, chunked: bool = False):
     """Compiled PAGED admission prefill: (params, kp, vp, pos, tok,
     bt [Ns, max_pages], suffix [Ns, Tb], slen [Ns], start [Ns], key)
     -> (kp, vp, pos, tok, first [Ns]).
@@ -985,7 +1214,15 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
     slen, start, key) -> (..., first)) and suffix rows quantize on
     write while the suffix still attends itself in float (mirroring
     the contiguous quant prefill, which also stores quantized but
-    attends the float activations)."""
+    attends the float activations).
+
+    ``chunked`` (ISSUE-10, see `make_paged_chunked_prefill`)
+    generalizes the prefix-hit resume to ARBITRARY chunk boundaries:
+    the signature grows a ``last`` [Ns] bool before the key, ``start``
+    may be any mid-prompt position (not just a page-aligned cache-hit
+    boundary — the attention math is already position-general), and
+    only chunks with ``last`` set sample/commit the first generated
+    token; mid-prompt chunks advance pos and report first = -1."""
     from deeplearning4j_tpu.ops.flash_decode import NEG_INF
     tp = _check_paged_mesh(cfg, mesh, top_k, top_p, page_size,
                            num_pages, max_pages)
@@ -1096,38 +1333,75 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
                               top_k, top_p)
         return admit, plen, first, kp, vp, ksc, vsc
 
-    def finish(admit, plen, first, pos, tok):
+    def finish(admit, plen, first, pos, tok, lastf=None):
+        # chunked: only the prompt's FINAL chunk commits the sampled
+        # first token; mid-prompt chunks advance pos only
+        take = admit if lastf is None else (admit & lastf)
         pos = jnp.where(admit, plen.astype(pos.dtype), pos)
-        tok = jnp.where(admit, first, tok)
-        return pos, tok, jnp.where(admit, first,
+        tok = jnp.where(take, first, tok)
+        return pos, tok, jnp.where(take, first,
                                    jnp.asarray(-1, jnp.int32))
 
     if kv_mode is None:
-        def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
-                key):
-            admit, plen, first, kp, vp, _, _ = body(
-                params, kp, vp, None, None, bt, suffix, slen, start,
-                key)
-            pos, tok, first = finish(admit, plen, first, pos, tok)
-            return kp, vp, pos, tok, first
+        if chunked:
+            def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
+                    last, key):
+                admit, plen, first, kp, vp, _, _ = body(
+                    params, kp, vp, None, None, bt, suffix, slen,
+                    start, key)
+                pos, tok, first = finish(admit, plen, first, pos, tok,
+                                         last)
+                return kp, vp, pos, tok, first
 
-        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, P())
+        else:
+            def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
+                    key):
+                admit, plen, first, kp, vp, _, _ = body(
+                    params, kp, vp, None, None, bt, suffix, slen,
+                    start, key)
+                pos, tok, first = finish(admit, plen, first, pos, tok)
+                return kp, vp, pos, tok, first
+
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        P())
         out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
                      _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
     else:
-        def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix, slen,
-                start, key):
-            admit, plen, first, kp, vp, ksc, vsc = body(
-                params, kp, vp, ksc, vsc, bt, suffix, slen, start, key)
-            pos, tok, first = finish(admit, plen, first, pos, tok)
-            return kp, vp, ksc, vsc, pos, tok, first
+        if chunked:
+            def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix,
+                    slen, start, last, key):
+                admit, plen, first, kp, vp, ksc, vsc = body(
+                    params, kp, vp, ksc, vsc, bt, suffix, slen, start,
+                    key)
+                pos, tok, first = finish(admit, plen, first, pos, tok,
+                                         last)
+                return kp, vp, ksc, vsc, pos, tok, first
 
-        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, P())
+        else:
+            def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix,
+                    slen, start, key):
+                admit, plen, first, kp, vp, ksc, vsc = body(
+                    params, kp, vp, ksc, vsc, bt, suffix, slen, start,
+                    key)
+                pos, tok, first = finish(admit, plen, first, pos, tok)
+                return kp, vp, ksc, vsc, pos, tok, first
+
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        P())
         out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
                      _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
                      _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
@@ -1135,6 +1409,31 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
     return jax.jit(sharded)
+
+
+def make_paged_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
+                               chunk_len: int, num_slots: int,
+                               page_size: int, max_pages: int,
+                               num_pages: int, temperature: float = 0.0,
+                               top_k: int = 0, top_p: float = 1.0,
+                               quantized=None, kv_mode=None):
+    """Paged twin of `make_chunked_prefill`: (params, kp, vp[, kscale,
+    vscale], pos, tok, bt [Ns, max_pages], toks [Ns, C], clen [Ns],
+    start [Ns], last [Ns] bool, key) -> (state', pos, tok, first).
+
+    The paged prefill's two-piece attention already resumes from an
+    arbitrary per-slot ``start`` as runtime data — the prefix-cache
+    hit boundary was just its only caller — so the chunked variant IS
+    `make_paged_prefill` with the chunk as the "suffix" plus the
+    ``last`` flag gating first-token commitment. Chunk K/V rows land
+    at (bt[slot, (start+t)//ps], (start+t)%ps); invalid rows route to
+    the scratch page exactly as the one-shot paged prefill's pad rows
+    do."""
+    return make_paged_prefill(cfg, mesh, chunk_len, num_slots,
+                              page_size, max_pages, num_pages,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, quantized=quantized,
+                              kv_mode=kv_mode, chunked=True)
 
 
 def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,

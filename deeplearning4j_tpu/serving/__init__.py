@@ -34,6 +34,16 @@ the layer between callers and the compiled decode step:
   back the `/debugz`, `/slo`, `/timeline.json` exporter endpoints
   (`observability/events|slo|timeline.py`, docs/observability.md).
 
+- Chunked prefill + token-budget scheduler (round 15, ISSUE-10):
+  `EngineConfig(prefill_chunk=, tick_token_budget=)` splits every
+  admission's prompt into fixed-size chunks interleaved with decode
+  under a per-tick token budget (decode billed first, prefill
+  oldest-first with a progress floor), so one long prompt can no
+  longer stall co-resident decoding slots for its whole prefill —
+  token-exact vs one-shot prefill across float/int8 KV,
+  contiguous/paged pools, and prefix-hit resume (docs/serving.md
+  "Chunked prefill & the token-budget scheduler").
+
 - Replicated serving fleet (round 14, ISSUE-9): `serving/fleet.py`'s
   `Router` fronts N engine replicas (in-process by default,
   subprocess via `SubprocessReplica` for crash realism) with

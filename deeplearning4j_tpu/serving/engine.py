@@ -146,6 +146,34 @@ and `serving_spec_*` metrics cover totals/ratio/current-K. The
 `draft_poison_at` injector knob proves a poisoned draft pass cannot
 corrupt committed KV. See docs/serving.md "Speculative decoding".
 
+Chunked prefill + token-budget scheduler (round 15, ISSUE-10,
+`EngineConfig(prefill_chunk=, tick_token_budget=)`; continuous mode):
+one-shot admission prefill runs a whole prompt as a single fused call,
+so a long prompt freezes every co-resident decoding slot for its full
+prefill — a TPOT-p99 stall the SLO layer measures but nothing bounds.
+With ``prefill_chunk`` set, admission merely SEATS the request (slot
+state PREFILLING: pos < committed-prefix length, not yet sampling) and
+the prompt advances through fixed-shape CHUNKED-prefill programs
+(parallel/serving.make_chunked_prefill / make_paged_chunked_prefill —
+resume position, valid length, and final-chunk flag are runtime data).
+Each tick spends ``tick_token_budget`` tokens: the decode chunk for
+every DECODING slot is billed first (decode never stalls), the
+remainder buys prefill chunks oldest-admission-first (TTFT fairness —
+the _fill_slots order assert), and a decode-saturated tick still
+advances the oldest admission one chunk (progress floor). Chunked
+prefill is TOKEN-EXACT vs one-shot (greedy and sampled, float and
+int8 KV, contiguous and paged, prefix-hit resume included) and a slot
+that dies or preempts MID-PREFILL resumes from its committed prefix
+exactly like a mid-decode one: isolation re-runs it solo, reload
+requeues it, deadline/cancel shed it, and a fleet failover re-prefills
+it on a survivor. `prefill_chunk=None` (default) keeps the one-shot
+path bit-identically with unchanged compiled-program cache keys.
+Observability: `serving_prefill_chunks_total`,
+`serving_tick_budget_utilization` (pull gauge), `prefill_chunk` fields
+on `admitted`/`prefill_done`/`decode_chunk` trace events, a
+`chunked_prefill` section in `debugz()`. See docs/serving.md "Chunked
+prefill & the token-budget scheduler".
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -171,8 +199,9 @@ from deeplearning4j_tpu.observability.metrics import (
     DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
 from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
 from deeplearning4j_tpu.parallel.serving import (
-    init_paged_state, init_slot_state, make_continuous_decode,
-    make_continuous_prefill, make_paged_decode, make_paged_prefill,
+    init_paged_state, init_slot_state, make_chunked_prefill,
+    make_continuous_decode, make_continuous_prefill,
+    make_paged_chunked_prefill, make_paged_decode, make_paged_prefill,
     make_paged_speculative_decode, make_parallel_generate,
     make_speculative_decode, shard_serving_params)
 from deeplearning4j_tpu.serving.paging import (PageAllocator,
@@ -310,6 +339,26 @@ class EngineConfig:
     spec_k: int = 4
     draft: str = "int8"
     spec_adaptive: bool = True       # False pins K at spec_k
+    # chunked prefill + token-budget scheduler (ISSUE-10, continuous
+    # mode). ``prefill_chunk`` splits every admission's prompt into
+    # fixed-size token chunks interleaved with decode: a seated slot
+    # enters the PREFILLING state and advances up to ``prefill_chunk``
+    # prompt tokens per scheduled chunk, so one long prompt can no
+    # longer freeze co-resident decoding slots for its whole prefill
+    # (the TPOT-p99 stall). Each tick spends ``tick_token_budget``
+    # tokens: the decode chunk for every DECODING slot is budgeted
+    # first (decode never stalls), and the remainder buys prefill
+    # chunks oldest-admission-first (TTFT fairness) — partial chunks
+    # spend the budget to the token. A tick whose decode work exhausts
+    # the budget still advances the oldest PREFILLING slot one chunk
+    # (progress floor: admissions can never starve). 0 auto-sizes the
+    # budget to num_slots * decode_chunk + prefill_chunk — every
+    # resident decodes AND one prefill chunk lands per tick.
+    # ``prefill_chunk=None`` (default) keeps the legacy one-shot
+    # admission prefill, bit-identically, with unchanged compiled-
+    # program cache keys.
+    prefill_chunk: Optional[int] = None
+    tick_token_budget: int = 0       # 0 = auto (see above)
 
 
 class RequestHandle:
@@ -422,6 +471,41 @@ def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
                                   top_k=top_k, top_p=top_p,
                                   quantized=quantized,
                                   kv_mode=kv_mode)
+
+
+@lru_cache(maxsize=64)
+def _compiled_chunked_prefill(cfg_fields: tuple, mesh, chunk_len: int,
+                              num_slots: int, temperature: float,
+                              top_k: int, top_p: float, quantized=None,
+                              kv_mode=None):
+    """Compiled-program cache for the CHUNKED admission prefill
+    (ISSUE-10): ONE entry per (prefill_chunk, num_slots) geometry —
+    resume positions, partial-chunk budgets, and final-chunk flags are
+    runtime data, so a whole mixed-length trace prefills through a
+    single program. Registered separately from _compiled_prefill so
+    prefill_chunk=None engines keep the PR-4/7/8 cache keys
+    byte-unchanged."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_chunked_prefill(cfg, mesh, chunk_len, num_slots,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p, quantized=quantized,
+                                kv_mode=kv_mode)
+
+
+@lru_cache(maxsize=64)
+def _compiled_paged_chunked_prefill(cfg_fields: tuple, mesh,
+                                    chunk_len: int, num_slots: int,
+                                    page_size: int, max_pages: int,
+                                    num_pages: int, temperature: float,
+                                    top_k: int, top_p: float,
+                                    quantized=None, kv_mode=None):
+    """Paged twin of _compiled_chunked_prefill (block tables and
+    chunk boundaries are runtime data)."""
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_chunked_prefill(
+        cfg, mesh, chunk_len, num_slots, page_size, max_pages,
+        num_pages, temperature=temperature, top_k=top_k, top_p=top_p,
+        quantized=quantized, kv_mode=kv_mode)
 
 
 @lru_cache(maxsize=64)
@@ -564,6 +648,31 @@ class InferenceEngine:
         self._chunk = (self.config.decode_chunk
                        if self.config.decode_chunk > 0
                        else DEFAULT_CONTINUOUS_CHUNK)
+        # chunked prefill + token-budget scheduler (ISSUE-10): None
+        # keeps the legacy one-shot admission prefill bit-identically
+        self._prefill_chunk = self.config.prefill_chunk
+        if self._prefill_chunk is not None:
+            if not self._continuous:
+                raise ValueError(
+                    "prefill_chunk requires mode='continuous' (batch "
+                    "mode has no persistent slot state to resume a "
+                    "partial prefill from)")
+            self._prefill_chunk = int(self._prefill_chunk)
+            if not 0 < self._prefill_chunk <= cfg.max_len:
+                raise ValueError(
+                    f"prefill_chunk {self._prefill_chunk} out of "
+                    f"(0, {cfg.max_len}]")
+        elif self.config.tick_token_budget:
+            raise ValueError(
+                "tick_token_budget without prefill_chunk has nothing "
+                "to schedule: set prefill_chunk to enable the "
+                "token-budget scheduler")
+        self._tick_budget = (
+            int(self.config.tick_token_budget)
+            or (self._num_slots * self._chunk
+                + (self._prefill_chunk or 0)))
+        self._last_tick_spent = 0
+        self._seat_seq = itertools.count()
         # quantized inference: resolve the requested modes against the
         # backend (fp8 -> int8 off-TPU), quantize the weight tree ON
         # LOAD — float weights never reach the mesh — and remember a
@@ -814,6 +923,20 @@ class InferenceEngine:
                     ).set_function(lambda: float(
                         0 if self._spec_plain > 0
                         else self._spec_cur_k))
+        # chunked prefill (ISSUE-10): registered only on chunked
+        # engines, so legacy scrapes are byte-unchanged
+        if self._prefill_chunk is not None:
+            self._m_prefill_chunks = r.counter(
+                "serving_prefill_chunks",
+                "Prefill chunks advanced by the token-budget "
+                "scheduler (one per slot per chunked-prefill call)")
+            r.gauge("serving_tick_budget_utilization",
+                    "Tokens scheduled in the last tick / "
+                    "tick_token_budget (decode chunks + prefill "
+                    "chunks; >1 when the progress floor overrode "
+                    "the budget)").set_function(
+                lambda: float(self._last_tick_spent)
+                / float(max(1, self._tick_budget)))
 
     # ------------------------------------------------------------------
     # HBM accounting (quant subsystem; backs the serving_param_bytes /
@@ -1091,14 +1214,31 @@ class InferenceEngine:
                     return
             # coalescing window: let near-simultaneous submissions
             # join — but never stall an actively decoding slot pool
-            # (admissions happen at the next chunk boundary anyway)
-            if self.config.batch_timeout_s > 0 and not self._pool_busy():
+            # (admissions happen at the next chunk boundary anyway),
+            # and never sleep when the queue can already fill every
+            # free slot (ISSUE-10 satellite: there is nothing left to
+            # coalesce, so the wait was pure TTFT latency)
+            if (self.config.batch_timeout_s > 0
+                    and not self._pool_busy()
+                    and not self._queue_fills_pool()):
                 time.sleep(self.config.batch_timeout_s)
             self.tick()
 
     def _pool_busy(self) -> bool:
         return self._continuous and any(s is not None
                                         for s in self._slots)
+
+    def _queue_fills_pool(self) -> bool:
+        """True when waiting cannot improve the next scheduling round:
+        the queue already holds at least as many requests as there are
+        seats to fill (free slots in continuous mode, the coalescing
+        cap in batch mode)."""
+        with self._lock:
+            if self._continuous:
+                seats = sum(s is None for s in self._slots)
+            else:
+                seats = self.config.max_batch_size
+            return len(self._queue) >= max(1, seats)
 
     def set_listeners(self, *listeners) -> None:
         """Attach train-listener-protocol observers: after every batch
@@ -1235,13 +1375,22 @@ class InferenceEngine:
     # continuous batching: slot-pool scheduling
     # ------------------------------------------------------------------
     def _tick_continuous(self) -> bool:
-        """One scheduling round: admit into free slots (one fused
-        prefill over the pool), then advance every occupied slot one
-        decode chunk. Slots free the moment their request completes or
-        is shed, so the next round refills them from the queue."""
+        """One scheduling round. Legacy (prefill_chunk=None): admit
+        into free slots (one fused prefill over the pool), then
+        advance every occupied slot one decode chunk. Chunked
+        (ISSUE-10): admissions merely SEAT (state PREFILLING), then
+        the tick spends its token budget — prefill chunks for
+        mid-prefill slots (oldest first, budget = tick_token_budget
+        minus the decode bill) followed by ONE decode chunk for every
+        DECODING slot — so no decode chunk ever waits longer than one
+        budget's worth of prefill compute. Slots free the moment
+        their request completes or is shed, so the next round refills
+        them from the queue."""
         t_start = self._clock()
         params = self._params    # admissions + this chunk share a tree
         admitted = self._fill_slots()
+        if self._prefill_chunk is not None:
+            return self._tick_budgeted(admitted, params, t_start)
         if admitted:
             try:
                 self._prefill_slots(admitted, params)
@@ -1258,6 +1407,12 @@ class InferenceEngine:
             return False
         self._m_batches.inc()
         n_active = len(occupied) or len(admitted)
+        self._tick_epilogue(t_start, n_active)
+        return True
+
+    def _tick_epilogue(self, t_start: float, n_active: int) -> None:
+        """Shared per-tick bookkeeping: batch-size/latency metrics +
+        the train-listener protocol."""
         self._m_batch_size.observe(n_active)
         idx = int(self._m_batches.value)
         latency = self._clock() - t_start
@@ -1269,7 +1424,165 @@ class InferenceEngine:
                 l.iteration_done(self, idx, latency)
             except Exception:     # listeners must not kill serving
                 log.exception("engine listener failed")
+
+    # ------------------------------------------------------------------
+    # chunked prefill: the token-budget scheduler (ISSUE-10)
+    # ------------------------------------------------------------------
+    def _is_prefilling(self, r: RequestHandle) -> bool:
+        """Slot state PREFILLING: seated with pos short of its
+        committed prefix — not yet sampling. Only a chunked engine
+        ever observes it (one-shot prefill completes at admission)."""
+        return (self._prefill_chunk is not None
+                and getattr(r, "_prefill_pos", 0)
+                < getattr(r, "_prefill_target", 0))
+
+    def _tick_budgeted(self, admitted, params, t_start) -> bool:
+        """The chunked scheduling round: decode's bill (one chunk per
+        DECODING slot) is reserved off the top of tick_token_budget,
+        the remainder buys prefill chunks oldest-first, then every
+        decoding slot — including admissions whose final prefill chunk
+        just landed — advances one decode chunk. The budget bounds the
+        prefill work co-scheduled with any decode chunk, which bounds
+        the residents' inter-token stall at ceil(budget/prefill_chunk)
+        chunk latencies instead of the longest prompt's full prefill."""
+        decoding0 = [(i, r) for i, r in self._occupied()
+                     if not self._is_prefilling(r)]
+        pf_budget = self._tick_budget - len(decoding0) * self._chunk
+        pf_spent = self._advance_prefill(params, pf_budget)
+        decoding = [(i, r) for i, r in self._occupied()
+                    if not self._is_prefilling(r) and not r.done()]
+        if decoding:
+            try:
+                self._decode_chunk_slots(decoding, params,
+                                         prefill_tokens=pf_spent)
+            except _BatchDecodeFailed as e:
+                self._isolate_slots([r for _, r in decoding], e)
+        self._reap(shed=True)
+        if not admitted and not decoding and pf_spent == 0:
+            return False            # idle tick: keep the last busy
+        #                             tick's budget utilization
+        self._last_tick_spent = pf_spent + len(decoding) * self._chunk
+        self._m_batches.inc()
+        self._tick_epilogue(t_start,
+                            len(decoding) or len(admitted) or 1)
         return True
+
+    def _advance_prefill(self, params, budget: int) -> int:
+        """Spend up to ``budget`` prompt tokens advancing PREFILLING
+        slots, oldest admission first (admission order == queue order
+        — the _fill_slots micro-assert — so TTFT stays fair). Each
+        compiled call advances a subset of slots by up to
+        prefill_chunk tokens each; partial chunks spend the budget to
+        the token. When decode's bill already exhausted the budget,
+        the oldest admission still advances ONE chunk (progress
+        floor — prefill can never starve). Returns tokens spent."""
+        if self._prefill_chunk is None:
+            return 0
+        spent = 0
+        floor_used = False
+        while True:
+            prefilling = sorted(
+                ((i, r) for i, r in self._occupied()
+                 if self._is_prefilling(r) and not r.done()),
+                key=lambda e: e[1]._seat_seq)
+            if not prefilling:
+                break
+            rem = budget - spent
+            if rem < 1:
+                if spent > 0 or floor_used:
+                    break
+                # progress floor: one chunk for the oldest admission
+                floor_used = True
+                rem = self._prefill_chunk
+                prefilling = prefilling[:1]
+            plan = []
+            for i, r in prefilling:
+                if rem < 1:
+                    break
+                n = min(self._prefill_chunk,
+                        r._prefill_target - r._prefill_pos, rem)
+                plan.append((i, r, n))
+                rem -= n
+            try:
+                self._prefill_chunk_call(plan, params)
+            except _BatchDecodeFailed as e:
+                self._isolate_slots([r for _, r, _ in plan], e)
+                continue
+            spent += sum(n for _, _, n in plan)
+        return spent
+
+    def _prefill_chunk_call(self, plan, params) -> None:
+        """One guarded chunked-prefill call advancing ``plan``
+        [(slot, handle, n_tokens)]: feeds each slot its next prompt
+        slice, marks final chunks so the program samples the first
+        generated token, and commits `prefill_done` (+ completion /
+        prefix-cache insertion) for slots whose prefill just finished."""
+        self._ensure_state()
+        entries = [(i, r) for i, r, _ in plan]
+        c = self._prefill_chunk
+        toks = np.zeros((self._num_slots, c), np.int32)
+        clen = np.zeros((self._num_slots,), np.int32)
+        start = np.zeros((self._num_slots,), np.int32)
+        lastm = np.zeros((self._num_slots,), bool)
+        for i, r, n in plan:
+            pre = np.concatenate([r.prompt, r.generated]
+                                 ).astype(np.int32)
+            toks[i, :n] = pre[r._prefill_pos:r._prefill_pos + n]
+            clen[i] = n
+            start[i] = r._prefill_pos
+            lastm[i] = (r._prefill_pos + n >= r._prefill_target)
+        if self._paged:
+            with self._lock:
+                self._ensure_writable(entries, prefill=True)
+                self._maybe_corrupt_page(entries, prefill=True)
+                bt = self._bt.copy()
+            fn = _compiled_paged_chunked_prefill(
+                astuple(self.cfg), self.mesh, c, self._num_slots,
+                self._page_size, self._max_pages, self._num_pages,
+                float(self.config.temperature),
+                int(self.config.top_k), float(self.config.top_p),
+                **self._quant_kwargs())
+            extra = (bt,)
+        else:
+            fn = _compiled_chunked_prefill(
+                astuple(self.cfg), self.mesh, c, self._num_slots,
+                float(self.config.temperature),
+                int(self.config.top_k), float(self.config.top_p),
+                **self._quant_kwargs())
+            extra = ()
+        state = self._slot_state
+        key = self._root_key()
+        n_state = len(state)
+
+        def call():
+            o = fn(params, *state, *extra, toks, clen, start, lastm,
+                   key)
+            return tuple(o[:n_state]), np.asarray(o[n_state])
+
+        state, first = self._guarded(call, [r for _, r in entries],
+                                     self._m_prefill_seconds,
+                                     prefill=True, chunked=True)
+        self._slot_state = state
+        finished = []
+        for i, r, n in plan:
+            with self._lock:
+                if self._slots[i] is not r:   # preempted by a reload
+                    continue
+            r._prefill_pos += n
+            self._m_prefill_chunks.inc()
+            if r._prefill_pos >= r._prefill_target:
+                finished.append((i, r))
+                self._commit_tokens(
+                    r, np.asarray([first[i]], np.int32),
+                    "prefill_done", slot=i,
+                    prefill_chunk=self._prefill_chunk)
+                if r.generated.shape[0] >= r.max_new_tokens:
+                    self._complete(r)
+        if self._paged and finished:
+            # the prompt's pages only hold complete KV once the FINAL
+            # chunk lands — mid-prefill pages must never be shareable
+            self._cache_prefilled(finished)
+        self._reap()
 
     def _fill_slots(self) -> List[tuple]:
         """Admission at a chunk boundary: seat queued requests into
@@ -1282,8 +1595,12 @@ class InferenceEngine:
         pages. Returns [(slot, handle)]."""
         admitted = []
         with self._lock:
-            free = [i for i in range(self._num_slots)
-                    if self._slots[i] is None]
+            # deque cursor, not list.pop(0) (ISSUE-10 satellite): the
+            # old quadratic pop also made it easy to perturb seating
+            # order; the popleft cursor is order-stable by construction
+            free = deque(i for i in range(self._num_slots)
+                         if self._slots[i] is None)
+            seated_order: List[RequestHandle] = []
             while free and self._queue:
                 r = self._queue.popleft()
                 self._shed_expired([r])
@@ -1301,7 +1618,8 @@ class InferenceEngine:
                             self._queue.appendleft(r)
                         break
                     hit = seated
-                free.pop(0)
+                free.popleft()
+                seated_order.append(r)
                 self._slots[i] = r
                 if self._spec:
                     # seat with the engine's CURRENT belief, not blind
@@ -1311,13 +1629,30 @@ class InferenceEngine:
                     self._accept_ema[i] = self._accept_pool
                 r.status = RequestStatus.RUNNING
                 r._in_flight = True
+                # chunked prefill (ISSUE-10): the slot seats in the
+                # PREFILLING state — pos starts at the prefix-cache
+                # hit boundary and advances chunk by chunk toward the
+                # committed prefix; re-seated (preempted) requests
+                # reset here, so a resume always re-prefills from its
+                # committed prefix, never from stale chunk progress
+                r._seat_seq = next(self._seat_seq)
+                r._prefill_pos = int(hit)
+                r._prefill_target = int(r.prompt.shape[0]
+                                        + r.generated.shape[0])
                 self._m_in_flight.inc()
+                extra = ({"prefill_chunk": self._prefill_chunk}
+                         if self._prefill_chunk is not None else {})
                 r.trace.add("admitted", slot=i, bucket=int(
                     self._bucket_len(r.prompt.shape[0]
                                      + r.generated.shape[0] - hit)),
-                    prefix_hit_tokens=int(hit))
+                    prefix_hit_tokens=int(hit), **extra)
                 self.slo.admitted(r.trace)
                 admitted.append((i, r))
+            # micro-assert (ISSUE-10 satellite): admission order IS
+            # queue order — the TTFT-fairness claim the oldest-first
+            # prefill scheduler builds on
+            assert [r for _, r in admitted] == seated_order, \
+                "admission order diverged from queue order"
         return admitted
 
     # ------------------------------------------------------------------
@@ -1443,6 +1778,13 @@ class InferenceEngine:
         committed-length - 1)."""
         plen = int(r.prompt.shape[0] + r.generated.shape[0])
         if prefill:
+            if self._prefill_chunk is not None:
+                # chunked prefill writes at most one chunk from the
+                # slot's resume position
+                lo = int(getattr(r, "_prefill_pos", 0))
+                return lo, min(lo + self._prefill_chunk,
+                               int(getattr(r, "_prefill_target",
+                                           plen)))
             return getattr(r, "_page_start", 0), plen
         lo = plen - 1
         span = self._chunk
@@ -1724,9 +2066,17 @@ class InferenceEngine:
                 self._complete(r)
         self._reap()
 
-    def _decode_chunk_slots(self, occupied, params) -> None:
+    def _decode_chunk_slots(self, occupied, params,
+                            prefill_tokens: Optional[int] = None) -> \
+            None:
+        """``prefill_tokens`` (chunked scheduler): prompt tokens the
+        same tick's prefill phase advanced — stamped on each
+        decode_chunk event so a trace shows exactly how much prefill
+        work was co-scheduled with (and therefore delayed) the chunk."""
+        data = ({} if prefill_tokens is None
+                else {"prefill_chunk": int(prefill_tokens)})
         if self._spec and self._spec_tick():
-            self._decode_spec_slots(occupied, params)
+            self._decode_spec_slots(occupied, params, **data)
             return
         call = (self._call_chunk_paged if self._paged
                 else self._call_chunk)
@@ -1739,7 +2089,7 @@ class InferenceEngine:
             need = min(self._chunk,
                        r.max_new_tokens - r.generated.shape[0])
             self._commit_tokens(r, toks[i, :need].astype(np.int32),
-                                "decode_chunk", slot=i)
+                                "decode_chunk", slot=i, **data)
             if r.generated.shape[0] >= r.max_new_tokens:
                 self._complete(r)
 
@@ -1769,7 +2119,7 @@ class InferenceEngine:
             return False
         return True
 
-    def _decode_spec_slots(self, occupied, params) -> None:
+    def _decode_spec_slots(self, occupied, params, **data) -> None:
         """One speculative round over the occupied slots: commit each
         slot's accepted prefix + correction token (1..K+1 tokens), feed
         acceptance to the metrics and the adaptive-K controller, and
@@ -1795,7 +2145,7 @@ class InferenceEngine:
                        r.max_new_tokens - r.generated.shape[0])
             self._commit_tokens(r, toks[i, :need].astype(np.int32),
                                 "decode_chunk", slot=i, drafted=d_i,
-                                accepted=a_i)
+                                accepted=a_i, **data)
             if r.generated.shape[0] >= r.max_new_tokens:
                 self._complete(r)
         self._spec_update(occupied, drafted, accepted, poison)
@@ -2037,7 +2387,7 @@ class InferenceEngine:
     # the guarded decode step
     # ------------------------------------------------------------------
     def _guarded(self, call, reqs: List[RequestHandle], hist,
-                 prefill: bool = False):
+                 prefill: bool = False, chunked: bool = False):
         """One compiled-call guard shared by every decode path:
         fault-injection hook (the injector sees the request ids of ALL
         co-resident work), latency histogram, retry with exponential
@@ -2052,8 +2402,12 @@ class InferenceEngine:
             try:
                 if self._injector is not None:
                     hook = self._injector.on_decode_step
-                    if prefill and hasattr(self._injector,
-                                           "on_prefill"):
+                    if (prefill and chunked
+                            and hasattr(self._injector,
+                                        "on_prefill_chunk")):
+                        hook = self._injector.on_prefill_chunk
+                    elif prefill and hasattr(self._injector,
+                                             "on_prefill"):
                         hook = self._injector.on_prefill
                     hook(self._step_counter, rids)
                 t_step = _perf()
@@ -2205,7 +2559,13 @@ class InferenceEngine:
                       "generated": int(sum(a.shape[0]
                                            for a in r._generated)),
                       "max_new_tokens": r.max_new_tokens,
-                      "age_s": age(r)}
+                      "age_s": age(r),
+                      **({"phase": ("prefilling"
+                                    if self._is_prefilling(r)
+                                    else "decoding"),
+                          "prefill_pos": int(r._prefill_pos),
+                          "prefill_target": int(r._prefill_target)}
+                         if self._prefill_chunk is not None else {})}
                      for i, r in enumerate(self._slots)
                      if r is not None]
             queue = [{"rid": r.rid, "queue_age_s": age(r)}
@@ -2242,6 +2602,16 @@ class InferenceEngine:
                          "shared_tokens": int(
                              self._m_prefix_shared_tokens.value)}
                         if self._prefix_cache is not None else None)}
+        if self._prefill_chunk is not None:
+            out["chunked_prefill"] = {
+                "prefill_chunk": self._prefill_chunk,
+                "tick_token_budget": self._tick_budget,
+                "last_tick_tokens": self._last_tick_spent,
+                "budget_utilization": round(
+                    self._last_tick_spent
+                    / max(1, self._tick_budget), 3),
+                "prefill_chunks_total": int(
+                    self._m_prefill_chunks.value)}
         if self._spec:
             out["spec"] = {
                 "spec_k": self._spec_k,
@@ -2293,6 +2663,7 @@ class InferenceEngine:
                     "kv_quantize": self._kv_mode,
                     "paged": self._paged,
                     "spec_decode": self._spec,
+                    "prefill_chunk": self._prefill_chunk,
                     **dict(self.stats)}
 
     def ready(self) -> bool:

@@ -1,0 +1,37 @@
+"""Shared test helpers (importable as ``from helpers import ...`` —
+pytest's rootdir handling puts tests/ on sys.path).
+
+`assert_no_recompiles` is the ONE implementation of the zero-recompile
+guard the serving suites previously hand-rolled (ISSUE-10 satellite):
+snapshot the `functools.lru_cache` compiled-program caches before a
+traffic wave, assert afterwards that no cache grew by more than the
+declared number of NEW geometries. Steady-state traffic must compile
+nothing — occupancy, budgets, block tables, chunk boundaries, and
+acceptance are all runtime data — so the default ``allow_new=0`` is
+the property under test; a warm-up wave that legitimately compiles its
+first bucket passes an explicit ``allow_new``.
+"""
+from contextlib import contextmanager
+
+
+@contextmanager
+def assert_no_recompiles(*caches, allow_new: int = 0):
+    """Assert the given lru_cache-wrapped compiled-program factories
+    gain at most ``allow_new`` entries across the with-body.
+
+    Usage::
+
+        with assert_no_recompiles(_compiled_prefill,
+                                  _compiled_decode_chunk):
+            for prompt in mixed_length_traffic:
+                eng.submit(prompt)
+            eng.run_pending()
+    """
+    before = [(c, c.cache_info().currsize) for c in caches]
+    yield
+    for c, b in before:
+        after = c.cache_info().currsize
+        assert after <= b + allow_new, (
+            f"{getattr(c, '__name__', c)} compiled "
+            f"{after - b} new program(s) (allowed {allow_new}): "
+            "steady-state traffic must not recompile")

@@ -1,0 +1,568 @@
+"""Chunked prefill + token-budget scheduler (ISSUE-10).
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- token exactness: chunked prefill == one-shot prefill == single-chip
+  `generate`, byte for byte, for every chunk size — greedy AND
+  sampled, float AND int8 KV, contiguous AND paged, fresh AND
+  prefix-hit-resume admissions;
+- the TPOT-stall bound, by name: while a max-length prompt prefills,
+  co-resident decoding slots advance EVERY tick and no inter-token gap
+  exceeds ceil(tick_token_budget / prefill_chunk) + 1 compiled-call
+  latencies (injected call-count clock);
+- zero steady-state recompiles: ONE chunked-prefill program per
+  (prefill_chunk, num_slots) geometry serves every prompt length —
+  resume position, valid length, and final-chunk flag are runtime
+  data (guard: helpers.assert_no_recompiles);
+- legacy preservation: prefill_chunk=None engines never touch the
+  chunked program caches and keep the PR-4/7/8 cache keys;
+- mid-prefill fault forensics: a slot that dies, preempts, cancels,
+  or deadlines MID-PREFILL resolves exactly like a mid-decode one —
+  isolation re-runs it solo from its committed prefix, co-resident
+  decoding slots never even see the failing call.
+"""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   generate, init_params)
+from deeplearning4j_tpu.observability.events import FlightRecorder
+from deeplearning4j_tpu.parallel.failure import (ServingFaultInjector,
+                                                 TrainingFailure)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestStatus)
+from deeplearning4j_tpu.serving.engine import (
+    DeadlineExceeded, RequestCancelled, RequestQuarantined,
+    _compiled_chunked_prefill, _compiled_decode_chunk,
+    _compiled_paged_chunked_prefill, _compiled_prefill)
+from helpers import assert_no_recompiles
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=6, backoff_base_s=0.0,
+                prefill_chunk=8)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _solo(params, mesh, prompt, max_new, **cfg_kw):
+    """One-shot (legacy) reference engine run for ``prompt``."""
+    eng = InferenceEngine(CFG, mesh, params,
+                          _config(prefill_chunk=None,
+                                  max_new_tokens=max_new, **cfg_kw))
+    h = eng.submit(prompt, max_new_tokens=max_new)
+    eng.run_pending()
+    return h.result(0)
+
+
+# ---------------------------------------------------------------------------
+# token exactness: chunked == one-shot == single-chip generate
+# ---------------------------------------------------------------------------
+
+def test_chunked_matches_oneshot_and_generate(params, mesh1):
+    """Every chunk size — including chunks that straddle the prompt
+    unevenly and a chunk larger than the prompt — reproduces the
+    one-shot engine AND single-chip `generate` byte for byte."""
+    want = np.asarray(generate(CFG, params, _prompt(24)[None], 6,
+                               key=jax.random.PRNGKey(0),
+                               temperature=0.0))[0]
+    for chunk in (3, 8, 24, 40):
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(prefill_chunk=chunk))
+        h = eng.submit(_prompt(24))
+        eng.run_pending()
+        np.testing.assert_array_equal(h.result(0), want)
+    np.testing.assert_array_equal(
+        _solo(params, mesh1, _prompt(24), 6), want)
+
+
+def test_chunked_sampled_continuations_bit_identical(params, mesh1):
+    """Sampled decode (temperature + top-k) is chunk-invariant: the
+    position-keyed sampling schedule depends on absolute sequence
+    position only, so the first token sampled at index plen matches
+    whatever chunk boundary produced it."""
+    kw = dict(temperature=0.8, top_k=5, max_new_tokens=8)
+    ref = _solo(params, mesh1, _prompt(20, 2), 8, temperature=0.8,
+                top_k=5)
+    for chunk in (4, 7):
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(prefill_chunk=chunk, **kw))
+        h = eng.submit(_prompt(20, 2))
+        eng.run_pending()
+        np.testing.assert_array_equal(h.result(0), ref)
+
+
+def test_chunked_int8_kv_token_exact(params, mesh1):
+    """int8 KV: later chunks re-read the prefix through its
+    quantization exactly as decode does, so the chunked int8 engine
+    matches the one-shot int8 engine token for token."""
+    ref = _solo(params, mesh1, _prompt(24, 1), 6, kv_quantize="int8")
+    for chunk in (5, 12):
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(prefill_chunk=chunk,
+                                      kv_quantize="int8"))
+        h = eng.submit(_prompt(24, 1))
+        eng.run_pending()
+        np.testing.assert_array_equal(h.result(0), ref)
+
+
+def test_chunked_paged_fresh_and_prefix_hit_resume(params, mesh1):
+    """Paged pool: a fresh chunked admission matches the one-shot
+    paged engine, and a PREFIX-HIT admission — whose chunked prefill
+    resumes from the radix-cache boundary, which is not a chunk
+    boundary — still matches byte for byte."""
+    ref = _solo(params, mesh1, _prompt(24), 6, paged=True, page_size=4)
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(prefill_chunk=5, paged=True,
+                                  page_size=4))
+    fresh = eng.submit(_prompt(24))
+    eng.run_pending()
+    np.testing.assert_array_equal(fresh.result(0), ref)
+    hit = eng.submit(_prompt(24))          # radix full-prefix hit
+    eng.run_pending()
+    np.testing.assert_array_equal(hit.result(0), ref)
+    assert eng.registry.get("serving_prefix_cache_hits").value >= 1
+    # int8 paged chunked, fresh + hit
+    ref8 = _solo(params, mesh1, _prompt(24), 6, paged=True,
+                 page_size=4, kv_quantize="int8")
+    eng8 = InferenceEngine(CFG, mesh1, params,
+                           _config(prefill_chunk=5, paged=True,
+                                   page_size=4, kv_quantize="int8"))
+    for _ in range(2):
+        h = eng8.submit(_prompt(24))
+        eng8.run_pending()
+        np.testing.assert_array_equal(h.result(0), ref8)
+
+
+def test_chunked_on_data_model_mesh(params, devices8):
+    """Chunked prefill shards like the one-shot pool (slots over
+    'data', heads over 'model'): 2x2-mesh results equal the 1x1 runs."""
+    mesh = make_mesh(MeshSpec(data=2, model=2))
+    mesh1 = make_mesh(MeshSpec(data=1, model=1))
+    eng = InferenceEngine(CFG, mesh, params, _config(prefill_chunk=6))
+    hs = [eng.submit(_prompt(8 + 4 * i, i)) for i in range(3)]
+    eng.run_pending()
+    for h in hs:
+        np.testing.assert_array_equal(
+            h.result(0), _solo(params, mesh1, h.prompt, 6))
+
+
+# ---------------------------------------------------------------------------
+# compile discipline
+# ---------------------------------------------------------------------------
+
+def test_chunked_zero_steady_state_recompiles(params, mesh1):
+    """ONE chunked-prefill program per (prefill_chunk, num_slots)
+    geometry covers EVERY prompt length — even lengths that would land
+    in different one-shot buckets — because resume position and valid
+    length are runtime data. After the warm-up request, a wave of
+    mixed lengths compiles nothing."""
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    eng.submit(_prompt(8))
+    eng.run_pending()
+    with assert_no_recompiles(_compiled_chunked_prefill,
+                              _compiled_decode_chunk):
+        for t0, seed in [(9, 1), (24, 2), (40, 3), (13, 4), (56, 5)]:
+            eng.submit(_prompt(t0, seed))
+        eng.run_pending()
+
+
+def test_legacy_engine_untouched_when_chunking_off(params, mesh1):
+    """prefill_chunk=None keeps the one-shot path: the chunked program
+    caches never grow, and the config knobs validate (a budget with
+    nothing to schedule, or chunking in batch mode, is a hard error
+    rather than silent misconfiguration)."""
+    with assert_no_recompiles(_compiled_chunked_prefill,
+                              _compiled_paged_chunked_prefill):
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(prefill_chunk=None))
+        h = eng.submit(_prompt(24))
+        eng.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+    assert eng.health()["prefill_chunk"] is None
+    with pytest.raises(ValueError, match="tick_token_budget"):
+        InferenceEngine(CFG, mesh1, params,
+                        _config(prefill_chunk=None,
+                                tick_token_budget=64))
+    with pytest.raises(ValueError, match="continuous"):
+        InferenceEngine(CFG, mesh1, params,
+                        _config(mode="batch"))
+
+
+# ---------------------------------------------------------------------------
+# the named TPOT-stall regression
+# ---------------------------------------------------------------------------
+
+class _CallClock(ServingFaultInjector):
+    """Injected clock: every compiled call (prefill, chunked prefill,
+    decode chunk) advances time by exactly 1 — so flight-recorder
+    timestamps measure schedule position, not this container's wall
+    clock, and the stall bound is asserted deterministically."""
+
+    def __init__(self):
+        super().__init__()
+        self.t = 0.0
+
+    def on_decode_step(self, step, request_ids=()):
+        self.t += 1.0
+        super().on_decode_step(step, request_ids)
+
+
+def test_tpot_stall_bounded_while_long_prompt_prefills(params, mesh1):
+    """REGRESSION (ISSUE-10, by name): admitting a max-length prompt
+    while 3 slots are mid-decode must NOT stall the residents for the
+    prompt's full prefill. Under the token-budget scheduler every
+    resident commits a decode chunk EVERY tick, and — on the injected
+    compiled-call clock — no resident's inter-chunk gap exceeds
+    ceil(tick_token_budget / prefill_chunk) prefill calls plus its own
+    decode call. The one-shot counterpoint below shows the unbounded
+    per-call prefill this replaces."""
+    budget, pfc, dchunk = 12, 8, 2
+    clk = _CallClock()
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=pfc, tick_token_budget=budget,
+                decode_chunk=dchunk, max_new_tokens=16, num_slots=4),
+        fault_injector=clk,
+        recorder=FlightRecorder(clock=lambda: clk.t))
+    residents = [eng.submit(_prompt(6, i), max_new_tokens=16)
+                 for i in range(3)]
+    eng.tick()                             # all 3 seated + first chunk
+    long_req = eng.submit(_prompt(CFG.max_len - 16, 9),
+                          max_new_tokens=4)
+    while eng._is_prefilling(long_req):
+        before = [r.generated.shape[0] for r in residents]
+        chunks0 = eng.registry.get("serving_prefill_chunks").value
+        eng.tick()
+        # (a) every resident advanced by exactly one decode chunk
+        for r, b in zip(residents, before):
+            assert r.generated.shape[0] == min(b + dchunk, 16), \
+                "resident stalled while the long prompt prefilled"
+        # (b) the tick's prefill work respected the budget
+        assert (eng.registry.get("serving_prefill_chunks").value
+                - chunks0) <= -(-budget // pfc)
+    eng.run_pending()
+    # (c) the injected-clock gap bound over every resident's trace
+    bound = -(-budget // pfc) + 1
+    for r in residents:
+        ts = [e.ts for e in r.trace.events
+              if e.kind in ("prefill_done", "decode_chunk")]
+        gaps = np.diff(ts)
+        assert gaps.size and gaps.max() <= bound, \
+            f"inter-token gap {gaps.max()} > {bound} compiled calls"
+    # everyone token-exact despite the interleaving
+    for i, r in enumerate(residents):
+        np.testing.assert_array_equal(
+            r.result(0), _solo(params, mesh1, _prompt(6, i), 16))
+    np.testing.assert_array_equal(
+        long_req.result(0),
+        _solo(params, mesh1, _prompt(CFG.max_len - 16, 9), 4))
+
+    # counterpoint: the one-shot engine runs the SAME admission as ONE
+    # compiled prefill spanning the whole prompt — per-call prefill
+    # work is bounded only by prompt length, which is the stall
+    eng1 = InferenceEngine(CFG, mesh1, params,
+                           _config(prefill_chunk=None,
+                                   max_new_tokens=4, num_slots=4))
+    eng1.submit(_prompt(CFG.max_len - 16, 9), max_new_tokens=4)
+    eng1.tick()
+    assert eng1.registry.get(
+        "serving_prefill_seconds")._unlabeled().snapshot()[2] == 1
+
+
+def test_prefill_is_oldest_first_for_ttft_fairness(params, mesh1):
+    """Two long admissions share the prefill budget oldest-first: the
+    earlier submission reaches its first token first (admission order
+    == queue order — the _fill_slots micro-assert feeds this)."""
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=8, tick_token_budget=8, num_slots=4,
+                max_new_tokens=2))
+    a = eng.submit(_prompt(40, 1), max_new_tokens=2)
+    b = eng.submit(_prompt(40, 2), max_new_tokens=2)
+    while not a.done() or not b.done():
+        eng.tick()
+        if a.generated.shape[0] == 0:
+            assert b.generated.shape[0] == 0, \
+                "younger admission sampled before the older one"
+    assert a.trace.first_ts("prefill_done") <= \
+        b.trace.first_ts("prefill_done")
+
+
+# ---------------------------------------------------------------------------
+# mid-prefill forensics: poison / preempt / cancel / deadline
+# ---------------------------------------------------------------------------
+
+def test_mid_prefill_chunk_fault_transient_retries(params, mesh1):
+    """The new prefill_chunk_fail_at knob: a transient chunk failure
+    retries the SAME chunk (same step index) and the request completes
+    token-exact — the retry event carries prefill=True."""
+    inj = ServingFaultInjector(prefill_chunk_fail_at=[1])
+    eng = InferenceEngine(CFG, mesh1, params, _config(prefill_chunk=8),
+                          fault_injector=inj)
+    h = eng.submit(_prompt(24))
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    assert inj.prefill_chunks_failed == 1
+    assert eng.stats["retries"] == 1
+    assert any(e.kind == "retry" and e.data.get("prefill")
+               for e in h.trace.events)
+    np.testing.assert_array_equal(
+        h.result(0), _solo(params, mesh1, _prompt(24), 6))
+
+
+def test_mid_prefill_poison_isolates_without_touching_decoders(
+        params, mesh1):
+    """A request POISONED while mid-prefill: its chunk calls fail and
+    isolation quarantines it — but decode calls never contained it
+    (PREFILLING slots are excluded from decode), so the co-resident
+    decoding request completes byte-exact WITHOUT a single decode
+    retry. Stronger isolation than one-shot mode, where admission and
+    decode share the tick's fate."""
+    inj = ServingFaultInjector()
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=4, tick_token_budget=6,
+                max_new_tokens=12, max_retries=1, num_slots=4),
+        fault_injector=inj)
+    good = eng.submit(_prompt(6, 1), max_new_tokens=12)
+    eng.tick()
+    bad = eng.submit(_prompt(40, 2), max_new_tokens=4)
+    inj.poison_requests.add(bad.rid)
+    eng.run_pending()
+    assert bad.status == RequestStatus.QUARANTINED
+    with pytest.raises(RequestQuarantined):
+        bad.result(0)
+    assert good.status == RequestStatus.COMPLETED
+    np.testing.assert_array_equal(
+        good.result(0), _solo(params, mesh1, _prompt(6, 1), 12))
+    # the poisoned request's trace shows the forensic chain
+    kinds = bad.trace.kinds()
+    assert "preempted" in kinds and "quarantined" in kinds
+    # and no retry event ever landed on the healthy decoder
+    assert not any(e.kind == "retry" for e in good.trace.events)
+
+
+def test_mid_prefill_persistent_chunk_fault_recovers_solo(params,
+                                                          mesh1):
+    """prefill_chunk_fail_at persistent at every step: the pooled
+    chunked prefill can never advance, but isolation's solo re-run
+    uses the ONE-SHOT scratch prefill (a different call kind the knob
+    does not target), so the request still completes token-exact —
+    committed-prefix resume generalizes to prefill chunk boundaries."""
+    inj = ServingFaultInjector(prefill_chunk_fail_at=range(1000),
+                               persistent=True)
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=8, max_retries=1,
+                breaker_failure_threshold=100),
+        fault_injector=inj)
+    h = eng.submit(_prompt(24))
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    assert inj.prefill_chunks_failed >= 1
+    assert eng.stats["preempted"] == 1
+    np.testing.assert_array_equal(
+        h.result(0), _solo(params, mesh1, _prompt(24), 6))
+
+
+def test_mid_prefill_cancel_frees_slot(params, mesh1):
+    """engine.cancel() on a mid-prefill request sheds it typed at the
+    next tick boundary, frees the slot, and the pool keeps serving."""
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=4, tick_token_budget=4, num_slots=2))
+    h = eng.submit(_prompt(40, 3))
+    eng.tick()
+    assert eng._is_prefilling(h)
+    assert eng.cancel(h)
+    eng.run_pending()
+    assert h.status == RequestStatus.SHED
+    with pytest.raises(RequestCancelled):
+        h.result(0)
+    assert eng.health()["slots_occupied"] == 0
+    nxt = eng.submit(_prompt(8, 4))
+    eng.run_pending()
+    assert nxt.status == RequestStatus.COMPLETED
+
+
+def test_mid_prefill_deadline_shed_with_injected_clock(params, mesh1):
+    """A deadline that expires MID-PREFILL (injected engine clock)
+    sheds the request typed `DeadlineExceeded` before it ever samples
+    a token; `on_deadline='partial'` completes it with its (empty)
+    committed tokens instead."""
+    t = {"now": 0.0}
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=4, tick_token_budget=4, num_slots=2),
+        clock=lambda: t["now"])
+    shed = eng.submit(_prompt(40, 1), deadline_s=5.0)
+    part = eng.submit(_prompt(40, 2), deadline_s=5.0,
+                      on_deadline="partial")
+    eng.tick()
+    assert eng._is_prefilling(shed)
+    t["now"] = 10.0                        # both deadlines expire
+    eng.run_pending()
+    assert shed.status == RequestStatus.SHED
+    with pytest.raises(DeadlineExceeded):
+        shed.result(0)
+    assert part.status == RequestStatus.COMPLETED
+    assert part.generated.shape[0] == 0    # nothing committed yet
+    assert eng.health()["slots_occupied"] == 0
+
+
+def test_mid_prefill_reload_preempts_and_requeues(tmp_path, params,
+                                                  mesh1):
+    """Hot reload while a slot is mid-prefill: the request is
+    preempted (requeued, nothing committed), resets its chunk
+    progress, and completes under the NEW weights."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    zeroed = jax.tree_util.tree_map(lambda a: a * 0, params)
+    mgr.save_tree(zeroed, 2)
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=4, tick_token_budget=4, num_slots=2,
+                max_new_tokens=4))
+    h = eng.submit(_prompt(40, 5), max_new_tokens=4)
+    eng.tick()
+    assert eng._is_prefilling(h) and h.generated.shape[0] == 0
+    assert eng.reload_weights(mgr, step=2) == 2
+    assert h.status == RequestStatus.QUEUED
+    assert eng.stats["preempted"] == 1
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    # the continuation ran under the zeroed weights
+    ref = InferenceEngine(CFG, mesh1, zeroed,
+                          _config(prefill_chunk=None,
+                                  max_new_tokens=4))
+    s = ref.submit(_prompt(40, 5), max_new_tokens=4)
+    ref.run_pending()
+    np.testing.assert_array_equal(h.result(0), s.result(0))
+
+
+def test_spec_decode_with_chunked_prefill_token_exact(params, mesh1):
+    """Speculative decode composes with chunked prefill: PREFILLING
+    slots are excluded from spec rounds (they are not decoding yet),
+    a slot joins speculation the tick after its first token, and the
+    self-drafting spec engine stays token-exact vs the plain chunked
+    engine while a long admission prefills mid-pool."""
+    kw = dict(prefill_chunk=4, tick_token_budget=6, num_slots=4,
+              max_new_tokens=10)
+
+    def run(spec: bool):
+        extra = dict(spec_decode=True, draft="self") if spec else {}
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(**kw, **extra))
+        a = eng.submit(_prompt(6, 1), max_new_tokens=10)
+        eng.tick()                         # a decoding
+        b = eng.submit(_prompt(30, 2), max_new_tokens=4)
+        eng.run_pending()                  # b prefills mid-pool
+        return eng, a, b
+
+    _, a_ref, b_ref = run(False)
+    eng, a, b = run(True)
+    np.testing.assert_array_equal(a.result(0), a_ref.result(0))
+    np.testing.assert_array_equal(b.result(0), b_ref.result(0))
+    assert eng.registry.get("serving_spec_drafted_tokens").value > 0
+
+
+def test_fleet_failover_mid_prefill_resumes_on_survivor(params, mesh1):
+    """A replica killed while its resident is MID-PREFILL: the router
+    fails the request over to the survivor, which re-prefills from
+    the committed prefix (nothing committed yet = full re-prefill)
+    and completes token-exact vs an uninterrupted run — the
+    committed-prefix resume contract generalizes to prefill chunk
+    boundaries."""
+    from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+    from deeplearning4j_tpu.serving import FleetConfig, Router
+    ec = _config(prefill_chunk=4, tick_token_budget=4, num_slots=2,
+                 max_new_tokens=4)
+    want = _solo(params, mesh1, _prompt(40, 3), 4)
+    inj = FleetFaultInjector(kill_at={2: 0})   # mid-prefill: prompt 40
+    #                                            at 4 tokens/tick
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=2,
+               engine_config=ec, fault_injector=inj,
+               config=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        h = r.submit(_prompt(40, 3), max_new_tokens=4)
+        r.run_pending()
+        assert inj.kills_injected == 1
+        assert h.status == RequestStatus.COMPLETED
+        np.testing.assert_array_equal(h.result(0), want)
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_chunked_metrics_events_and_debugz(params, mesh1):
+    """serving_prefill_chunks_total + serving_tick_budget_utilization
+    publish and render; admitted/prefill_done/decode_chunk events
+    carry the prefill_chunk field; debugz grows the chunked_prefill
+    section and per-slot PREFILLING phase."""
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    eng = InferenceEngine(
+        CFG, mesh1, params,
+        _config(prefill_chunk=8, tick_token_budget=10,
+                max_new_tokens=4, num_slots=2))
+    h = eng.submit(_prompt(24, 1))
+    eng.tick()
+    dz = eng.debugz()
+    if dz["slots"]:                        # still mid-prefill
+        assert dz["slots"][0]["phase"] in ("prefilling", "decoding")
+    eng.run_pending()
+    # prompt 24 @ budget 10/tick: chunks 8+2 | 8+2 | 4 = 5 calls
+    assert eng.registry.get("serving_prefill_chunks").value == 5
+    assert eng.registry.get(
+        "serving_tick_budget_utilization").value > 0
+    text = prometheus_text(eng.registry)
+    assert "serving_prefill_chunks_total 5" in text
+    assert "serving_tick_budget_utilization" in text
+    ev = {e.kind: e for e in h.trace.events}
+    assert ev["admitted"].data["prefill_chunk"] == 8
+    assert ev["prefill_done"].data["prefill_chunk"] == 8
+    assert "prefill_chunk" in ev["decode_chunk"].data
+    dz = eng.debugz()["chunked_prefill"]
+    assert dz["prefill_chunk"] == 8
+    assert dz["tick_token_budget"] == 10
+    assert dz["prefill_chunks_total"] == 5
+
+
+def test_injector_on_prefill_chunk_semantics():
+    inj = ServingFaultInjector(prefill_chunk_fail_at=[0],
+                               prefill_fail_at=[1],
+                               poison_requests=[7])
+    with pytest.raises(TrainingFailure, match="prefill-chunk"):
+        inj.on_prefill_chunk(0)            # chunk-only knob
+    inj.on_prefill_chunk(0)                # one-shot: consumed
+    with pytest.raises(TrainingFailure, match="prefill"):
+        inj.on_prefill_chunk(1)            # prefill_fail_at fires too
+    with pytest.raises(TrainingFailure, match="poisoned"):
+        inj.on_prefill_chunk(2, request_ids=[7])
+    inj.on_prefill_chunk(2, request_ids=[3])
+    assert inj.prefill_chunks_failed == 1
+    assert inj.prefills_failed == 1

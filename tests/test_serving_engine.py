@@ -596,3 +596,33 @@ def test_cancel_queued_and_in_flight(params, mesh1):
     # the cancelled sheds are traced with their reason
     assert [e.data["reason"] for e in running.trace.events
             if e.kind == "shed"] == ["cancelled"]
+
+
+def test_worker_skips_coalescing_sleep_when_queue_fills_pool(params,
+                                                             mesh1):
+    """REGRESSION (ISSUE-10 satellite): `_worker`'s coalescing sleep
+    used to run even when the queue already held enough requests to
+    fill every free slot — pure TTFT latency with nothing left to
+    coalesce. `_queue_fills_pool` is the worker's skip predicate:
+    true exactly when waiting cannot improve the next round."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=2, num_slots=2))
+    assert not eng._queue_fills_pool()       # empty queue: wait
+    eng.submit(_prompt(8, 1))
+    assert not eng._queue_fills_pool()       # 1 request, 2 free slots
+    eng.submit(_prompt(8, 2))
+    assert eng._queue_fills_pool()           # queue fills the pool
+    eng.tick()                               # both seated
+    eng.submit(_prompt(8, 3))
+    assert eng._queue_fills_pool()           # zero free slots: any
+    #                                          queued request saturates
+    eng.run_pending()
+    assert not eng._queue_fills_pool()
+    # batch mode compares against the coalescing cap instead
+    engb = InferenceEngine(CFG, mesh1, params,
+                           _config(mode="batch", max_batch_size=2))
+    engb.submit(_prompt(8, 1))
+    assert not engb._queue_fills_pool()
+    engb.submit(_prompt(8, 2))
+    assert engb._queue_fills_pool()
+    engb.run_pending()

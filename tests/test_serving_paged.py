@@ -207,28 +207,26 @@ def test_paged_no_recompile_within_bucket(params, mesh1):
     admission patterns are runtime data. A repeat prompt (prefix hit,
     smaller suffix bucket) adds at most one prefill entry on its
     FIRST hit, then the compiled-program space is closed."""
+    from helpers import assert_no_recompiles
     cfg = _config(max_new_tokens=4)
     eng = InferenceEngine(CFG, mesh1, params, cfg)
     eng.submit(_prompt(8))
     eng.run_pending()
-    pf0 = _compiled_paged_prefill.cache_info().currsize
-    dc0 = _compiled_paged_decode.cache_info().currsize
-    for t0, seed in [(9, 1), (11, 2), (16, 3), (8, 4), (13, 5)]:
-        eng.submit(_prompt(t0, seed))
-    eng.run_pending()
-    assert _compiled_paged_prefill.cache_info().currsize == pf0
-    assert _compiled_paged_decode.cache_info().currsize == dc0
+    with assert_no_recompiles(_compiled_paged_prefill,
+                              _compiled_paged_decode):
+        for t0, seed in [(9, 1), (11, 2), (16, 3), (8, 4), (13, 5)]:
+            eng.submit(_prompt(t0, seed))
+        eng.run_pending()
     # steady-state hit traffic: the first hit may compile its (smaller)
     # suffix bucket once; repeats stay closed
-    eng.submit(_prompt(16, 3))
-    eng.run_pending()
-    pf1 = _compiled_paged_prefill.cache_info().currsize
-    assert pf1 <= pf0 + 1
-    eng.submit(_prompt(16, 3))
-    eng.submit(_prompt(8, 4))
-    eng.run_pending()
-    assert _compiled_paged_prefill.cache_info().currsize == pf1
-    assert _compiled_paged_decode.cache_info().currsize == dc0
+    with assert_no_recompiles(_compiled_paged_prefill, allow_new=1):
+        eng.submit(_prompt(16, 3))
+        eng.run_pending()
+    with assert_no_recompiles(_compiled_paged_prefill,
+                              _compiled_paged_decode):
+        eng.submit(_prompt(16, 3))
+        eng.submit(_prompt(8, 4))
+        eng.run_pending()
 
 
 def test_paged_spec_off_bit_identical_with_unchanged_cache_keys(

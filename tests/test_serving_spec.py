@@ -309,6 +309,7 @@ def test_adaptive_k_walks_a_closed_program_set(params, mesh1):
     """Acceptance variance must never recompile: the controller only
     visits K in {spec_k, spec_k/2, .., 1}, so a second traffic wave
     adds ZERO spec-program cache entries."""
+    from helpers import assert_no_recompiles
     base = _compiled_spec_decode.cache_info().currsize
     eng = InferenceEngine(CFG, mesh1, params,
                           _config(draft="layers:1", spec_k=4))
@@ -316,10 +317,10 @@ def test_adaptive_k_walks_a_closed_program_set(params, mesh1):
         eng.submit(_prompt(8, s))
     eng.run_pending()                     # walks K down as it rejects
     n0 = _compiled_spec_decode.cache_info().currsize
-    for s in range(3, 8):
-        eng.submit(_prompt(8 + s % 4, s))
-    eng.run_pending()
-    assert _compiled_spec_decode.cache_info().currsize == n0
+    with assert_no_recompiles(_compiled_spec_decode):
+        for s in range(3, 8):
+            eng.submit(_prompt(8 + s % 4, s))
+        eng.run_pending()
     assert n0 - base <= 3                 # {4, 2, 1} at spec_k=4
 
 
