@@ -65,6 +65,15 @@ EVENT_KINDS = frozenset({
     #                  after a replica loss {from, to, committed}
     "hedge",         # fleet router: hedged pair resolved {winner,
     #                  loser, outcome: primary_won|hedge_won}
+    "handoff",       # tiered router: committed prefill KV moved from
+    #                  a prefill-tier replica toward a decode-tier
+    #                  one {from, tokens, outcome: ok|fallback|failed}
+    #                  — outcome "fallback"/"failed" means the decode
+    #                  dispatch re-prefills instead (ISSUE-11)
+    "autoscale",     # tiered router (rid 0, fleet-wide): a tier's
+    #                  replica count changed {tier, direction: up|down,
+    #                  replicas} — the occupancy-driven policy's
+    #                  audit trail (ISSUE-11)
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

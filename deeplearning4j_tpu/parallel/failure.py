@@ -190,6 +190,14 @@ class ServingFaultInjector(FaultInjector):
         scratch re-runs) stay healthy. ``prefill_fail_at`` also fires
         on chunked calls (they ARE prefill calls); this knob is the
         narrower one.
+      - ``adopt_fail_requests``: request ids whose cross-tier KV
+        ADOPTION fails at seating on the decode-side engine
+        (ISSUE-11): the engine must shed the request typed
+        ``shed{reason="handoff"}`` AND decref every page it allocated
+        for the adoption — the handoff error path's `_free_slot`-style
+        refcount audit (tests/test_serving_disagg.py). Request ids are
+        the ADOPTING engine's own rids (engine-local, like
+        ``poison_requests``).
       - ``draft_poison_at``: ``{step: request_id}`` — the SPECULATIVE
         engine derails the named request's draft proposals for the
         round at that step index ((d+1) mod V on device — guaranteed
@@ -214,8 +222,12 @@ class ServingFaultInjector(FaultInjector):
                  prefill_fail_at: Iterable[int] = (),
                  corrupt_page_at: Optional[dict] = None,
                  draft_poison_at: Optional[dict] = None,
-                 prefill_chunk_fail_at: Iterable[int] = ()):
+                 prefill_chunk_fail_at: Iterable[int] = (),
+                 adopt_fail_requests: Iterable[int] = ()):
         super().__init__(fail_at, persistent=persistent)
+        self.adopt_fail_requests = set(int(r)
+                                       for r in adopt_fail_requests)
+        self.adoptions_failed = 0
         self.poison_requests = set(int(r) for r in poison_requests)
         self.delay_at = {int(k): float(v)
                          for k, v in (delay_at or {}).items()}
@@ -240,6 +252,16 @@ class ServingFaultInjector(FaultInjector):
         The counter bumps when the engine confirms the poke landed
         (the request might have left its slot by then)."""
         return self.corrupt_page_at.pop(int(step), None)
+
+    def check_adopt(self, rid: int) -> bool:
+        """One-shot: True when request ``rid``'s KV adoption should
+        fail at seating (the decode-side handoff error path)."""
+        if int(rid) in self.adopt_fail_requests:
+            if not self.persistent:
+                self.adopt_fail_requests.discard(int(rid))
+            self.adoptions_failed += 1
+            return True
+        return False
 
     def check_draft_poison(self, step: int) -> Optional[int]:
         """One-shot: the request id whose draft proposals the
@@ -328,12 +350,19 @@ class FleetFaultInjector:
     - ``fail_probe``: ``{replica_id: n}`` — the replica's next ``n``
       probes fail (the router must take it out of rotation WITHOUT
       killing it, and return it when probes recover).
+    - ``handoff_fail_at``: handoff sequence indices (0-based, counted
+      across the tiered router's lifetime) whose KV EXPORT from the
+      prefill-tier replica fails (ISSUE-11). The contract under test:
+      the request is never lost — the decode dispatch falls back to
+      re-prefilling the committed prefix, token-exactly, and the
+      handoff is counted ``outcome="failed"``.
     """
 
     def __init__(self, kill_at: Optional[dict] = None,
                  hang_at: Optional[dict] = None,
                  slow_at: Optional[dict] = None,
-                 fail_probe: Optional[dict] = None):
+                 fail_probe: Optional[dict] = None,
+                 handoff_fail_at: Iterable[int] = ()):
         self.kill_at = {int(k): int(v)
                         for k, v in (kill_at or {}).items()}
         self.hang_at = {int(k): int(v)
@@ -342,10 +371,12 @@ class FleetFaultInjector:
                         for k, v in (slow_at or {}).items()}
         self.fail_probe = {int(k): int(v)
                            for k, v in (fail_probe or {}).items()}
+        self.handoff_fail_at = set(int(i) for i in handoff_fail_at)
         self.kills_injected = 0
         self.hangs_injected = 0
         self.slows_injected = 0
         self.probe_failures_injected = 0
+        self.handoffs_failed = 0
 
     def check_kill(self, tick: int) -> Optional[int]:
         """One-shot: the replica id to crash at ``tick``, else None."""
@@ -368,6 +399,16 @@ class FleetFaultInjector:
         if v is not None:
             self.slows_injected += 1
         return v
+
+    def check_handoff(self, seq: int) -> bool:
+        """One-shot: True when the ``seq``-th handoff's KV export
+        should fail (the tiered router then falls back to
+        re-prefilling on the decode tier)."""
+        if int(seq) in self.handoff_fail_at:
+            self.handoff_fail_at.discard(int(seq))
+            self.handoffs_failed += 1
+            return True
+        return False
 
     def check_probe(self, replica_id: int) -> bool:
         """True when this probe of ``replica_id`` should fail

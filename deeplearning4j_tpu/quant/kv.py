@@ -164,6 +164,29 @@ def paged_pool_bytes(cfg, num_slots: int, page_size: int,
     return pool + scales + bt + 2 * num_slots * 4
 
 
+def handoff_bytes(cfg, tokens: int, kv_mode: Optional[str] = None,
+                  tp: int = 1, cache_dtype=None) -> int:
+    """Analytic bytes one cross-tier KV handoff moves for a committed
+    prefix of ``tokens`` K/V rows (ISSUE-11): K + V values at the pool
+    dtype plus — when the pool is quantized — the per-row float32
+    scales, which TRAVEL WITH their rows through the host-gather →
+    device-put hop exactly as they travel with their page through
+    share/COW remaps. Backs `serving_handoff_bytes_total` and is the
+    operator's interconnect-budget input when the tiers stop sharing
+    a host."""
+    L = cfg.n_layers
+    d = cfg.d_model
+    if kv_mode is not None:
+        item = jnp.dtype(kv_cache_dtype(kv_mode)).itemsize
+        scales = 2 * L * tokens * tp * 4
+    else:
+        dt = cache_dtype if cache_dtype is not None \
+            else cfg.cache_jnp_dtype()
+        item = jnp.dtype(dt).itemsize
+        scales = 0
+    return 2 * L * tokens * d * item + scales
+
+
 def slot_pool_bytes(cfg, num_slots: int,
                     kv_mode: Optional[str] = None, tp: int = 1,
                     cache_dtype=None) -> int:

@@ -56,12 +56,27 @@ the layer between callers and the compiled decode step:
   deterministically testable via `parallel.failure.FleetFaultInjector`
   (tests/test_serving_fleet.py, docs/serving.md "Replicated fleet").
 
+- Disaggregated prefill/decode tiers (round 16, ISSUE-11):
+  `serving/disagg.py`'s `TieredRouter` fronts a prefill tier and a
+  decode tier of replicas joined by a cross-tier KV handoff — the
+  prefill tier runs (chunked) prefill to completion and holds the
+  finished slot, its committed KV pages are host-gathered and adopted
+  into a decode replica's page pool (exact for float AND int8 KV,
+  per-page scales travel), and decode resumes token-exactly; a lost
+  decode replica's requests re-prefill on the prefill tier. An
+  `Autoscaler` per tier drives replica counts from the
+  occupancy/budget-utilization gauges every health probe piggybacks
+  (scale-to-zero for the prefill tier under decode-only load) —
+  docs/serving.md "Disaggregated tiers & autoscaling".
+
 Lifecycle and thresholds: docs/serving.md.
 """
+from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
+    Autoscaler, AutoscalePolicy, TieredRouter)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     DeadlineExceeded, EngineConfig, EngineDraining, EngineStopped,
-    InferenceEngine, OverloadError, RequestCancelled, RequestHandle,
-    RequestQuarantined, RequestStatus)
+    HandoffError, InferenceEngine, KVHandoff, OverloadError,
+    RequestCancelled, RequestHandle, RequestQuarantined, RequestStatus)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetHandle, InProcessReplica, ReplicaState, Router,
     SubprocessReplica)

@@ -250,6 +250,43 @@ class RequestQuarantined(RuntimeError):
     quarantined so it cannot poison further batches."""
 
 
+class HandoffError(RuntimeError):
+    """Cross-tier KV handoff failed (ISSUE-11): exporting a held
+    slot's committed KV, or adopting a handed-off page chain at
+    seating. A request shed on the adoption path carries this error
+    and the typed ``shed{reason="handoff"}`` trace event, and every
+    page the adoption claimed is decref'd first."""
+
+
+@dataclass
+class KVHandoff:
+    """One request's committed KV state, portable across engines
+    (ISSUE-11): the host-gathered K/V rows for positions [0, pos), the
+    pending token (committed but not yet fed — its row is written by
+    the FIRST decode step on the adopting side), and — for quantized
+    pools — the per-row scales, which travel with their rows exactly
+    as they travel with their page through share/COW remaps
+    (quant/kv.py). Bit-preserving by construction: values are sliced,
+    never re-quantized, so a float OR int8 decode continuation on the
+    adopting engine is token-exact vs an uninterrupted single-engine
+    run."""
+    pos: int                 # K/V rows [0, pos) are committed
+    tok: int                 # pending token == last committed token
+    k: "np.ndarray"          # [L, pos, D] at the pool dtype
+    v: "np.ndarray"
+    k_scale: Optional["np.ndarray"] = None   # [L, pos, tp] f32
+    v_scale: Optional["np.ndarray"] = None
+    kv_mode: Optional[str] = None
+    n_layers: int = 0
+    d_model: int = 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in
+                   (self.k, self.v, self.k_scale, self.v_scale)
+                   if a is not None)
+
+
 class RequestStatus:
     QUEUED = "queued"
     RUNNING = "running"
@@ -375,6 +412,9 @@ class RequestHandle:
         self.error: Optional[BaseException] = None
         self.deadline_exceeded = False
         self._cancelled = False
+        self._hold_kv = False            # keep slot seated when done
+        self._kv = None                  # KVHandoff to adopt at seat
+        self._handoff_failed = False     # shed reason "handoff"
         self._generated: List[np.ndarray] = []
         self._done = threading.Event()
         self._in_flight = False          # continuous-mode accounting
@@ -618,6 +658,57 @@ def _compiled_page_poison(n_pool_arrays: int):
     return jax.jit(poison)
 
 
+@lru_cache(maxsize=8)
+def _compiled_page_gather(n_pool_arrays: int):
+    """Gather a page chain out of the pool (all layers, values +
+    scales) — the KV-export half of the cross-tier handoff (ISSUE-11).
+    One fixed-shape program per pool arity; the (max_pages-padded)
+    index vector is runtime data, so exporting never recompiles."""
+    import jax
+
+    def gather(idx, *pool):
+        return tuple(a[:, idx] for a in pool)
+
+    return jax.jit(gather)
+
+
+@lru_cache(maxsize=8)
+def _compiled_slot_gather(n_pool_arrays: int):
+    """Contiguous twin of _compiled_page_gather: one slot's full
+    [L, S, ...] planes out of the slot pool (slot index is runtime
+    data)."""
+    import jax
+
+    def gather(slot, *pool):
+        return tuple(a[:, slot] for a in pool)
+
+    return jax.jit(gather)
+
+
+@lru_cache(maxsize=8)
+def _compiled_kv_adopt(n_pool_arrays: int):
+    """Scatter a handed-off row chain INTO freshly allocated pages and
+    point the slot's pos/tok at the committed prefix — the device-put
+    half of the handoff. ``idx`` is max_pages-padded; invalid entries
+    are routed to the scratch page 0 (never attended), so the scatter
+    shape stays static and adoption never recompiles."""
+    import jax
+    import jax.numpy as jnp
+
+    def adopt(idx, valid, slot, new_pos, new_tok, *arrs):
+        n = (len(arrs) - 2) // 2
+        rows, pool = arrs[:n], arrs[n:2 * n]
+        pos, tok = arrs[-2], arrs[-1]
+        tgt = jnp.where(valid, idx, 0)
+        out = tuple(a.at[:, tgt].set(r.astype(a.dtype))
+                    for a, r in zip(pool, rows))
+        pos = pos.at[slot].set(new_pos)
+        tok = tok.at[slot].set(new_tok)
+        return (*out, pos, tok)
+
+    return jax.jit(adopt)
+
+
 class InferenceEngine:
     """Bounded-queue, deadline-aware, fault-tolerant front end for the
     sharded generate path. See module docstring for semantics; see
@@ -810,6 +901,8 @@ class InferenceEngine:
         shed = r.counter("serving_requests_shed",
                          "Requests rejected or abandoned, by reason",
                          labelnames=("reason",))
+        self._m_shed = shed          # reason="handoff" child created
+        #                              lazily: legacy scrapes unchanged
         self._m_shed_overload = shed.labels("overload")
         self._m_shed_deadline = shed.labels("deadline")
         self._m_shed_cancelled = shed.labels("cancelled")
@@ -902,6 +995,13 @@ class InferenceEngine:
                 "serving_prefix_shared_tokens",
                 "Prompt tokens whose prefill compute AND KV bytes "
                 "were served from the radix prefix cache")
+            # cross-tier KV adoption (ISSUE-11): children created
+            # lazily, so non-disagg paged scrapes are unchanged
+            self._m_adoptions = r.counter(
+                "serving_kv_adoptions",
+                "Handed-off KV chains seated into this engine's page "
+                "pool, by outcome (ok / blocked / shed)",
+                labelnames=("outcome",))
         # speculative decoding (ISSUE-8): registered only on spec
         # engines, so non-speculative scrapes are byte-unchanged
         if self._spec:
@@ -996,10 +1096,35 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_deadline: str = "shed") -> RequestHandle:
+               on_deadline: str = "shed",
+               hold_kv: bool = False,
+               kv: Optional[KVHandoff] = None) -> RequestHandle:
         """Admit one prompt. Raises OverloadError when the queue is full
         or the circuit breaker is open; in degraded mode the token
-        budget is silently capped (reported via health())."""
+        budget is silently capped (reported via health()).
+
+        ISSUE-11 (cross-tier handoff): ``hold_kv`` keeps the request's
+        slot SEATED after it completes — its KV pages stay referenced
+        — until `export_slot_kv()` / `release_held()` frees it (the
+        prefill-tier side). ``kv`` seats the request by ADOPTING a
+        `KVHandoff` instead of prefilling: the handed-off rows are
+        device-put into freshly allocated pages and decode resumes
+        from the committed prefix (the decode-tier side; paged
+        continuous engines only — an engine that cannot adopt drops
+        the handoff with a warning and re-prefills, which is slower
+        but token-identical)."""
+        if kv is not None and not (self._continuous and self._paged
+                                   and kv.kv_mode == self._kv_mode
+                                   and kv.n_layers == self.cfg.n_layers
+                                   and kv.d_model == self.cfg.d_model):
+            # availability over purity: a mismatched handoff target
+            # re-prefills (correct tokens, no shared compute) instead
+            # of failing the request for a router-side config skew
+            log.warning("KV handoff not adoptable here (paged=%s, "
+                        "kv_mode=%s vs handoff %s): falling back to "
+                        "re-prefill", self._paged, self._kv_mode,
+                        kv.kv_mode)
+            kv = None
         if on_deadline not in ("shed", "partial"):
             raise ValueError(f"on_deadline must be 'shed' or 'partial', "
                              f"got {on_deadline!r}")
@@ -1055,6 +1180,8 @@ class InferenceEngine:
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
                 on_deadline)
+            handle._hold_kv = bool(hold_kv)
+            handle._kv = kv
             handle.trace = self.recorder.start_trace(handle.rid)
             handle._on_terminal = self._on_terminal
             handle.trace.add(
@@ -1078,7 +1205,8 @@ class InferenceEngine:
                         partial=bool(r.deadline_exceeded))
         elif r.status == RequestStatus.SHED:
             r.trace.add("shed", reason=(
-                "cancelled" if r._cancelled
+                "handoff" if r._handoff_failed
+                else "cancelled" if r._cancelled
                 else "deadline" if r.deadline_exceeded
                 else "overload"))
         elif r.status == RequestStatus.QUARANTINED:
@@ -1396,7 +1524,10 @@ class InferenceEngine:
                 self._prefill_slots(admitted, params)
             except _BatchDecodeFailed as e:
                 self._isolate_slots([r for _, r in admitted], e)
-        occupied = self._occupied()
+        # done-but-held slots (hold_kv, ISSUE-11) stay seated but must
+        # never re-enter the decode round
+        occupied = [(i, r) for i, r in self._occupied()
+                    if not r.done()]
         if occupied:
             try:
                 self._decode_chunk_slots(occupied, params)
@@ -1608,7 +1739,23 @@ class InferenceEngine:
                     continue
                 i = free[0]
                 hit = 0
-                if self._paged:
+                adopted = False
+                if r._kv is not None:
+                    # cross-tier KV adoption (ISSUE-11): seat by
+                    # device-putting the handed-off rows into fresh
+                    # pages — no prefill call for this request
+                    seated = self._seat_adopted(i, r)
+                    if seated is None:
+                        # pool exhausted: block at the queue head,
+                        # exactly like a fresh paged admission —
+                        # unless _seat_adopted already shed it
+                        if not r.done():
+                            self._queue.appendleft(r)
+                        break
+                    if r.done():
+                        continue     # shed typed "handoff" at seating
+                    adopted = True
+                elif self._paged:
                     seated = self._seat_paged(i, r)
                     if seated is None:
                         # pool exhausted: block (requeue at the head)
@@ -1619,7 +1766,8 @@ class InferenceEngine:
                         break
                     hit = seated
                 free.popleft()
-                seated_order.append(r)
+                if not adopted:
+                    seated_order.append(r)
                 self._slots[i] = r
                 if self._spec:
                     # seat with the engine's CURRENT belief, not blind
@@ -1642,6 +1790,17 @@ class InferenceEngine:
                 self._m_in_flight.inc()
                 extra = ({"prefill_chunk": self._prefill_chunk}
                          if self._prefill_chunk is not None else {})
+                if adopted:
+                    # the whole committed prefix arrived via the
+                    # handoff: no prefill call, no bucket — the slot
+                    # goes straight to DECODING (pos/tok were set by
+                    # the adopt program)
+                    r._prefill_pos = r._prefill_target
+                    r.trace.add("admitted", slot=i, bucket=0,
+                                adopted=True, prefix_hit_tokens=int(
+                                    r._prefill_target - 1), **extra)
+                    self.slo.admitted(r.trace)
+                    continue
                 r.trace.add("admitted", slot=i, bucket=int(
                     self._bucket_len(r.prompt.shape[0]
                                      + r.generated.shape[0] - hit)),
@@ -1738,6 +1897,207 @@ class InferenceEngine:
                 self._m_prefix_misses.inc()
         return m
 
+    # ------------------------------------------------------------------
+    # cross-tier KV handoff: export / adopt (ISSUE-11)
+    # ------------------------------------------------------------------
+    def _shed_handoff(self, r: RequestHandle, msg: str) -> None:
+        """The typed handoff shed: ``shed{reason="handoff"}`` on the
+        trace, the lazily-created reason="handoff" counter child, and
+        a `HandoffError` on the handle — the satellite contract."""
+        r._handoff_failed = True
+        self._m_shed.labels("handoff").inc()
+        if self._paged:
+            self._m_adoptions.labels("shed").inc()
+        r._finish(RequestStatus.SHED, HandoffError(msg))
+
+    def _seat_adopted(self, i: int, r: RequestHandle) -> Optional[bool]:
+        """Seat request ``r`` into slot ``i`` by adopting its
+        `KVHandoff` (caller holds the lock): allocate a fresh private
+        page chain for the whole committed-prefix + decode budget
+        (all-or-nothing), scatter the handed-off rows + scales into it,
+        and point the slot's pos/tok at the committed prefix — decode
+        resumes token-exactly with no prefill call. Returns True on
+        success, None when the pool cannot cover it (admission BLOCKS
+        at the queue head, exactly like a fresh paged admission — a
+        near-full pool never corrupts residents), and sheds typed
+        ``handoff`` — decref'ing every page this adoption claimed —
+        on validation failure, injected adoption faults, or a failed
+        adopt call (the `_free_slot`-style refcount audit)."""
+        kv = r._kv
+        self._ensure_state()
+        prefix = np.concatenate([r.prompt, r.generated]).astype(np.int32)
+        plen = int(prefix.shape[0])
+        # hard alignment check: the handoff must be exactly one
+        # pending token short of the committed prefix, with its
+        # pending token equal to the prefix's last token — anything
+        # else means the rows do not describe this request's text, and
+        # decoding over them would be silently wrong
+        if kv.pos != plen - 1 or int(kv.tok) != int(prefix[-1]) \
+                or kv.k.shape[1] != kv.pos:
+            self._shed_handoff(
+                r, f"request {r.rid}: KV handoff misaligned "
+                   f"(pos={kv.pos} rows={kv.k.shape[1]} vs committed "
+                   f"prefix {plen}, tok={kv.tok} vs {int(prefix[-1])})")
+            return False
+        inj = self._injector
+        if (inj is not None and hasattr(inj, "check_adopt")
+                and inj.check_adopt(r.rid)):
+            self._shed_handoff(
+                r, f"request {r.rid}: injected adoption fault")
+            return False
+        total = plen + (r.max_new_tokens - int(r.generated.shape[0]))
+        need = pages_for(total, self._page_size)
+        fresh: List[int] = []
+        for _ in range(need):
+            p = self._alloc_page()
+            if p is None:
+                self._allocator.release_chain(fresh)   # no partial claim
+                if not any(pgs for pgs in self._slot_pages):
+                    # nothing else holds pages and eviction is dry:
+                    # blocking would deadlock — shed typed "handoff"
+                    self._shed_handoff(
+                        r, f"request {r.rid} needs {need} KV pages to "
+                           f"adopt its handoff; the pool cannot free "
+                           f"enough ({self._allocator.pages_free} "
+                           "free)")
+                    return False
+                self._m_adoptions.labels("blocked").inc()
+                return None
+            fresh.append(p)
+        try:
+            self._adopt_rows(fresh, kv, i)
+        except Exception as e:
+            # the decref audit on the handoff error path: every page
+            # this adoption claimed goes back before the shed
+            self._allocator.release_chain(fresh)
+            self._shed_handoff(
+                r, f"request {r.rid}: KV adopt call failed: {e}")
+            return False
+        self._slot_pages[i] = fresh
+        self._bt[i, :] = 0
+        self._bt[i, :len(fresh)] = fresh
+        r._page_start = plen - 1
+        r._kv = None                 # adopted: drop the host copy
+        self._m_adoptions.labels("ok").inc()
+        if self._prefix_cache is not None and kv.pos > 0:
+            # the adopted prompt rows are complete KV — cache the full
+            # pages so co-tenant decode-tier traffic sharing the
+            # prefix maps them instead of re-prefilling (the cache
+            # becomes a co-owner via refcount, as after any prefill)
+            self._prefix_cache.insert(prefix[:kv.pos], fresh)
+        return True
+
+    def _adopt_rows(self, pages: List[int], kv: KVHandoff,
+                    slot: int) -> None:
+        """Device-put the handed-off rows into ``pages``: rows (and
+        scales, which travel with their rows) are padded to the fixed
+        [L, max_pages * page_size, ...] geometry, reshaped to page
+        granularity, and scattered through one compiled program whose
+        page indices are runtime data — adoption never recompiles."""
+        mp, ps = self._max_pages, self._page_size
+        cap = mp * ps
+        pool, _ = self._pool_arrays()
+        rows = []
+        for src, plane in zip((kv.k, kv.v), pool[:2]):
+            buf = np.zeros((self.cfg.n_layers, cap, src.shape[-1]),
+                           np.asarray(plane).dtype)
+            buf[:, :kv.pos] = src
+            rows.append(buf.reshape(self.cfg.n_layers, mp, ps, -1))
+        if self._kv_mode:
+            for src, plane in zip((kv.k_scale, kv.v_scale), pool[2:]):
+                buf = np.ones((self.cfg.n_layers, cap, src.shape[-1]),
+                              np.float32)    # unwritten rows: scale 1
+                buf[:, :kv.pos] = src
+                rows.append(buf.reshape(self.cfg.n_layers, mp, ps, -1))
+        idx = np.zeros((mp,), np.int32)
+        idx[:len(pages)] = pages
+        valid = np.zeros((mp,), bool)
+        valid[:len(pages)] = True
+        out = _compiled_kv_adopt(len(pool))(
+            idx, valid, np.int32(slot), np.int32(kv.pos),
+            np.int32(kv.tok), *rows, *self._slot_state)
+        self._slot_state = tuple(out)
+
+    def export_slot_kv(self, handle: RequestHandle,
+                       release: bool = True) -> KVHandoff:
+        """Host-gather request ``handle``'s committed KV out of its
+        (still seated — submit with ``hold_kv=True``) slot: K/V rows
+        for positions [0, pos) plus per-row scales when the pool is
+        quantized, bit-exact slices of the live pool. ``release`` frees
+        the held slot afterwards (always, via finally — a failed
+        export must not leak the seat). Raises `HandoffError` when the
+        handle is not resident or still mid-prefill."""
+        try:
+            with self._lock:
+                slot = next((i for i, r in enumerate(self._slots)
+                             if r is handle), None)
+                if slot is None:
+                    raise HandoffError(
+                        f"request {handle.rid} is not resident — "
+                        "nothing to export (was it submitted with "
+                        "hold_kv=True?)")
+                if self._is_prefilling(handle):
+                    raise HandoffError(
+                        f"request {handle.rid} is mid-prefill: its KV "
+                        "rows are incomplete")
+                if self._slot_state is None:
+                    raise HandoffError("slot pool not allocated")
+                state = self._slot_state        # immutable snapshot
+                pages = (list(self._slot_pages[slot]) if self._paged
+                         else None)
+            import jax.numpy as jnp
+            pos = int(np.asarray(state[-2])[slot])
+            tok = int(np.asarray(state[-1])[slot])
+            pool = state[:-2]
+            if self._paged:
+                idx = np.zeros((self._max_pages,), np.int32)
+                idx[:len(pages)] = pages
+                planes = _compiled_page_gather(len(pool))(
+                    jnp.asarray(idx), *pool)
+                # [L, mp, ps, X] -> [L, mp*ps, X] -> the committed rows
+                planes = [np.asarray(a).reshape(
+                    self.cfg.n_layers, -1, a.shape[-1])[:, :pos]
+                    for a in planes]
+            else:
+                planes = _compiled_slot_gather(len(pool))(
+                    np.int32(slot), *pool)
+                planes = [np.asarray(a)[:, :pos] for a in planes]
+            k, v = planes[0], planes[1]
+            ksc = planes[2] if self._kv_mode else None
+            vsc = planes[3] if self._kv_mode else None
+            return KVHandoff(pos=pos, tok=tok, k=k, v=v, k_scale=ksc,
+                             v_scale=vsc, kv_mode=self._kv_mode,
+                             n_layers=self.cfg.n_layers,
+                             d_model=self.cfg.d_model)
+        finally:
+            if release:
+                self.release_held(handle)
+
+    def release_held(self, handle: RequestHandle) -> bool:
+        """Free a slot held past completion by ``hold_kv=True``
+        (idempotent). The pages decref; whatever the prefix cache
+        co-owns stays resident for the next tenant."""
+        with self._lock:
+            handle._hold_kv = False
+            for i, r in enumerate(self._slots):
+                if r is handle and r.done():
+                    self._free_slot(i)
+                    self._leave_flight(r)
+                    return True
+        return False
+
+    def committed_kv_pages(self, handle: RequestHandle) -> int:
+        """KV pages request ``handle``'s slot currently references —
+        what fleet_worker.py reports in its progress lines (0 for
+        non-resident requests and unpaged pools)."""
+        with self._lock:
+            if not self._paged:
+                return 0
+            for i, r in enumerate(self._slots):
+                if r is handle:
+                    return len(self._slot_pages[i])
+        return 0
+
     def _pool_arrays(self):
         """The page-indexed leading arrays of the slot state (kp, vp
         [+ kscale, vscale]) — pos/tok trail them."""
@@ -1755,8 +2115,7 @@ class InferenceEngine:
         self._slot_state = (*out, *rest)
 
     def _release_slot_pages(self, i: int) -> None:
-        for p in self._slot_pages[i]:
-            self._allocator.decref(p)
+        self._allocator.release_chain(self._slot_pages[i])
         self._slot_pages[i] = []
         self._bt[i, :] = 0
 
@@ -2289,6 +2648,11 @@ class InferenceEngine:
         with self._lock:
             for i, r in enumerate(self._slots):
                 if r is not None and r.done():
+                    if r._hold_kv:
+                        # held for KV export (ISSUE-11): the slot (and
+                        # its pages) stays seated until release_held /
+                        # export_slot_kv frees it
+                        continue
                     self._free_slot(i)
                     self._leave_flight(r)
 
@@ -2374,6 +2738,13 @@ class InferenceEngine:
         for i in range(self._num_slots - 1, -1, -1):
             r = self._slots[i]
             if r is None:
+                continue
+            if r.done():
+                # a done-but-held slot (hold_kv): free it — the KV
+                # encodes the old weights, so a later export would be
+                # wrong anyway (the exporter falls back to re-prefill)
+                self._free_slot(i)
+                self._leave_flight(r)
                 continue
             self._free_slot(i)
             r.status = RequestStatus.QUEUED
@@ -2650,14 +3021,26 @@ class InferenceEngine:
     def health(self) -> dict:
         with self._lock:
             self._tick_breaker(self._clock())
+            occupied = sum(s is not None for s in self._slots)
             return {"ready": self.ready(),
                     "breaker": self._breaker,
                     "degraded": self._degraded_locked(),
                     "draining": self._draining,
                     "queue_depth": len(self._queue),
                     "num_slots": self._num_slots,
-                    "slots_occupied": sum(s is not None
-                                          for s in self._slots),
+                    "slots_occupied": occupied,
+                    # load piggyback (ISSUE-11 satellite): the
+                    # serving_slot_occupancy / tick-budget-utilization
+                    # gauge VALUES ride on every health probe — in-
+                    # process and HTTP alike — so a router (and its
+                    # autoscaler) sees per-replica load without
+                    # scraping /metrics separately
+                    "slot_occupancy": occupied / max(1,
+                                                     self._num_slots),
+                    "tick_budget_utilization": (
+                        self._last_tick_spent
+                        / float(max(1, self._tick_budget))
+                        if self._prefill_chunk is not None else None),
                     "weights_step": self._weights_step,
                     "quantize": self._qmode,
                     "kv_quantize": self._kv_mode,

@@ -117,8 +117,11 @@ class ReplicaState:
     UNHEALTHY = "unhealthy"      # probes failing; in-flight may finish
     RESTARTING = "restarting"    # dead, restart scheduled
     DEAD = "dead"                # dead, crash budget exhausted
+    STOPPED = "stopped"          # deliberately scaled down (ISSUE-11);
+    #                              revivable by the autoscaler
 
-    ALL = ("ready", "draining", "unhealthy", "restarting", "dead")
+    ALL = ("ready", "draining", "unhealthy", "restarting", "dead",
+           "stopped")
 
 
 class ReplicaCrashed(RuntimeError):
@@ -178,6 +181,12 @@ class FleetHandle:
         self._queued_at = 0.0
         self._failovers = 0
         self._hedged = False
+        # tiered routing (ISSUE-11, serving/disagg.py): which tier the
+        # next dispatch targets (None reads as "prefill" under a
+        # TieredRouter; the plain Router never looks) and the pending
+        # KV handoff the decode dispatch should adopt
+        self._phase: Optional[str] = None
+        self._handoff = None
         self._done = threading.Event()
 
     @property
@@ -241,6 +250,10 @@ class InProcessReplica:
     routes `probe()` through real HTTP `/healthz` semantics."""
 
     kind = "inprocess"
+    #: in-process replicas can export/adopt KV handoffs by reference
+    #: (ISSUE-11); subprocess ones would need the rows serialized over
+    #: the pipe — the tiered router falls back to re-prefill there
+    supports_handoff = True
 
     def __init__(self, replica_id: int, factory: Callable[[], object],
                  http_probes: bool = False):
@@ -306,13 +319,25 @@ class InProcessReplica:
 
     _SLOW_STRIDE = 4
 
-    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline):
+    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline,
+               **kw):
+        """``kw`` passes the ISSUE-11 handoff knobs through to the
+        engine (``hold_kv=`` on the prefill tier, ``kv=`` on the
+        decode tier)."""
         if self._dead:
             raise ReplicaCrashed(f"replica {self.id} is dead")
         return self.engine.submit(prompt,
                                   max_new_tokens=max_new_tokens,
                                   deadline_s=deadline_s,
-                                  on_deadline=on_deadline)
+                                  on_deadline=on_deadline, **kw)
+
+    def export_kv(self, inner, release: bool = True):
+        """Host-gather ``inner``'s committed KV out of its held slot
+        (engine.export_slot_kv) — the prefill-tier half of a
+        cross-tier handoff."""
+        if self._dead:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        return self.engine.export_slot_kv(inner, release=release)
 
     def cancel(self, inner) -> None:
         if not self._dead:
@@ -436,6 +461,7 @@ class SubprocessReplica:
     in-process engine built the same way."""
 
     kind = "subprocess"
+    supports_handoff = False     # KV stays behind the process boundary
 
     def __init__(self, replica_id: int, spec: dict,
                  startup_timeout_s: float = 180.0):
@@ -558,7 +584,12 @@ class SubprocessReplica:
     def step(self) -> bool:
         return False             # the worker drives its own engine
 
-    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline):
+    def submit(self, prompt, max_new_tokens, deadline_s, on_deadline,
+               **kw):
+        if kw:
+            log.warning("subprocess replica %d ignores submit "
+                        "kwargs %s (no cross-pipe KV handoff)",
+                        self.id, sorted(kw))
         if not self.alive():
             raise ReplicaCrashed(f"replica {self.id} is dead")
         lrid = next(self._lrids)
@@ -677,6 +708,8 @@ class _ReplicaCtl:
     def __init__(self, replica):
         self.replica = replica
         self.id = replica.id
+        self.tier = "serving"        # TieredRouter: prefill | decode
+        self.scaled_down = False     # deliberately stopped (ISSUE-11)
         self.draining = False
         self.dead = False
         self.unhealthy = False
@@ -700,6 +733,8 @@ class _ReplicaCtl:
         return max(1, int(getattr(self.replica, "capacity", 1)))
 
     def state(self) -> str:
+        if self.scaled_down:
+            return ReplicaState.STOPPED
         if self.dead:
             return (ReplicaState.RESTARTING
                     if self.next_restart_at is not None
@@ -1170,6 +1205,7 @@ class Router:
                             f"{fr.max_new_tokens} tokens at replica "
                             f"{ctl.id}'s loss"))
                         continue
+                    self._prepare_failover(fr, ctl)
                     fr._failover_from = ctl.id
                     fr._failovers += 1
                     fr.status = RequestStatus.QUEUED
@@ -1300,8 +1336,10 @@ class Router:
         return (ctl.n_outstanding() / ctl.capacity
                 + 2.0 * ctl.err_ema)
 
-    def _pick(self, now: float,
-              exclude: Optional[int] = None) -> Optional[_ReplicaCtl]:
+    def _pick(self, now: float, exclude: Optional[int] = None,
+              fr: Optional[FleetHandle] = None) -> Optional[_ReplicaCtl]:
+        """``fr`` lets tier-aware subclasses pick by the request's
+        phase (serving/disagg.py); the flat router ignores it."""
         best, best_score = None, None
         for ctl in self._ctls:
             if ctl.id == exclude or not self._dispatchable(ctl, now):
@@ -1333,7 +1371,7 @@ class Router:
                         "dispatch"))
                     n += 1
                     continue
-                ctl = self._pick(now)
+                ctl = self._pick(now, fr=fr)
                 if ctl is None:
                     if (not self._restartable()
                             and not any(not c.dead
@@ -1353,7 +1391,7 @@ class Router:
                 self._age_window.append(age)
                 hedge_ctl = None
                 if self._should_hedge(fr, age):
-                    hedge_ctl = self._pick(now, exclude=ctl.id)
+                    hedge_ctl = self._pick(now, exclude=ctl.id, fr=fr)
             ok = self._dispatch_to(fr, ctl, now, hedge=False)
             if ok is None:
                 # replica-side rejection: the request is back at the
@@ -1403,9 +1441,8 @@ class Router:
                     "dispatch"))
                 return False
         try:
-            inner = ctl.replica.submit(prompt.astype(np.int32),
-                                       remaining, deadline_s,
-                                       fr.on_deadline)
+            inner = self._submit_hop(ctl, fr, prompt.astype(np.int32),
+                                     remaining, deadline_s)
         except (OverloadError, EngineDraining, EngineStopped,
                 ReplicaCrashed) as e:
             # dispatch failure: passive signal + breaker; requeue at
@@ -1440,6 +1477,22 @@ class Router:
         fr.trace.add("dispatched", replica=ctl.id, hedge=bool(hedge),
                      committed=int(committed.shape[0]))
         return True
+
+    def _submit_hop(self, ctl: _ReplicaCtl, fr: FleetHandle,
+                    prompt: np.ndarray, remaining: int,
+                    deadline_s: Optional[float]):
+        """One replica submit — the seam tier-aware subclasses
+        override (prefill hops carry hold_kv, decode hops carry the
+        pending KVHandoff)."""
+        return ctl.replica.submit(prompt, remaining, deadline_s,
+                                  fr.on_deadline)
+
+    def _prepare_failover(self, fr: FleetHandle,
+                          ctl: _ReplicaCtl) -> None:
+        """Hook before a lost replica's request is requeued: the
+        tiered router resets the request to the prefill phase here (a
+        lost decode replica's KV is gone — the committed prefix
+        re-prefills on the prefill tier)."""
 
     def _passive_failure(self, ctl: _ReplicaCtl) -> None:
         a = self.config.error_ema_alpha
@@ -1633,6 +1686,7 @@ class Router:
         with self._lock:
             replicas = [{
                 "replica": c.id,
+                "tier": c.tier,
                 "kind": getattr(c.replica, "kind", "?"),
                 "state": c.state(),
                 "ready": c.ready,
@@ -1644,6 +1698,12 @@ class Router:
                 "restarts": c.restarts,
                 "probe_url": getattr(c.replica, "probe_url", None),
                 "occupancy": c.last_health.get("slots_occupied"),
+                # health-probe load piggyback (ISSUE-11 satellite):
+                # the slot-occupancy / budget-utilization gauge values
+                # every probe now carries
+                "slot_occupancy": c.last_health.get("slot_occupancy"),
+                "budget_utilization": c.last_health.get(
+                    "tick_budget_utilization"),
                 "weights_step": c.last_health.get("weights_step"),
             } for c in self._ctls]
             queue = [{"rid": fr.rid,
@@ -1651,7 +1711,9 @@ class Router:
                                                now - fr._queued_at), 6),
                       "failovers": fr._failovers}
                      for fr in self._queue]
+            tiers = self._tier_table_locked()
         return {"replicas": replicas,
+                "tiers": tiers,
                 "queue_depth": len(queue),
                 "queue": queue,
                 "draining": self._draining,
@@ -1659,3 +1721,32 @@ class Router:
                 "stats": self.stats,
                 "recent_events": [e.as_dict() for e in
                                   self.recorder.recent(recent)]}
+
+    def _tier_table_locked(self) -> List[dict]:
+        """The per-tier summary table (ISSUE-11 satellite): one row
+        per tier with replica states, mean probe-reported occupancy,
+        in-flight work, and the tier's last handoff (tiered routers
+        only — the flat router is one 'serving' tier)."""
+        tiers: Dict[str, List[_ReplicaCtl]] = {}
+        for c in self._ctls:
+            tiers.setdefault(c.tier, []).append(c)
+        out = []
+        for tier, ctls in tiers.items():
+            occ = [c.last_health.get("slot_occupancy")
+                   for c in ctls
+                   if c.last_health.get("slot_occupancy") is not None]
+            states: Dict[str, int] = {}
+            for c in ctls:
+                states[c.state()] = states.get(c.state(), 0) + 1
+            out.append({
+                "tier": tier,
+                "replicas": len(ctls),
+                "states": states,
+                "occupancy": (round(sum(occ) / len(occ), 3)
+                              if occ else None),
+                "in_flight": sum(c.n_outstanding() for c in ctls),
+                "last_handoff": self._last_handoff_for(tier)})
+        return out
+
+    def _last_handoff_for(self, tier: str) -> Optional[dict]:
+        return None              # tiered routers override

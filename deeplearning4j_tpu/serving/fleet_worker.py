@@ -18,8 +18,9 @@ tests/test_multihost.py's pattern from training to serving. Protocol:
 - stdin thereafter: one JSON command per line — ``submit`` / ``cancel``
   / ``drain`` / ``resume`` / ``reload`` / ``stop``.
 - stdout thereafter: streamed request events — ``accepted`` /
-  ``rejected`` / ``progress`` (the committed tokens so far: the
-  router's failover substrate when this process is SIGKILLed) /
+  ``rejected`` / ``progress`` (the committed tokens so far — the
+  router's failover substrate when this process is SIGKILLed — plus
+  the slot's committed-KV page count, ISSUE-11 satellite) /
   ``done`` / ``error`` — plus ``drained``/``resumed``/``reloaded``
   acks.
 
@@ -109,8 +110,13 @@ def main() -> int:
                               "etype": type(h.error).__name__,
                               "msg": str(h.error), "tokens": toks})
                 else:
+                    # committed-KV page count rides every progress
+                    # line (ISSUE-11 satellite): the router-side view
+                    # of how much KV state a failover would re-prefill
+                    # (0 on unpaged engines)
                     emit({"ev": "progress", "rid": rid,
-                          "tokens": h.generated.tolist()})
+                          "tokens": h.generated.tolist(),
+                          "kv_pages": eng.committed_kv_pages(h)})
 
     threading.Thread(target=progress_loop, daemon=True,
                      name="fleet-worker-progress").start()

@@ -93,6 +93,24 @@ class PageAllocator:
         if self._ref[page] == 0:
             self._free.append(page)
 
+    # -- chain operations (cross-tier KV handoff, ISSUE-11) -------------
+    def alloc_chain(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages, all-or-nothing: either a full chain
+        (each page refcount 1) or None with NOTHING allocated — the
+        adopt path's no-partial-claim guarantee (a decode-side
+        adoption that cannot fit must block or shed, never leave
+        orphaned refcounts behind)."""
+        if n > len(self._free):
+            return None
+        return [self.alloc() for _ in range(n)]
+
+    def release_chain(self, pages: Sequence[int]) -> None:
+        """Decref every page of a chain — the one call every
+        slot-clearing AND handoff-error path shares, so the refcount
+        audit has a single choke point."""
+        for p in pages:
+            self.decref(p)
+
 
 class _Node:
     __slots__ = ("key", "page", "parent", "children", "last_used")

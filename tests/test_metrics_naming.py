@@ -257,6 +257,102 @@ def test_fleet_series_pass_the_lint():
             assert SNAKE.match(lab), f"label {lab!r} not snake_case"
 
 
+def test_tiered_fleet_series_pass_the_lint():
+    """The disaggregation series (ISSUE-11: serving_tier_* gauges,
+    serving_handoff_*_total counters + serving_handoff_seconds
+    histogram, serving_autoscale_events_total) live in the
+    TieredRouter registry — scrape one over real tiered traffic (a
+    handoff per request plus an autoscale cycle, so every family has
+    samples) and run the same naming rules over the whole
+    exposition."""
+    from deeplearning4j_tpu.serving import (AutoscalePolicy,
+                                            TieredRouter)
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    ec = EngineConfig(decode_chunk=2, max_new_tokens=12,
+                      backoff_base_s=0.0, max_batch_size=2, paged=True)
+
+    class _Clk:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = _Clk()
+    router = TieredRouter(cfg=cfg, mesh=mesh, params=params,
+                          prefill_replicas=1, decode_replicas=1,
+                          prefill_engine_config=ec,
+                          decode_engine_config=ec,
+                          decode_autoscale=AutoscalePolicy(
+                              min_replicas=1, max_replicas=2,
+                              window=2, cooldown_s=0.1),
+                          clock=clk)
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        hs = [router.submit(prompt, max_new_tokens=12)
+              for _ in range(6)]
+        for _ in range(3000):
+            if not router.pending():
+                break
+            router.tick()
+            clk.t += 0.05
+        assert all(h.done() for h in hs)
+        for _ in range(40):            # idle: exercise scale-down
+            router.tick()
+            clk.t += 0.05
+        srv = MetricsServer(router.registry, port=0,
+                            health=router.health, ready=router.ready,
+                            debug=router.debugz)
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+        finally:
+            srv.stop()
+    finally:
+        router.close()
+    types = _types(text)
+    # every ISSUE-11 family is present and correctly typed
+    assert types["serving_handoff_transfers_total"] == "counter"
+    assert types["serving_handoff_tokens_total"] == "counter"
+    assert types["serving_handoff_bytes_total"] == "counter"
+    assert types["serving_handoff_seconds"] == "histogram"
+    assert types["serving_autoscale_events_total"] == "counter"
+    assert types["serving_tier_replicas"] == "gauge"
+    assert types["serving_tier_occupancy"] == "gauge"
+    assert types["serving_tier_budget_utilization"] == "gauge"
+    assert types["serving_tier_queue_depth"] == "gauge"
+    # the traffic really exercised the handoff + autoscale families
+    assert 'serving_handoff_transfers_total{outcome="ok"} 0' \
+        not in text
+    assert 'direction="up"' in text and 'direction="down"' in text
+    # full-lint pass over the tiered exposition
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):
