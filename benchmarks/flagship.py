@@ -1771,6 +1771,181 @@ def bench_disagg(reps: int = 2, *, n_requests: int = 26,
     return out
 
 
+def bench_cold_start(reps: int = 2, *, seed: int = 0) -> dict:
+    """Replica cold-start + tick-loop raw speed (ISSUE-12 acceptance,
+    asserted IN-BENCH: restart-to-first-token >= 3x faster cache-warm
+    vs cache-cold, device-idle fraction per tick lower with the
+    double-buffered loop, token-exact everywhere, zero steady-state
+    recompiles after warmup).
+
+    Arm 1 — AOT compile cache. A "restart" is simulated by clearing
+    the in-memory compiled-program caches AND jax's dispatch caches
+    (what a fresh process starts without; only the on-disk cache
+    survives). Cold: an engine with an EMPTY compile_cache_dir warms
+    up (every program traced + XLA-compiled, then serialized). Warm:
+    the same config against the now-populated directory (every
+    program deserialized — jit compiles asserted ZERO). Both runs
+    serve the same trace token-identically, and the measured span is
+    restart-to-FIRST-TOKEN: engine construction + warmup + the first
+    request's first committed token — the fleet-elasticity number
+    (supervised restart, autoscale-up).
+
+    Arm 2 — double-buffered tick loop. The same warmed geometry
+    replays a saturating mixed trace through pipeline=off vs
+    pipeline=on engines; per-tick device-idle fraction (1 -
+    dispatched-work interval / tick wall) is averaged over busy
+    ticks. The pipelined engine dispatches tick N before syncing tick
+    N-1, so host scheduling work overlaps device compute and the
+    idle fraction drops — tokens bit-identical (schedule-ahead uses
+    deterministic token counts only)."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (
+        EngineConfig, InferenceEngine, _ProgramLRU,
+        _compiled_decode_chunk, _compiled_prefill)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
+                            n_layers=3, max_len=256)
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(8, 49))).astype(np.int32)
+               for _ in range(16)]
+
+    def fresh_process():
+        for c in _ProgramLRU._instances:
+            c.cache_clear()
+        jax.clear_caches()
+
+    def econf(**kw):
+        return EngineConfig(max_batch_size=8, max_queue=256,
+                            max_new_tokens=8, decode_chunk=4,
+                            degrade_queue_depth=10 ** 6, **kw)
+
+    def restart_to_first_token(cache_dir):
+        """Fresh-process engine build + warmup + first committed
+        token — the recovery-to-ready span."""
+        fresh_process()
+        t0 = _t.perf_counter()
+        eng = InferenceEngine(cfg, mesh, params,
+                              econf(compile_cache_dir=cache_dir,
+                                    warmup_on_init=True))
+        h = eng.submit(prompts[0])
+        while h.generated.shape[0] == 0:
+            eng.tick()
+        ttft = _t.perf_counter() - t0
+        hs = [eng.submit(p) for p in prompts[1:]]
+        eng.run_pending()
+        toks = [h.result(0)] + [x.result(0) for x in hs]
+        return eng, ttft, toks
+
+    cache_dir = tempfile.mkdtemp(prefix="dl4j-aot-bench-")
+    try:
+        # reference tokens (plain engine, also warms nothing we rely
+        # on — the cold arm clears every in-memory cache first)
+        eng_ref = InferenceEngine(cfg, mesh, params, econf())
+        ref_hs = [eng_ref.submit(p) for p in prompts]
+        eng_ref.run_pending()
+        ref = [h.result(0) for h in ref_hs]
+
+        cold_eng, cold_s, cold_toks = restart_to_first_token(cache_dir)
+        assert cold_eng.last_warmup["aot_cache"] == 0
+        warm_s, warm_eng = None, None
+        for _ in range(max(1, reps)):
+            eng, s, warm_toks = restart_to_first_token(cache_dir)
+            if warm_s is None or s < warm_s:
+                warm_s, warm_eng = s, eng
+        # token-exact across cold/warm/reference, in-bench
+        for a, b, c in zip(ref, cold_toks, warm_toks):
+            assert np.array_equal(a, b) and np.array_equal(a, c), \
+                "cold/warm restart diverged from the reference tokens"
+        # the zero-recompile guards: a warm restart compiles NOTHING,
+        # and post-warmup traffic added no program-cache entries
+        assert warm_eng.last_warmup["jit"] == 0, \
+            f"warm restart compiled {warm_eng.last_warmup['jit']}"
+        speedup = cold_s / max(warm_s, 1e-9)
+        assert speedup >= 3.0, \
+            f"cold-start speedup {speedup:.2f}x < 3x bar"
+
+        # arm 2: device-idle fraction, sync vs double-buffered (warm
+        # programs — the arms differ ONLY in the pipeline knob)
+        def idle_replay(pipeline):
+            """Time-weighted device-idle fraction over the replay:
+            1 - total dispatched-work interval / total wall (a
+            per-tick mean would over-weight the structural commit-only
+            drain tick at end of trace)."""
+            eng = InferenceEngine(
+                cfg, mesh, params,
+                econf(compile_cache_dir=cache_dir,
+                      warmup_on_init=True, pipeline=pipeline))
+            hs = [eng.submit(p) for p in prompts]
+            busy0 = eng._busy_total_s
+            t0 = _t.perf_counter()
+            while eng.tick():
+                pass
+            elapsed = _t.perf_counter() - t0
+            assert all(h.done() for h in hs)
+            toks = [h.result(0) for h in hs]
+            total = sum(t.shape[0] - p.shape[0]
+                        for t, p in zip(toks, prompts))
+            idle = max(0.0, 1.0 - (eng._busy_total_s - busy0)
+                       / max(elapsed, 1e-9))
+            return (idle, total / elapsed, toks)
+
+        sync_idle, sync_tps, sync_toks = None, None, None
+        pipe_idle, pipe_tps, pipe_toks = None, None, None
+        for _ in range(max(1, reps)):
+            fresh = idle_replay(False)
+            if sync_idle is None or fresh[1] > sync_tps:
+                sync_idle, sync_tps, sync_toks = fresh
+            fresh = idle_replay(True)
+            if pipe_idle is None or fresh[1] > pipe_tps:
+                pipe_idle, pipe_tps, pipe_toks = fresh
+        for a, b, c in zip(ref, sync_toks, pipe_toks):
+            assert np.array_equal(a, b) and np.array_equal(a, c), \
+                "pipelined replay diverged from the reference tokens"
+        pf0 = _compiled_prefill.cache_info().currsize
+        dc0 = _compiled_decode_chunk.cache_info().currsize
+        eng = InferenceEngine(cfg, mesh, params,
+                              econf(compile_cache_dir=cache_dir,
+                                    warmup_on_init=True,
+                                    pipeline=True))
+        for p in prompts:
+            eng.submit(p)
+        eng.run_pending()
+        assert _compiled_prefill.cache_info().currsize == pf0
+        assert _compiled_decode_chunk.cache_info().currsize == dc0
+        assert pipe_idle < sync_idle, \
+            (f"double-buffered idle fraction {pipe_idle:.3f} not "
+             f"below synchronous {sync_idle:.3f}")
+
+        return {"config": "cold_start", "value": round(speedup, 2),
+                "unit": "x_cold_start_speedup",
+                "cold_restart_to_first_token_s": round(cold_s, 3),
+                "warm_restart_to_first_token_s": round(warm_s, 3),
+                "warmup_programs": int(
+                    warm_eng.last_warmup["programs"]),
+                "aot_cache_bytes": warm_eng._aot.stats()["bytes"],
+                "device_idle_fraction_sync": round(sync_idle, 4),
+                "device_idle_fraction_pipelined": round(pipe_idle, 4),
+                "idle_reduction": round(
+                    1.0 - pipe_idle / max(sync_idle, 1e-9), 3),
+                "tokens_per_sec_sync": round(sync_tps, 1),
+                "tokens_per_sec_pipelined": round(pipe_tps, 1),
+                "token_exact": True, "recompiles": 0}
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def bench_word2vec(reps: int = 2) -> dict:
     """Word2Vec skip-gram+neg at the reference-workload-class vocab
     (v=100k) — the driver-captured row VERDICT r5 weak #2 demanded
@@ -1802,6 +1977,7 @@ BENCHES = {"transformer": bench_transformer,
            "fleet_failover": bench_fleet_failover,
            "chunked_prefill": bench_chunked_prefill,
            "disagg": bench_disagg,
+           "cold_start": bench_cold_start,
            "word2vec": bench_word2vec}
 
 

@@ -1636,8 +1636,13 @@ def _spec_accept_commit(spec_k: int, drafts, tgt, pos, tok, rem, act):
     rows = jnp.arange(ns)
     zero = jnp.asarray(0, jnp.int32)
     match = (drafts == tgt[:, :spec_k]) & act[:, None]
+    # .astype(int32): jnp.sum promotes int32 to the default int, which
+    # under jax_enable_x64 silently flips the slot pos/ncommit dtypes
+    # to int64 after the first round — a hidden extra jit signature on
+    # the lazy path and a hard aval mismatch for an AOT-compiled
+    # executable (ISSUE-12). Pin the accept count instead.
     acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                  axis=1)                                   # [Ns] 0..K
+                  axis=1).astype(jnp.int32)                 # [Ns] 0..K
     c = jnp.where(act, jnp.minimum(acc + 1, rem), zero)
     emit = jnp.where(jnp.arange(k1)[None, :] < c[:, None], tgt,
                      jnp.asarray(-1, jnp.int32))

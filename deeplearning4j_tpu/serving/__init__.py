@@ -69,14 +69,29 @@ the layer between callers and the compiled decode step:
   (scale-to-zero for the prefill tier under decode-only load) —
   docs/serving.md "Disaggregated tiers & autoscaling".
 
+- Raw speed: persistent AOT compile cache + double-buffered tick loop
+  (round 17, ISSUE-12): `EngineConfig(compile_cache_dir=,
+  warmup_on_init=)` serializes every compiled serving program
+  (executable bytes, `serving/compile_cache.py`) so a restarted or
+  autoscaled replica LOADS its closed program set instead of
+  recompiling it — restart-to-ready becomes milliseconds — and
+  `EngineConfig(pipeline=True)` dispatches each tick's compiled calls
+  without blocking, committing the previous tick's outputs at one
+  sync point, so host scheduling work overlaps device compute
+  (`serving_device_idle_fraction`; docs/serving.md "Engine internals
+  & raw speed").
+
 Lifecycle and thresholds: docs/serving.md.
 """
+from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
+    CompileCache)
 from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
     Autoscaler, AutoscalePolicy, TieredRouter)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     DeadlineExceeded, EngineConfig, EngineDraining, EngineStopped,
     HandoffError, InferenceEngine, KVHandoff, OverloadError,
-    RequestCancelled, RequestHandle, RequestQuarantined, RequestStatus)
+    RequestCancelled, RequestHandle, RequestQuarantined, RequestStatus,
+    set_program_cache_size)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetHandle, InProcessReplica, ReplicaState, Router,
     SubprocessReplica)

@@ -570,13 +570,18 @@ class TieredRouter(Router):
                 progressed |= self._scale_down(tier, now)
         return progressed
 
-    def _log_autoscale(self, tier: str, direction: str,
-                       now: float) -> None:
+    def _log_autoscale(self, tier: str, direction: str, now: float,
+                       cold_start_s: Optional[float] = None) -> None:
         n = len(self._active_ctls(tier))
         self._m_autoscale.labels(tier, direction).inc()
-        self.autoscale_log.append({"t": round(now, 6), "tier": tier,
-                                   "direction": direction,
-                                   "replicas": n})
+        entry = {"t": round(now, 6), "tier": tier,
+                 "direction": direction, "replicas": n}
+        if cold_start_s is not None:
+            # scale-up build latency (ISSUE-12): ~the compile set on a
+            # cold host, ~the AOT-cache load set on a warm one — the
+            # number EngineConfig.compile_cache_dir exists to shrink
+            entry["cold_start_s"] = round(cold_start_s, 4)
+        self.autoscale_log.append(entry)
         self.recorder.record("autoscale", rid=0, tier=tier,
                              direction=direction, replicas=n)
         log.info("autoscale: tier %s %s -> %d replica(s)", tier,
@@ -584,9 +589,14 @@ class TieredRouter(Router):
 
     def _scale_up(self, tier: str, now: float) -> bool:
         """Revive a STOPPED replica of the tier, else build a fresh
-        one from the tier's factory (the process-wide compiled-program
-        caches make either path cheap on a warm host; the AOT-cache
-        ROADMAP item is what makes them cheap on a cold one)."""
+        one from the tier's factory. The process-wide compiled-
+        program caches make either path cheap on a warm host, and a
+        factory whose EngineConfig sets compile_cache_dir (+
+        warmup_on_init) makes it cheap on a COLD one too: the new
+        engine LOADS its program set from the persistent AOT cache
+        (serving/compile_cache.py) instead of recompiling it — the
+        per-event build latency lands in autoscale_log as
+        cold_start_s."""
         for ctl in self._tier_ctls(tier):
             if ctl.scaled_down:
                 try:
@@ -604,7 +614,10 @@ class TieredRouter(Router):
                 ctl.breaker_failures = 0
                 ctl.breaker_open_until = 0.0
                 ctl.next_restart_at = None
-                self._log_autoscale(tier, "up", now)
+                self._log_autoscale(
+                    tier, "up", now,
+                    cold_start_s=getattr(ctl.replica, "cold_start_s",
+                                         None))
                 return True
         replica = InProcessReplica(self._next_id,
                                    self._factories[tier],
@@ -614,7 +627,9 @@ class TieredRouter(Router):
         ctl.tier = tier
         with self._lock:
             self._ctls.append(ctl)
-        self._log_autoscale(tier, "up", now)
+        self._log_autoscale(tier, "up", now,
+                            cold_start_s=getattr(replica,
+                                                 "cold_start_s", None))
         return True
 
     def _scale_down(self, tier: str, now: float) -> bool:

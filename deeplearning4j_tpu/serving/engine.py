@@ -174,6 +174,30 @@ on `admitted`/`prefill_done`/`decode_chunk` trace events, a
 `chunked_prefill` section in `debugz()`. See docs/serving.md "Chunked
 prefill & the token-budget scheduler".
 
+Raw speed (round 17, ISSUE-12): compiled-program resolution runs
+through a three-level stack — the in-memory program cache (ONE
+process-wide `EngineConfig.program_cache_size` bound for every
+factory below, evictions published because an evicted geometry is a
+guaranteed steady-state recompile), the persistent AOT compile cache
+(`EngineConfig.compile_cache_dir` → serving/compile_cache.py:
+compiled-executable bytes on disk, keyed by the same geometry tuples
+plus a jax/jaxlib/backend salt, atomic publish + corrupt-entry
+fallback), and finally `jit(...).lower(...).compile()`. `warmup()` /
+`EngineConfig(warmup_on_init=True)` resolves the whole closed program
+set up front, so a restarted or autoscaled replica with a warm cache
+LOADS instead of recompiling — restart-to-first-token drops ~20x on
+the CPU container (BASELINE.md `cold_start`). Independently,
+`EngineConfig(pipeline=True)` double-buffers the continuous tick
+loop: each tick's compiled calls are DISPATCHED without blocking and
+the previous tick's outputs commit at one sync point, so host
+scheduling/accounting work overlaps device compute (the schedule
+runs one tick ahead on deterministic token COUNTS; token VALUES are
+only ever observed after their sync — committed-prefix semantics,
+deadline/cancel/isolation/reload, and KV export all keep their
+contracts). `pipeline=False` (default) keeps this loop bit-identical
+to the synchronous PR-11 one. See docs/serving.md "Engine internals
+& raw speed".
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -184,9 +208,9 @@ import itertools
 import logging
 import threading
 import time
-from collections import deque
+import weakref
+from collections import OrderedDict, deque, namedtuple
 from dataclasses import dataclass, astuple
-from functools import lru_cache
 from typing import Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -297,6 +321,11 @@ class RequestStatus:
 
 DEFAULT_CONTINUOUS_CHUNK = 8
 
+# the ONE in-memory compiled-program cache bound (ISSUE-12 satellite:
+# the factories below used to mix lru maxsizes of 8 and 64);
+# EngineConfig.program_cache_size / set_program_cache_size resize it
+DEFAULT_PROGRAM_CACHE_SIZE = 64
+
 
 @dataclass
 class EngineConfig:
@@ -396,6 +425,35 @@ class EngineConfig:
     # program cache keys.
     prefill_chunk: Optional[int] = None
     tick_token_budget: int = 0       # 0 = auto (see above)
+    # raw-speed subsystem (ISSUE-12). ``program_cache_size`` is the
+    # ONE bound on the process-wide in-memory compiled-program caches
+    # (the old per-factory lru maxsizes mixed 8 and 64); evictions
+    # publish to serving_program_cache_evictions_total because an
+    # evicted geometry is a guaranteed steady-state recompile.
+    # ``compile_cache_dir`` enables the persistent AOT compile cache
+    # (serving/compile_cache.py): every continuous-mode program this
+    # engine compiles is serialized (compiled-executable bytes, not
+    # StableHLO) into the directory, and the next engine over the same
+    # geometry — a restarted replica, an autoscaled one — LOADS it
+    # instead of recompiling (serving_compiles_total{source=
+    # "aot_cache"}). ``warmup_on_init`` runs `warmup()` inside
+    # __init__ so the constructor returns a ready engine: the whole
+    # closed program set resolved (from the AOT cache when warm),
+    # restart-to-ready measured by the cold_start bench.
+    # ``pipeline`` switches the continuous tick loop to the
+    # double-buffered schedule: compiled calls are DISPATCHED without
+    # blocking and their outputs committed at the NEXT tick's single
+    # sync point, so host-side scheduling/accounting overlaps device
+    # compute (decode/prefill token COUNTS are deterministic, so the
+    # schedule runs one tick ahead of the committed values — token
+    # values are never observed before their sync). pipeline=False
+    # (default) keeps the synchronous PR-11 loop bit-identically.
+    # Incompatible with spec_decode (acceptance makes commit counts
+    # nondeterministic) and with mode="batch".
+    program_cache_size: int = DEFAULT_PROGRAM_CACHE_SIZE
+    compile_cache_dir: Optional[str] = None
+    warmup_on_init: bool = False
+    pipeline: bool = False
 
 
 class RequestHandle:
@@ -418,6 +476,10 @@ class RequestHandle:
         self._generated: List[np.ndarray] = []
         self._done = threading.Event()
         self._in_flight = False          # continuous-mode accounting
+        # tokens dispatched-but-uncommitted in the double-buffered
+        # tick pipeline (ISSUE-12): the scheduler's one-tick-ahead
+        # view; always 0 on synchronous engines
+        self._pending_n = 0
         # flight recorder (ISSUE-6): the engine swaps in a live
         # RequestTrace at submit; NULL_TRACE keeps direct
         # constructions (and disabled recording) zero-cost
@@ -465,7 +527,137 @@ class _BatchDecodeFailed(RuntimeError):
     underlying error); triggers the solo-isolation path."""
 
 
-@lru_cache(maxsize=64)
+@dataclass
+class _PendingTick:
+    """One dispatched-but-uncommitted scheduling round of the
+    double-buffered tick loop (ISSUE-12): the ordered commit items
+    (("prefill", entries, first_dev) / ("prefill_chunk", plan,
+    first_dev, finished) / ("decode", entries, toks_dev, needs,
+    data)), the device slot-state snapshot taken BEFORE the tick's
+    first dispatch (the recovery point for sync-time failures), and
+    the active count for the tick-epilogue metrics."""
+    items: list
+    in_state: Optional[tuple]
+    n_active: int
+
+
+# ---------------------------------------------------------------------------
+# the in-memory compiled-program cache (ISSUE-12 satellite)
+# ---------------------------------------------------------------------------
+_PROGRAM_CACHE_SIZE = [DEFAULT_PROGRAM_CACHE_SIZE]
+_CacheInfo = namedtuple("CacheInfo", ["hits", "misses", "maxsize",
+                                      "currsize"])
+# counters (one per live engine registry) notified on every eviction:
+# a silently-evicted program is a silent steady-state recompile, so
+# evictions are a first-class series, not a cache implementation detail
+_EVICTION_COUNTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _notify_evictions(n: int) -> None:
+    for c in list(_EVICTION_COUNTERS):
+        try:
+            c.inc(n)
+        except Exception:        # observability must not kill serving
+            pass
+
+
+class _ProgramLRU:
+    """`functools.lru_cache` twin for the compiled-program factories,
+    with the three properties lru_cache cannot give us (ISSUE-12
+    satellite): ONE process-wide maxsize for every factory (the old
+    code mixed 8 and 64 — `EngineConfig.program_cache_size` /
+    `set_program_cache_size` now govern them all), evictions published
+    to `serving_program_cache_evictions_total`, and a per-entry side
+    table (`entry()`) carrying the AOT-resolved executable through the
+    SAME lifecycle as its jit factory result — an eviction drops both,
+    so the eviction counter really does mean "this geometry will
+    recompile". `cache_info()`/`cache_clear()` keep the lru_cache
+    surface tests and benches already consume
+    (tests/helpers.assert_no_recompiles)."""
+
+    _instances: List["_ProgramLRU"] = []
+
+    def __init__(self, fn):
+        self.__wrapped__ = fn
+        self.__name__ = getattr(fn, "__name__", repr(fn))
+        self.__doc__ = fn.__doc__
+        self._od: "OrderedDict" = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._lock = threading.RLock()
+        _ProgramLRU._instances.append(self)
+
+    @staticmethod
+    def _key(args, kw):
+        return (args, tuple(sorted(kw.items())))
+
+    def __call__(self, *args, **kw):
+        k = self._key(args, kw)
+        with self._lock:
+            ent = self._od.get(k)
+            if ent is not None:
+                self._od.move_to_end(k)
+                self._hits += 1
+                return ent[0]
+            self._misses += 1
+        # build OUTSIDE the lock: factory bodies trace jax programs
+        val = self.__wrapped__(*args, **kw)
+        with self._lock:
+            if k not in self._od:
+                self._od[k] = [val, {}]
+                self._evict_overflow_locked()
+            else:
+                self._od.move_to_end(k)
+            return self._od[k][0]
+
+    def entry(self, *args, **kw) -> dict:
+        """The per-program side table (AOT executables). Created with
+        the cache entry and dropped with it at eviction."""
+        self(*args, **kw)
+        k = self._key(args, kw)
+        with self._lock:
+            ent = self._od.get(k)
+            return ent[1] if ent is not None else {}
+
+    def _evict_overflow_locked(self) -> None:
+        n = 0
+        while len(self._od) > max(1, _PROGRAM_CACHE_SIZE[0]):
+            self._od.popitem(last=False)
+            n += 1
+        if n:
+            _notify_evictions(n)
+
+    def cache_info(self) -> _CacheInfo:
+        with self._lock:
+            return _CacheInfo(self._hits, self._misses,
+                              _PROGRAM_CACHE_SIZE[0], len(self._od))
+
+    def cache_clear(self) -> None:
+        with self._lock:
+            self._od.clear()
+            self._hits = 0
+            self._misses = 0
+
+
+def _program_cache(fn) -> _ProgramLRU:
+    return _ProgramLRU(fn)
+
+
+def set_program_cache_size(n: int) -> int:
+    """Resize the process-wide compiled-program caches (all factories
+    share one bound — `EngineConfig.program_cache_size` routes here).
+    Shrinking evicts LRU entries immediately (counted)."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"program_cache_size must be >= 1, got {n}")
+    _PROGRAM_CACHE_SIZE[0] = n
+    for c in _ProgramLRU._instances:
+        with c._lock:
+            c._evict_overflow_locked()
+    return n
+
+
+@_program_cache
 def _compiled_generate(cfg_fields: tuple, mesh, max_new_tokens: int,
                        temperature: float, top_k: int, top_p: float,
                        quantized=None):
@@ -478,7 +670,7 @@ def _compiled_generate(cfg_fields: tuple, mesh, max_new_tokens: int,
                                   top_p=top_p, quantized=quantized)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_prefill(cfg_fields: tuple, mesh, bucket_len: int,
                       num_slots: int, temperature: float, top_k: int,
                       top_p: float, quantized=None, kv_mode=None):
@@ -497,7 +689,7 @@ def _compiled_prefill(cfg_fields: tuple, mesh, bucket_len: int,
                                    kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
                            num_slots: int, temperature: float,
                            top_k: int, top_p: float, quantized=None,
@@ -513,7 +705,7 @@ def _compiled_decode_chunk(cfg_fields: tuple, mesh, chunk: int,
                                   kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_chunked_prefill(cfg_fields: tuple, mesh, chunk_len: int,
                               num_slots: int, temperature: float,
                               top_k: int, top_p: float, quantized=None,
@@ -532,7 +724,7 @@ def _compiled_chunked_prefill(cfg_fields: tuple, mesh, chunk_len: int,
                                 kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_paged_chunked_prefill(cfg_fields: tuple, mesh,
                                     chunk_len: int, num_slots: int,
                                     page_size: int, max_pages: int,
@@ -548,7 +740,7 @@ def _compiled_paged_chunked_prefill(cfg_fields: tuple, mesh,
         quantized=quantized, kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_paged_prefill(cfg_fields: tuple, mesh, bucket_len: int,
                             num_slots: int, page_size: int,
                             max_pages: int, num_pages: int,
@@ -569,7 +761,7 @@ def _compiled_paged_prefill(cfg_fields: tuple, mesh, bucket_len: int,
                               kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_paged_decode(cfg_fields: tuple, mesh, chunk: int,
                            num_slots: int, page_size: int,
                            max_pages: int, num_pages: int,
@@ -585,7 +777,7 @@ def _compiled_paged_decode(cfg_fields: tuple, mesh, chunk: int,
                              kv_mode=kv_mode)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
                           num_slots: int, temperature: float,
                           top_k: int, top_p: float, quantized=None,
@@ -606,7 +798,7 @@ def _compiled_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
                                    draft_layers=draft_layers)
 
 
-@lru_cache(maxsize=64)
+@_program_cache
 def _compiled_paged_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
                                 num_slots: int, page_size: int,
                                 max_pages: int, num_pages: int,
@@ -624,7 +816,7 @@ def _compiled_paged_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
         draft_quantized=draft_quantized, draft_layers=draft_layers)
 
 
-@lru_cache(maxsize=8)
+@_program_cache
 def _compiled_page_copy(n_pool_arrays: int):
     """Copy one physical page (all layers, values + scales) — the
     copy-on-write materializer. One tiny fixed-shape program per pool
@@ -637,7 +829,7 @@ def _compiled_page_copy(n_pool_arrays: int):
     return jax.jit(copy)
 
 
-@lru_cache(maxsize=8)
+@_program_cache
 def _compiled_page_poison(n_pool_arrays: int):
     """Scribble a deterministic out-of-distribution pattern over one
     physical page's K/V values (scales untouched) — backs the
@@ -658,7 +850,7 @@ def _compiled_page_poison(n_pool_arrays: int):
     return jax.jit(poison)
 
 
-@lru_cache(maxsize=8)
+@_program_cache
 def _compiled_page_gather(n_pool_arrays: int):
     """Gather a page chain out of the pool (all layers, values +
     scales) — the KV-export half of the cross-tier handoff (ISSUE-11).
@@ -672,7 +864,7 @@ def _compiled_page_gather(n_pool_arrays: int):
     return jax.jit(gather)
 
 
-@lru_cache(maxsize=8)
+@_program_cache
 def _compiled_slot_gather(n_pool_arrays: int):
     """Contiguous twin of _compiled_page_gather: one slot's full
     [L, S, ...] planes out of the slot pool (slot index is runtime
@@ -685,7 +877,7 @@ def _compiled_slot_gather(n_pool_arrays: int):
     return jax.jit(gather)
 
 
-@lru_cache(maxsize=8)
+@_program_cache
 def _compiled_kv_adopt(n_pool_arrays: int):
     """Scatter a handed-off row chain INTO freshly allocated pages and
     point the slot's pos/tok at the committed prefix — the device-put
@@ -764,6 +956,60 @@ class InferenceEngine:
                 + (self._prefill_chunk or 0)))
         self._last_tick_spent = 0
         self._seat_seq = itertools.count()
+        # double-buffered tick loop (ISSUE-12): dispatch tick N without
+        # blocking, commit tick N-1's synced outputs — host scheduling
+        # work overlaps device compute. _pending holds the (at most
+        # one) dispatched-but-uncommitted tick; _pipe_defer is True
+        # only while _dispatch_tick runs, so every OTHER compiled-call
+        # site (isolation solo re-runs, batch mode, spec rounds) keeps
+        # its synchronous semantics untouched.
+        self._pipe = bool(self.config.pipeline)
+        if self._pipe:
+            if not self._continuous:
+                raise ValueError(
+                    "pipeline requires mode='continuous' (the batch "
+                    "path has no persistent slot state to schedule "
+                    "ahead over)")
+            if self.config.spec_decode:
+                raise ValueError(
+                    "pipeline is incompatible with spec_decode: "
+                    "acceptance makes per-round commit counts "
+                    "nondeterministic, so the scheduler cannot run "
+                    "one tick ahead of the committed values")
+        self._pending: deque = deque()
+        self._pipe_defer = False
+        self._pipe_items: Optional[list] = None
+        # host-sync discipline + device-idle accounting: _block_on /
+        # _block_on_many are the ONLY device->host sync points on the
+        # tick path (the satellite test counts them); the busy-interval
+        # estimate under them feeds serving_device_idle_fraction
+        self._syncs_total = 0
+        self._tick_sync_count = 0
+        self._last_tick_syncs = 0
+        self._last_sync_s = 0.0
+        self._busy_since: Optional[float] = None
+        self._tick_busy_s = 0.0
+        self._busy_total_s = 0.0     # cumulative dispatched-work time
+        #                              (the cold_start bench's time-
+        #                              weighted idle denominator)
+        self._last_idle = 0.0
+        self._tick_perf0 = _perf()
+        # in-memory compiled-program cache bound (process-wide; the
+        # factories are module-level, so the LAST constructed engine's
+        # setting governs — document, don't pretend otherwise)
+        set_program_cache_size(self.config.program_cache_size)
+        # persistent AOT compile cache (serving/compile_cache.py):
+        # compiled executables round-trip to disk so a restarted
+        # replica loads instead of recompiling
+        from deeplearning4j_tpu.serving.compile_cache import CompileCache
+        self._aot: Optional[CompileCache] = None
+        if self.config.compile_cache_dir is not None:
+            if CompileCache.available():
+                self._aot = CompileCache(self.config.compile_cache_dir)
+            else:
+                log.warning(
+                    "compile_cache_dir set but this runtime cannot "
+                    "serialize executables; engine will recompile")
         # quantized inference: resolve the requested modes against the
         # backend (fp8 -> int8 off-TPU), quantize the weight tree ON
         # LOAD — float weights never reach the mesh — and remember a
@@ -894,6 +1140,12 @@ class InferenceEngine:
             slo = (NULL_SLO if not recorder.enabled
                    else SLOTracker(registry=self.registry))
         self.slo = slo
+        # cold-start warm-up (ISSUE-12): resolve the whole closed
+        # program set before the constructor returns — from the AOT
+        # cache when warm, so restart-to-ready is a load, not a compile
+        self._last_warmup: Optional[dict] = None
+        if self.config.warmup_on_init:
+            self.warmup()
 
     def _init_metrics(self, r) -> None:
         self._m_completed = r.counter(
@@ -971,6 +1223,35 @@ class InferenceEngine:
             "serving_prefill_seconds",
             "Wall time of one compiled admission-prefill call",
             buckets=DECODE_LATENCY_BUCKETS)
+        # raw-speed observability (ISSUE-12): every program build is
+        # counted by source — "jit" = traced+XLA-compiled here, a
+        # recompile when it shows up in steady state; "aot_cache" =
+        # loaded from the persistent compile cache — and timed, so a
+        # cold start's compile bill and a warm start's load bill are
+        # both first-class series instead of mystery latency
+        self._m_compiles = r.counter(
+            "serving_compiles",
+            "Compiled-program builds, by program and source (jit = "
+            "traced + XLA-compiled in-process, aot_cache = loaded "
+            "from the persistent AOT compile cache)",
+            labelnames=("program", "source"))
+        self._m_compile_seconds = r.histogram(
+            "serving_compile_seconds",
+            "Wall time to materialize one compiled program (XLA "
+            "compile for source=jit, deserialize for aot_cache)",
+            labelnames=("program",), buckets=DECODE_LATENCY_BUCKETS)
+        self._m_prog_evictions = r.counter(
+            "serving_program_cache_evictions",
+            "In-memory compiled-program cache entries evicted "
+            "(process-wide caches; an evicted geometry is a "
+            "guaranteed steady-state recompile)")
+        _EVICTION_COUNTERS.add(self._m_prog_evictions)
+        r.gauge("serving_device_idle_fraction",
+                "Estimated fraction of the last scheduling round the "
+                "device spent idle (1 - dispatched-work interval / "
+                "tick wall time): the double-buffered tick loop's "
+                "target metric").set_function(
+            lambda: float(self._last_idle))
         # paged KV + prefix sharing (ISSUE-7): registered only on
         # paged engines, so unpaged scrapes are byte-unchanged
         if self._paged:
@@ -1235,6 +1516,8 @@ class InferenceEngine:
         engine_continuous benchmark's arrival-replay loop) can
         interleave submissions with decode progress."""
         if self._continuous:
+            if self._pipe:
+                return self._tick_pipelined()
             return self._tick_continuous()
         batch = self._form_batch()
         if not batch:
@@ -1298,9 +1581,11 @@ class InferenceEngine:
         return self
 
     def drained(self) -> bool:
-        """True when no request is queued or resident."""
+        """True when no request is queued, resident, or pending commit
+        in the tick pipeline."""
         with self._lock:
             return (not self._queue
+                    and not self._pending
                     and all(s is None for s in self._slots))
 
     def draining(self) -> bool:
@@ -1514,6 +1799,8 @@ class InferenceEngine:
         budget's worth of prefill compute. Slots free the moment
         their request completes or is shed, so the next round refills
         them from the queue."""
+        self._tick_perf0 = _perf()
+        self._tick_sync_count = 0
         t_start = self._clock()
         params = self._params    # admissions + this chunk share a tree
         admitted = self._fill_slots()
@@ -1542,8 +1829,22 @@ class InferenceEngine:
         return True
 
     def _tick_epilogue(self, t_start: float, n_active: int) -> None:
-        """Shared per-tick bookkeeping: batch-size/latency metrics +
-        the train-listener protocol."""
+        """Shared per-tick bookkeeping: batch-size/latency metrics,
+        the device-idle estimate, + the train-listener protocol."""
+        nowp = _perf()
+        wall = nowp - self._tick_perf0
+        if self._busy_since is not None:
+            # a dispatch chain is still outstanding (pipelined tick):
+            # fold the elapsed busy interval into THIS tick and roll
+            # the marker forward into the next one
+            self._tick_busy_s += nowp - self._busy_since
+            self._busy_since = nowp
+        if wall > 0:
+            self._last_idle = min(1.0, max(
+                0.0, 1.0 - self._tick_busy_s / wall))
+        self._busy_total_s += self._tick_busy_s
+        self._tick_busy_s = 0.0
+        self._last_tick_syncs = self._tick_sync_count
         self._m_batch_size.observe(n_active)
         idx = int(self._m_batches.value)
         latency = self._clock() - t_start
@@ -1662,33 +1963,38 @@ class InferenceEngine:
             clen[i] = n
             start[i] = r._prefill_pos
             lastm[i] = (r._prefill_pos + n >= r._prefill_target)
+        state = self._slot_state
+        key = self._root_key()
         if self._paged:
             with self._lock:
                 self._ensure_writable(entries, prefill=True)
                 self._maybe_corrupt_page(entries, prefill=True)
                 bt = self._bt.copy()
-            fn = _compiled_paged_chunked_prefill(
-                astuple(self.cfg), self.mesh, c, self._num_slots,
-                self._page_size, self._max_pages, self._num_pages,
-                float(self.config.temperature),
-                int(self.config.top_k), float(self.config.top_p),
-                **self._quant_kwargs())
+                state = self._slot_state
+            fn = self._resolve_program(
+                "paged_chunked_prefill", _compiled_paged_chunked_prefill,
+                (astuple(self.cfg), self.mesh, c, self._num_slots,
+                 self._page_size, self._max_pages, self._num_pages,
+                 float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p)),
+                self._quant_kwargs(),
+                (params, *state, bt, toks, clen, start, lastm, key))
             extra = (bt,)
         else:
-            fn = _compiled_chunked_prefill(
-                astuple(self.cfg), self.mesh, c, self._num_slots,
-                float(self.config.temperature),
-                int(self.config.top_k), float(self.config.top_p),
-                **self._quant_kwargs())
+            fn = self._resolve_program(
+                "chunked_prefill", _compiled_chunked_prefill,
+                (astuple(self.cfg), self.mesh, c, self._num_slots,
+                 float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p)),
+                self._quant_kwargs(),
+                (params, *state, toks, clen, start, lastm, key))
             extra = ()
-        state = self._slot_state
-        key = self._root_key()
         n_state = len(state)
 
         def call():
             o = fn(params, *state, *extra, toks, clen, start, lastm,
                    key)
-            return tuple(o[:n_state]), np.asarray(o[n_state])
+            return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         state, first = self._guarded(call, [r for _, r in entries],
                                      self._m_prefill_seconds,
@@ -1703,17 +2009,248 @@ class InferenceEngine:
             self._m_prefill_chunks.inc()
             if r._prefill_pos >= r._prefill_target:
                 finished.append((i, r))
-                self._commit_tokens(
-                    r, np.asarray([first[i]], np.int32),
-                    "prefill_done", slot=i,
-                    prefill_chunk=self._prefill_chunk)
-                if r.generated.shape[0] >= r.max_new_tokens:
-                    self._complete(r)
+        if self._pipe_defer:
+            # double-buffered dispatch (ISSUE-12): chunk progress is
+            # host scheduling state and advances NOW; the finished
+            # slots' first tokens commit at the next tick's sync
+            for i, r in finished:
+                r._pending_n += 1
+            if self._paged and finished:
+                self._cache_prefilled(finished)
+            self._pipe_items.append(
+                ("prefill_chunk", list(plan), first, finished))
+            return
+        for i, r in finished:
+            self._commit_tokens(
+                r, np.asarray([first[i]], np.int32),
+                "prefill_done", slot=i,
+                prefill_chunk=self._prefill_chunk)
+            if r.generated.shape[0] >= r.max_new_tokens:
+                self._complete(r)
         if self._paged and finished:
             # the prompt's pages only hold complete KV once the FINAL
             # chunk lands — mid-prefill pages must never be shareable
             self._cache_prefilled(finished)
         self._reap()
+
+    # ------------------------------------------------------------------
+    # the double-buffered tick loop (ISSUE-12)
+    # ------------------------------------------------------------------
+    def _tick_pipelined(self) -> bool:
+        """One double-buffered scheduling round: seat admissions,
+        DISPATCH this tick's prefill/decode calls without blocking
+        (jax async dispatch — the device starts immediately), then
+        commit the PREVIOUS tick's outputs at the single sync point —
+        so the host's admission assembly, runtime-data building, trace
+        /SLO accounting, and listener work all overlap device compute
+        instead of serializing after it. The schedule runs exactly one
+        tick ahead of the committed values: plain-decode and
+        chunked-prefill token COUNTS are deterministic (min(chunk,
+        remaining) / the chunk plan), so active/rem masks, write
+        ranges, and completion predictions never need the token
+        VALUES — which are observed only after sync, preserving the
+        committed-prefix contract every failure path (deadline,
+        cancel, isolation, reload, fleet failover) is built on."""
+        self._tick_perf0 = _perf()
+        self._tick_sync_count = 0
+        t_start = self._clock()
+        params = self._params
+        admitted = self._fill_slots()
+        pending = self._dispatch_tick(admitted, params)
+        prev = self._pending.popleft() if self._pending else None
+        if pending is not None:
+            self._pending.append(pending)
+        if prev is not None:
+            self._commit_tick(prev)
+        self._reap(shed=True)
+        if pending is None and prev is None and not admitted:
+            # a tick that ADMITTED but dispatched nothing (the whole
+            # admission wave was isolated away) still did work — the
+            # queue behind it must get the next round
+            return False
+        self._m_batches.inc()
+        self._tick_epilogue(t_start,
+                            (pending.n_active if pending else 0) or 1)
+        return True
+
+    def _dispatch_tick(self, admitted, params) -> "Optional[_PendingTick]":
+        """Dispatch one tick's compiled calls without syncing their
+        outputs; returns the pending record to commit next tick (None
+        when there was nothing to dispatch)."""
+        self._pipe_in_state = self._slot_state
+        self._pipe_items = []
+        self._pipe_defer = True
+        try:
+            if self._prefill_chunk is not None:
+                n_active = self._dispatch_budgeted(admitted, params)
+            else:
+                n_active = self._dispatch_oneshot(admitted, params)
+        finally:
+            self._pipe_defer = False
+            items, self._pipe_items = self._pipe_items, None
+        if not items:
+            return None
+        return _PendingTick(items=items, in_state=self._pipe_in_state,
+                            n_active=n_active)
+
+    def _sched_decoding(self) -> List[tuple]:
+        """Slots eligible for this tick's decode dispatch under the
+        SCHEDULED view: seated, not terminal, past prefill, and with
+        budget left after the tokens already in flight."""
+        return [(i, r) for i, r in self._occupied()
+                if not r.done() and not self._is_prefilling(r)
+                and (r.generated.shape[0] + r._pending_n
+                     < r.max_new_tokens)]
+
+    def _dispatch_oneshot(self, admitted, params) -> int:
+        if admitted:
+            self._ensure_state()
+            try:
+                call = (self._call_prefill_paged if self._paged
+                        else self._call_prefill)
+                state, first = call(params, self._slot_state, admitted)
+            except _BatchDecodeFailed as e:
+                with self._lock:
+                    for i, r in admitted:
+                        if self._slots[i] is r:
+                            self._free_slot(i)
+                self._isolate_slots([r for _, r in admitted], e)
+                admitted = []
+            else:
+                self._slot_state = state
+                for i, r in admitted:
+                    r._pending_n += 1
+                if self._paged:
+                    # page indices are host bookkeeping; the rows land
+                    # before any reader because every later dispatch
+                    # chains on this call's output state
+                    self._cache_prefilled(admitted)
+                self._pipe_items.append(
+                    ("prefill", list(admitted), first))
+        decoding = self._sched_decoding()
+        if decoding:
+            self._ensure_state()
+            self._dispatch_decode(decoding, params, {})
+        return len(decoding) or len(admitted)
+
+    def _dispatch_budgeted(self, admitted, params) -> int:
+        decoding0 = [(i, r) for i, r in self._occupied()
+                     if not self._is_prefilling(r)]
+        pf_budget = self._tick_budget - len(decoding0) * self._chunk
+        pf_spent = self._advance_prefill(params, pf_budget)
+        decoding = self._sched_decoding()
+        if decoding:
+            self._dispatch_decode(decoding, params,
+                                  {"prefill_chunk": int(pf_spent)})
+        self._last_tick_spent = pf_spent + len(decoding) * self._chunk
+        return len(decoding) or len(admitted)
+
+    def _dispatch_decode(self, decoding, params, data: dict) -> None:
+        try:
+            call = (self._call_chunk_paged if self._paged
+                    else self._call_chunk)
+            state, toks = call(params, self._slot_state, decoding)
+        except _BatchDecodeFailed as e:
+            self._isolate_slots([r for _, r in decoding], e)
+            return
+        self._slot_state = state
+        needs = []
+        for i, r in decoding:
+            n = min(self._chunk, r.max_new_tokens
+                    - r.generated.shape[0] - r._pending_n)
+            needs.append(int(n))
+            r._pending_n += int(n)
+        self._pipe_items.append(
+            ("decode", list(decoding), toks, needs, data))
+
+    def _commit_tick(self, prev: "_PendingTick") -> None:
+        """Sync a pending tick's outputs (the ONE blocking sync) and
+        commit them in dispatch order: prefill first tokens, then
+        decode chunks — exactly what the synchronous tick would have
+        committed, one tick later."""
+        try:
+            synced = self._block_on_many([it[2] for it in prev.items])
+        except RuntimeError as e:
+            self._recover_failed_tick(prev, e)
+            return
+        for it, arr in zip(prev.items, synced):
+            kind = it[0]
+            if kind == "prefill":
+                for i, r in it[1]:
+                    with self._lock:
+                        live = self._slots[i] is r
+                    r._pending_n = max(0, r._pending_n - 1)
+                    if not live or r.done():
+                        continue
+                    self._commit_tokens(
+                        r, np.asarray([arr[i]], np.int32),
+                        "prefill_done", slot=i)
+                    if r.generated.shape[0] >= r.max_new_tokens:
+                        self._complete(r)
+            elif kind == "prefill_chunk":
+                for i, r in it[3]:
+                    with self._lock:
+                        live = self._slots[i] is r
+                    r._pending_n = max(0, r._pending_n - 1)
+                    if not live or r.done():
+                        continue
+                    self._commit_tokens(
+                        r, np.asarray([arr[i]], np.int32),
+                        "prefill_done", slot=i,
+                        prefill_chunk=self._prefill_chunk)
+                    if r.generated.shape[0] >= r.max_new_tokens:
+                        self._complete(r)
+            else:                    # ("decode", entries, _, needs, d)
+                entries, needs, data = it[1], it[3], it[4]
+                for (i, r), n in zip(entries, needs):
+                    with self._lock:
+                        live = self._slots[i] is r
+                    r._pending_n = max(0, r._pending_n - n)
+                    if not live or r.done() or n <= 0:
+                        continue
+                    self._commit_tokens(
+                        r, arr[i, :n].astype(np.int32),
+                        "decode_chunk", slot=i, **data)
+                    if r.generated.shape[0] >= r.max_new_tokens:
+                        self._complete(r)
+
+    def _recover_failed_tick(self, prev: "_PendingTick", err) -> None:
+        """A pipelined tick's outputs failed AT SYNC (an async device
+        fault surfacing after dispatch): restore the slot state
+        snapshotted before the tick's first dispatch — the last
+        committed-consistent device state — drop every later dispatch
+        (it consumed the failed outputs), flush the prefix cache
+        (pages inserted at dispatch may hold the failed call's rows),
+        and hand every implicated request to slot isolation, whose
+        scratch-pool solo re-runs resume from the COMMITTED prefix:
+        token-exact, the same guarantee as a synchronous step
+        failure."""
+        log.warning("pipelined tick failed at sync (%s); recovering "
+                    "from last committed state", err)
+        records = [prev] + list(self._pending)
+        self._pending.clear()
+        reqs, seen = [], set()
+        for rec in records:
+            for it in rec.items:
+                ent = ([(i, r) for i, r, _ in it[1]]
+                       if it[0] == "prefill_chunk" else it[1])
+                for i, r in ent:
+                    if id(r) not in seen:
+                        seen.add(id(r))
+                        reqs.append(r)
+        self._slot_state = prev.in_state
+        if self._prefix_cache is not None:
+            flushed = self._prefix_cache.flush()
+            if flushed:
+                self._m_prefix_evictions.inc(flushed)
+        self._isolate_slots(reqs, _BatchDecodeFailed(str(err)))
+
+    def _flush_pipeline(self) -> None:
+        """Commit any dispatched-but-uncommitted tick NOW — KV export
+        and other committed-view consumers call this before reading
+        slot state."""
+        while self._pending:
+            self._commit_tick(self._pending.popleft())
 
     def _fill_slots(self) -> List[tuple]:
         """Admission at a chunk boundary: seat queued requests into
@@ -1784,6 +2321,7 @@ class InferenceEngine:
                 # reset here, so a resume always re-prefills from its
                 # committed prefix, never from stale chunk progress
                 r._seat_seq = next(self._seat_seq)
+                r._pending_n = 0
                 r._prefill_pos = int(hit)
                 r._prefill_target = int(r.prompt.shape[0]
                                         + r.generated.shape[0])
@@ -2028,6 +2566,9 @@ class InferenceEngine:
         export must not leak the seat). Raises `HandoffError` when the
         handle is not resident or still mid-prefill."""
         try:
+            # a pipelined engine's committed view trails one tick:
+            # commit the pending dispatch before gathering
+            self._flush_pipeline()
             with self._lock:
                 slot = next((i for i, r in enumerate(self._slots)
                              if r is handle), None)
@@ -2135,7 +2676,8 @@ class InferenceEngine:
         next decode chunk otherwise (a generated token's K/V row is
         written when the token is FED, so decoding writes start at
         committed-length - 1)."""
-        plen = int(r.prompt.shape[0] + r.generated.shape[0])
+        plen = int(r.prompt.shape[0] + r.generated.shape[0]
+                   + r._pending_n)
         if prefill:
             if self._prefill_chunk is not None:
                 # chunked prefill writes at most one chunk from the
@@ -2237,6 +2779,257 @@ class InferenceEngine:
             self._key = jax.random.PRNGKey(self.config.seed)
         return self._key
 
+    # ------------------------------------------------------------------
+    # host-sync discipline + compiled-program resolution (ISSUE-12)
+    # ------------------------------------------------------------------
+    def _busy_mark(self) -> None:
+        """Mark the device busy from now until the sync that drains
+        every outstanding dispatch — the interval estimate behind
+        serving_device_idle_fraction."""
+        if self._busy_since is None:
+            self._busy_since = _perf()
+
+    def _sync_done(self, t0: float) -> None:
+        now = _perf()
+        self._last_sync_s = now - t0
+        self._syncs_total += 1
+        self._tick_sync_count += 1
+        if self._busy_since is not None and not self._pending:
+            self._tick_busy_s += now - self._busy_since
+            self._busy_since = None
+
+    def _block_on(self, x) -> np.ndarray:
+        """ONE of the two device->host sync points on the tick path
+        (with `_block_on_many`): every `np.asarray` a scheduling round
+        performs funnels through here, so the double-buffered loop's
+        "<= 1 blocking sync per tick" contract is countable, and the
+        sync wait feeds the device-idle estimate."""
+        t0 = _perf()
+        out = np.asarray(x)
+        self._sync_done(t0)
+        return out
+
+    def _block_on_many(self, xs: Sequence) -> List[np.ndarray]:
+        """Sync a whole pending tick's outputs as ONE blocking event
+        (the first conversion waits on the chain; the rest are ready)."""
+        t0 = _perf()
+        out = [np.asarray(x) for x in xs]
+        self._sync_done(t0)
+        return out
+
+    def _out_sync(self, x):
+        """Output-conversion seam of the compiled-call wrappers: the
+        synchronous engine blocks here per call (the PR-11 contract,
+        bit-identical); a pipelined dispatch defers the block to the
+        NEXT tick's commit."""
+        if self._pipe_defer:
+            return x
+        return self._block_on(x)
+
+    def _resolve_program(self, program: str, factory, fargs: tuple,
+                         fkw: dict, example_args: Optional[tuple]):
+        """Resolve one compiled serving program through the cache
+        stack: in-memory program cache (the geometry-keyed factories)
+        -> persistent AOT compile cache -> jit trace+lower+compile.
+        Continuous-mode programs have FIXED shapes per geometry, so
+        they resolve to a concrete compiled executable (jax AOT
+        `lower().compile()`) that is memoized on the factory entry,
+        timed into serving_compile_seconds{program}, counted into
+        serving_compiles_total{program,source}, and — when
+        ``compile_cache_dir`` is set — serialized to disk so the next
+        process loads instead of compiling. ``example_args=None``
+        (batch-mode generate: shapes vary per call) keeps the lazy jit
+        path. Any AOT-side failure falls back to the lazy jit callable
+        — availability over purity."""
+        fn = factory(*fargs, **fkw)
+        if example_args is None:
+            return fn
+        slot = factory.entry(*fargs, **fkw)
+        exe = slot.get("exec")
+        if exe is not None:
+            if self._aot is not None:
+                # resolved earlier in-process (possibly by an engine
+                # without a cache dir): publish it so the NEXT process
+                # still gets the load-not-compile cold start
+                pub = ("published", str(self._aot.directory))
+                if not slot.get(pub):
+                    key = self._aot.entry_key(
+                        program, self.mesh,
+                        (fargs[0], *fargs[2:],
+                         tuple(sorted(fkw.items()))))
+                    if not self._aot.path(key).exists():
+                        self._aot.store(key, exe)
+                    slot[pub] = True
+            return exe
+        key = None
+        t0 = _perf()
+        if self._aot is not None:
+            # the disk key strips the mesh OBJECT (position 1 of every
+            # factory signature) for its logical descriptor; the rest
+            # of the geometry tuple is the in-memory cache key itself
+            key = self._aot.entry_key(
+                program, self.mesh,
+                (fargs[0], *fargs[2:], tuple(sorted(fkw.items()))))
+            exe = self._aot.load(key)
+            if exe is not None:
+                self._m_compile_seconds.labels(program).observe(
+                    _perf() - t0)
+                self._m_compiles.labels(program, "aot_cache").inc()
+                slot["exec"] = exe
+                slot[("published", str(self._aot.directory))] = True
+                return exe
+        try:
+            exe = fn.lower(*example_args).compile()
+        except Exception as e:
+            log.warning("AOT resolve of %s failed (%s); falling back "
+                        "to lazy jit", program, e)
+            return fn
+        self._m_compile_seconds.labels(program).observe(_perf() - t0)
+        self._m_compiles.labels(program, "jit").inc()
+        if self._aot is not None and key is not None:
+            self._aot.store(key, exe)
+            slot[("published", str(self._aot.directory))] = True
+        slot["exec"] = exe
+        return exe
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
+        """Resolve the engine's whole CLOSED compiled-program set up
+        front — decode (or the adaptive-K speculative ladder), the
+        admission-prefill bucket ladder (or the chunked-prefill
+        program), paged twins as configured — so the first admission
+        serves from warm programs. With a warm `compile_cache_dir`
+        every resolution is an AOT LOAD: restart-to-ready collapses
+        from the compile set's cost to the deserialize set's
+        (the cold_start bench's claim). Returns a report dict
+        ({"seconds", "programs", "jit", "aot_cache"}), also kept on
+        `engine.last_warmup` for debugz/health surfaces."""
+        if not self._continuous:
+            raise ValueError(
+                "warmup requires mode='continuous' (batch-mode "
+                "programs are shaped by per-call batch geometry)")
+        t0 = _perf()
+
+        def _totals():
+            out = {"jit": 0.0, "aot_cache": 0.0}
+            for labels, child in self._m_compiles.collect():
+                if len(labels) == 2 and labels[1] in out:
+                    out[labels[1]] += child.value
+            return out
+
+        before = _totals()
+        self._ensure_state()
+        params, state = self._params, self._slot_state
+        key = self._root_key()
+        ns = self._num_slots
+        active = np.zeros((ns,), bool)
+        rem = np.zeros((ns,), np.int32)
+        qkw = self._quant_kwargs()
+        cfgf = astuple(self.cfg)
+        samp = (float(self.config.temperature),
+                int(self.config.top_k), float(self.config.top_p))
+        n_programs = 0
+        if self._paged:
+            bt = np.zeros((ns, self._max_pages), np.int32)
+            pgeo = (self._page_size, self._max_pages, self._num_pages)
+            self._resolve_program(
+                "paged_decode", _compiled_paged_decode,
+                (cfgf, self.mesh, self._chunk, ns, *pgeo, *samp), qkw,
+                (params, *state, bt, active, rem, key))
+            n_programs += 1
+        else:
+            self._resolve_program(
+                "decode", _compiled_decode_chunk,
+                (cfgf, self.mesh, self._chunk, ns, *samp), qkw,
+                (params, *state, active, rem, key))
+            n_programs += 1
+        if self._spec:
+            poison = np.zeros((ns,), bool)
+            k = self._spec_k
+            ks = []
+            while k >= 1:
+                ks.append(k)
+                if k == 1:
+                    break
+                k = max(1, k // 2)
+            for k in ks:
+                skw = dict(qkw, draft_quantized=self._draft_qmode,
+                           draft_layers=self._draft_layers)
+                self._resolve_program(
+                    "spec_decode", _compiled_spec_decode,
+                    (cfgf, self.mesh, k, ns, *samp), skw,
+                    (params, self._draft_params, *state, active, rem,
+                     poison, key))
+                n_programs += 1
+        if self._prefill_chunk is not None:
+            c = self._prefill_chunk
+            toks = np.zeros((ns, c), np.int32)
+            clen = np.zeros((ns,), np.int32)
+            start = np.zeros((ns,), np.int32)
+            lastm = np.zeros((ns,), bool)
+            if self._paged:
+                self._resolve_program(
+                    "paged_chunked_prefill",
+                    _compiled_paged_chunked_prefill,
+                    (cfgf, self.mesh, c, ns, *pgeo, *samp), qkw,
+                    (params, *state, bt, toks, clen, start, lastm,
+                     key))
+            else:
+                self._resolve_program(
+                    "chunked_prefill", _compiled_chunked_prefill,
+                    (cfgf, self.mesh, c, ns, *samp), qkw,
+                    (params, *state, toks, clen, start, lastm, key))
+            n_programs += 1
+        # the admission-prefill bucket ladder (one-shot engines; paged
+        # engines warm the paged twin). The contiguous SCRATCH-pool
+        # programs a paged/chunked engine's solo isolation would use
+        # are deliberately NOT warmed: isolation is a failure path,
+        # and warming them here would resolve contiguous programs
+        # against this engine's differently-shaped pool state.
+        if buckets is None:
+            buckets = []
+            b = max(1, self.config.prefill_bucket_min)
+            while True:
+                buckets.append(min(b, self.cfg.max_len))
+                if b >= self.cfg.max_len:
+                    break
+                b *= 2
+        for tb in dict.fromkeys(int(b) for b in buckets):
+            prompts = np.zeros((ns, tb), np.int32)
+            if self._paged:
+                if self._prefill_chunk is None:
+                    slen = np.zeros((ns,), np.int32)
+                    st = np.zeros((ns,), np.int32)
+                    self._resolve_program(
+                        "paged_prefill", _compiled_paged_prefill,
+                        (cfgf, self.mesh, tb, ns, *pgeo, *samp), qkw,
+                        (params, *state, bt, prompts, slen, st, key))
+                    n_programs += 1
+                continue
+            if self._prefill_chunk is None:
+                plen = np.zeros((ns,), np.int32)
+                self._resolve_program(
+                    "prefill", _compiled_prefill,
+                    (cfgf, self.mesh, tb, ns, *samp), qkw,
+                    (params, *state, prompts, plen, key))
+                n_programs += 1
+        after = _totals()
+        report = {"seconds": round(_perf() - t0, 4),
+                  "programs": n_programs,
+                  "jit": int(after["jit"] - before["jit"]),
+                  "aot_cache": int(after["aot_cache"]
+                                   - before["aot_cache"]),
+                  "aot": (self._aot.stats()
+                          if self._aot is not None else None)}
+        self._last_warmup = report
+        log.info("engine warmup: %d program(s) in %.3fs (%d compiled, "
+                 "%d AOT-loaded)", n_programs, report["seconds"],
+                 report["jit"], report["aot_cache"])
+        return report
+
+    @property
+    def last_warmup(self) -> Optional[dict]:
+        return self._last_warmup
+
     def _bucket_len(self, need: int) -> int:
         """Prefill bucket policy: the smallest power-of-two scaling of
         prefill_bucket_min that covers ``need``, capped at max_len.
@@ -2266,18 +3059,18 @@ class InferenceEngine:
             pre = prefixes[i]
             prompts[i, :pre.shape[0]] = pre
             plen[i] = pre.shape[0]
-        fn = _compiled_prefill(astuple(self.cfg), self.mesh, int(tb),
-                               self._num_slots,
-                               float(self.config.temperature),
-                               int(self.config.top_k),
-                               float(self.config.top_p),
-                               **self._quant_kwargs())
         key = self._root_key()
+        fn = self._resolve_program(
+            "prefill", _compiled_prefill,
+            (astuple(self.cfg), self.mesh, int(tb), self._num_slots,
+             float(self.config.temperature), int(self.config.top_k),
+             float(self.config.top_p)), self._quant_kwargs(),
+            (params, *state, prompts, plen, key))
         n_state = len(state)
 
         def call():
             o = fn(params, *state, prompts, plen, key)
-            return tuple(o[:n_state]), np.asarray(o[n_state])
+            return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         return self._guarded(call, [r for _, r in entries],
                              self._m_prefill_seconds, prefill=True)
@@ -2291,19 +3084,23 @@ class InferenceEngine:
         rem = np.zeros((self._num_slots,), np.int32)
         for i, r in entries:
             active[i] = True
-            rem[i] = r.max_new_tokens - r.generated.shape[0]
-        fn = _compiled_decode_chunk(astuple(self.cfg), self.mesh,
-                                    self._chunk, self._num_slots,
-                                    float(self.config.temperature),
-                                    int(self.config.top_k),
-                                    float(self.config.top_p),
-                                    **self._quant_kwargs())
+            # scheduled-remaining (= committed-remaining when the tick
+            # loop is synchronous: _pending_n is 0 outside a pipelined
+            # dispatch) — the schedule-ahead contract of ISSUE-12
+            rem[i] = (r.max_new_tokens - r.generated.shape[0]
+                      - r._pending_n)
         key = self._root_key()
+        fn = self._resolve_program(
+            "decode", _compiled_decode_chunk,
+            (astuple(self.cfg), self.mesh, self._chunk,
+             self._num_slots, float(self.config.temperature),
+             int(self.config.top_k), float(self.config.top_p)),
+            self._quant_kwargs(), (params, *state, active, rem, key))
         n_state = len(state)
 
         def call():
             o = fn(params, *state, active, rem, key)
-            return tuple(o[:n_state]), np.asarray(o[n_state])
+            return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         return self._guarded(call, [r for _, r in entries],
                              self._m_step_seconds)
@@ -2334,17 +3131,19 @@ class InferenceEngine:
             suffix[i, :tail.shape[0]] = tail
             slen[i] = tail.shape[0]
             start[i] = st
-        fn = _compiled_paged_prefill(
-            astuple(self.cfg), self.mesh, int(tb), self._num_slots,
-            self._page_size, self._max_pages, self._num_pages,
-            float(self.config.temperature), int(self.config.top_k),
-            float(self.config.top_p), **self._quant_kwargs())
         key = self._root_key()
+        fn = self._resolve_program(
+            "paged_prefill", _compiled_paged_prefill,
+            (astuple(self.cfg), self.mesh, int(tb), self._num_slots,
+             self._page_size, self._max_pages, self._num_pages,
+             float(self.config.temperature), int(self.config.top_k),
+             float(self.config.top_p)), self._quant_kwargs(),
+            (params, *state, bt, suffix, slen, start, key))
         n_state = len(state)
 
         def call():
             o = fn(params, *state, bt, suffix, slen, start, key)
-            return tuple(o[:n_state]), np.asarray(o[n_state])
+            return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         return self._guarded(call, [r for _, r in entries],
                              self._m_prefill_seconds, prefill=True)
@@ -2361,19 +3160,22 @@ class InferenceEngine:
         rem = np.zeros((self._num_slots,), np.int32)
         for i, r in entries:
             active[i] = True
-            rem[i] = r.max_new_tokens - r.generated.shape[0]
-        fn = _compiled_paged_decode(
-            astuple(self.cfg), self.mesh, self._chunk,
-            self._num_slots, self._page_size, self._max_pages,
-            self._num_pages, float(self.config.temperature),
-            int(self.config.top_k), float(self.config.top_p),
-            **self._quant_kwargs())
+            rem[i] = (r.max_new_tokens - r.generated.shape[0]
+                      - r._pending_n)
         key = self._root_key()
+        fn = self._resolve_program(
+            "paged_decode", _compiled_paged_decode,
+            (astuple(self.cfg), self.mesh, self._chunk,
+             self._num_slots, self._page_size, self._max_pages,
+             self._num_pages, float(self.config.temperature),
+             int(self.config.top_k), float(self.config.top_p)),
+            self._quant_kwargs(), (params, *state, bt, active, rem,
+                                   key))
         n_state = len(state)
 
         def call():
             o = fn(params, *state, bt, active, rem, key)
-            return tuple(o[:n_state]), np.asarray(o[n_state])
+            return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         return self._guarded(call, [r for _, r in entries],
                              self._m_step_seconds)
@@ -2539,22 +3341,23 @@ class InferenceEngine:
             active[i] = True
             rem[i] = r.max_new_tokens - r.generated.shape[0]
         poison = self._spec_poison(entries)
-        fn = _compiled_spec_decode(astuple(self.cfg), self.mesh,
-                                   self._spec_cur_k, self._num_slots,
-                                   float(self.config.temperature),
-                                   int(self.config.top_k),
-                                   float(self.config.top_p),
-                                   draft_quantized=self._draft_qmode,
-                                   draft_layers=self._draft_layers,
-                                   **self._quant_kwargs())
         key = self._root_key()
-        n_state = len(state)
         dparams = self._draft_params
+        fn = self._resolve_program(
+            "spec_decode", _compiled_spec_decode,
+            (astuple(self.cfg), self.mesh, self._spec_cur_k,
+             self._num_slots, float(self.config.temperature),
+             int(self.config.top_k), float(self.config.top_p)),
+            dict(self._quant_kwargs(),
+                 draft_quantized=self._draft_qmode,
+                 draft_layers=self._draft_layers),
+            (params, dparams, *state, active, rem, poison, key))
+        n_state = len(state)
 
         def call():
             o = fn(params, dparams, *state, active, rem, poison, key)
             return (tuple(o[:n_state]),
-                    *(np.asarray(x) for x in o[n_state:n_state + 4]))
+                    *self._block_on_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
@@ -2576,22 +3379,25 @@ class InferenceEngine:
             active[i] = True
             rem[i] = r.max_new_tokens - r.generated.shape[0]
         poison = self._spec_poison(entries)
-        fn = _compiled_paged_spec_decode(
-            astuple(self.cfg), self.mesh, self._spec_cur_k,
-            self._num_slots, self._page_size, self._max_pages,
-            self._num_pages, float(self.config.temperature),
-            int(self.config.top_k), float(self.config.top_p),
-            draft_quantized=self._draft_qmode,
-            draft_layers=self._draft_layers, **self._quant_kwargs())
         key = self._root_key()
-        n_state = len(state)
         dparams = self._draft_params
+        fn = self._resolve_program(
+            "paged_spec_decode", _compiled_paged_spec_decode,
+            (astuple(self.cfg), self.mesh, self._spec_cur_k,
+             self._num_slots, self._page_size, self._max_pages,
+             self._num_pages, float(self.config.temperature),
+             int(self.config.top_k), float(self.config.top_p)),
+            dict(self._quant_kwargs(),
+                 draft_quantized=self._draft_qmode,
+                 draft_layers=self._draft_layers),
+            (params, dparams, *state, bt, active, rem, poison, key))
+        n_state = len(state)
 
         def call():
             o = fn(params, dparams, *state, bt, active, rem, poison,
                    key)
             return (tuple(o[:n_state]),
-                    *(np.asarray(x) for x in o[n_state:n_state + 4]))
+                    *self._block_on_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
@@ -2671,12 +3477,24 @@ class InferenceEngine:
         take down co-resident slots, and the pool keeps serving."""
         log.warning("slot pool of %d exhausted retries (%s); "
                     "isolating", len(requests), batch_err)
+        # solo re-runs are always synchronous, even when isolation is
+        # entered from inside a pipelined dispatch
+        defer, self._pipe_defer = self._pipe_defer, False
+        try:
+            self._isolate_slots_inner(requests, batch_err)
+        finally:
+            self._pipe_defer = defer
+
+    def _isolate_slots_inner(self, requests: List[RequestHandle],
+                             batch_err: _BatchDecodeFailed) -> None:
         with self._lock:
             implicated = set(id(r) for r in requests)
             for i, r in enumerate(self._slots):
                 if r is not None and id(r) in implicated:
                     self._free_slot(i)
         for r in requests:
+            r._pending_n = 0       # dispatched-but-uncommitted tokens
+            #                        died with the failed tick
             if r.status != RequestStatus.RUNNING:
                 if r.done():
                     self._leave_flight(r)
@@ -2748,6 +3566,9 @@ class InferenceEngine:
                 continue
             self._free_slot(i)
             r.status = RequestStatus.QUEUED
+            r._pending_n = 0     # uncommitted pipeline tokens are
+            #                      discarded and re-decoded (the
+            #                      documented reload semantic)
             self._leave_flight(r)
             r.trace.add("preempted", reason="reload")
             self._queue.appendleft(r)
@@ -2782,6 +3603,7 @@ class InferenceEngine:
                         hook = self._injector.on_prefill
                     hook(self._step_counter, rids)
                 t_step = _perf()
+                self._busy_mark()
                 out = call()
                 hist.observe(_perf() - t_step)
                 self._record_success()
@@ -2824,13 +3646,16 @@ class InferenceEngine:
         key = jax.random.fold_in(
             jax.random.PRNGKey(self.config.seed), prompts.shape[1])
         qkw = ({"quantized": self._qmode} if self._qmode else {})
-        fn = _compiled_generate(astuple(self.cfg), self.mesh, int(n),
-                                float(self.config.temperature),
-                                int(self.config.top_k),
-                                float(self.config.top_p), **qkw)
+        # batch-mode generate keeps the lazy jit path (prompt length
+        # shapes vary per call — example_args=None)
+        fn = self._resolve_program(
+            "generate", _compiled_generate,
+            (astuple(self.cfg), self.mesh, int(n),
+             float(self.config.temperature), int(self.config.top_k),
+             float(self.config.top_p)), qkw, None)
 
         def call():
-            return np.asarray(fn(params, jnp.asarray(prompts), key))
+            return self._block_on(fn(params, jnp.asarray(prompts), key))
 
         out = self._guarded(call, reqs, self._m_step_seconds)
         return out[:b, prompts.shape[1]:]
@@ -2973,6 +3798,21 @@ class InferenceEngine:
                          "shared_tokens": int(
                              self._m_prefix_shared_tokens.value)}
                         if self._prefix_cache is not None else None)}
+        if self._continuous:
+            # tick-pipeline + compile-cache state (ISSUE-12): the
+            # raw-speed section of the "why is it slow" snapshot
+            out["tick_pipeline"] = {
+                "pipeline": self._pipe,
+                "in_flight_ticks": len(self._pending),
+                "last_sync_s": round(self._last_sync_s, 6),
+                "syncs_last_tick": self._last_tick_syncs,
+                "syncs_total": self._syncs_total,
+                "device_idle_fraction": round(self._last_idle, 4)}
+            out["compile_cache"] = {
+                "program_cache_size": _PROGRAM_CACHE_SIZE[0],
+                "aot": (self._aot.stats() if self._aot is not None
+                        else None),
+                "last_warmup": self._last_warmup}
         if self._prefill_chunk is not None:
             out["chunked_prefill"] = {
                 "prefill_chunk": self._prefill_chunk,
@@ -3047,6 +3887,7 @@ class InferenceEngine:
                     "paged": self._paged,
                     "spec_decode": self._spec,
                     "prefill_chunk": self._prefill_chunk,
+                    "pipeline": self._pipe,
                     **dict(self.stats)}
 
     def ready(self) -> bool:

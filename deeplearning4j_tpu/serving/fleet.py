@@ -259,7 +259,14 @@ class InProcessReplica:
                  http_probes: bool = False):
         self.id = int(replica_id)
         self._factory = factory
+        # cold-start-to-ready (ISSUE-12): how long the factory took to
+        # hand back a servable engine — with a warm AOT compile cache
+        # (EngineConfig.compile_cache_dir + warmup_on_init) this is a
+        # load, not a compile set; surfaced on the debugz replica row
+        # so autoscale/restart latency is observable per replica
+        t0 = time.perf_counter()
         self.engine = factory()
+        self.cold_start_s = time.perf_counter() - t0
         self._dead = False
         self._hung = False
         self._slow_s = 0.0
@@ -370,7 +377,9 @@ class InProcessReplica:
         self._slow_s = float(seconds)
 
     def restart(self) -> None:
+        t0 = time.perf_counter()
         self.engine = self._factory()
+        self.cold_start_s = time.perf_counter() - t0
         self._dead = False
         self._hung = False
         if self._http:
@@ -1697,6 +1706,11 @@ class Router:
                 "consec_crashes": c.consec_crashes,
                 "restarts": c.restarts,
                 "probe_url": getattr(c.replica, "probe_url", None),
+                # replica build latency (ISSUE-12): ~the compile set
+                # cold, ~the AOT-cache load set warm — the autoscale /
+                # supervised-restart elasticity number
+                "cold_start_s": round(getattr(
+                    c.replica, "cold_start_s", 0.0), 4),
                 "occupancy": c.last_health.get("slots_occupied"),
                 # health-probe load piggyback (ISSUE-11 satellite):
                 # the slot-occupancy / budget-utilization gauge values

@@ -71,8 +71,18 @@ def main() -> int:
     params = init_params(cfg, jax.random.PRNGKey(
         int(spec.get("params_seed", 0))))
     mesh = make_mesh(MeshSpec(data=1, model=1))
+    # restart-to-ready (ISSUE-12): the engine kwargs may carry
+    # compile_cache_dir (+ warmup_on_init) so this worker LOADS its
+    # compiled program set from the persistent AOT cache instead of
+    # recompiling it — the hello line reports how long becoming
+    # servable took and whether the programs were loads or compiles,
+    # so the router-side restart/autoscale latency is attributable
+    t0 = time.perf_counter()
     eng = InferenceEngine(cfg, mesh, params,
                           EngineConfig(**spec.get("engine", {})))
+    if spec.get("warmup") and eng.last_warmup is None:
+        eng.warmup()
+    cold_start_s = time.perf_counter() - t0
     srv = MetricsServer(eng.registry, port=0, health=eng.health,
                         ready=eng.ready, debug=eng.debugz)
 
@@ -84,7 +94,9 @@ def main() -> int:
             sys.stdout.flush()
 
     emit({"ev": "hello", "port": srv.port, "pid": os.getpid(),
-          "num_slots": eng._num_slots})
+          "num_slots": eng._num_slots,
+          "cold_start_s": round(cold_start_s, 4),
+          "warmup": eng.last_warmup})
 
     handles: dict = {}
     h_lock = threading.Lock()

@@ -44,6 +44,14 @@ def rng():
 
 
 def pytest_configure(config):
+    # hermeticity (ISSUE-12 satellite): a crashed or interrupted run —
+    # exactly what --continue-on-collection-errors sessions tolerate —
+    # can leave AOT compile-cache directories (and their staging
+    # files) under the system temp dir; a later run must never load a
+    # previous run's executables, so sweep them before collection.
+    from deeplearning4j_tpu.serving.compile_cache import \
+        sweep_stray_caches
+    sweep_stray_caches(prefix="dl4j-aot-test-")
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests")
     config.addinivalue_line(

@@ -97,6 +97,13 @@ def test_scrape_covers_every_engine_family(scrape):
     assert "serving_queue_age_seconds" in types
     assert "serving_slo_requests_total" in types
     assert "serving_goodput_ratio" in types
+    # raw-speed series (ISSUE-12): compiles by source, compile/load
+    # latency, program-cache evictions, device-idle estimate
+    assert types.get("serving_compiles_total") == "counter"
+    assert types.get("serving_compile_seconds") == "histogram"
+    assert types.get(
+        "serving_program_cache_evictions_total") == "counter"
+    assert types.get("serving_device_idle_fraction") == "gauge"
     assert set(types.values()) == {"counter", "gauge", "histogram"}
 
 

@@ -1,0 +1,357 @@
+"""Double-buffered tick loop (ISSUE-12).
+
+The overlap guarantees, each proven deterministically on CPU:
+
+- host-sync discipline, by name: on the injected compiled-call clock,
+  the pipelined engine performs AT MOST ONE blocking device->host sync
+  per tick (the previous tick's commit), where the synchronous engine
+  pays one per compiled call — and a pipeline=off engine stays
+  BIT-identical to the PR-11 loop with unchanged compiled-program
+  cache keys;
+- token exactness: pipelined == synchronous == single-request solo,
+  byte for byte — greedy AND sampled, contiguous AND paged, one-shot
+  AND chunked prefill, float AND int8 KV (the schedule runs one tick
+  ahead on deterministic token COUNTS; token VALUES are only observed
+  after their sync);
+- pipeline depth is bounded at ONE in-flight tick;
+- failure semantics survive the reordering: transient dispatch faults
+  retry, persistent poison quarantines without touching co-residents,
+  a SYNC-time failure (the async-dispatch-specific failure mode)
+  restores the last committed state and isolates token-exactly,
+  deadline/cancel shed at the commit boundary, and a hot reload
+  discards in-flight uncommitted tokens exactly as documented;
+- spec_decode and batch mode reject the knob (commit counts must be
+  deterministic to schedule ahead).
+"""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestStatus)
+from deeplearning4j_tpu.serving.engine import (
+    _compiled_decode_chunk, _compiled_prefill)
+from helpers import assert_no_recompiles
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=8, num_slots=4,
+                backoff_base_s=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _run(mesh, params, prompts, inj=None, **cfg_kw):
+    eng = InferenceEngine(CFG, mesh, params, _config(**cfg_kw),
+                          fault_injector=inj)
+    hs = [eng.submit(p) for p in prompts]
+    eng.run_pending()
+    return eng, hs
+
+
+PROMPTS = [lambda: [_prompt(5 + 3 * i, i) for i in range(6)]][0]
+
+
+class _CallClock(ServingFaultInjector):
+    """Injected compiled-call clock (the test_serving_chunked.py
+    pattern): every compiled call advances time by exactly 1, so
+    per-tick accounting is deterministic on any container."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.t = 0.0
+
+    def on_decode_step(self, step, request_ids=()):
+        self.t += 1.0
+        super().on_decode_step(step, request_ids)
+
+    def on_prefill(self, step, request_ids=()):
+        self.t += 1.0
+        super().on_prefill(step, request_ids)
+
+
+# ---------------------------------------------------------------------------
+# the named host-sync-discipline regression
+# ---------------------------------------------------------------------------
+
+def test_at_most_one_blocking_sync_per_tick(params, mesh1):
+    """REGRESSION (ISSUE-12, by name): on the injected compiled-call
+    clock, the double-buffered engine blocks on the device AT MOST
+    ONCE per tick — the previous tick's single commit sync — while the
+    synchronous engine pays one blocking sync per compiled call (2 on
+    an admit+decode tick). Every device->host conversion on the tick
+    path funnels through _block_on/_block_on_many, so the counter IS
+    the discipline."""
+    per_tick = {}
+    for pipeline in (False, True):
+        clk = _CallClock()
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(pipeline=pipeline),
+                              fault_injector=clk)
+        for p in PROMPTS():
+            eng.submit(p)
+        deltas = []
+        while True:
+            s0 = eng._syncs_total
+            if not eng.tick():
+                break
+            deltas.append(eng._syncs_total - s0)
+            assert (eng.debugz()["tick_pipeline"]["syncs_last_tick"]
+                    == deltas[-1])
+        per_tick[pipeline] = deltas
+        assert all(h is not None for h in [clk])
+    assert max(per_tick[True]) <= 1, \
+        f"pipelined engine synced {max(per_tick[True])}x in one tick"
+    # the synchronous engine's admit+decode ticks pay one sync per
+    # compiled call — the cost the pipeline exists to take off the
+    # device's critical path
+    assert max(per_tick[False]) >= 2
+    # depth bound: double-buffered means at most ONE in-flight tick
+    # (checked live in the loop via debugz below)
+
+
+def test_pipeline_depth_bounded_at_one(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config(pipeline=True))
+    for p in PROMPTS():
+        eng.submit(p)
+    while True:
+        assert len(eng._pending) <= 1
+        assert eng.debugz()["tick_pipeline"]["in_flight_ticks"] <= 1
+        if not eng.tick():
+            break
+    assert eng.drained()
+
+
+def test_pipeline_off_bit_identical_with_unchanged_cache_keys(
+        params, mesh1):
+    """pipeline=False keeps the PR-11 synchronous loop: a fresh
+    default-config engine serves the reference tokens with ZERO new
+    compiled-program cache entries beyond the already-warm geometry —
+    the unchanged-cache-keys guard."""
+    _, ref = _run(mesh1, params, PROMPTS())          # warms geometry
+    with assert_no_recompiles(_compiled_prefill,
+                              _compiled_decode_chunk):
+        eng, hs = _run(mesh1, params, PROMPTS())
+    for a, b in zip(ref, hs):
+        np.testing.assert_array_equal(a.result(0), b.result(0))
+    assert eng.health()["pipeline"] is False
+    assert eng.debugz()["tick_pipeline"]["pipeline"] is False
+
+
+# ---------------------------------------------------------------------------
+# token exactness across configurations
+# ---------------------------------------------------------------------------
+
+def test_pipelined_token_exact_across_configs(params, mesh1):
+    """Pipelined == synchronous, byte for byte, across the pool/
+    prefill/quantization matrix (the pipelined run reuses the warm
+    programs, so this is also a schedule-equivalence proof)."""
+    matrix = [
+        {},
+        {"paged": True, "page_size": 8},
+        {"prefill_chunk": 8, "tick_token_budget": 24},
+        {"paged": True, "page_size": 8, "prefill_chunk": 8,
+         "tick_token_budget": 24},
+        {"kv_quantize": "int8"},
+        {"temperature": 0.8, "top_k": 5, "seed": 7},
+    ]
+    for kw in matrix:
+        _, ref = _run(mesh1, params, PROMPTS(), **kw)
+        _, got = _run(mesh1, params, PROMPTS(), pipeline=True, **kw)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a.result(0), b.result(0),
+                                          err_msg=str(kw))
+
+
+def test_pipelined_schedule_matches_sync(params, mesh1):
+    """The pipeline reorders SYNCS, never the schedule: on the
+    injected compiled-call clock both engines issue the same number of
+    compiled calls, and every request's trace carries the identical
+    token-bearing event sequence (same kinds, same per-event token
+    counts) — commits trail dispatch by one tick, but no round is
+    added, dropped, or resized."""
+    shapes, calls = {}, {}
+    for pipeline in (False, True):
+        clk = _CallClock()
+        eng = InferenceEngine(CFG, mesh1, params,
+                              _config(pipeline=pipeline),
+                              fault_injector=clk)
+        hs = [eng.submit(_prompt(6, i)) for i in range(4)]
+        eng.run_pending()
+        shapes[pipeline] = [
+            [(e.kind, e.data.get("tokens")) for e in h.trace.events
+             if e.kind in ("prefill_done", "decode_chunk")]
+            for h in hs]
+        calls[pipeline] = clk.t
+    assert shapes[True] == shapes[False]
+    assert calls[True] == calls[False]
+
+
+# ---------------------------------------------------------------------------
+# failure semantics under the reordering
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retries_token_exact(params, mesh1):
+    _, ref = _run(mesh1, params, PROMPTS())
+    inj = ServingFaultInjector(fail_at=[1, 3])
+    eng, hs = _run(mesh1, params, PROMPTS(), inj=inj, pipeline=True)
+    for a, b in zip(ref, hs):
+        np.testing.assert_array_equal(a.result(0), b.result(0))
+    assert eng.stats["retries"] >= 2
+
+
+def test_poisoned_request_quarantined_co_residents_exact(params,
+                                                         mesh1):
+    _, ref = _run(mesh1, params, PROMPTS())
+    inj = ServingFaultInjector(poison_requests=[3])
+    eng, hs = _run(mesh1, params, PROMPTS(), inj=inj, pipeline=True)
+    assert hs[2].status == RequestStatus.QUARANTINED   # rid 3
+    survivors = [(a, b) for a, b in zip(ref, hs)
+                 if b.status == RequestStatus.COMPLETED]
+    assert len(survivors) == len(PROMPTS()) - 1
+    for a, b in survivors:
+        np.testing.assert_array_equal(a.result(0), b.result(0))
+    assert eng.stats["quarantined"] == 1
+
+
+def test_sync_time_failure_recovers_from_committed_state(params,
+                                                         mesh1):
+    """The async-dispatch-specific failure mode: the tick's outputs
+    fail AT SYNC, after the next tick already dispatched. The engine
+    restores the pre-dispatch state snapshot, drops the in-flight
+    dispatch, and isolates — every request still completes
+    token-exactly from its committed prefix."""
+    _, ref = _run(mesh1, params, PROMPTS())
+    eng = InferenceEngine(CFG, mesh1, params, _config(pipeline=True))
+    orig = eng._block_on_many
+    fired = []
+
+    def flaky(xs):
+        if not fired and eng._m_batches.value >= 3:
+            fired.append(True)
+            raise RuntimeError("injected sync-time device failure")
+        return orig(xs)
+
+    eng._block_on_many = flaky
+    hs = [eng.submit(p) for p in PROMPTS()]
+    eng.run_pending()
+    assert fired, "the injected sync failure never fired"
+    for a, b in zip(ref, hs):
+        np.testing.assert_array_equal(a.result(0), b.result(0))
+    assert eng.stats["preempted"] > 0
+    assert not eng._pending
+
+
+def test_deadline_and_cancel_shed_at_commit_boundary(params, mesh1):
+    t = {"now": 0.0}
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(pipeline=True, max_new_tokens=16),
+                          clock=lambda: t["now"])
+    h_dead = eng.submit(_prompt(6, 0), deadline_s=1.0)
+    h_live = eng.submit(_prompt(6, 1))
+    h_cancel = eng.submit(_prompt(6, 2))
+    eng.tick()
+    eng.tick()
+    assert h_dead.generated.shape[0] > 0
+    t["now"] = 5.0                      # h_dead is now past deadline
+    eng.cancel(h_cancel)
+    eng.run_pending()
+    assert h_dead.status == RequestStatus.SHED
+    assert h_cancel.status == RequestStatus.SHED
+    assert h_live.status == RequestStatus.COMPLETED
+    _, ref = _run(mesh1, params, [_prompt(6, 1)], max_new_tokens=16)
+    np.testing.assert_array_equal(h_live.result(0), ref[0].result(0))
+
+
+def test_reload_mid_pipeline_discards_uncommitted(params, mesh1,
+                                                  tmp_path):
+    """A hot reload with a tick in flight: in-flight slots preempt and
+    requeue with their COMMITTED tokens only (dispatched-but-unsynced
+    tokens are discarded and re-decoded under the new weights — here
+    the same weights, so the result is byte-identical to an
+    uninterrupted run)."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+    _, ref = _run(mesh1, params, [_prompt(8, 2)], max_new_tokens=12)
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(pipeline=True, max_new_tokens=12))
+    h = eng.submit(_prompt(8, 2))
+    eng.tick()
+    eng.tick()                          # one tick pending commit
+    assert len(eng._pending) == 1
+    assert eng.reload_weights(mgr, step=1) == 1
+    assert h.status == RequestStatus.QUEUED
+    assert h._pending_n == 0
+    eng.run_pending()
+    np.testing.assert_array_equal(h.result(0), ref[0].result(0))
+    assert eng.stats["preempted"] == 1
+
+
+def test_drained_accounts_for_pending_tick(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config(pipeline=True))
+    eng.submit(_prompt(6, 1))
+    eng.tick()
+    # the request's whole budget may already be dispatched, but its
+    # tokens are not committed: the engine must NOT report drained
+    assert not eng.drained()
+    eng.run_pending()
+    assert eng.drained()
+
+
+def test_worker_thread_drives_pipelined_engine(params, mesh1):
+    _, ref = _run(mesh1, params, PROMPTS())
+    eng = InferenceEngine(CFG, mesh1, params, _config(pipeline=True))
+    eng.start()
+    try:
+        hs = [eng.submit(p) for p in PROMPTS()]
+        outs = [h.result(timeout=60.0) for h in hs]
+    finally:
+        eng.stop()
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a.result(0), b)
+
+
+def test_pipeline_validation(params, mesh1):
+    with pytest.raises(ValueError, match="continuous"):
+        InferenceEngine(CFG, mesh1, params,
+                        _config(mode="batch", pipeline=True))
+    with pytest.raises(ValueError, match="spec_decode"):
+        InferenceEngine(CFG, mesh1, params,
+                        _config(pipeline=True, spec_decode=True))
+
+
+def test_idle_fraction_gauge_and_debugz_section(params, mesh1):
+    """serving_device_idle_fraction publishes, and the debugz
+    tick_pipeline section carries the depth/sync-latency fields the
+    satellite names."""
+    eng, _ = _run(mesh1, params, PROMPTS(), pipeline=True)
+    g = eng.registry.get("serving_device_idle_fraction")
+    assert 0.0 <= g.value <= 1.0
+    tp = eng.debugz()["tick_pipeline"]
+    assert tp["pipeline"] is True
+    assert set(tp) >= {"in_flight_ticks", "last_sync_s",
+                       "syncs_last_tick", "syncs_total",
+                       "device_idle_fraction"}
+    assert eng.health()["pipeline"] is True
