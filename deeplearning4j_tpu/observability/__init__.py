@@ -27,6 +27,15 @@ one substrate they all publish into:
   goodput derived from the traces, with a windowed `report()`.
 - `timeline` — Chrome/Perfetto `trace_event` JSON export of the
   recorder: one lane per serving slot plus a queue lane.
+- `stitch` — distributed-trace stitching (ISSUE-13): merge a fleet
+  router's trace with the per-hop replica traces (clock-offset
+  aligned) into one `StitchedTrace` of events + queue/prefill/
+  decode/handoff spans, plus the fleet-wide Perfetto export with one
+  process lane group per replica per tier.
+- `federation` — metrics federation (ISSUE-13): merge per-replica
+  registry snapshots into ONE fleet scrape (counters summed and
+  histograms bucket-merged under `tier=`, gauges kept per-replica
+  under `tier=`/`replica=`), with a series-cardinality guard.
 
 Publishers: `serving.InferenceEngine` (queue/batch/shed/quarantine/
 retry/breaker/decode-latency; `health()` is registry-backed),
@@ -43,7 +52,7 @@ from deeplearning4j_tpu.observability.tracing import (  # noqa: F401
     current_span, span, traced)
 from deeplearning4j_tpu.observability.export import (  # noqa: F401
     CONTENT_TYPE_LATEST, MetricsServer, json_snapshot, probe_response,
-    prometheus_text)
+    prometheus_text, snapshot_prometheus_text)
 from deeplearning4j_tpu.observability.events import (  # noqa: F401
     EVENT_KINDS, Event, FlightRecorder, NULL_RECORDER, NULL_TRACE,
     NullRecorder, RequestTrace, TERMINAL_KINDS)
@@ -51,3 +60,9 @@ from deeplearning4j_tpu.observability.slo import (  # noqa: F401
     NULL_SLO, SLOTracker, TPOT_BUCKETS)
 from deeplearning4j_tpu.observability.timeline import (  # noqa: F401
     timeline_json, trace_events)
+from deeplearning4j_tpu.observability.stitch import (  # noqa: F401
+    SPAN_NAMES, StitchedTrace, fleet_timeline_json, router_lane_events,
+    stitch)
+from deeplearning4j_tpu.observability.federation import (  # noqa: F401
+    DEFAULT_SERIES_BUDGET, check_cardinality, merge_snapshots,
+    series_cardinality)

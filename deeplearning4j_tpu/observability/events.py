@@ -103,12 +103,22 @@ class RequestTrace:
 
     `add()` stamps the event once and appends it to BOTH this trace
     and the owning recorder's ring, so the per-request view and the
-    engine-wide view can never disagree."""
+    engine-wide view can never disagree.
 
-    __slots__ = ("rid", "_recorder", "_events", "_lock")
+    ``ctx`` is the distributed-tracing hop context (ISSUE-13): a small
+    dict (``{"fleet_rid": ..., "hop": ..., "tier": ...}``) stamped by
+    a fleet router at dispatch and merged into EVERY event this trace
+    records, so a replica's local ring events stay attributable to the
+    fleet request that caused them — the raw material
+    `observability/stitch.py` reassembles into one distributed trace.
+    Explicit per-event data wins over ctx keys on collision."""
 
-    def __init__(self, rid: int, recorder: "FlightRecorder" = None):
+    __slots__ = ("rid", "ctx", "_recorder", "_events", "_lock")
+
+    def __init__(self, rid: int, recorder: "FlightRecorder" = None,
+                 ctx: Optional[dict] = None):
         self.rid = int(rid)
+        self.ctx = dict(ctx) if ctx else None
         self._recorder = recorder
         self._events: List[Event] = []
         self._lock = threading.Lock()
@@ -117,6 +127,8 @@ class RequestTrace:
         if kind not in EVENT_KINDS:
             raise ValueError(f"unknown event kind {kind!r}; "
                              f"valid: {sorted(EVENT_KINDS)}")
+        if self.ctx:
+            data = {**self.ctx, **data}
         rec = self._recorder
         ev = Event(rec.now() if rec is not None else _now(),
                    kind, self.rid, data)
@@ -171,6 +183,13 @@ class FlightRecorder:
     def __init__(self, capacity: int = 4096,
                  clock: Callable[[], float] = _now):
         self.capacity = int(capacity)
+        # long-soak fleet stitching needs DEEPER rings (ISSUE-13
+        # satellite: EngineConfig.recorder_capacity / the Router's
+        # recorder_capacity kwarg size this); a non-positive ring
+        # cannot hold a single lifecycle and is always a config bug
+        if self.capacity < 1:
+            raise ValueError(
+                f"recorder capacity must be >= 1, got {capacity}")
         self._clock = clock
         self._ring: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
@@ -178,8 +197,9 @@ class FlightRecorder:
     def now(self) -> float:
         return self._clock()
 
-    def start_trace(self, rid: int) -> RequestTrace:
-        return RequestTrace(rid, self)
+    def start_trace(self, rid: int,
+                    ctx: Optional[dict] = None) -> RequestTrace:
+        return RequestTrace(rid, self, ctx=ctx)
 
     def record(self, kind: str, rid: int = 0, **data) -> Event:
         """Ring-only event (no per-request trace) — engine-scope
@@ -225,6 +245,7 @@ class NullTrace:
 
     __slots__ = ()
     rid = 0
+    ctx = None
     events: Tuple[Event, ...] = ()
 
     def add(self, kind: str, **data) -> Event:
@@ -263,7 +284,8 @@ class NullRecorder:
     def now(self) -> float:
         return _now()
 
-    def start_trace(self, rid: int) -> NullTrace:
+    def start_trace(self, rid: int,
+                    ctx: Optional[dict] = None) -> NullTrace:
         return NULL_TRACE
 
     def record(self, kind: str, rid: int = 0, **data) -> Event:

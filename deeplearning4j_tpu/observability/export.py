@@ -95,6 +95,44 @@ def prometheus_text(registry=None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def snapshot_prometheus_text(snap: Dict[str, dict]) -> str:
+    """Render a JSON-schema snapshot (the `json_snapshot` shape — also
+    what `observability.federation.merge_snapshots` produces) in the
+    Prometheus text exposition format. This is how a fleet router's
+    FEDERATED view (ISSUE-13) serves `/metrics`: the merged samples
+    exist only as a snapshot, never as live instrument objects, so the
+    registry-walking `prometheus_text` cannot render them."""
+    lines = []
+    for name in sorted(snap):
+        fam = snap[name]
+        kind = fam.get("kind", "untyped")
+        out_name = (name + "_total"
+                    if kind == "counter" and not name.endswith("_total")
+                    else name)
+        lines.append(f"# HELP {out_name} "
+                     f"{_escape_help(fam.get('help', ''))}")
+        lines.append(f"# TYPE {out_name} {kind}")
+        for s in fam.get("samples", ()):
+            labels = s.get("labels") or {}
+            lnames, lvals = list(labels), list(labels.values())
+            if kind == "histogram" or "buckets" in s:
+                for edge, c in (s.get("buckets") or {}).items():
+                    le = f'le="{edge}"'
+                    lines.append(
+                        f"{out_name}_bucket"
+                        f"{_label_str(lnames, lvals, le)} {int(c)}")
+                base = _label_str(lnames, lvals)
+                lines.append(f"{out_name}_sum{base} "
+                             f"{_fmt(float(s.get('sum', 0.0)))}")
+                lines.append(f"{out_name}_count{base} "
+                             f"{int(s.get('count', 0))}")
+            else:
+                lines.append(
+                    f"{out_name}{_label_str(lnames, lvals)} "
+                    f"{_fmt(float(s.get('value', 0.0)))}")
+    return "\n".join(lines) + "\n"
+
+
 def json_snapshot(registry=None) -> Dict[str, dict]:
     """Machine-readable snapshot: {name: {kind, help, samples: [...]}}.
     Histogram samples carry cumulative buckets + sum + count."""
@@ -146,6 +184,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     debug_fn: Optional[Callable] = None
     slo_fn: Optional[Callable] = None
     timeline_fn: Optional[Callable] = None
+    snapshot_fn: Optional[Callable] = None   # federated view override
 
     def log_message(self, *args) -> None:   # silence request logging
         pass
@@ -191,9 +230,27 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         cls = type(self)
         path = urlparse(self.path).path
         if path == "/metrics":
+            # snapshot override (ISSUE-13): a router serving its
+            # FEDERATED fleet view builds the merged snapshot per
+            # scrape; a failing federation must 500, never kill the
+            # exporter thread
+            if cls.snapshot_fn is not None:
+                try:
+                    body = snapshot_prometheus_text(
+                        cls.snapshot_fn()).encode()
+                except Exception as e:
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+                    return
+                self._send(200, body, CONTENT_TYPE_LATEST)
+                return
             self._send(200, prometheus_text(cls.registry).encode(),
                        CONTENT_TYPE_LATEST)
         elif path == "/metrics.json":
+            if cls.snapshot_fn is not None:
+                self._send_callable_json(cls.snapshot_fn)
+                return
             self._send(200, json.dumps(
                 json_snapshot(cls.registry)).encode(),
                 "application/json")
@@ -235,6 +292,11 @@ class MetricsServer:
     ...                     slo=engine.slo_report,
     ...                     timeline=engine.timeline)
     >>> # curl .../debugz  .../slo  .../timeline.json
+
+    ``snapshot`` overrides what `/metrics` and `/metrics.json` serve:
+    a callable returning a JSON-schema snapshot (the `json_snapshot`
+    shape) rendered per scrape — wire `Router.federate` here and the
+    router's port serves the whole FLEET's merged series (ISSUE-13).
     """
 
     def __init__(self, registry=None, port: int = 0,
@@ -242,13 +304,15 @@ class MetricsServer:
                  ready: Optional[Callable] = None,
                  debug: Optional[Callable] = None,
                  slo: Optional[Callable] = None,
-                 timeline: Optional[Callable] = None):
+                 timeline: Optional[Callable] = None,
+                 snapshot: Optional[Callable] = None):
         self.registry = (registry if registry is not None
                          else default_registry())
         handler = type("BoundMetricsHandler", (_MetricsHandler,),
                        {"registry": self.registry, "health_fn": health,
                         "ready_fn": ready, "debug_fn": debug,
-                        "slo_fn": slo, "timeline_fn": timeline})
+                        "slo_fn": slo, "timeline_fn": timeline,
+                        "snapshot_fn": snapshot})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -70,33 +70,43 @@ class SLOTracker:
     `first_token(trace, ts)` when its first generated token commits,
     and `finished(trace)` at the terminal transition; everything else
     (timestamps, token counts, outcome) is derived from the trace so
-    the tracker stays decoupled from engine internals."""
+    the tracker stays decoupled from engine internals.
 
-    def __init__(self, registry=None, window: int = 512):
+    ``prefix`` names the metric families: the default ``"serving"``
+    keeps the round-11 engine series; a fleet router passes
+    ``"serving_fleet"`` so its STITCHED-trace rollup (ISSUE-13 — TTFT
+    and e2e that include router queue time and cross-tier handoff
+    time) publishes as ``serving_fleet_ttft_seconds`` etc. without
+    colliding with the per-replica engine series it federates."""
+
+    def __init__(self, registry=None, window: int = 512,
+                 prefix: str = "serving"):
         reg = registry if registry is not None else default_registry()
         self._ttft = reg.histogram(
-            "serving_ttft_seconds",
+            f"{prefix}_ttft_seconds",
             "Submit to first generated token (time-to-first-token)",
             buckets=DECODE_LATENCY_BUCKETS)
         self._tpot = reg.histogram(
-            "serving_tpot_seconds",
+            f"{prefix}_tpot_seconds",
             "Inter-token latency: decode span / (tokens - 1)",
             buckets=TPOT_BUCKETS)
         self._e2e = reg.histogram(
-            "serving_e2e_seconds",
+            f"{prefix}_e2e_seconds",
             "Submit to terminal event (end-to-end request latency)",
             buckets=DECODE_LATENCY_BUCKETS)
         self._qage = reg.histogram(
-            "serving_queue_age_seconds",
-            "Wait between enqueue (submit or preemption) and admission",
+            f"{prefix}_queue_age_seconds",
+            "Wait between enqueue (submit or preemption) and admission"
+            if prefix == "serving" else
+            "Router-queue wait between (re-)enqueue and dispatch",
             buckets=DECODE_LATENCY_BUCKETS)
         self._outcomes = reg.counter(
-            "serving_slo_requests",
+            f"{prefix}_slo_requests",
             "Terminal requests by SLO outcome", labelnames=("outcome",))
         self._outcome_cells = {o: self._outcomes.labels(o)
                                for o in _OUTCOMES}
         reg.gauge(
-            "serving_goodput_ratio",
+            f"{prefix}_goodput_ratio",
             "Fraction of windowed terminal requests finished within "
             "deadline (1.0 when the window is empty)"
         ).set_function(self.goodput)

@@ -48,9 +48,17 @@ def _lane_of(ev: Event, num_slots: int) -> int:
 
 
 def trace_events(events: Iterable[Event],
-                 num_slots: Optional[int] = None) -> List[dict]:
+                 num_slots: Optional[int] = None,
+                 pid: int = _PID,
+                 process_name: str = "serving engine",
+                 base: Optional[float] = None) -> List[dict]:
     """Render lifecycle events as a ``traceEvents`` list. ``events``
-    must be in chronological order (the recorder's ring is)."""
+    must be in chronological order (the recorder's ring is).
+
+    ``pid``/``process_name``/``base`` exist for the FLEET timeline
+    (ISSUE-13, observability/stitch.py): each replica renders as its
+    own process lane group, and every group re-bases to one shared
+    fleet-wide t=0 so the lanes align in Perfetto."""
     evs = [e for e in events]
     out: List[dict] = []
     if num_slots is None:
@@ -58,7 +66,8 @@ def trace_events(events: Iterable[Event],
             [int(e.data["slot"]) for e in evs
              if e.data.get("slot") is not None and not e.data.get(
                  "scratch")] or [-1])
-    base = evs[0].ts if evs else 0.0
+    if base is None:
+        base = evs[0].ts if evs else 0.0
     us = lambda t: round((t - base) * 1e6, 3)      # noqa: E731
 
     lanes: Dict[int, str] = {_QUEUE_TID: "queue"}
@@ -70,7 +79,7 @@ def trace_events(events: Iterable[Event],
 
     def close(rid: int, end_ts: float, status: str) -> None:
         start_ts, tid, phase = open_span.pop(rid)
-        out.append({"name": f"r{rid} {phase}", "ph": "X", "pid": _PID,
+        out.append({"name": f"r{rid} {phase}", "ph": "X", "pid": pid,
                     "tid": tid, "ts": us(start_ts),
                     "dur": max(0.0, round((end_ts - start_ts) * 1e6,
                                           3)),
@@ -101,7 +110,7 @@ def trace_events(events: Iterable[Event],
             tid = (open_span[rid][1] if rid in open_span
                    else _QUEUE_TID)
             out.append({"name": f"{ev.kind} r{rid}", "ph": "i",
-                        "pid": _PID, "tid": tid, "ts": us(ev.ts),
+                        "pid": pid, "tid": tid, "ts": us(ev.ts),
                         "s": "t", "args": {"rid": rid, **ev.data}})
 
     # still-running requests: close their span at the last known time
@@ -110,13 +119,16 @@ def trace_events(events: Iterable[Event],
             close(rid, evs[-1].ts, "running")
 
     meta: List[dict] = [{"name": "process_name", "ph": "M",
-                         "pid": _PID, "tid": 0,
-                         "args": {"name": "serving engine"}}]
+                         "pid": pid, "tid": 0,
+                         "args": {"name": process_name}},
+                        {"name": "process_sort_index", "ph": "M",
+                         "pid": pid, "tid": 0,
+                         "args": {"sort_index": pid}}]
     for tid in sorted(lanes):
-        meta.append({"name": "thread_name", "ph": "M", "pid": _PID,
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid, "args": {"name": lanes[tid]}})
         meta.append({"name": "thread_sort_index", "ph": "M",
-                     "pid": _PID, "tid": tid,
+                     "pid": pid, "tid": tid,
                      "args": {"sort_index": tid}})
     return meta + out
 

@@ -69,6 +69,20 @@ the layer between callers and the compiled decode step:
   (scale-to-zero for the prefill tier under decode-only load) —
   docs/serving.md "Disaggregated tiers & autoscaling".
 
+- Fleet observability (round 18, ISSUE-13): every router dispatch
+  stamps a hop context its replica merges into its own flight-
+  recorder events; resolved hops ship their replica-side traces back
+  (pipe-shipped + clock-offset aligned for subprocess workers) and
+  the router stitches ONE distributed trace per request —
+  `Router.distributed_trace(rid)` with queue/prefill-hop/handoff/
+  decode-hop spans, a fleet SLO rollup whose TTFT/e2e include router
+  queue + handoff time, a per-tier latency breakdown, and a
+  fleet-wide Perfetto timeline (one lane group per replica per
+  tier). `Router.federate()` merges every replica's registry
+  snapshot into one `/metrics` scrape (counters summed, histograms
+  bucket-merged, gauges per-replica) — docs/observability.md
+  "Distributed traces & federation".
+
 - Raw speed: persistent AOT compile cache + double-buffered tick loop
   (round 17, ISSUE-12): `EngineConfig(compile_cache_dir=,
   warmup_on_init=)` serializes every compiled serving program

@@ -114,6 +114,13 @@ class AutoscalePolicy:
     scale_up_occupancy: float = 0.75     # mean occupancy >= -> up
     scale_up_budget_utilization: float = 0.95   # OR budget util >= ->
     scale_down_occupancy: float = 0.25   # mean occupancy <= -> down
+    # latency-aware scale-up (ISSUE-13): the tier's stitched-trace
+    # span p99 (prefill span for the prefill tier, decode span for
+    # the decode tier — Router.tier_latency()) at/over this many
+    # milliseconds counts as a high observation, so a tier can scale
+    # on what users feel even when occupancy averages hide it.
+    # None (default) keeps the pure-occupancy policy.
+    scale_up_span_p99_ms: Optional[float] = None
     window: int = 4                      # consecutive observations
     cooldown_s: float = 0.5              # between actions
 
@@ -144,7 +151,8 @@ class Autoscaler:
 
     def observe(self, now: float, active: int, occupancy: float,
                 budget_utilization: Optional[float], pending: int,
-                in_flight: int) -> int:
+                in_flight: int,
+                span_p99_ms: Optional[float] = None) -> int:
         """One observation -> a decision. ``active`` counts replicas
         in rotation (not draining/stopped/dead); ``pending`` is queued
         work addressed to this tier; ``in_flight`` its dispatched
@@ -162,7 +170,10 @@ class Autoscaler:
         high = (occupancy >= p.scale_up_occupancy
                 or (budget_utilization is not None
                     and budget_utilization
-                    >= p.scale_up_budget_utilization))
+                    >= p.scale_up_budget_utilization)
+                or (span_p99_ms is not None
+                    and p.scale_up_span_p99_ms is not None
+                    and span_p99_ms >= p.scale_up_span_p99_ms))
         low = (occupancy <= p.scale_down_occupancy and pending == 0
                and (active > 1 or in_flight == 0))
         self._high = self._high + 1 if high else 0
@@ -208,7 +219,7 @@ class TieredRouter(Router):
     `EngineConfig` per tier; replica ids are prefill-first, then
     decode, then autoscale-created ones."""
 
-    def __init__(self, *, cfg, mesh, params,
+    def __init__(self, *, cfg=None, mesh=None, params=None,
                  prefill_replicas: int = 1,
                  decode_replicas: int = 2,
                  prefill_engine_config: Optional[EngineConfig] = None,
@@ -219,38 +230,71 @@ class TieredRouter(Router):
                  fault_injector=None,
                  clock: Callable[[], float] = time.monotonic,
                  registry=None, recorder=None,
+                 recorder_capacity: int = 4096,
                  http_probes: bool = False,
-                 engine_kwargs: Optional[dict] = None):
-        if prefill_replicas < 0 or decode_replicas < 1:
-            raise ValueError("need prefill_replicas >= 0 and "
-                             "decode_replicas >= 1")
-        dc = decode_engine_config or EngineConfig(paged=True)
-        pc = prefill_engine_config or replace(dc, paged=True)
-        _validate_tier_configs(pc, dc)
-        self._tier_cfgs = {PREFILL: pc, DECODE: dc}
-        ekw = dict(engine_kwargs or {})
-        ekw.setdefault("clock", clock)
-        self._factories: Dict[str, Callable[[], object]] = {
-            tier: (lambda c=c: InferenceEngine(cfg, mesh, params, c,
-                                               **ekw))
-            for tier, c in self._tier_cfgs.items()}
+                 engine_kwargs: Optional[dict] = None,
+                 replicas: Optional[List] = None,
+                 tiers: Optional[List[str]] = None):
         self._http_probes = bool(http_probes)
-        replicas = []
-        tiers = []
-        rid = 0
-        for tier, n in ((PREFILL, prefill_replicas),
-                        (DECODE, decode_replicas)):
-            for _ in range(n):
-                replicas.append(InProcessReplica(
-                    rid, self._factories[tier],
-                    http_probes=http_probes))
-                tiers.append(tier)
-                rid += 1
-        self._next_id = rid
+        if replicas is not None:
+            # pre-built replicas (e.g. SubprocessReplicas, ISSUE-13):
+            # the caller assigns each to a tier. No factories exist,
+            # so the autoscaler (which builds/revives replicas) is
+            # unsupported here, and config parity across tiers is the
+            # caller's contract. Handoff-incapable replicas degrade
+            # to re-prefill on the decode tier, exactly like a failed
+            # export — slower, never wrong.
+            if tiers is None or len(tiers) != len(replicas):
+                raise ValueError("pass tiers=[...] naming each "
+                                 "pre-built replica's tier")
+            bad = set(tiers) - {PREFILL, DECODE}
+            if bad:
+                raise ValueError(f"unknown tier(s) {sorted(bad)}; "
+                                 f"use {PREFILL!r}/{DECODE!r}")
+            if DECODE not in tiers:
+                raise ValueError("need at least one decode replica")
+            if prefill_autoscale or decode_autoscale:
+                raise ValueError(
+                    "autoscaling needs engine factories; it is not "
+                    "supported with pre-built replicas")
+            self._tier_cfgs = {}
+            self._factories = {}
+            tier_list = list(tiers)
+            self._next_id = 1 + max(int(r.id) for r in replicas)
+        else:
+            if cfg is None or mesh is None or params is None:
+                raise ValueError("pass cfg+mesh+params (or pre-built "
+                                 "replicas= + tiers=)")
+            if prefill_replicas < 0 or decode_replicas < 1:
+                raise ValueError("need prefill_replicas >= 0 and "
+                                 "decode_replicas >= 1")
+            dc = decode_engine_config or EngineConfig(paged=True)
+            pc = prefill_engine_config or replace(dc, paged=True)
+            _validate_tier_configs(pc, dc)
+            self._tier_cfgs = {PREFILL: pc, DECODE: dc}
+            ekw = dict(engine_kwargs or {})
+            ekw.setdefault("clock", clock)
+            self._factories: Dict[str, Callable[[], object]] = {
+                tier: (lambda c=c: InferenceEngine(cfg, mesh, params,
+                                                   c, **ekw))
+                for tier, c in self._tier_cfgs.items()}
+            replicas = []
+            tier_list = []
+            rid = 0
+            for tier, n in ((PREFILL, prefill_replicas),
+                            (DECODE, decode_replicas)):
+                for _ in range(n):
+                    replicas.append(InProcessReplica(
+                        rid, self._factories[tier],
+                        http_probes=http_probes))
+                    tier_list.append(tier)
+                    rid += 1
+            self._next_id = rid
         super().__init__(replicas, cfg=cfg, config=config,
                          fault_injector=fault_injector, clock=clock,
-                         registry=registry, recorder=recorder)
-        for ctl, tier in zip(self._ctls, tiers):
+                         registry=registry, recorder=recorder,
+                         recorder_capacity=recorder_capacity)
+        for ctl, tier in zip(self._ctls, tier_list):
             ctl.tier = tier
         self._scalers: Dict[str, Optional[Autoscaler]] = {
             PREFILL: (Autoscaler(prefill_autoscale)
@@ -411,7 +455,11 @@ class TieredRouter(Router):
                 return n
             n += 1
 
-    def _submit_hop(self, ctl, fr, prompt, remaining, deadline_s):
+    def _hop_phase(self, fr) -> str:
+        return self._phase_of(fr)
+
+    def _submit_hop(self, ctl, fr, prompt, remaining, deadline_s,
+                    ctx=None):
         if self._phase_of(fr) == PREFILL:
             # the prefill tier's job ends at the first token: hold the
             # finished slot (when the replica can export) so the
@@ -419,13 +467,14 @@ class TieredRouter(Router):
             hold = bool(getattr(ctl.replica, "supports_handoff",
                                 False))
             return ctl.replica.submit(prompt, 1, deadline_s,
-                                      fr.on_deadline, hold_kv=hold)
+                                      fr.on_deadline, hold_kv=hold,
+                                      trace_ctx=ctx)
         kv, fr._handoff = fr._handoff, None   # consumed: a redispatch
         #                                       after any failure
         #                                       re-prefills instead
         kw = {"kv": kv} if kv is not None else {}
         return ctl.replica.submit(prompt, remaining, deadline_s,
-                                  fr.on_deadline, **kw)
+                                  fr.on_deadline, trace_ctx=ctx, **kw)
 
     # ------------------------------------------------------------------
     # the handoff
@@ -446,6 +495,10 @@ class TieredRouter(Router):
         is the tail latency now). Export failure of any kind degrades
         to re-prefill on the decode tier — never a lost request."""
         now = self._clock()
+        # capture the prefill hop's trace before its slot releases —
+        # the stitched distributed trace's prefill-hop span (ISSUE-13)
+        self._record_hop(fr, hop, self._ctl(hop.replica_id),
+                         "completed")
         fr._committed = hop.committed()
         ctl = self._ctl(hop.replica_id)
         seq = self._handoff_seq
@@ -491,7 +544,10 @@ class TieredRouter(Router):
         fr.trace.add("handoff", outcome=outcome, **{
             "from": int(hop.replica_id),
             "tokens": (int(handoff.pos) if handoff is not None
-                       else int(fr._committed.shape[0]))})
+                       else int(fr._committed.shape[0])),
+            # the export's wall time rides in the event so the
+            # stitcher can derive the handoff SPAN (ISSUE-13)
+            "seconds": round(dt, 6)})
         self._last_handoff = {
             "t": round(now, 6), "rid": fr.rid,
             "from": int(hop.replica_id), "outcome": outcome,
@@ -554,16 +610,25 @@ class TieredRouter(Router):
     def _autoscale_tick(self) -> bool:
         now = self._clock()
         progressed = self._finish_scale_downs()
+        lat = (self.tier_latency()
+               if any(s is not None
+                      and s.policy.scale_up_span_p99_ms is not None
+                      for s in self._scalers.values()) else {})
         for tier, scaler in self._scalers.items():
             if scaler is None:
                 continue
             active = self._active_ctls(tier)
             in_flight = sum(c.n_outstanding()
                             for c in self._tier_ctls(tier))
+            # the tier's own work span (prefill tier -> prefill span,
+            # decode tier -> decode span) from stitched traces
+            span = lat.get(tier, {}).get(
+                PREFILL if tier == PREFILL else DECODE, {})
             d = scaler.observe(
                 now, len(active), self._tier_occupancy(tier),
                 self._tier_budget_utilization(tier),
-                self._tier_pending(tier), in_flight)
+                self._tier_pending(tier), in_flight,
+                span_p99_ms=span.get("p99_ms"))
             if d > 0:
                 progressed |= self._scale_up(tier, now)
             elif d < 0:

@@ -454,6 +454,13 @@ class EngineConfig:
     compile_cache_dir: Optional[str] = None
     warmup_on_init: bool = False
     pipeline: bool = False
+    # flight-recorder ring depth (ISSUE-13 satellite): the engine's
+    # FlightRecorder keeps the last N lifecycle events. The default
+    # matches the old hardcoded ring; fleet-level trace stitching on
+    # long soaks needs DEEPER rings (the router reads replica rings
+    # for its fleet timeline), so the bound is finally a config knob.
+    # Ignored when an explicit recorder= is injected.
+    recorder_capacity: int = 4096
 
 
 class RequestHandle:
@@ -1134,7 +1141,8 @@ class InferenceEngine:
         if recorder is None:
             recorder = (NULL_RECORDER
                         if isinstance(self.registry, NullRegistry)
-                        else FlightRecorder())
+                        else FlightRecorder(
+                            capacity=self.config.recorder_capacity))
         self.recorder = recorder
         if slo is None:
             slo = (NULL_SLO if not recorder.enabled
@@ -1379,10 +1387,18 @@ class InferenceEngine:
                deadline_s: Optional[float] = None,
                on_deadline: str = "shed",
                hold_kv: bool = False,
-               kv: Optional[KVHandoff] = None) -> RequestHandle:
+               kv: Optional[KVHandoff] = None,
+               trace_ctx: Optional[dict] = None) -> RequestHandle:
         """Admit one prompt. Raises OverloadError when the queue is full
         or the circuit breaker is open; in degraded mode the token
         budget is silently capped (reported via health()).
+
+        ``trace_ctx`` (ISSUE-13) is the distributed-tracing hop
+        context a fleet router stamps on each dispatch
+        (``{"fleet_rid": ..., "hop": ...}``): merged into every
+        lifecycle event this request records, so the engine's local
+        ring stays attributable to the fleet request — the raw
+        material `observability/stitch.py` reassembles.
 
         ISSUE-11 (cross-tier handoff): ``hold_kv`` keeps the request's
         slot SEATED after it completes — its KV pages stay referenced
@@ -1463,7 +1479,8 @@ class InferenceEngine:
                 on_deadline)
             handle._hold_kv = bool(hold_kv)
             handle._kv = kv
-            handle.trace = self.recorder.start_trace(handle.rid)
+            handle.trace = self.recorder.start_trace(handle.rid,
+                                                     ctx=trace_ctx)
             handle._on_terminal = self._on_terminal
             handle.trace.add(
                 "submit", prompt_tokens=int(prompt.shape[0]),
@@ -3888,7 +3905,26 @@ class InferenceEngine:
                     "spec_decode": self._spec,
                     "prefill_chunk": self._prefill_chunk,
                     "pipeline": self._pipe,
+                    # cold-start piggyback (ISSUE-13 satellite): the
+                    # warmup report + compiles-by-source ride every
+                    # health probe, so a router's debugz replica rows
+                    # show a cold autoscaled replica (compiles
+                    # climbing, no warmup) without scraping /metrics
+                    "last_warmup": self._last_warmup,
+                    "compiles_by_source": self._compiles_by_source(),
                     **dict(self.stats)}
+
+    def _compiles_by_source(self) -> dict:
+        """serving_compiles_total summed over programs, keyed by
+        source (jit vs aot_cache) — the probe-piggyback form."""
+        fam = self.registry.get("serving_compiles")
+        out: dict = {}
+        if fam is None:
+            return out
+        for values, child in fam.collect():
+            src = values[1] if len(values) > 1 else "jit"
+            out[src] = out.get(src, 0) + int(child.value)
+        return out
 
     def ready(self) -> bool:
         with self._lock:

@@ -99,8 +99,13 @@ import numpy as np
 from deeplearning4j_tpu.observability.events import (FlightRecorder,
                                                      NULL_RECORDER,
                                                      NULL_TRACE)
+from deeplearning4j_tpu.observability.export import json_snapshot
+from deeplearning4j_tpu.observability.federation import merge_snapshots
 from deeplearning4j_tpu.observability.metrics import (
     DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
+from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
+from deeplearning4j_tpu.observability.stitch import (fleet_timeline_json,
+                                                     stitch)
 from deeplearning4j_tpu.serving.engine import (DeadlineExceeded,
                                                EngineDraining,
                                                EngineStopped,
@@ -187,6 +192,14 @@ class FleetHandle:
         # KV handoff the decode dispatch should adopt
         self._phase: Optional[str] = None
         self._handoff = None
+        # distributed tracing (ISSUE-13): every resolved hop's replica
+        # trace is captured here (clock offset and all) so the router
+        # can stitch ONE timeline per request; _next_hop numbers the
+        # dispatches, _stitched caches the terminal stitch
+        self._hops_done: List[dict] = []
+        self._next_hop = 0
+        self._stitched = None
+        self._on_terminal: Optional[Callable] = None
         self._done = threading.Event()
 
     @property
@@ -210,6 +223,13 @@ class FleetHandle:
                 error: Optional[BaseException] = None) -> None:
         self.status = status
         self.error = error
+        hook = self._on_terminal
+        if hook is not None:
+            try:
+                hook(self)       # stitch + fleet SLO before done flips
+            except Exception:
+                log.exception("fleet trace finalize failed (rid %d)",
+                              self.rid)
         self._done.set()
 
 
@@ -217,16 +237,22 @@ class _Hop:
     """One dispatch of a fleet request onto one replica."""
 
     __slots__ = ("fr", "replica_id", "inner", "base", "hedge",
-                 "dispatched_at")
+                 "dispatched_at", "seq", "phase", "trace_ts",
+                 "recorded")
 
     def __init__(self, fr: FleetHandle, replica_id: int, inner,
-                 base: np.ndarray, hedge: bool, t: float):
+                 base: np.ndarray, hedge: bool, t: float,
+                 seq: int = 0, phase: str = "serving"):
         self.fr = fr
         self.replica_id = replica_id
         self.inner = inner           # engine RequestHandle / proxy
         self.base = base             # tokens committed before this hop
         self.hedge = hedge
         self.dispatched_at = t
+        self.seq = seq               # hop index within the request
+        self.phase = phase           # prefill | decode | serving
+        self.trace_ts = None         # recorder ts of the dispatched ev
+        self.recorded = False        # captured into fr._hops_done
 
     def committed(self) -> np.ndarray:
         """base + whatever this hop's replica has committed since."""
@@ -254,6 +280,9 @@ class InProcessReplica:
     #: (ISSUE-11); subprocess ones would need the rows serialized over
     #: the pipe — the tiered router falls back to re-prefill there
     supports_handoff = True
+    #: same process, same perf_counter: replica trace timestamps are
+    #: already in the router's clock domain (ISSUE-13)
+    clock_offset = 0.0
 
     def __init__(self, replica_id: int, factory: Callable[[], object],
                  http_probes: bool = False):
@@ -286,6 +315,10 @@ class InProcessReplica:
     @property
     def capacity(self) -> int:
         return self.engine._num_slots
+
+    @property
+    def last_warmup(self) -> Optional[dict]:
+        return self.engine.last_warmup
 
     @property
     def probe_url(self) -> Optional[str]:
@@ -430,6 +463,10 @@ class _ProxyHandle:
         self.deadline_exceeded = False
         self._cancelled = False
         self._tokens = np.zeros((0,), np.int32)
+        # the worker ships the request's completed RequestTrace back
+        # on its done/error line (ISSUE-13); a SIGKILLed worker leaves
+        # this empty and the stitched trace shows only the router side
+        self.trace_events: List[dict] = []
         self._done = threading.Event()
 
     @property
@@ -472,6 +509,9 @@ class SubprocessReplica:
     kind = "subprocess"
     supports_handoff = False     # KV stays behind the process boundary
 
+    #: probe-RTT pings per clock handshake; min-RTT midpoint wins
+    _CLOCK_PINGS = 5
+
     def __init__(self, replica_id: int, spec: dict,
                  startup_timeout_s: float = 180.0):
         self.id = int(replica_id)
@@ -480,6 +520,10 @@ class SubprocessReplica:
         self._lrids = itertools.count(1)
         self._lock = threading.Lock()
         self._proc: Optional[subprocess.Popen] = None
+        self.clock_offset = 0.0      # worker perf_counter - router's
+        self.clock_rtt: Optional[float] = None
+        self.cold_start_s = 0.0
+        self.last_warmup: Optional[dict] = None
         self._spawn()
 
     # -- process lifecycle ---------------------------------------------
@@ -503,6 +547,8 @@ class SubprocessReplica:
              "deeplearning4j_tpu.serving.fleet_worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, env=env, text=True)
+        self._clock_samples: List[tuple] = []
+        self._clock_done = threading.Event()
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True,
                                         name=f"fleet-replica-{self.id}")
@@ -513,6 +559,30 @@ class SubprocessReplica:
             raise TimeoutError(
                 f"subprocess replica {self.id} did not come up within "
                 f"{self._startup_timeout_s}s")
+        self._sync_clock()
+
+    def _sync_clock(self, timeout: float = 10.0) -> None:
+        """Per-process clock alignment (ISSUE-13): each ping carries
+        this side's perf_counter; the worker answers with ITS
+        perf_counter; the reply computes offset = worker_t - RTT
+        midpoint. The min-RTT sample wins (the NTP discipline) — the
+        residual error is bounded by RTT/2, which `stitch()` absorbs
+        by clamping hop edges. A worker that never answers (older
+        protocol) leaves the offset at 0 with a warning."""
+        self._clock_samples = []
+        self._clock_done.clear()
+        try:
+            for _ in range(self._CLOCK_PINGS):
+                self._send({"op": "clock",
+                            "t0": time.perf_counter()})
+        except ReplicaCrashed:
+            return
+        self._clock_done.wait(timeout)
+        if not self._clock_samples:
+            log.warning("replica %d: clock handshake got no reply; "
+                        "trace timestamps stay unaligned", self.id)
+            return
+        self.clock_rtt, self.clock_offset = min(self._clock_samples)
 
     def _send(self, obj: dict) -> None:
         try:
@@ -539,7 +609,22 @@ class SubprocessReplica:
         if kind == "hello":
             self._port = int(ev["port"])
             self.capacity = int(ev.get("num_slots", 1))
+            # cold-start surfacing (ISSUE-13 satellite): the hello
+            # line has carried these since ISSUE-12 — now they land on
+            # the replica object for the router's debugz rows
+            self.cold_start_s = float(ev.get("cold_start_s", 0.0)
+                                      or 0.0)
+            self.last_warmup = ev.get("warmup")
             self._hello.set()
+            return
+        if kind == "clock":
+            t1 = time.perf_counter()
+            t0 = float(ev.get("t0", t1))
+            rtt = max(0.0, t1 - t0)
+            off = float(ev.get("t", 0.0)) - (t0 + t1) / 2.0
+            self._clock_samples.append((rtt, off))
+            if len(self._clock_samples) >= self._CLOCK_PINGS:
+                self._clock_done.set()
             return
         if kind in ("reloaded", "drained", "resumed"):
             self._ack_payload[kind] = ev
@@ -555,10 +640,12 @@ class SubprocessReplica:
         if kind == "progress":
             h._update(ev.get("tokens", []))
         elif kind == "done":
+            h.trace_events = ev.get("trace") or []
             h.deadline_exceeded = bool(ev.get("partial", False))
             h._finish(RequestStatus.COMPLETED,
                       tokens=ev.get("tokens", []))
         elif kind in ("error", "rejected"):
+            h.trace_events = ev.get("trace") or []
             etype = ev.get("etype", "RuntimeError")
             msg = ev.get("msg", "")
             if etype == "DeadlineExceeded":
@@ -595,6 +682,10 @@ class SubprocessReplica:
 
     def submit(self, prompt, max_new_tokens, deadline_s, on_deadline,
                **kw):
+        # the hop's trace context DOES cross the pipe (ISSUE-13): the
+        # worker stamps it on every engine event so the shipped-back
+        # trace stays attributable; the KV-handoff knobs still don't
+        trace_ctx = kw.pop("trace_ctx", None)
         if kw:
             log.warning("subprocess replica %d ignores submit "
                         "kwargs %s (no cross-pipe KV handoff)",
@@ -610,7 +701,8 @@ class SubprocessReplica:
                     "prompt": np.asarray(prompt).tolist(),
                     "max_new_tokens": max_new_tokens,
                     "deadline_s": deadline_s,
-                    "on_deadline": on_deadline})
+                    "on_deadline": on_deadline,
+                    "trace_ctx": trace_ctx})
         return h
 
     def cancel(self, inner) -> None:
@@ -780,6 +872,8 @@ class Router:
                  fault_injector=None,
                  clock: Callable[[], float] = time.monotonic,
                  registry=None, recorder=None,
+                 recorder_capacity: int = 4096,
+                 slo=None,
                  http_probes: bool = False,
                  engine_kwargs: Optional[dict] = None):
         self.config = config or FleetConfig()
@@ -819,8 +913,27 @@ class Router:
         if recorder is None:
             recorder = (NULL_RECORDER
                         if isinstance(self.registry, NullRegistry)
-                        else FlightRecorder())
+                        else FlightRecorder(
+                            capacity=recorder_capacity))
         self.recorder = recorder
+        # fleet SLO rollup (ISSUE-13): derived from STITCHED traces at
+        # each request's terminal, so serving_fleet_ttft_seconds /
+        # _e2e_seconds include router queue time and handoff time —
+        # numbers no per-replica tracker can see
+        if slo is None:
+            slo = (NULL_SLO if not recorder.enabled
+                   else SLOTracker(registry=self.registry,
+                                   prefix="serving_fleet"))
+        self.slo = slo
+        # per-tier span-latency window (queue/prefill/decode/handoff
+        # durations from stitched traces): tier_latency()'s substrate,
+        # the breakdown the autoscaler can consume
+        self._span_window: deque = deque(maxlen=512)
+        # recently seen fleet handles, rid-keyed, for
+        # distributed_trace(): done handles are evicted oldest-first
+        # past the retention bound, live ones never are
+        self._recent_handles: Dict[int, FleetHandle] = {}
+        self._trace_retention = 256
 
     # ------------------------------------------------------------------
     # metrics
@@ -881,6 +994,18 @@ class Router:
                 "Fleet requests currently dispatched to a replica"
                 ).set_function(
             lambda: float(sum(c.n_outstanding() for c in self._ctls)))
+        # distributed tracing + federation (ISSUE-13)
+        self._m_span_seconds = r.histogram(
+            "serving_fleet_span_seconds",
+            "Stitched distributed-trace span durations by tier and "
+            "span (queue / prefill / decode / handoff)",
+            labelnames=("tier", "span"),
+            buckets=DECODE_LATENCY_BUCKETS)
+        self._m_federation_errors = r.counter(
+            "serving_fleet_federation_errors",
+            "Per-replica snapshot scrapes that failed during metrics "
+            "federation (the replica's series are absent from that "
+            "federated scrape)")
 
     @property
     def stats(self) -> dict:
@@ -940,6 +1065,9 @@ class Router:
                 now + deadline_s if deadline_s is not None else None,
                 on_deadline)
             fr.trace = self.recorder.start_trace(fr.rid)
+            if self.recorder.enabled:
+                fr._on_terminal = self._finalize_trace
+                self._remember_locked(fr)
             fr.trace.add("submit", prompt_tokens=int(prompt.shape[0]),
                          max_new_tokens=int(eff),
                          deadline_s=(float(deadline_s)
@@ -956,6 +1084,215 @@ class Router:
             if eng is not None:
                 return int(eng.config.max_new_tokens)
         return 32
+
+    # ------------------------------------------------------------------
+    # distributed tracing (ISSUE-13)
+    # ------------------------------------------------------------------
+    def _remember_locked(self, fr: FleetHandle) -> None:
+        """Retain ``fr`` for distributed_trace(); evict the oldest
+        DONE handles past the retention bound (live ones are never
+        evicted — their trace is still being built)."""
+        self._recent_handles[fr.rid] = fr
+        if len(self._recent_handles) <= self._trace_retention:
+            return
+        for rid in list(self._recent_handles):
+            if len(self._recent_handles) <= self._trace_retention:
+                break
+            if self._recent_handles[rid].done():
+                del self._recent_handles[rid]
+
+    def _hop_phase(self, fr: FleetHandle) -> str:
+        """Which phase the next dispatch serves — the flat router is
+        single-phase; the tiered router reads the request."""
+        return "serving"
+
+    def _hop_record(self, hop: _Hop, ctl: Optional[_ReplicaCtl],
+                    status: str) -> dict:
+        """One hop's capture: identity, clock offset, and the replica-
+        side trace (read by reference for in-process replicas, the
+        pipe-shipped copy for subprocess ones)."""
+        inner = hop.inner
+        # Event tuples pass through by reference (immutable) — the
+        # as_dict conversion happens lazily at export time, not on
+        # the serving path (the ≤2% fleet-overhead bound)
+        tr = getattr(inner, "trace", None)
+        if tr is not None and getattr(tr, "events", None):
+            evs = list(tr.events)
+        else:
+            evs = list(getattr(inner, "trace_events", None) or [])
+        replica = ctl.replica if ctl is not None else None
+        return {"hop": hop.seq, "replica": hop.replica_id,
+                "tier": ctl.tier if ctl is not None else "?",
+                "kind": getattr(replica, "kind", "?"),
+                "phase": hop.phase, "hedge": hop.hedge,
+                "status": status,
+                "clock_offset": float(getattr(replica, "clock_offset",
+                                              0.0) or 0.0),
+                "dispatched_ts": hop.trace_ts,
+                "events": evs}
+
+    def _record_hop(self, fr: FleetHandle, hop: _Hop,
+                    ctl: Optional[_ReplicaCtl], status: str) -> None:
+        if hop.recorded or not self.recorder.enabled:
+            return
+        hop.recorded = True
+        try:
+            fr._hops_done.append(self._hop_record(hop, ctl, status))
+        except Exception:
+            log.exception("hop capture failed (rid %d, replica %d)",
+                          fr.rid, hop.replica_id)
+
+    def _finalize_trace(self, fr: FleetHandle) -> None:
+        """Terminal hook: stitch the request's router trace with its
+        captured hops into ONE distributed trace, feed the fleet SLO
+        rollup (TTFT/e2e now include queue + handoff time), and bank
+        the per-tier span durations for tier_latency()."""
+        if not self.recorder.enabled or fr._stitched is not None:
+            return
+        st = stitch(fr.rid, fr.trace.events, fr._hops_done)
+        fr._stitched = st
+        tok = next((e for e in st.events
+                    if e.kind in ("prefill_done", "decode_chunk")
+                    and e.data.get("tokens")), None)
+        if tok is not None:
+            self.slo.first_token(st, tok.ts)
+        self.slo.finished(st)
+        for s in st.spans:
+            tier = s.get("tier") or "fleet"
+            dur = max(0.0, s["t1"] - s["t0"])
+            if s["name"] == "hop":
+                continue       # sub-spans carry the usable breakdown
+            self._m_span_seconds.labels(tier, s["name"]).observe(dur)
+            self._span_window.append((tier, s["name"], dur))
+
+    def distributed_trace(self, rid: int) -> Optional[dict]:
+        """THE stitched view of one fleet request: every router event
+        and every hop's replica events on one aligned timeline, plus
+        the derived queue/prefill/decode/handoff spans. Completed
+        requests return their cached terminal stitch; in-flight ones
+        stitch the live hop snapshots. None when the rid has aged out
+        (or tracing is disabled)."""
+        fr = self._recent_handles.get(int(rid))
+        if fr is None:
+            return None
+        st = fr._stitched
+        if st is None:
+            hops = list(fr._hops_done)
+            with self._lock:
+                live = [(ctl, hop) for ctl in self._ctls
+                        for hop in ctl.outstanding.get(fr.rid, ())]
+            for ctl, hop in live:
+                hops.append(self._hop_record(hop, ctl, "running"))
+            st = stitch(fr.rid, fr.trace.events, hops)
+        return st.to_dict()
+
+    def tier_latency(self) -> Dict[str, dict]:
+        """Windowed per-tier span-latency breakdown from stitched
+        traces: ``{tier: {span: {p50_ms, p95_ms, p99_ms, n}}}`` — the
+        signal an occupancy autoscaler can consume to scale on
+        latency, and the `slo_report()` "tiers" section."""
+        window = list(self._span_window)
+        grouped: Dict[tuple, List[float]] = {}
+        for tier, span, dur in window:
+            grouped.setdefault((tier, span), []).append(dur)
+        out: Dict[str, dict] = {}
+        for (tier, span), vals in sorted(grouped.items()):
+            vals.sort()
+            cell = {"n": len(vals)}
+            for q in (50, 95, 99):
+                i = min(len(vals) - 1,
+                        int(round(q / 100.0 * (len(vals) - 1))))
+                cell[f"p{q}_ms"] = round(vals[i] * 1e3, 3)
+            out.setdefault(tier, {})[span] = cell
+        return out
+
+    def slo_report(self) -> dict:
+        """The fleet `/slo` body: the stitched-trace SLO window
+        (TTFT/e2e include router queue + handoff time) plus the
+        per-tier span breakdown."""
+        rep = self.slo.report()
+        rep["tiers"] = self.tier_latency()
+        return rep
+
+    def timeline(self, n: Optional[int] = None) -> dict:
+        """Fleet-wide Perfetto export: the router's queue/dispatch
+        lanes as one process group plus one process group per replica
+        (``<tier>/replica <id>``) — in-process replicas render their
+        live recorder ring, subprocess replicas render the pipe-
+        shipped hop traces of recently completed requests — all
+        re-based to one shared t=0."""
+        groups = [{"pid": 0, "name": "fleet router", "router": True,
+                   "events": self.recorder.recent(n)}]
+        with self._lock:
+            ctls = list(self._ctls)
+            recents = [fr for fr in self._recent_handles.values()
+                       if fr._stitched is not None]
+        for ctl in ctls:
+            name = f"{ctl.tier}/replica {ctl.id}"
+            eng = getattr(ctl.replica, "engine", None)
+            if eng is not None and not ctl.dead:
+                groups.append({"pid": ctl.id + 1, "name": name,
+                               "events": eng.recorder.recent(n),
+                               "num_slots": eng._num_slots})
+                continue
+            evs = [e for fr in recents for e in fr._stitched.events
+                   if e.data.get("src") == "replica"
+                   and e.data.get("replica") == ctl.id]
+            evs.sort(key=lambda e: e.ts)
+            if evs:
+                groups.append({"pid": ctl.id + 1, "name": name,
+                               "events": evs[-(n or len(evs)):],
+                               "num_slots": ctl.capacity})
+        return fleet_timeline_json(groups)
+
+    # ------------------------------------------------------------------
+    # metrics federation (ISSUE-13)
+    # ------------------------------------------------------------------
+    def federate(self) -> dict:
+        """One scrape for the whole fleet: the router's own registry
+        plus every live replica's snapshot (in-process registries read
+        directly, subprocess ones scraped over `/metrics.json`),
+        merged under ``tier=``/``replica=`` labels — counters summed,
+        histogram buckets merged bucket-exact, gauges kept
+        per-replica (observability/federation.py has the contract).
+        A replica that fails to answer is skipped and counted in
+        ``serving_fleet_federation_errors_total``; federation
+        degrades, it never takes the fleet scrape down."""
+        parts = [({"tier": "router", "replica": "router"},
+                  json_snapshot(self.registry))]
+        with self._lock:
+            ctls = list(self._ctls)
+        for ctl in ctls:
+            if ctl.dead or ctl.scaled_down:
+                continue
+            try:
+                eng = getattr(ctl.replica, "engine", None)
+                if eng is not None:
+                    snap = json_snapshot(eng.registry)
+                else:
+                    url = getattr(ctl.replica, "probe_url", None)
+                    if url is None:
+                        continue
+                    with urllib.request.urlopen(
+                            url + "/metrics.json",
+                            timeout=self.config.probe_timeout_s
+                            ) as resp:
+                        snap = json.loads(resp.read().decode())
+                parts.append(({"tier": ctl.tier, "replica": ctl.id},
+                              snap))
+            except Exception as e:
+                self._m_federation_errors.inc()
+                log.warning("federation: replica %d snapshot failed "
+                            "(%s)", ctl.id, e)
+        return merge_snapshots(parts)
+
+    def federated_text(self) -> str:
+        """The federated scrape in Prometheus text format — what the
+        router's `/metrics` serves when wired via
+        ``MetricsServer(snapshot=router.federate)``."""
+        from deeplearning4j_tpu.observability.export import \
+            snapshot_prometheus_text
+        return snapshot_prometheus_text(self.federate())
 
     # ------------------------------------------------------------------
     # driving
@@ -1194,6 +1531,16 @@ class Router:
                     if fr.done():
                         continue
                     inner = hop.inner
+                    # capture the dying hop's trace NOW (ISSUE-13):
+                    # an in-process engine's ring is still readable
+                    # after the kill; a SIGKILLed worker left only
+                    # what it streamed — the stitched trace shows
+                    # the truncation honestly either way
+                    self._record_hop(
+                        fr, hop, ctl,
+                        "completed" if (inner.done() and inner.status
+                                        == RequestStatus.COMPLETED)
+                        else "lost")
                     if (inner.done()
                             and inner.status == RequestStatus.COMPLETED):
                         # the result survived the crash (it was already
@@ -1449,9 +1796,16 @@ class Router:
                     f"fleet request {fr.rid} past deadline at "
                     "dispatch"))
                 return False
+        # hop context (ISSUE-13): every dispatch gets a per-request
+        # hop id the replica stamps on its own recorder events
+        seq = fr._next_hop
+        fr._next_hop += 1
+        phase = self._hop_phase(fr)
+        ctx = ({"fleet_rid": fr.rid, "hop": seq, "tier": ctl.tier}
+               if self.recorder.enabled else None)
         try:
             inner = self._submit_hop(ctl, fr, prompt.astype(np.int32),
-                                     remaining, deadline_s)
+                                     remaining, deadline_s, ctx)
         except (OverloadError, EngineDraining, EngineStopped,
                 ReplicaCrashed) as e:
             # dispatch failure: passive signal + breaker; requeue at
@@ -1472,7 +1826,8 @@ class Router:
             self._shed(fr, "overload", e)
             return False
         self._passive_success(ctl)
-        hop = _Hop(fr, ctl.id, inner, committed, hedge, now)
+        hop = _Hop(fr, ctl.id, inner, committed, hedge, now,
+                   seq=seq, phase=phase)
         with self._lock:
             ctl.outstanding.setdefault(fr.rid, []).append(hop)
             ctl.last_progress_t = now    # a dispatch IS progress
@@ -1483,18 +1838,23 @@ class Router:
                 "from": int(fr._failover_from), "to": ctl.id,
                 "committed": int(committed.shape[0])})
             fr._failover_from = None
-        fr.trace.add("dispatched", replica=ctl.id, hedge=bool(hedge),
-                     committed=int(committed.shape[0]))
+        ev = fr.trace.add("dispatched", replica=ctl.id,
+                          hedge=bool(hedge),
+                          committed=int(committed.shape[0]),
+                          hop=seq, tier=ctl.tier, phase=phase)
+        hop.trace_ts = ev.ts if self.recorder.enabled else None
         return True
 
     def _submit_hop(self, ctl: _ReplicaCtl, fr: FleetHandle,
                     prompt: np.ndarray, remaining: int,
-                    deadline_s: Optional[float]):
+                    deadline_s: Optional[float],
+                    ctx: Optional[dict] = None):
         """One replica submit — the seam tier-aware subclasses
         override (prefill hops carry hold_kv, decode hops carry the
-        pending KVHandoff)."""
+        pending KVHandoff). ``ctx`` is the ISSUE-13 hop context the
+        replica stamps on its recorder events."""
         return ctl.replica.submit(prompt, remaining, deadline_s,
-                                  fr.on_deadline)
+                                  fr.on_deadline, trace_ctx=ctx)
 
     def _prepare_failover(self, fr: FleetHandle,
                           ctl: _ReplicaCtl) -> None:
@@ -1555,7 +1915,9 @@ class Router:
             with self._lock:
                 self._drop_hop(hop)
             if fr.done():
+                self._record_hop(fr, hop, ctl, str(inner.status))
                 continue         # a twin already resolved it
+            self._record_hop(fr, hop, ctl, str(inner.status))
             st = inner.status
             if st == RequestStatus.COMPLETED:
                 self._resolve_success(fr, hop)
@@ -1600,6 +1962,8 @@ class Router:
         if fr.done():
             return
         if hop is not None:
+            self._record_hop(fr, hop, self._ctl(hop.replica_id),
+                             "completed")
             fr._committed = hop.committed()
             fr.deadline_exceeded = bool(hop.inner.deadline_exceeded)
         winners = "hedge_won" if (hop is not None
@@ -1628,6 +1992,7 @@ class Router:
             for ctl, hop in losers:
                 self._drop_hop(hop)
         for ctl, hop in losers:
+            self._record_hop(fr, hop, ctl, "cancelled")
             try:
                 ctl.replica.cancel(hop.inner)
             except Exception:
@@ -1711,6 +2076,15 @@ class Router:
                 # supervised-restart elasticity number
                 "cold_start_s": round(getattr(
                     c.replica, "cold_start_s", 0.0), 4),
+                # compile-cache/warmup surfacing (ISSUE-13 satellite):
+                # a cold autoscaled replica is visible at the fleet
+                # level — no warmup report, jit compiles climbing
+                "last_warmup": getattr(c.replica, "last_warmup",
+                                       None),
+                "compiles_by_source": c.last_health.get(
+                    "compiles_by_source"),
+                "clock_offset_s": round(float(getattr(
+                    c.replica, "clock_offset", 0.0) or 0.0), 6),
                 "occupancy": c.last_health.get("slots_occupied"),
                 # health-probe load piggyback (ISSUE-11 satellite):
                 # the slot-occupancy / budget-utilization gauge values
@@ -1726,6 +2100,12 @@ class Router:
                       "failovers": fr._failovers}
                      for fr in self._queue]
             tiers = self._tier_table_locked()
+            # stitched-trace section (ISSUE-13): the last few
+            # completed requests' distributed traces in summary form
+            # (full bodies via Router.distributed_trace(rid))
+            stitched = [fr._stitched
+                        for fr in self._recent_handles.values()
+                        if fr._stitched is not None][-8:]
         return {"replicas": replicas,
                 "tiers": tiers,
                 "queue_depth": len(queue),
@@ -1733,6 +2113,18 @@ class Router:
                 "draining": self._draining,
                 "ticks": self._ticks,
                 "stats": self.stats,
+                "distributed_traces": [
+                    {"rid": st.rid,
+                     "hops": [{k: h.get(k) for k in
+                               ("hop", "replica", "tier", "phase",
+                                "status")}
+                              for h in st.hops],
+                     "spans": [{"name": s["name"],
+                                "tier": s.get("tier"),
+                                "ms": round(1e3 * max(
+                                    0.0, s["t1"] - s["t0"]), 3)}
+                               for s in st.spans]}
+                    for st in stitched],
                 "recent_events": [e.as_dict() for e in
                                   self.recorder.recent(recent)]}
 

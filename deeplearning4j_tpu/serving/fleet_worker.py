@@ -15,14 +15,19 @@ tests/test_multihost.py's pattern from training to serving. Protocol:
   ..., "num_slots": ...}`` — the port serves the engine's REAL
   `/healthz`/`/readyz`/`/metrics`/`/debugz` endpoints
   (observability.MetricsServer); the router probes them over HTTP.
-- stdin thereafter: one JSON command per line — ``submit`` / ``cancel``
-  / ``drain`` / ``resume`` / ``reload`` / ``stop``.
+- stdin thereafter: one JSON command per line — ``submit`` (carrying
+  the router's distributed-tracing hop context, ISSUE-13) /
+  ``cancel`` / ``clock`` (clock-offset handshake: echoed back with
+  this process's perf_counter) / ``drain`` / ``resume`` / ``reload``
+  / ``stop``.
 - stdout thereafter: streamed request events — ``accepted`` /
   ``rejected`` / ``progress`` (the committed tokens so far — the
   router's failover substrate when this process is SIGKILLed — plus
   the slot's committed-KV page count, ISSUE-11 satellite) /
-  ``done`` / ``error`` — plus ``drained``/``resumed``/``reloaded``
-  acks.
+  ``done`` / ``error`` (both carrying the request's completed
+  ``RequestTrace`` so the router can stitch the fleet-wide
+  distributed trace, ISSUE-13) — plus
+  ``drained``/``resumed``/``reloaded`` acks.
 
 The engine runs its own background worker thread; a progress thread
 polls in-flight handles at ``progress_interval_s``. A SIGKILL at any
@@ -88,9 +93,16 @@ def main() -> int:
 
     out_lock = threading.Lock()
 
+    def _json_default(o):
+        """Trace payloads may carry numpy scalars; the pipe is JSON."""
+        if hasattr(o, "item"):
+            return o.item()
+        return str(o)
+
     def emit(obj: dict) -> None:
         with out_lock:
-            sys.stdout.write(json.dumps(obj) + "\n")
+            sys.stdout.write(json.dumps(obj, default=_json_default)
+                             + "\n")
             sys.stdout.flush()
 
     emit({"ev": "hello", "port": srv.port, "pid": os.getpid(),
@@ -114,13 +126,20 @@ def main() -> int:
                     with h_lock:
                         handles.pop(rid, None)
                     toks = h.generated.tolist()
+                    # the request's completed RequestTrace ships back
+                    # on the terminal line (ISSUE-13): the router
+                    # stitches it — clock-offset aligned — into the
+                    # fleet-wide distributed trace
+                    trace = h.trace.as_dicts()
                     if h.error is None:
                         emit({"ev": "done", "rid": rid, "tokens": toks,
-                              "partial": bool(h.deadline_exceeded)})
+                              "partial": bool(h.deadline_exceeded),
+                              "trace": trace})
                     else:
                         emit({"ev": "error", "rid": rid,
                               "etype": type(h.error).__name__,
-                              "msg": str(h.error), "tokens": toks})
+                              "msg": str(h.error), "tokens": toks,
+                              "trace": trace})
                 else:
                     # committed-KV page count rides every progress
                     # line (ISSUE-11 satellite): the router-side view
@@ -147,7 +166,8 @@ def main() -> int:
                     np.asarray(cmd["prompt"], np.int32),
                     max_new_tokens=cmd.get("max_new_tokens"),
                     deadline_s=cmd.get("deadline_s"),
-                    on_deadline=cmd.get("on_deadline", "shed"))
+                    on_deadline=cmd.get("on_deadline", "shed"),
+                    trace_ctx=cmd.get("trace_ctx"))
             except Exception as e:
                 emit({"ev": "rejected", "rid": rid,
                       "etype": type(e).__name__, "msg": str(e)})
@@ -160,6 +180,12 @@ def main() -> int:
                 h = handles.get(cmd.get("rid"))
             if h is not None:
                 eng.cancel(h)
+        elif op == "clock":
+            # clock-offset handshake (ISSUE-13): echo the router's t0
+            # with OUR perf_counter; the router takes the min-RTT
+            # midpoint as this process's offset
+            emit({"ev": "clock", "t0": cmd.get("t0"),
+                  "t": time.perf_counter()})
         elif op == "drain":
             eng.drain(wait=True)
             emit({"ev": "drained"})

@@ -12,7 +12,12 @@ shows:
   restart and recovery time;
 - a rolling weight reload across the fleet with zero dropped
   requests;
-- the `serving_fleet_*` Prometheus series a scraper would collect.
+- ONE stitched distributed trace for a failed-over request — both
+  hops, the re-prefill, and the derived queue/decode spans on one
+  aligned timeline (`router.distributed_trace`, ISSUE-13);
+- the FEDERATED `/metrics` scrape: every replica's registry merged
+  under `tier=`/`replica=` labels, counters summed, served from the
+  router's own port (`MetricsServer(snapshot=router.federate)`).
 
 Run: JAX_PLATFORMS=cpu python examples/fleet_serving.py
 """
@@ -59,7 +64,8 @@ def main() -> None:
                     config=FleetConfig(restart_backoff_base_s=0.05))
     server = MetricsServer(router.registry, port=0,
                            health=router.health, ready=router.ready,
-                           debug=router.debugz)
+                           debug=router.debugz, slo=router.slo_report,
+                           snapshot=router.federate)
 
     print(f"fleet of 3 replicas up; router metrics at {server.url}")
     print("submitting 12 requests, then killing replica 1 "
@@ -116,10 +122,40 @@ def main() -> None:
           f"{sum(h.status == 'completed' for h in more)}/6 requests "
           "served through the rollout, 0 shed")
 
-    print("\nfleet scrape (serving_fleet_* series):")
-    for line in prometheus_text(router.registry).splitlines():
-        if line.startswith("serving_fleet") and "_bucket" not in line:
+    # the stitched kill-and-failover trace (ISSUE-13): both hops, the
+    # failover, and the derived spans on one aligned timeline
+    failed_over = [h for h in handles
+                   if "failover" in h.trace.kinds()]
+    if failed_over:
+        dt = router.distributed_trace(failed_over[0].rid)
+        print(f"\nstitched distributed trace of request {dt['rid']} "
+              "(the failed-over one):")
+        print("  hops: " + " -> ".join(
+            f"replica {h['replica']} ({h['status']}, "
+            f"{h['n_events']} events)" for h in dt["hops"]))
+        t0 = dt["events"][0]["ts"]
+        for s in dt["spans"]:
+            print(f"  span {s['name']:<8} "
+                  f"+{(s['t0'] - t0) * 1e3:8.1f} ms  "
+                  f"dur {(s['t1'] - s['t0']) * 1e3:8.1f} ms")
+    rep = router.slo_report()
+    print(f"\nfleet SLO (stitched: queue time included): "
+          f"ttft_p50 {rep['ttft_p50_ms']} ms, "
+          f"e2e_p99 {rep['e2e_p99_ms']} ms, "
+          f"goodput {rep['goodput']:.2f}")
+
+    print("\nFEDERATED fleet scrape (router + every replica, one "
+          "port; counters summed, gauges per-replica):")
+    shown = 0
+    for line in router.federated_text().splitlines():
+        if line.startswith(("serving_fleet_requests",
+                            "serving_requests_completed",
+                            "serving_queue_depth")) \
+                and "_bucket" not in line:
             print(f"  {line}")
+            shown += 1
+            if shown >= 12:
+                break
 
     server.stop()
     router.close()

@@ -1,0 +1,570 @@
+"""Fleet-wide distributed tracing + metrics federation (ISSUE-13).
+
+The acceptance behaviors, proven deterministically on CPU:
+
+- a request served through a `TieredRouter` yields ONE stitched
+  distributed trace containing router queue, prefill-hop, handoff,
+  and decode-hop SPANS with monotonically consistent aligned
+  timestamps — and a kill-mid-decode failover shows both hops and the
+  re-prefill in the SAME trace (span structure asserted, not just
+  presence);
+- the router's federated `/metrics` view: counters equal the SUM of
+  per-replica counters (verified against direct per-replica
+  registries), histograms merge bucket-exact, gauges stay
+  per-replica under `replica=`/`tier=` labels;
+- the fleet SLO report is built from stitched traces (TTFT/e2e
+  include router queue + handoff time) and carries the per-tier
+  latency breakdown;
+- satellites: configurable recorder ring capacity with bounds,
+  warmup/compile stats surfaced in the fleet debugz rows and the
+  federated scrape, the autoscaler's latency signal, and clock-offset
+  alignment for subprocess replicas (multiproc-marked, pipe-shipped
+  traces — the real two-clock case).
+"""
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.observability.events import (Event,
+                                                     FlightRecorder)
+from deeplearning4j_tpu.observability.export import (MetricsServer,
+                                                     json_snapshot)
+from deeplearning4j_tpu.observability.federation import (
+    check_cardinality, merge_snapshots, series_cardinality)
+from deeplearning4j_tpu.observability.stitch import stitch
+from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, FleetConfig,
+                                        InferenceEngine, Router,
+                                        SubprocessReplica, TieredRouter)
+from deeplearning4j_tpu.serving.disagg import (Autoscaler,
+                                               AutoscalePolicy)
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+HARD_TIMEOUT_S = 240.0
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _ec(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=12, backoff_base_s=0.0,
+                max_batch_size=2)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _tiered(params, mesh, prefill=1, decode=1, **kw):
+    ec = _ec(paged=True)
+    return TieredRouter(cfg=CFG, mesh=mesh, params=params,
+                        prefill_replicas=prefill,
+                        decode_replicas=decode,
+                        prefill_engine_config=ec,
+                        decode_engine_config=ec,
+                        config=FleetConfig(restart_backoff_base_s=0.01),
+                        **kw)
+
+
+def _span_names(dt):
+    return [(s["name"], s.get("phase")) for s in dt["spans"]]
+
+
+def _assert_monotonic(dt):
+    ts = [e["ts"] for e in dt["events"]]
+    assert ts == sorted(ts), "stitched event timestamps not monotonic"
+    for s in dt["spans"]:
+        assert s["t1"] >= s["t0"], f"span {s['name']} runs backwards"
+
+
+# ---------------------------------------------------------------------------
+# stitched distributed traces
+# ---------------------------------------------------------------------------
+
+def test_tiered_request_yields_one_stitched_trace(params, mesh1):
+    """Acceptance: one tiered request -> ONE distributed trace whose
+    SPAN STRUCTURE is queue -> prefill hop (with a prefill span) ->
+    handoff -> queue -> decode hop (with a decode span), timestamps
+    monotonically consistent."""
+    r = _tiered(params, mesh1)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=8)
+              for i in range(3)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        dt = r.distributed_trace(hs[0].rid)
+        assert dt is not None and dt["rid"] == hs[0].rid
+        names = _span_names(dt)
+        # span structure, in time order
+        assert names.index(("queue", None)) == 0
+        assert ("hop", "prefill") in names
+        assert ("prefill", "prefill") in names
+        handoff = [s for s in dt["spans"] if s["name"] == "handoff"]
+        assert len(handoff) == 1 and handoff[0]["outcome"] == "ok"
+        assert ("hop", "decode") in names
+        assert ("decode", "decode") in names
+        # the decode hop starts AFTER the handoff resolves
+        dec = next(s for s in dt["spans"]
+                   if s["name"] == "hop" and s.get("phase") == "decode")
+        assert dec["t0"] >= handoff[0]["t1"]
+        # exactly the two expected hops, attributed to their tiers
+        assert [(h["tier"], h["status"]) for h in dt["hops"]] == \
+            [("prefill", "completed"), ("decode", "completed")]
+        _assert_monotonic(dt)
+        # replica-side events really are in the merged timeline,
+        # stamped with the hop context the router dispatched
+        repl = [e for e in dt["events"] if e.get("src") == "replica"]
+        assert any(e["kind"] == "prefill_done" for e in repl)
+        assert all(e.get("fleet_rid") == hs[0].rid for e in repl)
+    finally:
+        r.close()
+
+
+def test_kill_mid_decode_failover_in_one_trace(params, mesh1):
+    """Acceptance: a decode-replica kill shows BOTH hops and the
+    re-prefill in the SAME stitched trace — a lost decode hop, the
+    router failover event, and a second prefill-phase hop after it."""
+    inj = FleetFaultInjector(kill_at={6: 1})   # replica 1 = decode
+    r = _tiered(params, mesh1, decode=2, fault_injector=inj)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=12)
+              for i in range(5)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        assert r.stats["failovers"] >= 1
+        dts = [r.distributed_trace(h.rid) for h in hs]
+        failed_over = [
+            dt for dt in dts
+            if any(e["kind"] == "failover" for e in dt["events"])]
+        assert failed_over, "no stitched trace recorded the failover"
+        dt = failed_over[0]
+        hops = dt["hops"]
+        lost = [h for h in hops if h["status"] == "lost"]
+        assert len(lost) == 1 and lost[0]["tier"] == "decode"
+        # the re-prefill: a LATER prefill-phase hop than the lost one
+        assert any(h["phase"] == "prefill" and h["hop"] > lost[0]["hop"]
+                   for h in hops)
+        # both hops carry spans in the one trace
+        hop_spans = [s for s in dt["spans"] if s["name"] == "hop"]
+        assert len(hop_spans) >= 2
+        _assert_monotonic(dt)
+    finally:
+        r.close()
+
+
+def test_distributed_trace_unknown_rid_is_none(params, mesh1):
+    r = _tiered(params, mesh1)
+    try:
+        assert r.distributed_trace(99999) is None
+    finally:
+        r.close()
+
+
+def test_stitch_aligns_and_clamps_foreign_clock():
+    """Unit: a hop whose events live on a clock 100s ahead (a
+    subprocess replica's perf_counter) aligns back into the router
+    domain, and residual midpoint error can never push the hop's
+    first event before its dispatch or past the terminal."""
+    t = 1000.0
+    router = [Event(t, "submit", 7, {}),
+              Event(t + 0.001, "queued", 7, {}),
+              Event(t + 0.010, "dispatched", 7,
+                    {"replica": 3, "hop": 0, "tier": "serving"}),
+              Event(t + 0.500, "finished", 7, {"tokens": 4})]
+    off = 100.0
+    replica_evs = [
+        # first event 5 ms BEFORE the dispatch after alignment:
+        # simulated midpoint error — must clamp-shift right
+        {"ts": t + 0.005 + off, "kind": "submit", "rid": 1},
+        {"ts": t + 0.050 + off, "kind": "prefill_done", "rid": 1,
+         "tokens": 1},
+        {"ts": t + 0.400 + off, "kind": "decode_chunk", "rid": 1,
+         "tokens": 3},
+        # and an event past the router terminal — must clamp left
+        {"ts": t + 0.700 + off, "kind": "finished", "rid": 1,
+         "tokens": 4},
+    ]
+    st = stitch(7, router, [{
+        "hop": 0, "replica": 3, "tier": "serving", "phase": "serving",
+        "kind": "subprocess", "status": "completed", "hedge": False,
+        "clock_offset": off, "dispatched_ts": t + 0.010,
+        "events": replica_evs}])
+    repl = [e for e in st.events if e.data.get("src") == "replica"]
+    assert repl and repl[0].ts >= t + 0.010
+    assert all(e.ts <= t + 0.500 for e in repl)
+    ts = [e.ts for e in st.events]
+    assert ts == sorted(ts)
+    # the router terminal stays the LAST event despite ties
+    assert st.events[-1].kind == "finished"
+    assert st.events[-1].data["src"] == "router"
+    assert st.complete()
+    # spans derived across the clock boundary
+    assert {s["name"] for s in st.spans} >= {"queue", "hop", "prefill",
+                                             "decode"}
+
+
+# ---------------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------------
+
+def test_federated_counters_sum_and_histograms_merge_exact(params,
+                                                           mesh1):
+    """Acceptance: federated counters equal the SUM of the per-replica
+    counters row for row, histogram buckets merge bucket-exact, and
+    gauges stay per-replica under replica=/tier= labels."""
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=2,
+               engine_config=_ec(),
+               config=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=8)
+              for i in range(6)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        engines = [c.replica.engine for c in r._ctls]
+        fed = r.federate()
+
+        # counters: the one serving-tier row == sum over replicas
+        rows = fed["serving_requests_completed"]["samples"]
+        assert [row["labels"] for row in rows] == [{"tier": "serving"}]
+        want = sum(e.registry.get("serving_requests_completed").value
+                   for e in engines)
+        assert rows[0]["value"] == want > 0
+
+        # histograms: cumulative buckets sum edge-exact
+        fam = "serving_decode_step_seconds"
+        fed_row = fed[fam]["samples"][0]
+        parts = [json_snapshot(e.registry)[fam]["samples"][0]
+                 for e in engines]
+        for edge, c in fed_row["buckets"].items():
+            assert c == sum(p["buckets"][edge] for p in parts), edge
+        assert fed_row["count"] == sum(p["count"] for p in parts)
+        assert fed_row["sum"] == pytest.approx(
+            sum(p["sum"] for p in parts))
+
+        # gauges: one row per replica, never summed
+        grows = fed["serving_queue_depth"]["samples"]
+        assert sorted(row["labels"]["replica"] for row in grows) == \
+            ["0", "1"]
+        # the router's own families are present under tier="router"
+        assert any(row["labels"].get("tier") == "router"
+                   for row in fed["serving_fleet_dispatches"]["samples"])
+    finally:
+        r.close()
+
+
+def test_router_metrics_endpoint_serves_federated_view(params, mesh1):
+    """The router's own /metrics (MetricsServer(snapshot=federate))
+    serves the merged exposition over real HTTP — text and JSON."""
+    import urllib.request
+    import json as _json
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=2,
+               engine_config=_ec())
+    srv = MetricsServer(r.registry, port=0, health=r.health,
+                        ready=r.ready, debug=r.debugz,
+                        slo=r.slo_report, snapshot=r.federate)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=6)
+              for i in range(4)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'serving_requests_completed_total{tier="serving"} 4' \
+            in text
+        assert 'replica="0"' in text and 'replica="1"' in text
+        with urllib.request.urlopen(srv.url + "/metrics.json",
+                                    timeout=10) as resp:
+            snap = _json.loads(resp.read().decode())
+        assert snap["serving_requests_completed"]["samples"][0][
+            "value"] == 4
+        with urllib.request.urlopen(srv.url + "/slo",
+                                    timeout=10) as resp:
+            rep = _json.loads(resp.read().decode())
+        assert rep["window"] == 4 and "tiers" in rep
+    finally:
+        srv.stop()
+        r.close()
+
+
+def test_federation_cardinality_guard():
+    """The guard fails a snapshot whose label combos exceed budget."""
+    snap = {"serving_thing": {
+        "kind": "counter", "help": "", "samples": [
+            {"labels": {"k": str(i)}, "value": 1.0}
+            for i in range(9)]}}
+    assert series_cardinality(snap) == {"serving_thing": 9}
+    with pytest.raises(ValueError, match="cardinality budget"):
+        check_cardinality(snap, budget=8)
+    check_cardinality(snap, budget=9)      # at budget passes
+
+
+def test_federation_survives_kind_mismatch_and_edge_skew():
+    """Version-skewed replicas degrade (skip + keep first) instead of
+    corrupting the merge or killing the scrape."""
+    a = {"serving_x": {"kind": "counter", "help": "",
+                       "samples": [{"labels": {}, "value": 2.0}]},
+         "serving_h_seconds": {"kind": "histogram", "help": "",
+                               "samples": [{"labels": {},
+                                            "buckets": {"1": 1,
+                                                        "+Inf": 2},
+                                            "sum": 1.0, "count": 2}]}}
+    b = {"serving_x": {"kind": "gauge", "help": "",
+                       "samples": [{"labels": {}, "value": 5.0}]},
+         "serving_h_seconds": {"kind": "histogram", "help": "",
+                               "samples": [{"labels": {},
+                                            "buckets": {"2": 1,
+                                                        "+Inf": 1},
+                                            "sum": 1.0, "count": 1}]}}
+    m = merge_snapshots([({"tier": "t", "replica": 0}, a),
+                         ({"tier": "t", "replica": 1}, b)])
+    assert m["serving_x"]["kind"] == "counter"
+    assert m["serving_x"]["samples"][0]["value"] == 2.0
+    assert m["serving_h_seconds"]["samples"][0]["buckets"] == \
+        {"1": 1, "+Inf": 2}
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO rollup + per-tier breakdown
+# ---------------------------------------------------------------------------
+
+def test_fleet_slo_built_from_stitched_traces(params, mesh1):
+    """The fleet SLO report covers every request, publishes the
+    serving_fleet_* families, and carries the per-tier span breakdown
+    (prefill / decode / handoff / queue) the autoscaler can consume."""
+    r = _tiered(params, mesh1)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=8)
+              for i in range(4)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        rep = r.slo_report()
+        assert rep["window"] == 4
+        assert rep["ttft_p50_ms"] is not None
+        assert rep["e2e_p99_ms"] is not None
+        tiers = rep["tiers"]
+        assert "prefill" in tiers["prefill"]
+        assert "handoff" in tiers["prefill"]
+        assert "decode" in tiers["decode"]
+        assert "queue" in tiers["fleet"]
+        assert tiers["prefill"]["handoff"]["n"] == 4
+        # the histogram form is in the ROUTER registry for scrapers
+        fam = r.registry.get("serving_fleet_span_seconds")
+        assert fam is not None and fam.labelnames == ("tier", "span")
+        ttft = r.registry.get("serving_fleet_ttft_seconds")
+        assert ttft.labels().snapshot()[2] == 4    # count == window
+        # fleet TTFT measures submit -> first token THROUGH the
+        # prefill hop: it can never undercut the prefill span alone
+        assert rep["ttft_p50_ms"] >= tiers["prefill"]["prefill"][
+            "p50_ms"] * 0.99
+    finally:
+        r.close()
+
+
+def test_autoscaler_consumes_span_latency_signal():
+    """AutoscalePolicy(scale_up_span_p99_ms=) turns the stitched
+    per-tier breakdown into scale-up pressure even at low occupancy."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, window=2,
+                          cooldown_s=0.0, scale_up_span_p99_ms=50.0)
+    sc = Autoscaler(pol)
+    # low occupancy, fast spans: no action
+    assert sc.observe(0.0, 1, 0.3, None, 1, 1, span_p99_ms=10.0) == 0
+    assert sc.observe(1.0, 1, 0.3, None, 1, 1, span_p99_ms=10.0) == 0
+    # low occupancy, SLOW spans: scales up after the window
+    assert sc.observe(2.0, 1, 0.3, None, 1, 1, span_p99_ms=80.0) == 0
+    assert sc.observe(3.0, 1, 0.3, None, 1, 1, span_p99_ms=80.0) == 1
+    # None signal (tracing off) keeps the pure-occupancy policy
+    sc2 = Autoscaler(pol)
+    assert sc2.observe(0.0, 1, 0.3, None, 1, 1, span_p99_ms=None) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellites: recorder capacity, warmup surfacing
+# ---------------------------------------------------------------------------
+
+def test_recorder_capacity_configurable_and_bounded(params, mesh1):
+    """EngineConfig(recorder_capacity=) sizes the engine ring;
+    the Router kwarg sizes the fleet ring; both enforce bounds."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _ec(recorder_capacity=8))
+    assert eng.recorder.capacity == 8
+    for i in range(4):
+        h = eng.submit(_prompt(8, i), max_new_tokens=6)
+    eng.run_pending()
+    assert h.done()
+    assert len(eng.recorder) == 8          # ring stayed bounded
+    with pytest.raises(ValueError, match="capacity"):
+        InferenceEngine(CFG, mesh1, params, _ec(recorder_capacity=0))
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=-1)
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=1,
+               engine_config=_ec(), recorder_capacity=16)
+    try:
+        assert r.recorder.capacity == 16
+    finally:
+        r.close()
+
+
+def test_warmup_and_compiles_surface_at_fleet_level(params, mesh1):
+    """Satellite: a warmed replica's warmup report + compiles-by-
+    source ride the probe piggyback into the fleet debugz rows, and
+    serving_compiles_total lands tier-labeled in the federated
+    scrape — a cold autoscaled replica is visible fleet-wide."""
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=1,
+               engine_config=_ec(warmup_on_init=True))
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=6)
+              for i in range(2)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        row = r.debugz()["replicas"][0]
+        assert row["last_warmup"] is not None
+        assert row["last_warmup"]["programs"] > 0
+        assert row["cold_start_s"] > 0
+        by_src = row["compiles_by_source"]
+        assert by_src is not None and sum(by_src.values()) > 0
+        fed = r.federate()
+        rows = fed["serving_compiles"]["samples"]
+        assert rows and all(row["labels"]["tier"] == "serving"
+                            for row in rows)
+        assert sum(row["value"] for row in rows) == sum(
+            by_src.values())
+    finally:
+        r.close()
+
+
+def test_fleet_timeline_has_lane_group_per_replica_per_tier(params,
+                                                            mesh1):
+    """The fleet Perfetto export: one process group per replica named
+    <tier>/replica <id>, plus the router group, on one shared
+    timebase."""
+    r = _tiered(params, mesh1)
+    try:
+        hs = [r.submit(_prompt(8, i), max_new_tokens=6)
+              for i in range(3)]
+        r.run_pending()
+        assert all(h.done() for h in hs)
+        tl = r.timeline()
+        evs = tl["traceEvents"]
+        names = {e["args"]["name"] for e in evs
+                 if e["name"] == "process_name"}
+        assert names == {"fleet router", "prefill/replica 0",
+                         "decode/replica 1"}
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1, 2}
+        assert all(e["ts"] >= 0 for e in evs if e["ph"] != "M")
+        import json as _json
+        _json.dumps(tl)                    # JSON-serializable whole
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# the real two-clock case (multiproc: subprocess replicas)
+# ---------------------------------------------------------------------------
+
+SUB_SPEC = {
+    "cfg": dict(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                max_len=64),
+    "engine": dict(decode_chunk=2, max_new_tokens=12,
+                   backoff_base_s=0.0, max_batch_size=2),
+    "params_seed": 0,
+    "progress_interval_s": 0.01,
+}
+
+
+@pytest.fixture
+def fleet_watchdog():
+    replicas = []
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for rep in replicas:
+            try:
+                rep.kill()
+            except Exception:
+                pass
+
+    timer = threading.Timer(HARD_TIMEOUT_S, _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield replicas.append
+    finally:
+        timer.cancel()
+        for rep in replicas:
+            try:
+                rep.close()
+            except Exception:
+                pass
+    assert not fired.is_set(), \
+        f"fleet watchdog fired after {HARD_TIMEOUT_S}s"
+
+
+@pytest.mark.multiproc
+def test_subprocess_tiered_stitch_and_federation(params, mesh1,
+                                                 fleet_watchdog):
+    """Acceptance (the real process boundary): a TieredRouter over
+    SUBPROCESS replicas still yields ONE stitched trace per request —
+    worker traces ship back over the pipe, clock-offset aligned, the
+    handoff degrades to outcome="fallback" (no cross-pipe KV) but its
+    span is in the trace — and the federated counters equal the sum
+    of the workers' own /metrics.json scrapes."""
+    import urllib.request
+    import json as _json
+    reps = [SubprocessReplica(i, SUB_SPEC,
+                              startup_timeout_s=HARD_TIMEOUT_S)
+            for i in range(2)]
+    for rep in reps:
+        fleet_watchdog(rep)
+    assert all(rep.clock_rtt is not None for rep in reps), \
+        "clock handshake did not complete"
+    r = TieredRouter(cfg=CFG, replicas=reps,
+                     tiers=["prefill", "decode"],
+                     config=FleetConfig(max_restarts=0,
+                                        hang_min_s=30.0))
+    hs = [r.submit(_prompt(8, i), max_new_tokens=8) for i in range(3)]
+    deadline = time.monotonic() + HARD_TIMEOUT_S
+    while r.pending() and time.monotonic() < deadline:
+        r.tick()
+    assert all(h.done() for h in hs)
+    dt = r.distributed_trace(hs[0].rid)
+    names = _span_names(dt)
+    assert names[0] == ("queue", None)
+    assert ("hop", "prefill") in names and ("hop", "decode") in names
+    handoff = [s for s in dt["spans"] if s["name"] == "handoff"]
+    assert len(handoff) == 1 and handoff[0]["outcome"] == "fallback"
+    _assert_monotonic(dt)
+    repl = [e for e in dt["events"] if e.get("src") == "replica"]
+    assert repl, "no worker trace events shipped over the pipe"
+    assert all(e.get("fleet_rid") == hs[0].rid for e in repl)
+    # federation: router-side sums equal the workers' own scrapes
+    fed = r.federate()
+    direct = []
+    for rep in reps:
+        with urllib.request.urlopen(rep.probe_url + "/metrics.json",
+                                    timeout=10) as resp:
+            direct.append(_json.loads(resp.read().decode()))
+    fam = "serving_requests_completed"
+    fed_total = sum(row["value"] for row in fed[fam]["samples"])
+    want = sum(s[fam]["samples"][0]["value"] for s in direct)
+    assert fed_total == want > 0
+    r.close()

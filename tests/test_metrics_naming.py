@@ -360,6 +360,77 @@ def test_tiered_fleet_series_pass_the_lint():
             assert SNAKE.match(lab), f"label {lab!r} not snake_case"
 
 
+def test_federated_exposition_passes_the_lint():
+    """ISSUE-13 satellite: the FEDERATED exposition — router + every
+    replica merged under tier=/replica= labels — stays lint-clean
+    (snake_case, unit suffixes, _total<->counter), contains NO
+    duplicate series after the merge, and every family stays inside a
+    sane label-cardinality budget."""
+    from deeplearning4j_tpu.observability.federation import (
+        check_cardinality)
+    from deeplearning4j_tpu.serving import TieredRouter
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    ec = EngineConfig(decode_chunk=2, max_new_tokens=12,
+                      backoff_base_s=0.0, max_batch_size=2, paged=True)
+    router = TieredRouter(cfg=cfg, mesh=mesh, params=params,
+                          prefill_replicas=1, decode_replicas=2,
+                          prefill_engine_config=ec,
+                          decode_engine_config=ec)
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        hs = [router.submit(prompt, max_new_tokens=8)
+              for _ in range(4)]
+        router.run_pending()
+        assert all(h.done() for h in hs)
+        snap = router.federate()
+        text = router.federated_text()
+    finally:
+        router.close()
+    # the merge really federated: engine series tier-labeled, fleet
+    # SLO rollup present, gauges per-replica
+    types = _types(text)
+    assert types["serving_requests_completed_total"] == "counter"
+    assert types["serving_fleet_ttft_seconds"] == "histogram"
+    assert types["serving_fleet_span_seconds"] == "histogram"
+    assert types["serving_fleet_federation_errors_total"] == "counter"
+    assert 'tier="prefill"' in text and 'tier="decode"' in text
+    assert 'tier="router"' in text and 'replica="0"' in text
+    # full lint over the merged exposition
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    seen = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+        # NO duplicate series after the merge: one line per
+        # (name, full label set)
+        key = (m.group(1), m.group(3))
+        assert key not in seen, f"duplicate series after merge: {key}"
+        seen.add(key)
+    # label-cardinality guard: every fleet family inside the budget
+    check_cardinality(snap, budget=64)
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):
