@@ -74,6 +74,12 @@ EVENT_KINDS = frozenset({
     #                  replica count changed {tier, direction: up|down,
     #                  replicas} — the occupancy-driven policy's
     #                  audit trail (ISSUE-11)
+    "kv_migration",  # fleet router: a cached prefix chain moved
+    #                  across replicas ahead of a dispatch {from, to,
+    #                  tokens, bytes, outcome: ok|stale|failed} —
+    #                  "stale" means the advertised chain was evicted
+    #                  before export, "failed" an export error; both
+    #                  degrade to a normal prefill (ISSUE-14)
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

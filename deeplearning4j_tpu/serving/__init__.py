@@ -83,17 +83,31 @@ the layer between callers and the compiled decode step:
   bucket-merged, gauges per-replica) — docs/observability.md
   "Distributed traces & federation".
 
+- Fleet-wide prefix-cache affinity + KV migration (round 19,
+  ISSUE-14): every replica advertises a compact digest of its radix
+  prefix cache (top-K chain hashes + a bloom filter, generation-
+  stamped) on the health-probe channel; the `Router` blends
+  advertised cached-prefix locality into dispatch (anti-herd capped,
+  staleness-TTL'd), and when capacity forces a request away from its
+  cached prefix — or the autoscaler brings up a cold replica — the
+  chain MIGRATES (`engine.export_cached_chain` → cache-source
+  `KVHandoff` → radix-cache seed at the target) instead of being
+  recomputed. Misprediction costs one normal prefill, never
+  correctness — docs/serving.md "Prefix affinity & KV migration".
+
 - Raw speed: persistent AOT compile cache + double-buffered tick loop
   (round 17, ISSUE-12): `EngineConfig(compile_cache_dir=,
   warmup_on_init=)` serializes every compiled serving program
   (executable bytes, `serving/compile_cache.py`) so a restarted or
   autoscaled replica LOADS its closed program set instead of
-  recompiling it — restart-to-ready becomes milliseconds — and
-  `EngineConfig(pipeline=True)` dispatches each tick's compiled calls
-  without blocking, committing the previous tick's outputs at one
-  sync point, so host scheduling work overlaps device compute
+  recompiling it — restart-to-ready becomes milliseconds — and the
+  double-buffered tick loop (`EngineConfig(pipeline=)`, the DEFAULT
+  since round 19) dispatches each tick's compiled calls without
+  blocking, committing the previous tick's outputs at one sync point,
+  so host scheduling work overlaps device compute
   (`serving_device_idle_fraction`; docs/serving.md "Engine internals
-  & raw speed").
+  & raw speed"). spec_decode/batch configs auto-fall-back to the
+  synchronous loop bit-identically.
 
 Lifecycle and thresholds: docs/serving.md.
 """

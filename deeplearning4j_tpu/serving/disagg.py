@@ -390,6 +390,12 @@ class TieredRouter(Router):
     # ------------------------------------------------------------------
     # tier-aware dispatch
     # ------------------------------------------------------------------
+    def _affinity_applies(self, fr) -> bool:
+        # prefix affinity (ISSUE-14) steers PREFILL dispatch only:
+        # the decode tier receives its KV via the cross-tier handoff,
+        # so cached-prefix locality buys it nothing
+        return self._phase_of(fr) == PREFILL
+
     def _pick(self, now, exclude=None, fr=None):
         tier = DECODE if fr is None else self._phase_of(fr)
         best, best_score = None, None
@@ -397,7 +403,7 @@ class TieredRouter(Router):
             if (ctl.tier != tier or ctl.id == exclude
                     or not self._dispatchable(ctl, now)):
                 continue
-            s = self._score(ctl)
+            s = self._score(ctl) - self._affinity_bonus(ctl, fr, now)
             if best_score is None or s < best_score:
                 best, best_score = ctl, s
         return best
@@ -463,12 +469,17 @@ class TieredRouter(Router):
         if self._phase_of(fr) == PREFILL:
             # the prefill tier's job ends at the first token: hold the
             # finished slot (when the replica can export) so the
-            # handoff finds its pages still referenced
+            # handoff finds its pages still referenced. A migrated
+            # cache chain (ISSUE-14) rides along, consumed-on-dispatch
+            kw = {}
+            mig, fr._migrate_kv = fr._migrate_kv, None
+            if mig is not None:
+                kw["kv"] = mig
             hold = bool(getattr(ctl.replica, "supports_handoff",
                                 False))
             return ctl.replica.submit(prompt, 1, deadline_s,
                                       fr.on_deadline, hold_kv=hold,
-                                      trace_ctx=ctx)
+                                      trace_ctx=ctx, **kw)
         kv, fr._handoff = fr._handoff, None   # consumed: a redispatch
         #                                       after any failure
         #                                       re-prefills instead

@@ -293,7 +293,15 @@ class KVHandoff:
     (quant/kv.py). Bit-preserving by construction: values are sliced,
     never re-quantized, so a float OR int8 decode continuation on the
     adopting engine is token-exact vs an uninterrupted single-engine
-    run."""
+    run.
+
+    ISSUE-14 adds the CACHE-CHAIN source: ``source="cache"`` carries a
+    radix-prefix-cache chain (full pages only) instead of a live
+    slot's committed state — ``tokens`` holds the chain's token ids
+    (adoption must know WHAT text the rows encode to seed the target's
+    radix cache) and ``weights_step`` the exporter's weights version
+    (rows encode the weights that wrote them; a target on different
+    weights must refuse the seed and fall back to prefilling)."""
     pos: int                 # K/V rows [0, pos) are committed
     tok: int                 # pending token == last committed token
     k: "np.ndarray"          # [L, pos, D] at the pool dtype
@@ -303,6 +311,9 @@ class KVHandoff:
     kv_mode: Optional[str] = None
     n_layers: int = 0
     d_model: int = 0
+    source: str = "slot"     # "slot" (ISSUE-11) | "cache" (ISSUE-14)
+    tokens: Optional["np.ndarray"] = None    # cache source: chain ids
+    weights_step: Optional[int] = None
 
     @property
     def nbytes(self) -> int:
@@ -446,14 +457,18 @@ class EngineConfig:
     # sync point, so host-side scheduling/accounting overlaps device
     # compute (decode/prefill token COUNTS are deterministic, so the
     # schedule runs one tick ahead of the committed values — token
-    # values are never observed before their sync). pipeline=False
-    # (default) keeps the synchronous PR-11 loop bit-identically.
-    # Incompatible with spec_decode (acceptance makes commit counts
-    # nondeterministic) and with mode="batch".
+    # values are never observed before their sync). True (the default
+    # since ISSUE-14: the loop soaked through round 17's bench matrix
+    # token-exact with every failure semantic preserved) pipelines
+    # every continuous engine; spec_decode (acceptance makes commit
+    # counts nondeterministic) and mode="batch" AUTO-FALL-BACK to the
+    # synchronous loop with a warning — bit-identically, never a
+    # constructor rejection. pipeline=False pins the synchronous
+    # PR-11 loop.
     program_cache_size: int = DEFAULT_PROGRAM_CACHE_SIZE
     compile_cache_dir: Optional[str] = None
     warmup_on_init: bool = False
-    pipeline: bool = False
+    pipeline: bool = True
     # flight-recorder ring depth (ISSUE-13 satellite): the engine's
     # FlightRecorder keeps the last N lifecycle events. The default
     # matches the old hardcoded ring; fleet-level trace stitching on
@@ -908,6 +923,27 @@ def _compiled_kv_adopt(n_pool_arrays: int):
     return jax.jit(adopt)
 
 
+@_program_cache
+def _compiled_chain_adopt(n_pool_arrays: int):
+    """Pool-only twin of _compiled_kv_adopt (ISSUE-14): scatter a
+    migrated prefix-cache chain into freshly allocated pages WITHOUT
+    touching any slot's pos/tok — the chain seeds the radix cache, not
+    a seated request, so per-slot state must stay untouched. Page
+    indices are runtime data; invalid entries route to the scratch
+    page 0, so seeding never recompiles."""
+    import jax
+    import jax.numpy as jnp
+
+    def adopt(idx, valid, *arrs):
+        n = len(arrs) // 2
+        rows, pool = arrs[:n], arrs[n:]
+        tgt = jnp.where(valid, idx, 0)
+        return tuple(a.at[:, tgt].set(r.astype(a.dtype))
+                     for a, r in zip(pool, rows))
+
+    return jax.jit(adopt)
+
+
 class InferenceEngine:
     """Bounded-queue, deadline-aware, fault-tolerant front end for the
     sharded generate path. See module docstring for semantics; see
@@ -971,18 +1007,24 @@ class InferenceEngine:
         # site (isolation solo re-runs, batch mode, spec rounds) keeps
         # its synchronous semantics untouched.
         self._pipe = bool(self.config.pipeline)
-        if self._pipe:
-            if not self._continuous:
-                raise ValueError(
-                    "pipeline requires mode='continuous' (the batch "
-                    "path has no persistent slot state to schedule "
-                    "ahead over)")
-            if self.config.spec_decode:
-                raise ValueError(
-                    "pipeline is incompatible with spec_decode: "
-                    "acceptance makes per-round commit counts "
-                    "nondeterministic, so the scheduler cannot run "
-                    "one tick ahead of the committed values")
+        if self._pipe and not self._continuous:
+            # auto-fallback, not rejection (ISSUE-14 satellite):
+            # pipeline became the default once it soaked, so configs
+            # it cannot serve drop to the synchronous loop
+            # bit-identically instead of refusing to construct
+            log.warning(
+                "pipeline requires mode='continuous' (the batch path "
+                "has no persistent slot state to schedule ahead "
+                "over); falling back to the synchronous loop")
+            self._pipe = False
+        if self._pipe and self.config.spec_decode:
+            log.warning(
+                "pipeline is incompatible with spec_decode: "
+                "acceptance makes per-round commit counts "
+                "nondeterministic, so the scheduler cannot run one "
+                "tick ahead of the committed values; falling back to "
+                "the synchronous loop")
+            self._pipe = False
         self._pending: deque = deque()
         self._pipe_defer = False
         self._pipe_items: Optional[list] = None
@@ -1231,6 +1273,14 @@ class InferenceEngine:
             "serving_prefill_seconds",
             "Wall time of one compiled admission-prefill call",
             buckets=DECODE_LATENCY_BUCKETS)
+        # prefill-compute accounting (ISSUE-14): the prompt tokens
+        # whose K/V THIS engine actually computed — prefix-cache hits
+        # and adopted handoffs excluded — i.e. the fleet affinity
+        # bench's "prefill compute spent" numerator
+        self._m_prefill_tokens = r.counter(
+            "serving_prefill_tokens",
+            "Prompt tokens prefilled by this engine (prefix-cache "
+            "hits and adopted KV handoffs excluded)")
         # raw-speed observability (ISSUE-12): every program build is
         # counted by source — "jit" = traced+XLA-compiled here, a
         # recompile when it shows up in steady state; "aot_cache" =
@@ -1410,18 +1460,26 @@ class InferenceEngine:
         continuous engines only — an engine that cannot adopt drops
         the handoff with a warning and re-prefills, which is slower
         but token-identical)."""
-        if kv is not None and not (self._continuous and self._paged
-                                   and kv.kv_mode == self._kv_mode
-                                   and kv.n_layers == self.cfg.n_layers
-                                   and kv.d_model == self.cfg.d_model):
-            # availability over purity: a mismatched handoff target
-            # re-prefills (correct tokens, no shared compute) instead
-            # of failing the request for a router-side config skew
-            log.warning("KV handoff not adoptable here (paged=%s, "
-                        "kv_mode=%s vs handoff %s): falling back to "
-                        "re-prefill", self._paged, self._kv_mode,
-                        kv.kv_mode)
-            kv = None
+        if kv is not None:
+            adoptable = (self._continuous and self._paged
+                         and kv.kv_mode == self._kv_mode
+                         and kv.n_layers == self.cfg.n_layers
+                         and kv.d_model == self.cfg.d_model)
+            if getattr(kv, "source", "slot") == "cache":
+                # a migrated cache chain (ISSUE-14) seeds the radix
+                # cache at seating — no cache, nothing to seed
+                adoptable = adoptable and self._prefix_cache is not None
+            if not adoptable:
+                # availability over purity: a mismatched handoff
+                # target re-prefills (correct tokens, no shared
+                # compute) instead of failing the request for a
+                # router-side config skew
+                log.warning("KV handoff not adoptable here (paged=%s, "
+                            "kv_mode=%s vs handoff %s, source=%s): "
+                            "falling back to re-prefill", self._paged,
+                            self._kv_mode, kv.kv_mode,
+                            getattr(kv, "source", "slot"))
+                kv = None
         if on_deadline not in ("shed", "partial"):
             raise ValueError(f"on_deadline must be 'shed' or 'partial', "
                              f"got {on_deadline!r}")
@@ -2294,6 +2352,17 @@ class InferenceEngine:
                 i = free[0]
                 hit = 0
                 adopted = False
+                if (r._kv is not None
+                        and getattr(r._kv, "source", "slot")
+                        == "cache"):
+                    # KV migration (ISSUE-14): the handoff seeds the
+                    # radix cache, then admission proceeds as a NORMAL
+                    # paged seat that hits the just-seeded chain — a
+                    # failed seed (pool full, weights skew, malformed
+                    # chain) costs one normal prefill, never
+                    # correctness
+                    self._seed_cached_chain(r._kv)
+                    r._kv = None
                 if r._kv is not None:
                     # cross-tier KV adoption (ISSUE-11): seat by
                     # device-putting the handed-off rows into fresh
@@ -2342,6 +2411,9 @@ class InferenceEngine:
                 r._prefill_pos = int(hit)
                 r._prefill_target = int(r.prompt.shape[0]
                                         + r.generated.shape[0])
+                if not adopted:
+                    self._m_prefill_tokens.inc(
+                        max(0, r._prefill_target - r._prefill_pos))
                 self._m_in_flight.inc()
                 extra = ({"prefill_chunk": self._prefill_chunk}
                          if self._prefill_chunk is not None else {})
@@ -2542,13 +2614,11 @@ class InferenceEngine:
             self._prefix_cache.insert(prefix[:kv.pos], fresh)
         return True
 
-    def _adopt_rows(self, pages: List[int], kv: KVHandoff,
-                    slot: int) -> None:
-        """Device-put the handed-off rows into ``pages``: rows (and
-        scales, which travel with their rows) are padded to the fixed
-        [L, max_pages * page_size, ...] geometry, reshaped to page
-        granularity, and scattered through one compiled program whose
-        page indices are runtime data — adoption never recompiles."""
+    def _handoff_row_buffers(self, kv: KVHandoff) -> List[np.ndarray]:
+        """Pad a handoff's rows (and scales, which travel with their
+        rows) to the fixed [L, max_pages * page_size, ...] geometry and
+        reshape to page granularity — the runtime-data form both adopt
+        programs scatter from."""
         mp, ps = self._max_pages, self._page_size
         cap = mp * ps
         pool, _ = self._pool_arrays()
@@ -2564,10 +2634,25 @@ class InferenceEngine:
                               np.float32)    # unwritten rows: scale 1
                 buf[:, :kv.pos] = src
                 rows.append(buf.reshape(self.cfg.n_layers, mp, ps, -1))
-        idx = np.zeros((mp,), np.int32)
+        return rows
+
+    def _page_index_vectors(self, pages: List[int]) -> tuple:
+        idx = np.zeros((self._max_pages,), np.int32)
         idx[:len(pages)] = pages
-        valid = np.zeros((mp,), bool)
+        valid = np.zeros((self._max_pages,), bool)
         valid[:len(pages)] = True
+        return idx, valid
+
+    def _adopt_rows(self, pages: List[int], kv: KVHandoff,
+                    slot: int) -> None:
+        """Device-put the handed-off rows into ``pages``: rows (and
+        scales, which travel with their rows) are padded to the fixed
+        [L, max_pages * page_size, ...] geometry, reshaped to page
+        granularity, and scattered through one compiled program whose
+        page indices are runtime data — adoption never recompiles."""
+        pool, _ = self._pool_arrays()
+        rows = self._handoff_row_buffers(kv)
+        idx, valid = self._page_index_vectors(pages)
         out = _compiled_kv_adopt(len(pool))(
             idx, valid, np.int32(slot), np.int32(kv.pos),
             np.int32(kv.tok), *rows, *self._slot_state)
@@ -2643,6 +2728,101 @@ class InferenceEngine:
                     self._leave_flight(r)
                     return True
         return False
+
+    def export_cached_chain(self,
+                            chain_hash: int) -> Optional[KVHandoff]:
+        """Host-gather a radix-prefix-cache chain by its advertised
+        chain hash (ISSUE-14): the fleet router's KV-migration source.
+        Returns a ``source="cache"`` `KVHandoff` carrying the chain's
+        K/V rows (+ per-row scales on quantized pools, bit-exact
+        slices), its token ids, and this engine's weights version —
+        or None when the chain is no longer cached (evicted since the
+        advertisement) or the pool was never materialized. A None here
+        costs the caller one normal prefill, never correctness."""
+        if not (self._continuous and self._paged
+                and self._prefix_cache is not None):
+            return None
+        self._flush_pipeline()
+        with self._lock:
+            node = self._prefix_cache.node_for_hash(chain_hash)
+            if node is None or self._slot_state is None:
+                return None
+            pages = self._prefix_cache.chain_pages(node)
+            tokens = self._prefix_cache.chain_tokens(node)
+            import jax.numpy as jnp
+            pos = len(pages) * self._page_size
+            pool = self._slot_state[:-2]
+            idx = np.zeros((self._max_pages,), np.int32)
+            idx[:len(pages)] = pages
+            planes = _compiled_page_gather(len(pool))(
+                jnp.asarray(idx), *pool)
+            planes = [np.asarray(a).reshape(
+                self.cfg.n_layers, -1, a.shape[-1])[:, :pos]
+                for a in planes]
+        return KVHandoff(
+            pos=pos, tok=int(tokens[-1]),
+            k=planes[0], v=planes[1],
+            k_scale=planes[2] if self._kv_mode else None,
+            v_scale=planes[3] if self._kv_mode else None,
+            kv_mode=self._kv_mode, n_layers=self.cfg.n_layers,
+            d_model=self.cfg.d_model, source="cache", tokens=tokens,
+            weights_step=self._weights_step)
+
+    def _seed_cached_chain(self, kv: KVHandoff) -> bool:
+        """Adopt a migrated ``source="cache"`` handoff INTO the radix
+        prefix cache (caller holds the lock): allocate fresh pages for
+        the chain (all-or-nothing), scatter the rows through the
+        pool-only adopt program (no slot's pos/tok is touched — the
+        chain seeds the CACHE, not a seat), and insert tokens->pages
+        so the very next admission sharing the prefix maps them as an
+        ordinary prefix hit. Every failure path returns False with
+        nothing claimed — the request just prefills normally."""
+        cache = self._prefix_cache
+        ps = self._page_size
+        npages = kv.pos // ps
+        tokens = (np.asarray(kv.tokens, np.int32)
+                  if kv.tokens is not None else None)
+        if (cache is None or tokens is None or npages < 1
+                or kv.pos % ps != 0
+                or int(tokens.shape[0]) != kv.pos
+                or kv.k.shape[1] != kv.pos):
+            self._m_adoptions.labels("seed_failed").inc()
+            return False
+        if kv.weights_step != self._weights_step:
+            # cached K/V encodes the weights that wrote it: a seed
+            # from a different weights version would be silently wrong
+            log.warning("cache-chain seed refused: exporter weights "
+                        "step %s vs local %s", kv.weights_step,
+                        self._weights_step)
+            self._m_adoptions.labels("seed_failed").inc()
+            return False
+        self._ensure_state()
+        pages: List[int] = []
+        for _ in range(npages):
+            p = self._alloc_page()
+            if p is None:
+                self._allocator.release_chain(pages)  # no partial claim
+                self._m_adoptions.labels("seed_failed").inc()
+                return False
+            pages.append(p)
+        try:
+            pool_n = len(self._slot_state) - 2
+            rows = self._handoff_row_buffers(kv)
+            idx, valid = self._page_index_vectors(pages)
+            out = _compiled_chain_adopt(pool_n)(
+                idx, valid, *rows, *self._slot_state[:-2])
+            self._slot_state = (*out, *self._slot_state[-2:])
+        except Exception as e:
+            self._allocator.release_chain(pages)
+            self._m_adoptions.labels("seed_failed").inc()
+            log.warning("cache-chain seed scatter failed: %s", e)
+            return False
+        cache.insert(tokens, pages)
+        # the cache co-owns what it adopted; drop our claim (chunks it
+        # already had keep their older page — ours just frees)
+        self._allocator.release_chain(pages)
+        self._m_adoptions.labels("seeded").inc()
+        return True
 
     def committed_kv_pages(self, handle: RequestHandle) -> int:
         """KV pages request ``handle``'s slot currently references —
@@ -3912,6 +4092,17 @@ class InferenceEngine:
                     # climbing, no warmup) without scraping /metrics
                     "last_warmup": self._last_warmup,
                     "compiles_by_source": self._compiles_by_source(),
+                    # prefix-cache advertisement (ISSUE-14): the
+                    # chain digest rides EVERY health probe —
+                    # in-process and HTTP alike — so a fleet router
+                    # can weight dispatch toward replicas whose cache
+                    # already holds a request's prefix. Cached per
+                    # cache generation: an idle replica's probes cost
+                    # a dict lookup, not a trie walk.
+                    **({"prefix_digest":
+                        self._prefix_cache.chain_digest()}
+                       if self._paged and self._prefix_cache is not None
+                       else {}),
                     **dict(self.stats)}
 
     def _compiles_by_source(self) -> dict:

@@ -112,6 +112,8 @@ from deeplearning4j_tpu.serving.engine import (DeadlineExceeded,
                                                OverloadError,
                                                RequestQuarantined,
                                                RequestStatus)
+from deeplearning4j_tpu.serving.paging import (chain_hashes,
+                                               digest_lookup)
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -162,6 +164,26 @@ class FleetConfig:
     max_restarts: int = 3            # CONSECUTIVE crash budget/replica
     restart_backoff_base_s: float = 0.05  # exponential: base*2^(n-1)
     restart_backoff_max_s: float = 2.0
+    # prefix-cache affinity dispatch + KV migration (ISSUE-14).
+    # ``affinity_weight`` blends the advertised-cached-tokens fraction
+    # into the dispatch score: score = occupancy + error-EMA penalty
+    # - affinity_weight * (cached_tokens / prompt_len) — 0 disables
+    # affinity entirely (pure occupancy dispatch, the bench's control
+    # arm). The ANTI-HERD cap zeroes the bonus on any replica at or
+    # above ``affinity_max_occupancy`` occupancy, so one hot tenant
+    # cannot pin a single replica into overload — the spillover
+    # replica gets the chain MIGRATED instead (``migrate_kv``): the
+    # router pulls it from the advertising replica via
+    # engine.export_cached_chain and ships it on the dispatch as a
+    # cache-source KVHandoff that seeds the target's radix cache.
+    # Advertisements older than ``affinity_digest_ttl_s`` are ignored
+    # (a replica that stopped answering probes must not keep
+    # attracting traffic on a stale digest).
+    affinity_weight: float = 1.0
+    affinity_max_occupancy: float = 0.75
+    affinity_digest_ttl_s: float = 10.0
+    migrate_kv: bool = True
+    migrate_min_tokens: int = 16     # don't ship chains smaller than
 
 
 class FleetHandle:
@@ -199,6 +221,11 @@ class FleetHandle:
         self._hops_done: List[dict] = []
         self._next_hop = 0
         self._stitched = None
+        # prefix affinity (ISSUE-14): page-prefix chain hashes of the
+        # PROMPT, computed lazily once per page size encountered, and
+        # the migrated cache-chain handoff the next dispatch ships
+        self._chain_hashes: Dict[int, List[int]] = {}
+        self._migrate_kv = None
         self._on_terminal: Optional[Callable] = None
         self._done = threading.Event()
 
@@ -238,7 +265,7 @@ class _Hop:
 
     __slots__ = ("fr", "replica_id", "inner", "base", "hedge",
                  "dispatched_at", "seq", "phase", "trace_ts",
-                 "recorded")
+                 "recorded", "aff_pred", "aff_ps", "aff_checked")
 
     def __init__(self, fr: FleetHandle, replica_id: int, inner,
                  base: np.ndarray, hedge: bool, t: float,
@@ -253,6 +280,14 @@ class _Hop:
         self.phase = phase           # prefill | decode | serving
         self.trace_ts = None         # recorder ts of the dispatched ev
         self.recorded = False        # captured into fr._hops_done
+        # affinity prediction audit (ISSUE-14): tokens the dispatch
+        # believed were cached at the target (+ the digest's page
+        # size); checked against the replica's admitted event at
+        # harvest — a shortfall is a MISPREDICT (bloom false positive
+        # or eviction), which cost only a normal prefill
+        self.aff_pred = 0
+        self.aff_ps = 0
+        self.aff_checked = False
 
     def committed(self) -> np.ndarray:
         """base + whatever this hop's replica has committed since."""
@@ -319,6 +354,14 @@ class InProcessReplica:
     @property
     def last_warmup(self) -> Optional[dict]:
         return self.engine.last_warmup
+
+    @property
+    def cache_warm(self) -> Optional[bool]:
+        """Did this replica's warmup load its program set from the
+        persistent AOT cache instead of compiling it (ISSUE-14
+        satellite: the autoscale-onto-new-host priming signal)? None
+        until a warmup ran."""
+        return _warmup_cache_warm(self.engine.last_warmup)
 
     @property
     def probe_url(self) -> Optional[str]:
@@ -438,6 +481,15 @@ class InProcessReplica:
                 pass
 
 
+def _warmup_cache_warm(report: Optional[dict]) -> Optional[bool]:
+    """Classify a warmup report as cache-warm (every program an AOT
+    load, zero jit compiles) vs cold. None when no warmup ran."""
+    if not report:
+        return None
+    return (int(report.get("aot_cache", 0) or 0) > 0
+            and int(report.get("jit", 0) or 0) == 0)
+
+
 def _http_probe(url: str, timeout: float) -> dict:
     """GET a probe endpoint; 503 bodies parse like 200 bodies (the
     probe ANSWERED — "ready": False is information, not an error)."""
@@ -524,6 +576,11 @@ class SubprocessReplica:
         self.clock_rtt: Optional[float] = None
         self.cold_start_s = 0.0
         self.last_warmup: Optional[dict] = None
+        self.cache_warm: Optional[bool] = None   # hello-reported
+        # the worker piggybacks its radix-cache digest on hello and
+        # progress lines (ISSUE-14): the router's probe loop reads it
+        # here between HTTP probes
+        self.prefix_digest: Optional[dict] = None
         self._spawn()
 
     # -- process lifecycle ---------------------------------------------
@@ -615,6 +672,13 @@ class SubprocessReplica:
             self.cold_start_s = float(ev.get("cold_start_s", 0.0)
                                       or 0.0)
             self.last_warmup = ev.get("warmup")
+            # cache-warm vs cold (ISSUE-14 satellite): a fresh host
+            # primed via compile_cache_dir says so in its hello line
+            self.cache_warm = ev.get("cache_warm",
+                                     _warmup_cache_warm(
+                                         self.last_warmup))
+            if ev.get("prefix_digest"):
+                self.prefix_digest = ev["prefix_digest"]
             self._hello.set()
             return
         if kind == "clock":
@@ -639,6 +703,8 @@ class SubprocessReplica:
             return
         if kind == "progress":
             h._update(ev.get("tokens", []))
+            if ev.get("prefix_digest"):
+                self.prefix_digest = ev["prefix_digest"]
         elif kind == "done":
             h.trace_events = ev.get("trace") or []
             h.deadline_exceeded = bool(ev.get("partial", False))
@@ -817,6 +883,10 @@ class _ReplicaCtl:
         self.ready = False           # last probe's readiness verdict
         self.last_health: dict = {}
         self.consec_probe_failures = 0
+        # prefix-cache advertisement (ISSUE-14): the last probe's
+        # chain digest + when it landed (the TTL's reference point)
+        self.digest: Optional[dict] = None
+        self.digest_at = 0.0
         self.err_ema = 0.0
         self.breaker_failures = 0
         self.breaker_open_until = 0.0
@@ -1006,6 +1076,39 @@ class Router:
             "Per-replica snapshot scrapes that failed during metrics "
             "federation (the replica's series are absent from that "
             "federated scrape)")
+        # prefix-cache affinity dispatch + KV migration (ISSUE-14)
+        self._m_aff_hits = r.counter(
+            "serving_fleet_affinity_hits",
+            "Dispatches routed to a replica advertising a cached "
+            "prefix of the request")
+        self._m_aff_misses = r.counter(
+            "serving_fleet_affinity_misses",
+            "Dispatches for which no replica advertised a usable "
+            "cached prefix (counted only while some replica "
+            "advertises a digest)")
+        self._m_aff_mispredicts = r.counter(
+            "serving_fleet_affinity_mispredicts",
+            "Affinity dispatches whose advertised prefix turned out "
+            "evicted or a bloom false positive at admission — served "
+            "as a normal prefill, never wrong")
+        self._m_migrations = r.counter(
+            "serving_fleet_kv_migrations",
+            "Cross-replica prefix-chain KV migrations, by outcome: "
+            "ok (chain shipped on the dispatch), stale (advertised "
+            "chain already evicted at the source), failed (export "
+            "error) — stale/failed degrade to a normal prefill",
+            labelnames=("outcome",))
+        self._m_migrations_ok = self._m_migrations.labels("ok")
+        self._m_migrations_stale = self._m_migrations.labels("stale")
+        self._m_migrations_failed = self._m_migrations.labels("failed")
+        self._m_migrated_tokens = r.counter(
+            "serving_fleet_kv_migrated_tokens",
+            "Prefix-chain K/V rows migrated across replicas instead "
+            "of being recomputed")
+        self._m_migrated_bytes = r.counter(
+            "serving_fleet_kv_migrated_bytes",
+            "Bytes of prefix-chain K/V values + scales migrated "
+            "across replicas")
 
     @property
     def stats(self) -> dict:
@@ -1020,7 +1123,16 @@ class Router:
             "hedges_primary_won": int(self._m_hedge_primary.value),
             "hedges_hedge_won": int(self._m_hedge_hedge.value),
             "restarts": int(self._m_restarts.value),
-            "probe_failures": int(self._m_probe_failures.value)}
+            "probe_failures": int(self._m_probe_failures.value),
+            "affinity_hits": int(self._m_aff_hits.value),
+            "affinity_misses": int(self._m_aff_misses.value),
+            "affinity_mispredicts": int(
+                self._m_aff_mispredicts.value),
+            "kv_migrations_ok": int(self._m_migrations_ok.value),
+            "kv_migrations_stale": int(self._m_migrations_stale.value),
+            "kv_migrations_failed": int(
+                self._m_migrations_failed.value),
+            "kv_migrated_tokens": int(self._m_migrated_tokens.value)}
 
     # ------------------------------------------------------------------
     # admission
@@ -1497,6 +1609,7 @@ class Router:
         ctl.killed_at = now
         ctl.consec_crashes += 1
         ctl.ready = False
+        ctl.digest = None            # its cache died with it
         cfgf = self.config
         if ctl.consec_crashes <= cfgf.max_restarts:
             backoff = min(
@@ -1595,6 +1708,7 @@ class Router:
             ctl.unhealthy = False
             ctl.next_restart_at = None
             ctl.no_progress = 0
+            ctl.digest = None        # fresh engine, empty cache
             ctl.restarts += 1
             ctl.breaker_failures = 0
             ctl.breaker_open_until = 0.0
@@ -1639,6 +1753,13 @@ class Router:
             ctl.unhealthy = False
             ctl.last_health = h if isinstance(h, dict) else {}
             ctl.ready = bool(ctl.last_health.get("ready", False))
+            # prefix-cache advertisement capture (ISSUE-14): from the
+            # probe body, or — subprocess replicas between HTTP
+            # probes — the digest its worker piggybacked on the pipe
+            dg = (ctl.last_health.get("prefix_digest")
+                  or getattr(ctl.replica, "prefix_digest", None))
+            if dg:
+                ctl.digest, ctl.digest_at = dg, now
 
     def _detect_hangs(self) -> None:
         """A replica with in-flight work that commits nothing for
@@ -1692,15 +1813,70 @@ class Router:
         return (ctl.n_outstanding() / ctl.capacity
                 + 2.0 * ctl.err_ema)
 
+    # ------------------------------------------------------------------
+    # prefix-cache affinity (ISSUE-14)
+    # ------------------------------------------------------------------
+    def _request_hashes(self, fr: FleetHandle,
+                        page_size: int) -> List[int]:
+        hs = fr._chain_hashes.get(page_size)
+        if hs is None:
+            hs = chain_hashes(fr.prompt, page_size)
+            fr._chain_hashes[page_size] = hs
+        return hs
+
+    def _affinity_tokens(self, ctl: _ReplicaCtl, fr: FleetHandle,
+                         now: float) -> tuple:
+        """``(cached_tokens, chain_hash)`` the replica's advertised
+        digest claims for ``fr``'s prompt — (0, None) when the digest
+        is absent or older than the staleness TTL (the generation-
+        stamped digest goes stale the moment probes stop refreshing
+        it, and a stale advertisement must not attract traffic)."""
+        dg = ctl.digest
+        if (not dg
+                or now - ctl.digest_at
+                > self.config.affinity_digest_ttl_s):
+            return 0, None
+        ps = int(dg.get("page_size", 0) or 0)
+        if ps <= 0:
+            return 0, None
+        toks, h = digest_lookup(dg, self._request_hashes(fr, ps))
+        return min(toks, int(fr.prompt.shape[0])), h
+
+    def _affinity_applies(self, fr: FleetHandle) -> bool:
+        """Which dispatches affinity scores — every one on the flat
+        router; only prefill-phase hops on the tiered router (the
+        decode tier receives its KV via the cross-tier handoff)."""
+        return True
+
+    def _affinity_bonus(self, ctl: _ReplicaCtl,
+                        fr: Optional[FleetHandle],
+                        now: float) -> float:
+        """The dispatch-score credit for advertised cached prefix
+        tokens, anti-herd capped: a replica already at/above the
+        occupancy cap gets NO bonus, so a hot tenant spills to
+        emptier replicas (which the KV migration then warms) instead
+        of pinning one replica into overload."""
+        w = self.config.affinity_weight
+        if w <= 0.0 or fr is None or not self._affinity_applies(fr):
+            return 0.0
+        if (ctl.n_outstanding() / ctl.capacity
+                >= self.config.affinity_max_occupancy):
+            return 0.0
+        toks, _ = self._affinity_tokens(ctl, fr, now)
+        if toks <= 0:
+            return 0.0
+        return w * min(1.0, toks / max(1, int(fr.prompt.shape[0])))
+
     def _pick(self, now: float, exclude: Optional[int] = None,
               fr: Optional[FleetHandle] = None) -> Optional[_ReplicaCtl]:
         """``fr`` lets tier-aware subclasses pick by the request's
-        phase (serving/disagg.py); the flat router ignores it."""
+        phase (serving/disagg.py) and gives affinity (ISSUE-14) the
+        prompt to score cached-prefix advertisements against."""
         best, best_score = None, None
         for ctl in self._ctls:
             if ctl.id == exclude or not self._dispatchable(ctl, now):
                 continue
-            s = self._score(ctl)
+            s = self._score(ctl) - self._affinity_bonus(ctl, fr, now)
             if best_score is None or s < best_score:
                 best, best_score = ctl, s
         return best
@@ -1796,6 +1972,11 @@ class Router:
                     f"fleet request {fr.rid} past deadline at "
                     "dispatch"))
                 return False
+        # prefix affinity + KV migration (ISSUE-14): what does the
+        # chosen replica advertise for this prompt, and should a
+        # hotter chain elsewhere be shipped ahead of the dispatch?
+        aff_pred, aff_ps = self._affinity_accounting(fr, ctl, now,
+                                                     hedge)
         # hop context (ISSUE-13): every dispatch gets a per-request
         # hop id the replica stamps on its own recorder events
         seq = fr._next_hop
@@ -1828,6 +2009,7 @@ class Router:
         self._passive_success(ctl)
         hop = _Hop(fr, ctl.id, inner, committed, hedge, now,
                    seq=seq, phase=phase)
+        hop.aff_pred, hop.aff_ps = aff_pred, aff_ps
         with self._lock:
             ctl.outstanding.setdefault(fr.rid, []).append(hop)
             ctl.last_progress_t = now    # a dispatch IS progress
@@ -1841,9 +2023,113 @@ class Router:
         ev = fr.trace.add("dispatched", replica=ctl.id,
                           hedge=bool(hedge),
                           committed=int(committed.shape[0]),
-                          hop=seq, tier=ctl.tier, phase=phase)
+                          hop=seq, tier=ctl.tier, phase=phase,
+                          affinity_tokens=int(aff_pred))
         hop.trace_ts = ev.ts if self.recorder.enabled else None
         return True
+
+    # ------------------------------------------------------------------
+    # prefix affinity accounting + KV migration (ISSUE-14)
+    # ------------------------------------------------------------------
+    def _affinity_accounting(self, fr: FleetHandle, ctl: _ReplicaCtl,
+                             now: float, hedge: bool) -> tuple:
+        """Pre-dispatch affinity bookkeeping for the chosen replica:
+        count the hit/miss (primary dispatches only — a hedge twin is
+        a latency bet, not a routing decision), and when another
+        replica advertises a meaningfully deeper chain, MIGRATE it —
+        export from the advertiser, stamp it on ``fr`` so
+        `_submit_hop` ships it with the dispatch. Returns
+        ``(predicted_cached_tokens, digest_page_size)`` for the hop's
+        mispredict audit."""
+        if not self._affinity_applies(fr):
+            return 0, 0
+        if self.config.affinity_weight <= 0.0:
+            # pure-occupancy control arm: the affinity series must
+            # not move (migration stays independently gated below)
+            if self.config.migrate_kv:
+                mig = self._maybe_migrate(fr, ctl, 0, now)
+                if mig:
+                    return mig, int((ctl.digest or {}).get(
+                        "page_size", 0) or 0)
+            return 0, 0
+        pred, _ = self._affinity_tokens(ctl, fr, now)
+        ps = int((ctl.digest or {}).get("page_size", 0) or 0)
+        advertised_anywhere = any(
+            c.digest is not None and not c.dead for c in self._ctls)
+        if not hedge and advertised_anywhere:
+            (self._m_aff_hits if pred > 0
+             else self._m_aff_misses).inc()
+        mig = self._maybe_migrate(fr, ctl, pred, now)
+        if mig:
+            pred = max(pred, mig)
+            ps = ps or int((ctl.digest or {}).get("page_size", 0)
+                           or 0)
+        return pred, ps
+
+    def _migration_target_engine(self, ctl: _ReplicaCtl):
+        """The chosen replica's engine when it can ADOPT a migrated
+        chain (in-process, paged, radix cache on) — None otherwise
+        (subprocess replicas can't take KV across the pipe yet)."""
+        eng = getattr(ctl.replica, "engine", None)
+        if (eng is not None and getattr(eng, "_paged", False)
+                and getattr(eng, "_prefix_cache", None) is not None):
+            return eng
+        return None
+
+    def _maybe_migrate(self, fr: FleetHandle, ctl: _ReplicaCtl,
+                       pred: int, now: float) -> int:
+        """Move bytes, don't recompute: when capacity (or the
+        anti-herd cap) forced ``fr`` onto a replica missing its
+        prefix while another replica advertises it, pull the chain
+        from the advertiser (engine.export_cached_chain) and ship it
+        on this dispatch as a cache-source KVHandoff. Misprediction —
+        the chain evicted between advertisement and export (stale),
+        or an export error (failed) — degrades to a normal prefill.
+        Returns the migrated token count (0 = no migration)."""
+        cfgf = self.config
+        if not cfgf.migrate_kv or fr._migrate_kv is not None:
+            return 0
+        if self._migration_target_engine(ctl) is None:
+            return 0
+        best_toks, best_hash, best_ctl = 0, None, None
+        for cand in self._ctls:
+            if (cand is ctl or cand.dead
+                    or not cand.replica.alive()
+                    or getattr(cand.replica, "engine", None) is None):
+                continue
+            toks, h = self._affinity_tokens(cand, fr, now)
+            if h is not None and toks > best_toks:
+                best_toks, best_hash, best_ctl = toks, h, cand
+        if (best_ctl is None
+                or best_toks < cfgf.migrate_min_tokens
+                or best_toks <= pred):
+            return 0
+        outcome, kvh = "stale", None
+        try:
+            kvh = best_ctl.replica.engine.export_cached_chain(
+                best_hash)
+            if kvh is not None:
+                outcome = "ok"
+        except Exception as e:
+            outcome = "failed"
+            log.warning("KV migration export from replica %d failed "
+                        "(%s); request %d prefills normally",
+                        best_ctl.id, e, fr.rid)
+        nbytes = int(kvh.nbytes) if kvh is not None else 0
+        toks = int(kvh.pos) if kvh is not None else 0
+        if kvh is not None:
+            self._m_migrations_ok.inc()
+            self._m_migrated_tokens.inc(toks)
+            self._m_migrated_bytes.inc(nbytes)
+            fr._migrate_kv = kvh
+        elif outcome == "failed":
+            self._m_migrations_failed.inc()
+        else:
+            self._m_migrations_stale.inc()
+        fr.trace.add("kv_migration", outcome=outcome, **{
+            "from": int(best_ctl.id), "to": int(ctl.id),
+            "tokens": toks, "bytes": nbytes})
+        return toks
 
     def _submit_hop(self, ctl: _ReplicaCtl, fr: FleetHandle,
                     prompt: np.ndarray, remaining: int,
@@ -1852,9 +2138,15 @@ class Router:
         """One replica submit — the seam tier-aware subclasses
         override (prefill hops carry hold_kv, decode hops carry the
         pending KVHandoff). ``ctx`` is the ISSUE-13 hop context the
-        replica stamps on its recorder events."""
+        replica stamps on its recorder events. A migrated cache chain
+        (ISSUE-14) rides the same submit, consumed-on-dispatch so a
+        failed dispatch never replays it."""
+        kw = {}
+        kv, fr._migrate_kv = fr._migrate_kv, None
+        if kv is not None:
+            kw["kv"] = kv
         return ctl.replica.submit(prompt, remaining, deadline_s,
-                                  fr.on_deadline, trace_ctx=ctx)
+                                  fr.on_deadline, trace_ctx=ctx, **kw)
 
     def _prepare_failover(self, fr: FleetHandle,
                           ctl: _ReplicaCtl) -> None:
@@ -1914,6 +2206,7 @@ class Router:
             inner = hop.inner
             with self._lock:
                 self._drop_hop(hop)
+            self._affinity_outcome(hop)
             if fr.done():
                 self._record_hop(fr, hop, ctl, str(inner.status))
                 continue         # a twin already resolved it
@@ -1956,6 +2249,40 @@ class Router:
                     self._queue.appendleft(fr)
                 n += 1
         return n
+
+    @staticmethod
+    def _admitted_hit_tokens(inner) -> Optional[int]:
+        """The replica-reported prefix-cache hit of a hop's admission
+        — from the live RequestTrace (in-process) or the pipe-shipped
+        event dicts (subprocess). None when untraced."""
+        tr = getattr(inner, "trace", None)
+        evs = list(getattr(tr, "events", None) or [])
+        if not evs:
+            evs = list(getattr(inner, "trace_events", None) or [])
+        for e in evs:
+            kind = getattr(e, "kind", None)
+            data = getattr(e, "data", None)
+            if kind is None and isinstance(e, dict):
+                kind, data = e.get("kind"), e
+            if kind == "admitted" and data is not None:
+                v = data.get("prefix_hit_tokens")
+                return int(v) if v is not None else None
+        return None
+
+    def _affinity_outcome(self, hop: _Hop) -> None:
+        """The mispredict audit (ISSUE-14): a hop dispatched on an
+        advertised cached prefix whose admission reported at least a
+        page LESS than predicted hit a stale digest, an eviction, or
+        a bloom false positive — the cost was one normal prefill,
+        counted so operators can see advertisement quality."""
+        if hop.aff_pred <= 0 or hop.aff_checked:
+            return
+        hop.aff_checked = True
+        actual = self._admitted_hit_tokens(hop.inner)
+        if actual is None:
+            return               # untraced replica: nothing to audit
+        if actual + max(1, hop.aff_ps) <= hop.aff_pred:
+            self._m_aff_mispredicts.inc()
 
     def _resolve_success(self, fr: FleetHandle,
                          hop: Optional[_Hop]) -> None:
@@ -2086,6 +2413,18 @@ class Router:
                 "clock_offset_s": round(float(getattr(
                     c.replica, "clock_offset", 0.0) or 0.0), 6),
                 "occupancy": c.last_health.get("slots_occupied"),
+                # prefix-cache advertisement (ISSUE-14): what this
+                # replica's digest claims, and how old the claim is —
+                # the affinity dispatcher's per-replica view
+                "prefix_digest": ({
+                    "generation": c.digest.get("generation"),
+                    "entries": c.digest.get("entries"),
+                    "top_chains": len(c.digest.get("top", ())),
+                    "age_s": round(max(0.0, now - c.digest_at), 3)}
+                    if c.digest else None),
+                # cross-host compile-cache priming (ISSUE-14
+                # satellite): did this replica start warm?
+                "cache_warm": getattr(c.replica, "cache_warm", None),
                 # health-probe load piggyback (ISSUE-11 satellite):
                 # the slot-occupancy / budget-utilization gauge values
                 # every probe now carries

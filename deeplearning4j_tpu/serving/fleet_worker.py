@@ -105,14 +105,39 @@ def main() -> int:
                              + "\n")
             sys.stdout.flush()
 
+    warm = eng.last_warmup
     emit({"ev": "hello", "port": srv.port, "pid": os.getpid(),
           "num_slots": eng._num_slots,
           "cold_start_s": round(cold_start_s, 4),
-          "warmup": eng.last_warmup})
+          "warmup": warm,
+          # cross-host compile-cache priming (ISSUE-14 satellite): a
+          # spec whose engine kwargs carry compile_cache_dir (+
+          # warmup) starts WARM on a fresh host — every program an
+          # AOT load — and says so here, so the router's debugz shows
+          # whether autoscale-onto-new-host actually primed
+          "cache_warm": (None if not warm
+                         else (int(warm.get("aot_cache", 0) or 0) > 0
+                               and int(warm.get("jit", 0) or 0) == 0)),
+          # prefix-affinity advertisement (ISSUE-14): empty at birth,
+          # but the key's presence tells the router this worker
+          # piggybacks digests on its progress lines too
+          "prefix_digest": eng.health().get("prefix_digest")})
 
     handles: dict = {}
     h_lock = threading.Lock()
     stop = threading.Event()
+
+    # digest piggyback state (ISSUE-14): re-emit the radix-cache
+    # digest on a progress line only when its generation moved, so an
+    # idle cache costs the pipe nothing
+    last_digest_gen = [None]
+
+    def _digest_update():
+        dg = eng.health().get("prefix_digest")
+        if dg and dg.get("generation") != last_digest_gen[0]:
+            last_digest_gen[0] = dg.get("generation")
+            return dg
+        return None
 
     def progress_loop() -> None:
         """Stream each in-flight request's committed tokens — the
@@ -144,10 +169,15 @@ def main() -> int:
                     # committed-KV page count rides every progress
                     # line (ISSUE-11 satellite): the router-side view
                     # of how much KV state a failover would re-prefill
-                    # (0 on unpaged engines)
-                    emit({"ev": "progress", "rid": rid,
-                          "tokens": h.generated.tolist(),
-                          "kv_pages": eng.committed_kv_pages(h)})
+                    # (0 on unpaged engines). The prefix-cache digest
+                    # rides along when its generation moved (ISSUE-14)
+                    msg = {"ev": "progress", "rid": rid,
+                           "tokens": h.generated.tolist(),
+                           "kv_pages": eng.committed_kv_pages(h)}
+                    dg = _digest_update()
+                    if dg is not None:
+                        msg["prefix_digest"] = dg
+                    emit(msg)
 
     threading.Thread(target=progress_loop, daemon=True,
                      name="fleet-worker-progress").start()

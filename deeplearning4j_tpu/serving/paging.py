@@ -27,12 +27,26 @@ cached prefix chains can map straight into a new slot's table.
   their children go. `flush()` drops everything — hot weight reload
   must call it, because cached K/V encodes the weights that wrote it.
 
+Fleet-wide prefix affinity (ISSUE-14) adds the ADVERTISEMENT layer:
+every page-aligned prefix chain in the trie carries a deterministic
+64-bit `chain hash` (chained blake2b over the page's token bytes, so
+two processes hashing the same tokens agree), and `chain_digest()`
+compacts the whole cache into a probe-sized summary — the top-K
+hottest chains as exact (hash, tokens) pairs plus a small bloom filter
+over EVERY chain hash, stamped with a `generation` counter that bumps
+on insert/evict/flush so a router can age out stale advertisements.
+`chain_hashes()` + `digest_lookup()` are the router-side half: hash a
+request's prompt at page granularity and find the deepest advertised
+chain. A bloom false positive or an eviction between probe and
+dispatch costs one normal prefill — never correctness.
+
 Thread-safety: both classes are driven only under the engine lock
 (admission, reap, reload all already serialize on it), so they stay
 lock-free themselves.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -40,6 +54,105 @@ import numpy as np
 #: Physical page index reserved as the device scratch target for
 #: masked/inactive writes — never allocated, never attended.
 SCRATCH_PAGE = 0
+
+# ---------------------------------------------------------------------------
+# chain hashing + digests (ISSUE-14: fleet-wide prefix affinity)
+# ---------------------------------------------------------------------------
+
+_MASK64 = (1 << 64) - 1
+#: the empty chain's hash (root node) — a fixed seed so chain hashes
+#: are a pure function of the token content, identical across
+#: processes (Python's own hash() is salted per process and would
+#: break router<->replica hash agreement)
+ROOT_CHAIN_HASH = int.from_bytes(
+    hashlib.blake2b(b"dl4j-prefix-chain-v1", digest_size=8).digest(),
+    "little")
+
+#: digest shape defaults: K exact chains + an m-bit/k-hash bloom over
+#: every chain. At the default geometry 64 cached chains keep the
+#: bloom false-positive rate ≈ (1 - e^(-k*n/m))^k ≈ 2.4% — and a
+#: false positive only costs the router a mispredicted dispatch that
+#: degrades to a normal prefill.
+DIGEST_TOP_K = 16
+DIGEST_BLOOM_BITS = 512
+DIGEST_BLOOM_HASHES = 4
+
+
+def page_chain_hash(parent_hash: int, key: Sequence[int]) -> int:
+    """Hash of the chain ``parent chain + one page of tokens``:
+    blake2b over (parent hash || page token bytes), 64-bit."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent_hash).to_bytes(8, "little"))
+    h.update(np.asarray(key, np.int32).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_hashes(tokens: Sequence[int], page_size: int) -> List[int]:
+    """Hashes of every page-aligned prefix of ``tokens``:
+    ``out[j-1]`` is the hash of the first ``j`` full pages. The
+    router computes these ONCE per request and compares against
+    advertised digests."""
+    toks = np.asarray(tokens, np.int32)
+    ps = int(page_size)
+    out: List[int] = []
+    h = ROOT_CHAIN_HASH
+    for j in range(toks.shape[0] // ps):
+        h = page_chain_hash(h, toks[j * ps:(j + 1) * ps])
+        out.append(h)
+    return out
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer — decorrelates the k bloom probes derived
+    from one chain hash."""
+    x &= _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+def _bloom_indices(h: int, m: int, k: int):
+    for i in range(k):
+        yield _mix64(h + i * 0x9E3779B97F4A7C15) % m
+
+
+def bloom_add(bits: int, h: int, m: int, k: int) -> int:
+    for idx in _bloom_indices(h, m, k):
+        bits |= 1 << idx
+    return bits
+
+
+def bloom_has(bits: int, h: int, m: int, k: int) -> bool:
+    return all((bits >> idx) & 1 for idx in _bloom_indices(h, m, k))
+
+
+def digest_lookup(digest: Optional[dict],
+                  hashes: Sequence[int]) -> Tuple[int, Optional[int]]:
+    """The router-side match: given a replica's advertised
+    ``chain_digest()`` and a request's page-prefix ``chain_hashes``,
+    return ``(cached_tokens, chain_hash)`` for the DEEPEST advertised
+    chain prefixing the request — exact top-K entries first, then the
+    bloom filter (probabilistic: a false positive costs one normal
+    prefill). ``(0, None)`` when nothing matches."""
+    if not digest or not hashes:
+        return 0, None
+    ps = int(digest.get("page_size", 0) or 0)
+    if ps <= 0:
+        return 0, None
+    top = {int(h) for h, _ in digest.get("top", ())}
+    for j in range(len(hashes), 0, -1):
+        if hashes[j - 1] in top:
+            return j * ps, int(hashes[j - 1])
+    bloom = digest.get("bloom")
+    if bloom:
+        bits = int(bloom, 16)
+        m = int(digest.get("bloom_m", DIGEST_BLOOM_BITS))
+        k = int(digest.get("bloom_k", DIGEST_BLOOM_HASHES))
+        if m > 0 and k > 0:
+            for j in range(len(hashes), 0, -1):
+                if bloom_has(bits, hashes[j - 1], m, k):
+                    return j * ps, int(hashes[j - 1])
+    return 0, None
 
 
 class PageAllocator:
@@ -113,7 +226,8 @@ class PageAllocator:
 
 
 class _Node:
-    __slots__ = ("key", "page", "parent", "children", "last_used")
+    __slots__ = ("key", "page", "parent", "children", "last_used",
+                 "chain_hash", "depth")
 
     def __init__(self, key, page, parent):
         self.key = key                    # tuple of page_size tokens
@@ -121,6 +235,11 @@ class _Node:
         self.parent = parent
         self.children: Dict[tuple, "_Node"] = {}
         self.last_used = 0
+        # ISSUE-14: every node IS a page-aligned chain (root -> here);
+        # its deterministic hash is what digests advertise and what
+        # export_cached_chain() is asked for
+        self.chain_hash = ROOT_CHAIN_HASH
+        self.depth = 0                    # pages from root
 
 
 class RadixPrefixCache:
@@ -134,6 +253,19 @@ class RadixPrefixCache:
         self._nodes = 0
         # lifetime stats (the engine mirrors them into counters)
         self.evictions = 0
+        # ISSUE-14: chain-hash index for export_cached_chain() plus
+        # the generation counter every digest is stamped with —
+        # bumped on insert/evict/flush so a router can tell a live
+        # advertisement from a stale one (the idle-replica staleness
+        # fix: an unchanged generation means the digest is still
+        # exact, a bumped one means re-read it)
+        self._by_hash: Dict[int, _Node] = {}
+        self._gen = 0
+        self._digest_cache: Optional[tuple] = None
+
+    @property
+    def generation(self) -> int:
+        return self._gen
 
     def __len__(self) -> int:
         return self._nodes
@@ -176,12 +308,18 @@ class RadixPrefixCache:
                 self.alloc.incref(page)
                 child = _Node(key, page, node)
                 child.last_used = self._tick
+                child.chain_hash = page_chain_hash(node.chain_hash,
+                                                   key)
+                child.depth = node.depth + 1
                 node.children[key] = child
+                self._by_hash[child.chain_hash] = child
                 self._nodes += 1
                 adopted += 1
             else:
                 child.last_used = self._tick
             node = child
+        if adopted:
+            self._gen += 1
         return adopted
 
     def evict(self, n_pages: int) -> int:
@@ -201,6 +339,8 @@ class RadixPrefixCache:
             self._drop(victim)
             freed += 1
             self.evictions += 1
+        if freed:
+            self._gen += 1
         return freed
 
     def _iter_leaves(self):
@@ -214,6 +354,8 @@ class RadixPrefixCache:
 
     def _drop(self, node: _Node) -> None:
         del node.parent.children[node.key]
+        if self._by_hash.get(node.chain_hash) is node:
+            del self._by_hash[node.chain_hash]
         self._nodes -= 1
         self.alloc.decref(node.page)
 
@@ -229,13 +371,82 @@ class RadixPrefixCache:
             self.alloc.decref(n.page)
             dropped += 1
         self._root.children.clear()
+        self._by_hash.clear()
         self._nodes = 0
+        if dropped:
+            self._gen += 1
         return dropped
 
     def stats(self) -> dict:
         return {"entries": self._nodes,
                 "page_size": self.page_size,
+                "generation": self._gen,
                 "evictions": self.evictions}
+
+    # -- advertisement + export (ISSUE-14) ------------------------------
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    def node_for_hash(self, chain_hash: int) -> Optional[_Node]:
+        return self._by_hash.get(int(chain_hash))
+
+    def chain_pages(self, node: _Node) -> List[int]:
+        """Physical pages of the chain root -> ``node``, logical
+        order."""
+        out: List[int] = []
+        while node is not None and node.parent is not None:
+            out.append(node.page)
+            node = node.parent
+        out.reverse()
+        return out
+
+    def chain_tokens(self, node: _Node) -> np.ndarray:
+        """Token ids of the chain root -> ``node`` (full pages)."""
+        keys: List[tuple] = []
+        while node is not None and node.parent is not None:
+            keys.append(node.key)
+            node = node.parent
+        keys.reverse()
+        return np.asarray([t for k in keys for t in k], np.int32)
+
+    def chain_digest(self, top_k: int = DIGEST_TOP_K,
+                     bloom_m: int = DIGEST_BLOOM_BITS,
+                     bloom_k: int = DIGEST_BLOOM_HASHES) -> dict:
+        """The probe-sized advertisement of this cache: the ``top_k``
+        hottest chains as exact ``[chain_hash, cached_tokens]`` pairs
+        (ranked by recency, then depth — the system-prompt interior
+        nodes co-tenant traffic matches through stay hot because
+        `match()` touches the whole path) plus a ``bloom_m``-bit
+        bloom filter over EVERY chain hash, so deep uncommon chains
+        are still findable probabilistically. JSON-pure (ints + a hex
+        string) so it rides health probes and worker pipes verbatim.
+        Cached per generation: an idle replica's probes cost a dict
+        lookup, not a trie walk."""
+        key = (self._gen, int(top_k), int(bloom_m), int(bloom_k))
+        if self._digest_cache is not None \
+                and self._digest_cache[0] == key:
+            return self._digest_cache[1]
+        nodes = list(self._iter_nodes())
+        bits = 0
+        for n in nodes:
+            bits = bloom_add(bits, n.chain_hash, bloom_m, bloom_k)
+        nodes.sort(key=lambda n: (n.last_used, n.depth), reverse=True)
+        digest = {
+            "generation": int(self._gen),
+            "page_size": int(self.page_size),
+            "entries": int(self._nodes),
+            "top": [[int(n.chain_hash), int(n.depth * self.page_size)]
+                    for n in nodes[:max(0, int(top_k))]],
+            "bloom_m": int(bloom_m),
+            "bloom_k": int(bloom_k),
+            "bloom": format(bits, "x") if bits else "",
+        }
+        self._digest_cache = (key, digest)
+        return digest
 
 
 def pages_for(tokens: int, page_size: int) -> int:

@@ -406,8 +406,11 @@ def test_debugz_shows_live_slots_and_queue(params, mesh1):
                           _config(max_batch_size=1, max_new_tokens=10))
     seated = eng.submit(_prompt(8, 1))
     waiting = eng.submit(_prompt(8, 2))
-    eng.tick()                             # seat 1 (pool of 1), decode
-    dbg = eng.debugz()
+    for _ in range(4):    # seat 1 (pool of 1), ~1 chunk committed
+        eng.tick()        # (the pipelined default commits a tick late)
+        dbg = eng.debugz()
+        if dbg["slots"] and dbg["slots"][0]["generated"] > 0:
+            break
     assert [s["rid"] for s in dbg["slots"]] == [seated.rid]
     assert dbg["slots"][0]["status"] == "running"
     assert 0 < dbg["slots"][0]["generated"] < 10

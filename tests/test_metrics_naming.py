@@ -431,6 +431,78 @@ def test_federated_exposition_passes_the_lint():
     check_cardinality(snap, budget=64)
 
 
+def test_affinity_and_migration_series_pass_the_lint():
+    """The prefix-affinity series (ISSUE-14:
+    serving_fleet_affinity_{hits,misses,mispredicts}_total,
+    serving_fleet_kv_migrations_total{outcome},
+    serving_fleet_kv_migrated_{tokens,bytes}_total, and the engine's
+    serving_prefill_tokens_total / serving_kv_adoptions_total) over
+    REAL affinity traffic — a warm pass, an affinity-followed pass,
+    and a capacity-forced migration — then the same naming rules over
+    both the router exposition and the FEDERATED merge."""
+    from deeplearning4j_tpu.serving import FleetConfig, Router
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    shared = np.arange(16, dtype=np.int32)
+    router = Router(cfg=cfg, mesh=mesh, params=params, num_replicas=2,
+                    engine_config=EngineConfig(
+                        decode_chunk=2, max_new_tokens=4,
+                        max_batch_size=1, num_slots=1, paged=True,
+                        page_size=4, backoff_base_s=0.0),
+                    config=FleetConfig(migrate_min_tokens=8))
+    try:
+        h0 = router.submit(np.concatenate(
+            [shared, np.asarray([5, 7], np.int32)]))
+        router.run_pending()
+        hs = [router.submit(np.concatenate(
+            [shared, np.asarray([6 + i, 8], np.int32)]))
+            for i in range(2)]
+        router.run_pending()
+        assert h0.done() and all(h.done() for h in hs)
+        from deeplearning4j_tpu.observability.export import \
+            prometheus_text
+        text = prometheus_text(router.registry)
+        fed = router.federated_text()
+    finally:
+        router.close()
+    types = _types(text)
+    assert types["serving_fleet_affinity_hits_total"] == "counter"
+    assert types["serving_fleet_affinity_misses_total"] == "counter"
+    assert types["serving_fleet_affinity_mispredicts_total"] \
+        == "counter"
+    assert types["serving_fleet_kv_migrations_total"] == "counter"
+    assert types["serving_fleet_kv_migrated_tokens_total"] == "counter"
+    assert types["serving_fleet_kv_migrated_bytes_total"] == "counter"
+    # the traffic really exercised the series
+    assert "serving_fleet_affinity_hits_total 0" not in text
+    assert 'serving_fleet_kv_migrations_total{outcome="ok"} 0' \
+        not in text
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+    # the FEDERATED scrape carries the engine-side affinity series
+    # (prefill-token accounting + adoption outcomes) lint-clean
+    fed_types = _types(fed)
+    assert fed_types["serving_prefill_tokens_total"] == "counter"
+    assert fed_types["serving_kv_adoptions_total"] == "counter"
+    assert fed_types["serving_fleet_kv_migrations_total"] == "counter"
+    for name, kind in fed_types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):

@@ -414,9 +414,13 @@ def test_end_to_end_scrape_serving_training_prefetch(params, mesh1):
     from deeplearning4j_tpu.train.listeners import PerformanceListener
 
     reg = MetricsRegistry()
+    # pipeline=False: the training_samples assertion below counts
+    # listener batches, which track the SYNC loop's tick structure
+    # (the pipelined default adds a commit-only tick)
     eng = InferenceEngine(CFG, mesh1, params,
                           EngineConfig(decode_chunk=0,
-                                       max_new_tokens=4),
+                                       max_new_tokens=4,
+                                       pipeline=False),
                           registry=reg)
     eng.set_listeners(PerformanceListener(frequency=1, report=False,
                                           registry=reg))

@@ -232,7 +232,11 @@ def test_deadline_partial_returns_decoded_prefix(params, mesh1):
     ref.run_pending()
 
     inj = ServingFaultInjector(delay_at={1: 0.08})
-    eng = InferenceEngine(CFG, mesh1, params, _config(),
+    # pinned synchronous: the ≥1-token partial guarantee under a
+    # wall-clock deadline is a sync-loop property (the pipelined loop
+    # sheds at the COMMIT boundary — its own deadline semantics are
+    # covered in tests/test_serving_pipeline.py)
+    eng = InferenceEngine(CFG, mesh1, params, _config(pipeline=False),
                           fault_injector=inj)
     h = eng.submit(_prompt(), deadline_s=0.04, on_deadline="partial")
     eng.run_pending()

@@ -20,8 +20,10 @@ The overlap guarantees, each proven deterministically on CPU:
   restores the last committed state and isolates token-exactly,
   deadline/cancel shed at the commit boundary, and a hot reload
   discards in-flight uncommitted tokens exactly as documented;
-- spec_decode and batch mode reject the knob (commit counts must be
-  deterministic to schedule ahead).
+- spec_decode and batch mode auto-fall-back to the synchronous loop
+  (commit counts must be deterministic to schedule ahead) — with
+  pipeline the DEFAULT since ISSUE-14, bit-identically and with a
+  warning instead of a constructor rejection.
 """
 import numpy as np
 import jax
@@ -146,13 +148,13 @@ def test_pipeline_depth_bounded_at_one(params, mesh1):
 def test_pipeline_off_bit_identical_with_unchanged_cache_keys(
         params, mesh1):
     """pipeline=False keeps the PR-11 synchronous loop: a fresh
-    default-config engine serves the reference tokens with ZERO new
-    compiled-program cache entries beyond the already-warm geometry —
-    the unchanged-cache-keys guard."""
+    opted-out engine serves the (pipelined-default) reference tokens
+    with ZERO new compiled-program cache entries beyond the
+    already-warm geometry — the unchanged-cache-keys guard."""
     _, ref = _run(mesh1, params, PROMPTS())          # warms geometry
     with assert_no_recompiles(_compiled_prefill,
                               _compiled_decode_chunk):
-        eng, hs = _run(mesh1, params, PROMPTS())
+        eng, hs = _run(mesh1, params, PROMPTS(), pipeline=False)
     for a, b in zip(ref, hs):
         np.testing.assert_array_equal(a.result(0), b.result(0))
     assert eng.health()["pipeline"] is False
@@ -333,13 +335,41 @@ def test_worker_thread_drives_pipelined_engine(params, mesh1):
         np.testing.assert_array_equal(a.result(0), b)
 
 
-def test_pipeline_validation(params, mesh1):
-    with pytest.raises(ValueError, match="continuous"):
-        InferenceEngine(CFG, mesh1, params,
-                        _config(mode="batch", pipeline=True))
-    with pytest.raises(ValueError, match="spec_decode"):
-        InferenceEngine(CFG, mesh1, params,
-                        _config(pipeline=True, spec_decode=True))
+def test_pipeline_default_on_with_auto_fallback(params, mesh1,
+                                                caplog):
+    """ISSUE-14 satellite: pipeline defaults ON now that it has
+    soaked, and the spec_decode / batch-mode incompatibilities
+    AUTO-FALL-BACK to the synchronous loop with a warning instead of
+    rejecting the constructor."""
+    assert EngineConfig().pipeline is True
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    assert eng.health()["pipeline"] is True
+    import logging
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        batch = InferenceEngine(CFG, mesh1, params,
+                                _config(mode="batch", pipeline=True))
+        spec = InferenceEngine(CFG, mesh1, params,
+                               _config(pipeline=True, spec_decode=True,
+                                       spec_k=2, draft="self"))
+    assert batch._pipe is False and spec._pipe is False
+    assert spec.health()["pipeline"] is False
+    text = caplog.text
+    assert "falling back to the synchronous loop" in text
+    assert "spec_decode" in text
+
+
+def test_spec_fallback_bit_identical_to_sync(params, mesh1):
+    """ISSUE-14 satellite regression: a spec_decode engine built with
+    the (now-default) pipeline=True falls back to the synchronous loop
+    BIT-identically to one built with pipeline=False."""
+    outs = {}
+    for pipeline in (False, True):
+        eng, hs = _run(mesh1, params, PROMPTS(), pipeline=pipeline,
+                       spec_decode=True, spec_k=2, draft="self")
+        assert eng._pipe is False
+        outs[pipeline] = [h.result(0) for h in hs]
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_idle_fraction_gauge_and_debugz_section(params, mesh1):
