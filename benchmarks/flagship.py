@@ -2313,6 +2313,137 @@ def bench_cold_start(reps: int = 2, *, seed: int = 0) -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_profiling_overhead(reps: int = 2, *, n_requests: int = 72,
+                             seed: int = 0) -> dict:
+    """Continuous profiling & cost attribution overhead (ISSUE-15
+    acceptance: ≤ 2% tokens/sec vs the NULL profiler) — plus the
+    per-program roofline table and the per-tenant cost breakdown the
+    instrumented arm produces.
+
+    One mixed-length, 4-tenant trace (70% short / 30% long, the
+    engine_slo shape) drives two CONTINUOUS engines that differ ONLY
+    in the profiler injection: the default live EngineProfiler +
+    TenantMeter (cost table capture, per-tick device attribution,
+    per-commit tenant billing) vs profiler=NULL_PROFILER (every
+    profiling call a no-op; both arms keep a live registry + flight
+    recorder, so the delta isolates the NEW subsystem). Interleaved
+    best-of bursts (engine_slo's design: burst replays measure the
+    subsystem, not sleep-granularity jitter). In-bench asserts:
+    overhead ≤ 2%, token-exact across arms, per-tenant bills sum
+    EXACTLY to the engine totals, and the cost table covers every
+    dispatched program."""
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.observability.profiling import NULL_PROFILER
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
+                            n_layers=3, max_len=128)
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(seed)
+    tenants = ["acme", "beta", "gamma", "delta"]
+    events = []
+    for i in range(n_requests):
+        if rng.random() < 0.7:
+            plen, nt = int(rng.integers(6, 17)), 8
+        else:
+            plen, nt = int(rng.integers(33, 65)), 32
+        events.append((rng.integers(0, cfg.vocab_size,
+                                    plen).astype(np.int32), nt,
+                       tenants[i % len(tenants)]))
+    total_new = sum(nt for _, nt, _ in events)
+    econf = EngineConfig(max_batch_size=8, max_queue=4 * n_requests,
+                         max_new_tokens=32, decode_chunk=8,
+                         degrade_queue_depth=10 ** 6)
+
+    def make_engine(profiled: bool):
+        return InferenceEngine(
+            cfg, mesh, params, econf,
+            **({} if profiled else {"profiler": NULL_PROFILER}))
+
+    def burst(profiled: bool):
+        eng = make_engine(profiled)
+        t0 = _t.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=nt, tenant=t)
+              for p, nt, t in events]
+        eng.run_pending()
+        dt = _t.perf_counter() - t0
+        assert all(h.done() for h in hs)
+        return dt, eng, [h.result(0) for h in hs]
+
+    _, _, ref = burst(False)               # warm: compile every bucket
+    _, _, got = burst(True)
+    for a, b in zip(ref, got):             # token-exact across arms
+        np.testing.assert_array_equal(a, b)
+    # PAIRED per-round ratios, order alternated (the min-of-mins
+    # estimator drifts ±3% run-to-run on this container when
+    # machine-wide load is phase-correlated with one arm; a
+    # back-to-back pair shares its round's conditions, so the median
+    # ratio cancels drift AND ordering bias)
+    ratios = []
+    prof = float("inf")
+    eng_prof = None
+    for r in range(max(8, 4 * reps)):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        times = {}
+        for arm in order:
+            dt, eng, _ = burst(arm)
+            times[arm] = dt
+            if arm and dt < prof:
+                prof, eng_prof = dt, eng
+        ratios.append(times[True] / times[False])
+    bare = prof / sorted(ratios)[len(ratios) // 2]
+    overhead_pct = 100.0 * (sorted(ratios)[len(ratios) // 2] - 1.0)
+    assert overhead_pct <= 2.0, \
+        f"profiling overhead {overhead_pct:.2f}% exceeds the 2% bound"
+
+    rep = eng_prof.profile_report()
+    # the cost table covers every dispatched program, with rates
+    for label, row in rep["programs"].items():
+        assert row["flops_per_invocation"] > 0, label
+        assert row["invocations"] > 0, label
+    # per-tenant bills sum EXACTLY to the engine totals
+    tcosts = rep["tenant_costs"]["tenants"]
+    assert set(tcosts) == set(tenants)
+    fam = eng_prof.registry.get("serving_request_cost_flops")
+    counter_total = sum(c.value for _, c in fam.collect())
+    assert counter_total == sum(v["flops"] for v in tcosts.values())
+    bills = [e.data["cost_flops"]
+             for e in eng_prof.recorder.recent(100_000)
+             if e.kind == "finished"]
+    assert len(bills) == n_requests
+    assert abs(sum(bills) - counter_total) <= 1e-6 * counter_total
+
+    programs = {l: {"flops_per_invocation": row["flops_per_invocation"],
+                    "device_seconds": round(row["device_seconds"], 4),
+                    "intensity_flops_per_byte":
+                        row["intensity_flops_per_byte"],
+                    "bound": row["bound"]}
+                for l, row in rep["programs"].items()}
+    return {"config": f"profiling_overhead_{n_requests}req_4tenants",
+            "value": round(overhead_pct, 2),
+            "unit": "pct_overhead_profiled_vs_null",
+            "bound_pct": 2.0,
+            "profiled_tokens_per_sec": round(total_new / prof, 1),
+            "bare_tokens_per_sec": round(total_new / bare, 1),
+            "mfu": rep["mfu"],
+            "achieved_flops_per_s": rep["achieved_flops_per_s"],
+            "programs": programs,
+            "tenant_costs": {t: {"flops": v["flops"],
+                                 "prefill_tokens": v["prefill_tokens"],
+                                 "decode_tokens": v["decode_tokens"]}
+                             for t, v in tcosts.items()},
+            "token_exact": True, "bills_sum_exact": True}
+
+
 def bench_word2vec(reps: int = 2) -> dict:
     """Word2Vec skip-gram+neg at the reference-workload-class vocab
     (v=100k) — the driver-captured row VERDICT r5 weak #2 demanded
@@ -2347,6 +2478,7 @@ BENCHES = {"transformer": bench_transformer,
            "prefix_affinity": bench_prefix_affinity,
            "fleet_obs": bench_fleet_obs,
            "cold_start": bench_cold_start,
+           "profiling_overhead": bench_profiling_overhead,
            "word2vec": bench_word2vec}
 
 

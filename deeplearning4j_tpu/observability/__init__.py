@@ -36,6 +36,12 @@ one substrate they all publish into:
   registry snapshots into ONE fleet scrape (counters summed and
   histograms bucket-merged under `tier=`, gauges kept per-replica
   under `tier=`/`replica=`), with a series-cardinality guard.
+- `profiling` — continuous profiling & cost attribution (ISSUE-15):
+  `EngineProfiler` (per-program XLA cost table, per-tick device-time
+  attribution, live `serving_mfu`, roofline classification),
+  `TenantMeter` (per-tenant analytic FLOP/byte metering with a
+  top-N + "other" cardinality bound), and `ProfileCapture`
+  (single-flight `/profilez?seconds=N` jax.profiler capture).
 
 Publishers: `serving.InferenceEngine` (queue/batch/shed/quarantine/
 retry/breaker/decode-latency; `health()` is registry-backed),
@@ -66,3 +72,7 @@ from deeplearning4j_tpu.observability.stitch import (  # noqa: F401
 from deeplearning4j_tpu.observability.federation import (  # noqa: F401
     DEFAULT_SERIES_BUDGET, check_cardinality, merge_snapshots,
     series_cardinality)
+from deeplearning4j_tpu.observability.profiling import (  # noqa: F401
+    DEFAULT_TENANT, EngineProfiler, NULL_PROFILER, NullProfiler,
+    OTHER_TENANT, ProfileCapture, TenantMeter, cost_from_compiled,
+    roofline)

@@ -185,6 +185,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     slo_fn: Optional[Callable] = None
     timeline_fn: Optional[Callable] = None
     snapshot_fn: Optional[Callable] = None   # federated view override
+    profilez_fn: Optional[Callable] = None   # on-demand capture
 
     def log_message(self, *args) -> None:   # silence request logging
         pass
@@ -268,6 +269,29 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self._send_callable_json(cls.slo_fn)
         elif path == "/timeline.json":
             self._send_callable_json(cls.timeline_fn)
+        elif path == "/profilez":
+            # on-demand profiler capture (ISSUE-15):
+            # GET /profilez?seconds=N starts one bounded jax.profiler
+            # trace. The callable owns the status semantics — it
+            # returns (code, body): 200 started, 503 unsupported/busy
+            # (single-flight), 400 bad seconds — because "cannot
+            # capture right now" is an HTTP condition, not a server
+            # error
+            if cls.profilez_fn is None:
+                self._send(404, b'{"error": "not wired"}',
+                           "application/json")
+                return
+            from urllib.parse import parse_qs
+            qs = parse_qs(urlparse(self.path).query)
+            seconds = (qs.get("seconds") or ["1.0"])[0]
+            try:
+                code, body = cls.profilez_fn(seconds)
+                self._send(int(code), json.dumps(body).encode(),
+                           "application/json")
+            except Exception as e:
+                self._send(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode(),
+                    "application/json")
         else:
             self._send(404, b'{"error": "not found"}',
                        "application/json")
@@ -297,6 +321,12 @@ class MetricsServer:
     a callable returning a JSON-schema snapshot (the `json_snapshot`
     shape) rendered per scrape — wire `Router.federate` here and the
     router's port serves the whole FLEET's merged series (ISSUE-13).
+
+    ``profilez`` wires `GET /profilez?seconds=N` (ISSUE-15): a
+    callable taking the seconds value and returning ``(status, body)``
+    — wire `engine.profilez` (single-flight bounded `jax.profiler`
+    capture, 503 when unsupported or already capturing) or
+    `Router.profilez` for the fleet-fanned version.
     """
 
     def __init__(self, registry=None, port: int = 0,
@@ -305,14 +335,16 @@ class MetricsServer:
                  debug: Optional[Callable] = None,
                  slo: Optional[Callable] = None,
                  timeline: Optional[Callable] = None,
-                 snapshot: Optional[Callable] = None):
+                 snapshot: Optional[Callable] = None,
+                 profilez: Optional[Callable] = None):
         self.registry = (registry if registry is not None
                          else default_registry())
         handler = type("BoundMetricsHandler", (_MetricsHandler,),
                        {"registry": self.registry, "health_fn": health,
                         "ready_fn": ready, "debug_fn": debug,
                         "slo_fn": slo, "timeline_fn": timeline,
-                        "snapshot_fn": snapshot})
+                        "snapshot_fn": snapshot,
+                        "profilez_fn": profilez})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

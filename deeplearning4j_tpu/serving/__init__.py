@@ -109,6 +109,18 @@ the layer between callers and the compiled decode step:
   & raw speed"). spec_decode/batch configs auto-fall-back to the
   synchronous loop bit-identically.
 
+- Continuous profiling & cost attribution (round 20, ISSUE-15):
+  every compiled serving program's XLA cost analysis lands in a
+  per-engine cost table at resolve time (AOT-cache entries persist it
+  beside the executable), the tick loop attributes device-busy time
+  across the programs dispatched each tick
+  (`serving_program_device_seconds_total{program}`, a live
+  `serving_mfu` gauge, per-program roofline classifications), and
+  `submit(tenant=)` meters per-tenant analytic FLOPs/bytes with a
+  top-N + "other" cardinality bound — `Router.cost_report()` is the
+  fleet-wide bill, `/profilez?seconds=N` the on-demand jax.profiler
+  capture (docs/observability.md "Profiling & cost attribution").
+
 Lifecycle and thresholds: docs/serving.md.
 """
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401

@@ -64,6 +64,11 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 _MAGIC = b"DL4JAOT1"
 _FORMAT_VERSION = 1
+# version of the OPTIONAL meta sidecar framed beside the executable
+# (ISSUE-15: cost analysis). Deliberately NOT part of the entry key:
+# a pre-meta entry must keep loading its executable (degrading to a
+# lazy cost recompute), never become a cache miss.
+_META_VERSION = 1
 _STAGING_SUFFIX = ".aot-tmp"
 
 
@@ -169,18 +174,32 @@ class CompileCache:
         """Deserialize-and-load the entry's executable, or None on any
         miss/corruption (corrupt entries are deleted so the follow-up
         store publishes a clean one)."""
+        fn, _ = self.load_entry(key)
+        return fn
+
+    def load_entry(self, key: str
+                   ) -> "tuple[Optional[Callable], Optional[dict]]":
+        """(executable, meta) for one entry — ``meta`` is the sidecar
+        dict stored beside the executable (ISSUE-15: the program's XLA
+        cost analysis, so a cache-warm restart has a complete cost
+        table with ZERO compiles). The frame field is versioned
+        in-payload: a pre-meta entry (the 3-tuple frame rounds 17-19
+        wrote) still loads its executable fine and returns meta=None —
+        the caller lazily recomputes the analysis from the loaded
+        executable. Old entries degrade, they NEVER become cache
+        misses."""
         p = self.path(key)
         try:
             blob = p.read_bytes()
         except FileNotFoundError:
             with self._lock:
                 self.misses += 1
-            return None
+            return None, None
         except OSError as e:
             log.warning("AOT cache: unreadable entry %s (%s)", p, e)
             with self._lock:
                 self.misses += 1
-            return None
+            return None, None
         try:
             if blob[:len(_MAGIC)] != _MAGIC:
                 raise ValueError("bad magic")
@@ -190,7 +209,21 @@ class CompileCache:
             if zlib.crc32(payload) != crc:
                 raise ValueError("payload CRC mismatch")
             from jax.experimental import serialize_executable as se
-            serialized, in_tree, out_tree = pickle.loads(payload)
+            frame = pickle.loads(payload)
+            meta: Optional[dict] = None
+            if len(frame) == 3:              # pre-meta frame (v1)
+                serialized, in_tree, out_tree = frame
+            elif len(frame) == 4:
+                serialized, in_tree, out_tree, meta = frame
+                if (not isinstance(meta, dict)
+                        or int(meta.get("meta_version", 0))
+                        > _META_VERSION):
+                    # a NEWER meta schema than this runtime knows:
+                    # the executable is still valid — keep it, drop
+                    # the sidecar (lazy recompute covers it)
+                    meta = None
+            else:
+                raise ValueError(f"unknown frame arity {len(frame)}")
             fn = se.deserialize_and_load(serialized, in_tree, out_tree)
         except Exception as e:
             # corrupt / foreign / version-skewed entry: fail CLOSED to
@@ -205,18 +238,25 @@ class CompileCache:
                 p.unlink()
             except OSError:
                 pass
-            return None
+            return None, None
         with self._lock:
             self.hits += 1
-        return fn
+        return fn, meta
 
-    def store(self, key: str, compiled) -> bool:
+    def store(self, key: str, compiled,
+              meta: Optional[dict] = None) -> bool:
         """Serialize ``compiled`` (a `jax.stages.Compiled`) and publish
-        it atomically. Returns False — never raises — when the backend
-        cannot serialize or the write fails."""
+        it atomically — with an optional ``meta`` sidecar dict
+        (ISSUE-15: the cost analysis) framed beside it under a
+        versioned field. Returns False — never raises — when the
+        backend cannot serialize or the write fails."""
         try:
             from jax.experimental import serialize_executable as se
-            payload = pickle.dumps(se.serialize(compiled))
+            frame = se.serialize(compiled)
+            if meta is not None:
+                meta = dict(meta, meta_version=_META_VERSION)
+                frame = (*frame, meta)
+            payload = pickle.dumps(frame)
         except Exception as e:
             log.warning("AOT cache: backend cannot serialize %s (%s); "
                         "entry skipped", key, e)

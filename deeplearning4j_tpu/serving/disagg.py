@@ -475,6 +475,9 @@ class TieredRouter(Router):
             mig, fr._migrate_kv = fr._migrate_kv, None
             if mig is not None:
                 kw["kv"] = mig
+            if fr.tenant is not None:         # per-tenant metering
+                kw["tenant"] = fr.tenant      # (ISSUE-15): both hops
+            #                                   bill the same tenant
             hold = bool(getattr(ctl.replica, "supports_handoff",
                                 False))
             return ctl.replica.submit(prompt, 1, deadline_s,
@@ -484,6 +487,8 @@ class TieredRouter(Router):
         #                                       after any failure
         #                                       re-prefills instead
         kw = {"kv": kv} if kv is not None else {}
+        if fr.tenant is not None:
+            kw["tenant"] = fr.tenant
         return ctl.replica.submit(prompt, remaining, deadline_s,
                                   fr.on_deadline, trace_ctx=ctx, **kw)
 

@@ -198,6 +198,32 @@ contracts). `pipeline=False` (default) keeps this loop bit-identical
 to the synchronous PR-11 one. See docs/serving.md "Engine internals
 & raw speed".
 
+Continuous profiling & cost attribution (round 20, ISSUE-15,
+observability/profiling.py): `_resolve_program` captures every
+compiled program's XLA cost analysis (FLOPs + bytes accessed) into a
+per-engine cost table — jit compiles, in-memory hits, AND AOT-cache
+loads (the analysis is persisted beside the cached executable, so a
+cache-warm restart has a complete table with zero compiles; pre-meta
+entries lazily recompute it from the loaded executable). The tick
+loop attributes each tick's device-busy interval across the programs
+dispatched in it (`serving_program_device_seconds_total{program}`,
+`serving_program_flops_total{program}`), a live `serving_mfu` gauge
+tracks achieved FLOP/s against the chip's peak, and each program gets
+a roofline classification (arithmetic intensity vs the chip's ridge
+point → compute- or memory-bound) in `profile_report()`/`debugz()`.
+`submit(tenant=)` meters per-tenant analytic cost — tokens actually
+computed (prefix-cache hits and migrated chains bill only the
+recompute) x the per-token program cost — into
+`serving_request_cost_{flops,bytes}_total{tenant}` under a top-N +
+"other" label bound; per-request bills accumulate on
+`handle.cost_flops` and ride the terminal trace event.
+`EngineConfig(profile_dir=)` + `engine.profilez(seconds)` back the
+`/profilez?seconds=N` on-demand jax.profiler capture (single-flight,
+503 when unsupported). `profiler=observability.NULL_PROFILER`
+disables it all by injection — the profiling_overhead benchmark's
+off arm (≤ 2% bound, BASELINE.md). See docs/observability.md
+"Profiling & cost attribution".
+
 Every behavior is deterministically testable on the CPU backend via
 `parallel.failure.ServingFaultInjector` — see
 tests/test_serving_engine.py and docs/serving.md.
@@ -221,6 +247,8 @@ from deeplearning4j_tpu.observability.events import (FlightRecorder,
                                                      NULL_TRACE)
 from deeplearning4j_tpu.observability.metrics import (
     DECODE_LATENCY_BUCKETS, MetricsRegistry, NullRegistry)
+from deeplearning4j_tpu.observability.profiling import (
+    EngineProfiler, NULL_PROFILER, ProfileCapture, cost_from_compiled)
 from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
 from deeplearning4j_tpu.parallel.serving import (
     init_paged_state, init_slot_state, make_chunked_prefill,
@@ -476,6 +504,20 @@ class EngineConfig:
     # for its fleet timeline), so the bound is finally a config knob.
     # Ignored when an explicit recorder= is injected.
     recorder_capacity: int = 4096
+    # continuous profiling & cost attribution (ISSUE-15).
+    # ``profile_dir`` enables the on-demand `/profilez?seconds=N`
+    # jax.profiler capture into that directory (None = the endpoint
+    # answers 503 unsupported). ``tenant_top_n`` bounds the tenant
+    # label cardinality of the per-tenant cost counters: the first N
+    # distinct tenants get their own label, later ones fold into
+    # "other" — a hostile tenant-id stream cannot explode the scrape.
+    # The profiler itself (per-program cost table, device-time
+    # attribution, serving_mfu, rooflines) defaults ON with a live
+    # registry and OFF with NULL_REGISTRY, exactly like the flight
+    # recorder; inject profiler=observability.NULL_PROFILER for the
+    # profiling-disabled arm (the profiling_overhead bench).
+    profile_dir: Optional[str] = None
+    tenant_top_n: int = 8
 
 
 class RequestHandle:
@@ -491,6 +533,13 @@ class RequestHandle:
         self.status = RequestStatus.QUEUED
         self.error: Optional[BaseException] = None
         self.deadline_exceeded = False
+        # per-tenant cost metering (ISSUE-15): the tenant label this
+        # request bills under, and its accumulated analytic bill —
+        # sum(handle.cost_flops) over a run equals the
+        # serving_request_cost_flops_total counters by construction
+        self.tenant: Optional[str] = None
+        self.cost_flops = 0.0
+        self.cost_bytes = 0.0
         self._cancelled = False
         self._hold_kv = False            # keep slot seated when done
         self._kv = None                  # KVHandoff to adopt at seat
@@ -960,7 +1009,7 @@ class InferenceEngine:
                  registry=None,
                  quantize: Optional[str] = None,
                  kv_quantize: Optional[str] = None,
-                 recorder=None, slo=None):
+                 recorder=None, slo=None, profiler=None):
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or EngineConfig()
@@ -1190,6 +1239,20 @@ class InferenceEngine:
             slo = (NULL_SLO if not recorder.enabled
                    else SLOTracker(registry=self.registry))
         self.slo = slo
+        # continuous profiling & cost attribution (ISSUE-15): the
+        # per-program cost table + device-time attribution + tenant
+        # meter. Defaults ON with a live registry, mirroring the
+        # recorder; profiler=NULL_PROFILER is the disabled arm of the
+        # profiling_overhead benchmark.
+        if profiler is None:
+            profiler = (NULL_PROFILER
+                        if isinstance(self.registry, NullRegistry)
+                        else EngineProfiler(
+                            self.registry,
+                            tenant_top_n=self.config.tenant_top_n))
+        self.profiler = profiler
+        self._decode_bill_label: Optional[str] = None
+        self._capture = ProfileCapture(self.config.profile_dir)
         # cold-start warm-up (ISSUE-12): resolve the whole closed
         # program set before the constructor returns — from the AOT
         # cache when warm, so restart-to-ready is a load, not a compile
@@ -1438,7 +1501,8 @@ class InferenceEngine:
                on_deadline: str = "shed",
                hold_kv: bool = False,
                kv: Optional[KVHandoff] = None,
-               trace_ctx: Optional[dict] = None) -> RequestHandle:
+               trace_ctx: Optional[dict] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Admit one prompt. Raises OverloadError when the queue is full
         or the circuit breaker is open; in degraded mode the token
         budget is silently capped (reported via health()).
@@ -1537,6 +1601,12 @@ class InferenceEngine:
                 on_deadline)
             handle._hold_kv = bool(hold_kv)
             handle._kv = kv
+            # per-tenant cost metering (ISSUE-15): the tenant label
+            # rides the handle AND every trace event (via the submit
+            # event) so the bill and the forensic trace agree on who
+            # the work was for
+            handle.tenant = (str(tenant) if tenant is not None
+                             else None)
             handle.trace = self.recorder.start_trace(handle.rid,
                                                      ctx=trace_ctx)
             handle._on_terminal = self._on_terminal
@@ -1544,7 +1614,9 @@ class InferenceEngine:
                 "submit", prompt_tokens=int(prompt.shape[0]),
                 max_new_tokens=int(eff),
                 deadline_s=(float(deadline_s)
-                            if deadline_s is not None else None))
+                            if deadline_s is not None else None),
+                **({"tenant": handle.tenant}
+                   if handle.tenant is not None else {}))
             self._queue.append(handle)
             handle.trace.add("queued", depth=len(self._queue))
             self._cv.notify()
@@ -1554,19 +1626,28 @@ class InferenceEngine:
         """RequestHandle._finish hook: terminal trace event + SLO
         accounting — runs exactly once, whatever path finished the
         request (complete / deadline shed / partial / quarantine)."""
+        # the request's accumulated analytic bill (ISSUE-15) rides its
+        # terminal event — the audit trail for "sum of per-request
+        # bills == the per-tenant counters" (shed/quarantined requests
+        # billed the compute they consumed before dying)
+        bill = ({"cost_flops": float(r.cost_flops),
+                 "cost_bytes": float(r.cost_bytes),
+                 **({"tenant": r.tenant}
+                    if r.tenant is not None else {})}
+                if self.profiler.enabled else {})
         if r.status == RequestStatus.COMPLETED:
             r.trace.add("finished",
                         tokens=int(sum(a.shape[0]
                                        for a in r._generated)),
-                        partial=bool(r.deadline_exceeded))
+                        partial=bool(r.deadline_exceeded), **bill)
         elif r.status == RequestStatus.SHED:
             r.trace.add("shed", reason=(
                 "handoff" if r._handoff_failed
                 else "cancelled" if r._cancelled
                 else "deadline" if r.deadline_exceeded
-                else "overload"))
+                else "overload"), **bill)
         elif r.status == RequestStatus.QUARANTINED:
-            r.trace.add("quarantined")
+            r.trace.add("quarantined", **bill)
         self.slo.finished(r.trace)
 
     # ------------------------------------------------------------------
@@ -1858,6 +1939,13 @@ class InferenceEngine:
         ev = r.trace.add(kind, tokens=int(toks.shape[0]), **data)
         if first:
             self.slo.first_token(r.trace, ev.ts)
+        if kind == "decode_chunk":
+            # per-tenant decode billing (ISSUE-15): committed tokens x
+            # the per-token analytic cost of the decode program that
+            # produced them (prefill tokens bill at their own call
+            # sites — a prefill_done's sampled token is prefill work)
+            self.profiler.bill_tokens(r, self._decode_bill_label,
+                                      int(toks.shape[0]), "decode")
 
     # ------------------------------------------------------------------
     # continuous batching: slot-pool scheduling
@@ -1876,6 +1964,7 @@ class InferenceEngine:
         them from the queue."""
         self._tick_perf0 = _perf()
         self._tick_sync_count = 0
+        self.profiler.tick_begin()
         t_start = self._clock()
         params = self._params    # admissions + this chunk share a tree
         admitted = self._fill_slots()
@@ -1917,6 +2006,9 @@ class InferenceEngine:
         if wall > 0:
             self._last_idle = min(1.0, max(
                 0.0, 1.0 - self._tick_busy_s / wall))
+        # device-time attribution (ISSUE-15): this tick's busy
+        # interval splits across the programs dispatched in it
+        self.profiler.tick_end(self._tick_busy_s)
         self._busy_total_s += self._tick_busy_s
         self._tick_busy_s = 0.0
         self._last_tick_syncs = self._tick_sync_count
@@ -2075,6 +2167,14 @@ class InferenceEngine:
                                      self._m_prefill_seconds,
                                      prefill=True, chunked=True)
         self._slot_state = state
+        # per-tenant prefill billing (ISSUE-15): the chunk tokens each
+        # slot actually advanced this call (partial chunks bill to the
+        # token; a prefix-hit resume never re-bills the cached prefix)
+        bill_label = ("paged_chunked_prefill" if self._paged
+                      else "chunked_prefill")
+        for i, r, n in plan:
+            self.profiler.bill_tokens(r, bill_label, int(n),
+                                      "prefill")
         finished = []
         for i, r, n in plan:
             with self._lock:
@@ -2128,6 +2228,7 @@ class InferenceEngine:
         cancel, isolation, reload, fleet failover) is built on."""
         self._tick_perf0 = _perf()
         self._tick_sync_count = 0
+        self.profiler.tick_begin()
         t_start = self._clock()
         params = self._params
         admitted = self._fill_slots()
@@ -3039,11 +3140,17 @@ class InferenceEngine:
         path. Any AOT-side failure falls back to the lazy jit callable
         — availability over purity."""
         fn = factory(*fargs, **fkw)
+        label = self._program_label(program, fargs)
         if example_args is None:
+            # batch-mode generate: per-call shapes, no fixed geometry
+            # to cost — invocations still count under the bare label
+            self.profiler.dispatched(label)
             return fn
+        ptokens = self._program_tokens(program, fargs)
         slot = factory.entry(*fargs, **fkw)
         exe = slot.get("exec")
         if exe is not None:
+            self._profile_program(label, slot, exe, ptokens)
             if self._aot is not None:
                 # resolved earlier in-process (possibly by an engine
                 # without a cache dir): publish it so the NEXT process
@@ -3055,7 +3162,9 @@ class InferenceEngine:
                         (fargs[0], *fargs[2:],
                          tuple(sorted(fkw.items()))))
                     if not self._aot.path(key).exists():
-                        self._aot.store(key, exe)
+                        self._aot.store(key, exe,
+                                        meta={"cost":
+                                              slot.get("cost") or {}})
                     slot[pub] = True
             return exe
         key = None
@@ -3067,12 +3176,19 @@ class InferenceEngine:
             key = self._aot.entry_key(
                 program, self.mesh,
                 (fargs[0], *fargs[2:], tuple(sorted(fkw.items()))))
-            exe = self._aot.load(key)
+            exe, meta = self._aot.load_entry(key)
             if exe is not None:
                 self._m_compile_seconds.labels(program).observe(
                     _perf() - t0)
                 self._m_compiles.labels(program, "aot_cache").inc()
                 slot["exec"] = exe
+                # cost sidecar (ISSUE-15): persisted beside the cached
+                # executable; pre-meta entries (rounds 17-19) degrade
+                # to a lazy recompute from the LOADED executable —
+                # never a cache miss
+                if meta is not None and "cost" in meta:
+                    slot["cost"] = dict(meta["cost"])
+                self._profile_program(label, slot, exe, ptokens)
                 slot[("published", str(self._aot.directory))] = True
                 return exe
         try:
@@ -3080,14 +3196,62 @@ class InferenceEngine:
         except Exception as e:
             log.warning("AOT resolve of %s failed (%s); falling back "
                         "to lazy jit", program, e)
+            self.profiler.dispatched(label)
             return fn
         self._m_compile_seconds.labels(program).observe(_perf() - t0)
         self._m_compiles.labels(program, "jit").inc()
+        slot["cost"] = cost_from_compiled(exe)
         if self._aot is not None and key is not None:
-            self._aot.store(key, exe)
+            self._aot.store(key, exe, meta={"cost": slot["cost"]})
             slot[("published", str(self._aot.directory))] = True
         slot["exec"] = exe
+        self._profile_program(label, slot, exe, ptokens)
         return exe
+
+    # ------------------------------------------------------------------
+    # continuous profiling & cost attribution (ISSUE-15)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _program_label(program: str, fargs: tuple) -> str:
+        """Bounded-cardinality metric label for one compiled program:
+        the program name, plus the bucket for admission prefills (the
+        bucket ladder is log2-bounded) and K for speculative rounds —
+        the geometries whose per-invocation cost genuinely differs."""
+        if program in ("prefill", "paged_prefill"):
+            return f"{program}_b{int(fargs[2])}"
+        if program in ("spec_decode", "paged_spec_decode"):
+            return f"{program}_k{int(fargs[2])}"
+        return program
+
+    def _program_tokens(self, program: str, fargs: tuple
+                        ) -> Optional[int]:
+        """Tokens one full invocation of ``program`` computes — the
+        denominator of the per-token analytic cost. Every continuous
+        program's factory signature carries (chunk-or-bucket,
+        num_slots) at positions 2 and 3; a speculative round scores
+        K+1 window positions per slot."""
+        if program in ("decode", "paged_decode", "prefill",
+                       "paged_prefill", "chunked_prefill",
+                       "paged_chunked_prefill"):
+            return int(fargs[2]) * int(fargs[3])
+        if program in ("spec_decode", "paged_spec_decode"):
+            return (int(fargs[2]) + 1) * int(fargs[3])
+        return None
+
+    def _profile_program(self, label: str, slot: dict, exe,
+                         ptokens: Optional[int]) -> None:
+        """Install ``label``'s cost into the profiler table (lazily
+        recomputing the analysis from the executable when no sidecar
+        survived) and record the dispatch for this tick's device-time
+        attribution."""
+        if self.profiler.enabled and not self.profiler.has_program(
+                label):
+            cost = slot.get("cost")
+            if cost is None:
+                cost = cost_from_compiled(exe)
+                slot["cost"] = cost
+            self.profiler.record_program(label, cost, ptokens)
+        self.profiler.dispatched(label)
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> dict:
         """Resolve the engine's whole CLOSED compiled-program set up
@@ -3269,8 +3433,14 @@ class InferenceEngine:
             o = fn(params, *state, prompts, plen, key)
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
-        return self._guarded(call, [r for _, r in entries],
-                             self._m_prefill_seconds, prefill=True)
+        out = self._guarded(call, [r for _, r in entries],
+                            self._m_prefill_seconds, prefill=True)
+        # per-tenant prefill billing (ISSUE-15): every prompt token
+        # this call actually computed, at this bucket's analytic rate
+        for i, r in entries:
+            self.profiler.bill_tokens(r, f"prefill_b{int(tb)}",
+                                      int(plen[i]), "prefill")
+        return out
 
     def _call_chunk(self, params, state, entries):
         """One guarded decode chunk over ``state`` for the occupied
@@ -3293,6 +3463,7 @@ class InferenceEngine:
              self._num_slots, float(self.config.temperature),
              int(self.config.top_k), float(self.config.top_p)),
             self._quant_kwargs(), (params, *state, active, rem, key))
+        self._decode_bill_label = "decode"
         n_state = len(state)
 
         def call():
@@ -3342,8 +3513,14 @@ class InferenceEngine:
             o = fn(params, *state, bt, suffix, slen, start, key)
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
-        return self._guarded(call, [r for _, r in entries],
-                             self._m_prefill_seconds, prefill=True)
+        out = self._guarded(call, [r for _, r in entries],
+                            self._m_prefill_seconds, prefill=True)
+        # per-tenant prefill billing (ISSUE-15): the SUFFIX lengths —
+        # prefix-cache hits bill only the tokens actually recomputed
+        for i, r in entries:
+            self.profiler.bill_tokens(r, f"paged_prefill_b{int(tb)}",
+                                      int(slen[i]), "prefill")
+        return out
 
     def _call_chunk_paged(self, params, state, entries):
         """Paged decode chunk: contiguous contract + the block table
@@ -3368,6 +3545,7 @@ class InferenceEngine:
              int(self.config.top_k), float(self.config.top_p)),
             self._quant_kwargs(), (params, *state, bt, active, rem,
                                    key))
+        self._decode_bill_label = "paged_decode"
         n_state = len(state)
 
         def call():
@@ -3549,6 +3727,7 @@ class InferenceEngine:
                  draft_quantized=self._draft_qmode,
                  draft_layers=self._draft_layers),
             (params, dparams, *state, active, rem, poison, key))
+        self._decode_bill_label = f"spec_decode_k{self._spec_cur_k}"
         n_state = len(state)
 
         def call():
@@ -3588,6 +3767,8 @@ class InferenceEngine:
                  draft_quantized=self._draft_qmode,
                  draft_layers=self._draft_layers),
             (params, dparams, *state, bt, active, rem, poison, key))
+        self._decode_bill_label = \
+            f"paged_spec_decode_k{self._spec_cur_k}"
         n_state = len(state)
 
         def call():
@@ -3851,6 +4032,11 @@ class InferenceEngine:
              float(self.config.temperature), int(self.config.top_k),
              float(self.config.top_p)), qkw, None)
 
+        # batch-mode shapes vary per call, so "generate" carries no
+        # analytic rate: decode tokens still COUNT per tenant, the
+        # FLOP bill is continuous-mode-only (documented)
+        self._decode_bill_label = "generate"
+
         def call():
             return self._block_on(fn(params, jnp.asarray(prompts), key))
 
@@ -4010,6 +4196,11 @@ class InferenceEngine:
                 "aot": (self._aot.stats() if self._aot is not None
                         else None),
                 "last_warmup": self._last_warmup}
+        if self.profiler.enabled:
+            # profiling & cost attribution (ISSUE-15): live MFU,
+            # per-program rooflines, and the per-tenant bill — the
+            # "how fast COULD it have gone, and for whom" section
+            out["profiling"] = self.profiler.report()
         if self._prefill_chunk is not None:
             out["chunked_prefill"] = {
                 "prefill_chunk": self._prefill_chunk,
@@ -4040,6 +4231,22 @@ class InferenceEngine:
         e2e / queue-age percentiles + goodput — `GET /slo`'s body and
         the engine_slo benchmark's output."""
         return self.slo.report()
+
+    def profile_report(self) -> dict:
+        """Continuous-profiling report (ISSUE-15,
+        observability/profiling.py): chip peaks, live MFU, achieved
+        FLOP/s and bytes/s, the per-program cost/roofline table, and
+        the per-tenant bill — the `/slo`-style accounting surface."""
+        return self.profiler.report()
+
+    def profilez(self, seconds) -> tuple:
+        """`GET /profilez?seconds=N` backend (ISSUE-15): start one
+        bounded single-flight jax.profiler capture into
+        ``EngineConfig.profile_dir``; (503, ...) when no directory is
+        configured, the runtime lacks jax.profiler, or a capture is
+        already running. Returns ``(http_status, body_dict)`` — wire
+        via ``MetricsServer(profilez=engine.profilez)``."""
+        return self._capture.capture(seconds)
 
     def timeline(self, n: Optional[int] = None) -> dict:
         """Chrome/Perfetto trace_event JSON over the recorder's recent

@@ -202,6 +202,9 @@ class FleetHandle:
         self.status = RequestStatus.QUEUED
         self.error: Optional[BaseException] = None
         self.deadline_exceeded = False
+        # per-tenant cost metering (ISSUE-15): forwarded on every
+        # dispatch hop so the serving replica bills the right tenant
+        self.tenant: Optional[str] = None
         self.trace = NULL_TRACE
         self._committed = np.zeros((0,), np.int32)
         self._failover_from: Optional[int] = None
@@ -748,10 +751,11 @@ class SubprocessReplica:
 
     def submit(self, prompt, max_new_tokens, deadline_s, on_deadline,
                **kw):
-        # the hop's trace context DOES cross the pipe (ISSUE-13): the
-        # worker stamps it on every engine event so the shipped-back
-        # trace stays attributable; the KV-handoff knobs still don't
+        # the hop's trace context DOES cross the pipe (ISSUE-13), and
+        # so does the tenant label (ISSUE-15: the worker's engine
+        # bills the right tenant); the KV-handoff knobs still don't
         trace_ctx = kw.pop("trace_ctx", None)
+        tenant = kw.pop("tenant", None)
         if kw:
             log.warning("subprocess replica %d ignores submit "
                         "kwargs %s (no cross-pipe KV handoff)",
@@ -768,7 +772,8 @@ class SubprocessReplica:
                     "max_new_tokens": max_new_tokens,
                     "deadline_s": deadline_s,
                     "on_deadline": on_deadline,
-                    "trace_ctx": trace_ctx})
+                    "trace_ctx": trace_ctx,
+                    "tenant": tenant})
         return h
 
     def cancel(self, inner) -> None:
@@ -1139,11 +1144,18 @@ class Router:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_deadline: str = "shed") -> FleetHandle:
+               on_deadline: str = "shed",
+               tenant: Optional[str] = None) -> FleetHandle:
         """Admit one prompt to the fleet. The submit-time deadline is
         stamped ABSOLUTE here and every later hop — dispatch, failover,
         hedge — carries only the remaining budget, so no retry can
-        resurrect a request past its deadline."""
+        resurrect a request past its deadline.
+
+        ``tenant`` (ISSUE-15) labels every dispatch hop's analytic
+        cost bill — `cost_report()` federates the per-tenant
+        serving_request_cost_* counters across the fleet into one
+        bill, failovers and hedges included (a re-dispatched request
+        bills its recompute to the same tenant)."""
         if on_deadline not in ("shed", "partial"):
             raise ValueError(f"on_deadline must be 'shed' or "
                              f"'partial', got {on_deadline!r}")
@@ -1176,6 +1188,7 @@ class Router:
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
                 on_deadline)
+            fr.tenant = str(tenant) if tenant is not None else None
             fr.trace = self.recorder.start_trace(fr.rid)
             if self.recorder.enabled:
                 fr._on_terminal = self._finalize_trace
@@ -1184,7 +1197,9 @@ class Router:
                          max_new_tokens=int(eff),
                          deadline_s=(float(deadline_s)
                                      if deadline_s is not None
-                                     else None))
+                                     else None),
+                         **({"tenant": fr.tenant}
+                            if fr.tenant is not None else {}))
             fr._queued_at = now
             self._queue.append(fr)
             fr.trace.add("queued", depth=len(self._queue))
@@ -1405,6 +1420,108 @@ class Router:
         from deeplearning4j_tpu.observability.export import \
             snapshot_prometheus_text
         return snapshot_prometheus_text(self.federate())
+
+    # ------------------------------------------------------------------
+    # profiling & cost attribution (ISSUE-15)
+    # ------------------------------------------------------------------
+    def cost_report(self) -> dict:
+        """ONE fleet-wide per-tenant bill: the replicas' per-tenant
+        serving_request_cost_flops/_bytes + serving_tenant_tokens
+        counters, federated (counters sum across tiers/replicas by the
+        ISSUE-13 merge) and re-grouped by tenant. The exactness
+        contract: every tenant row equals the sum of that tenant's
+        per-request bills across the whole fleet, prefix-cache hits
+        and migrated chains billing only the tokens actually
+        computed."""
+        snap = self.federate()
+        tenants: Dict[str, dict] = {}
+
+        def _cell(t: str) -> dict:
+            return tenants.setdefault(
+                t, {"flops": 0.0, "bytes": 0.0,
+                    "prefill_tokens": 0, "decode_tokens": 0})
+
+        for fam, key in (("serving_request_cost_flops", "flops"),
+                         ("serving_request_cost_bytes", "bytes")):
+            for s in snap.get(fam, {}).get("samples", ()):
+                t = (s.get("labels") or {}).get("tenant", "default")
+                _cell(t)[key] += float(s.get("value", 0.0))
+        for s in snap.get("serving_tenant_tokens",
+                          {}).get("samples", ()):
+            labels = s.get("labels") or {}
+            t = labels.get("tenant", "default")
+            kind = labels.get("kind", "decode")
+            _cell(t)[f"{kind}_tokens"] = (
+                _cell(t).get(f"{kind}_tokens", 0)
+                + int(s.get("value", 0)))
+        ranked = dict(sorted(tenants.items(),
+                             key=lambda kv: -kv[1]["flops"]))
+        return {"tenants": ranked,
+                "total_flops": sum(v["flops"]
+                                   for v in tenants.values()),
+                "total_bytes": sum(v["bytes"]
+                                   for v in tenants.values())}
+
+    def profile_report(self) -> dict:
+        """Per-replica profiling reports (cost tables, MFU,
+        rooflines) for every in-process replica, keyed
+        ``"<tier>/<id>"`` — subprocess replicas expose the same data
+        on their own `/debugz`; the federated scrape already carries
+        their counters."""
+        out = {}
+        with self._lock:
+            ctls = list(self._ctls)
+        for ctl in ctls:
+            eng = getattr(ctl.replica, "engine", None)
+            if eng is None or ctl.dead or ctl.scaled_down:
+                continue
+            try:
+                out[f"{ctl.tier}/{ctl.id}"] = eng.profile_report()
+            except Exception as e:
+                out[f"{ctl.tier}/{ctl.id}"] = {"error": str(e)}
+        return out
+
+    def profilez(self, seconds) -> tuple:
+        """Fleet-fanned on-demand capture (ISSUE-15): start one
+        bounded jax.profiler trace on EVERY live replica — in-process
+        engines directly, subprocess ones over their real
+        `/profilez?seconds=N` endpoint. Returns ``(status, body)``
+        with the per-replica outcomes; 200 when at least one replica
+        started capturing, 503 when none could (each replica's
+        single-flight/unsupported semantics are its own)."""
+        results = {}
+        started = 0
+        with self._lock:
+            ctls = list(self._ctls)
+        for ctl in ctls:
+            if ctl.dead or ctl.scaled_down:
+                continue
+            name = f"{ctl.tier}/{ctl.id}"
+            try:
+                eng = getattr(ctl.replica, "engine", None)
+                if eng is not None:
+                    code, body = eng.profilez(seconds)
+                else:
+                    url = getattr(ctl.replica, "probe_url", None)
+                    if url is None:
+                        results[name] = {"status": 503,
+                                         "error": "unreachable"}
+                        continue
+                    req = urllib.request.urlopen(
+                        f"{url}/profilez?seconds={float(seconds)}",
+                        timeout=self.config.probe_timeout_s)
+                    with req as resp:
+                        code = resp.getcode()
+                        body = json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                code, body = e.code, {"error": str(e)}
+            except Exception as e:
+                code, body = 503, {"error": f"{type(e).__name__}: {e}"}
+            results[name] = {"status": int(code), **body}
+            if code == 200:
+                started += 1
+        return ((200 if started else 503),
+                {"replicas": results, "started": started})
 
     # ------------------------------------------------------------------
     # driving
@@ -2145,6 +2262,8 @@ class Router:
         kv, fr._migrate_kv = fr._migrate_kv, None
         if kv is not None:
             kw["kv"] = kv
+        if fr.tenant is not None:
+            kw["tenant"] = fr.tenant
         return ctl.replica.submit(prompt, remaining, deadline_s,
                                   fr.on_deadline, trace_ctx=ctx, **kw)
 

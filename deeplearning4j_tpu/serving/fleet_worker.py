@@ -89,7 +89,8 @@ def main() -> int:
         eng.warmup()
     cold_start_s = time.perf_counter() - t0
     srv = MetricsServer(eng.registry, port=0, health=eng.health,
-                        ready=eng.ready, debug=eng.debugz)
+                        ready=eng.ready, debug=eng.debugz,
+                        profilez=eng.profilez)
 
     out_lock = threading.Lock()
 
@@ -197,7 +198,8 @@ def main() -> int:
                     max_new_tokens=cmd.get("max_new_tokens"),
                     deadline_s=cmd.get("deadline_s"),
                     on_deadline=cmd.get("on_deadline", "shed"),
-                    trace_ctx=cmd.get("trace_ctx"))
+                    trace_ctx=cmd.get("trace_ctx"),
+                    tenant=cmd.get("tenant"))
             except Exception as e:
                 emit({"ev": "rejected", "rid": rid,
                       "etype": type(e).__name__, "msg": str(e)})

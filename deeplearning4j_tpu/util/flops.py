@@ -37,18 +37,43 @@ _PEAK_BF16 = {
     "TPU v6e": 918e12,
 }
 
+# Peak HBM bandwidth per chip, bytes/s, from the same public specs
+# (v5e: 819 GB/s; v4: 1228; v5p: 2765; v6e: 1640) — the denominator of
+# the roofline ridge point (observability/profiling.py: a program
+# whose arithmetic intensity sits left of peak_flops/peak_bw is
+# memory-bound on that chip).
+_PEAK_HBM_BPS = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
 
-def chip_peak_flops(device: "jax.Device | None" = None) -> float | None:
-    """Peak bf16 FLOP/s for one chip, or None when unknown (CPU etc.)."""
+
+def _peak_lookup(table: dict, device) -> float | None:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "")
     # longest-prefix match so "TPU v5 lite" beats "TPU v5"
     best = None
-    for k, v in _PEAK_BF16.items():
+    for k, v in table.items():
         if kind.startswith(k) and (best is None or len(k) > best[0]):
             best = (len(k), v)
     return best[1] if best else None
+
+
+def chip_peak_flops(device: "jax.Device | None" = None) -> float | None:
+    """Peak bf16 FLOP/s for one chip, or None when unknown (CPU etc.)."""
+    return _peak_lookup(_PEAK_BF16, device)
+
+
+def chip_peak_bytes_per_s(device: "jax.Device | None" = None
+                          ) -> float | None:
+    """Peak HBM bytes/s for one chip, or None when unknown (CPU
+    etc.) — the roofline ridge point's denominator."""
+    return _peak_lookup(_PEAK_HBM_BPS, device)
 
 
 def cost_analysis(jitted_fn, *args, **kwargs) -> dict:

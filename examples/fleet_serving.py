@@ -70,10 +70,12 @@ def main() -> None:
     print(f"fleet of 3 replicas up; router metrics at {server.url}")
     print("submitting 12 requests, then killing replica 1 "
           "mid-trace...\n")
+    tenants = ["acme", "globex", "initech"]
     handles = [router.submit(
         rng.integers(0, cfg.vocab_size,
                      int(rng.integers(6, 20))).astype(np.int32),
-        max_new_tokens=24) for _ in range(12)]
+        max_new_tokens=24, tenant=tenants[i % 3])
+        for i in range(12)]
     t0 = time.perf_counter()
     router.run_pending()
     elapsed = time.perf_counter() - t0
@@ -156,6 +158,19 @@ def main() -> None:
             shown += 1
             if shown >= 12:
                 break
+
+    # the fleet-wide per-tenant bill (ISSUE-15): analytic FLOPs/bytes
+    # each tenant's traffic cost, federated across every replica —
+    # failovers bill their recompute to the same tenant
+    cr = router.cost_report()
+    print("\nfleet cost report (per-tenant analytic bill, "
+          "failover recompute included):")
+    for t, row in cr["tenants"].items():
+        print(f"  {t:<8} {row['flops'] / 1e6:8.1f} MFLOPs  "
+              f"{row['bytes'] / 1e6:8.1f} MB  "
+              f"prefill {row['prefill_tokens']:>4} tok  "
+              f"decode {row['decode_tokens']:>4} tok")
+    print(f"  fleet total: {cr['total_flops'] / 1e6:.1f} MFLOPs")
 
     server.stop()
     router.close()

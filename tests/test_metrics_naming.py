@@ -503,6 +503,104 @@ def test_affinity_and_migration_series_pass_the_lint():
                 f"{name}: gauge name collides with histogram samples"
 
 
+def test_profiling_and_cost_series_pass_the_lint():
+    """The profiling & cost-attribution series (ISSUE-15:
+    serving_program_{invocations,device_seconds,flops,bytes}_total
+    counters labeled by program, the serving_mfu /
+    serving_achieved_*_per_second gauges, and the tenant-labeled
+    serving_request_cost_{flops,bytes}_total +
+    serving_tenant_tokens_total counters) over real multi-tenant
+    traffic — engine scrape AND the federated merge — with
+    kind/unit-suffix checks and the cardinality budget."""
+    from deeplearning4j_tpu.observability.federation import (
+        check_cardinality)
+    from deeplearning4j_tpu.serving import Router
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    router = Router(cfg=cfg, mesh=mesh, params=params, num_replicas=2,
+                    engine_config=EngineConfig(
+                        decode_chunk=2, max_new_tokens=4,
+                        max_batch_size=2, tenant_top_n=4))
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        hs = [router.submit(prompt, tenant=f"tenant-{i % 3}")
+              for i in range(6)]
+        router.run_pending()
+        assert all(h.done() for h in hs)
+        eng = router._ctls[0].replica.engine
+        from deeplearning4j_tpu.observability.export import \
+            prometheus_text
+        text = prometheus_text(eng.registry)
+        snap = router.federate()
+        fed = router.federated_text()
+    finally:
+        router.close()
+    types = _types(text)
+    # kind checks: cost/accounting series are COUNTERS (exposed
+    # _total), the MFU/rate surfaces are gauges
+    assert types["serving_program_invocations_total"] == "counter"
+    assert types["serving_program_device_seconds_total"] == "counter"
+    assert types["serving_program_flops_total"] == "counter"
+    assert types["serving_program_bytes_total"] == "counter"
+    assert types["serving_request_cost_flops_total"] == "counter"
+    assert types["serving_request_cost_bytes_total"] == "counter"
+    assert types["serving_tenant_tokens_total"] == "counter"
+    assert types["serving_mfu"] == "gauge"
+    assert types["serving_achieved_flops_per_second"] == "gauge"
+    assert types["serving_achieved_bytes_per_second"] == "gauge"
+    # unit-suffix checks: the unit sits immediately before _total
+    # (flops/bytes/tokens/seconds), and serving_mfu is a deliberately
+    # unitless ratio gauge — it must not masquerade as a counter or
+    # carry a fake unit
+    for name, kind in types.items():
+        if kind != "counter" or not name.startswith(
+                ("serving_program_", "serving_request_cost_",
+                 "serving_tenant_")):
+            continue
+        stem = name[:-len("_total")]
+        assert stem.endswith(("_flops", "_bytes", "_tokens",
+                              "_seconds", "_invocations",
+                              "_evictions")), \
+            f"{name}: cost counters need a unit before _total"
+    assert not types["serving_mfu"] == "counter"
+    # the traffic really exercised the families
+    assert 'tenant="tenant-0"' in text
+    assert 'program="decode"' in text
+    # full-lint pass over the engine exposition
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+    # the FEDERATED merge carries the same families lint-clean and
+    # inside the cardinality budget (the tenant bound holds fleet-wide)
+    fed_types = _types(fed)
+    assert fed_types["serving_request_cost_flops_total"] == "counter"
+    assert fed_types["serving_tenant_tokens_total"] == "counter"
+    assert fed_types["serving_mfu"] == "gauge"
+    for name, kind in fed_types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+    check_cardinality(snap, budget=64)
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):
