@@ -180,7 +180,7 @@ def flagship_lines(which: str) -> None:
                   "engine_slo", "ckpt_async", "quant_decode",
                   "kv_paged", "spec_decode", "fleet_failover",
                   "chunked_prefill", "disagg", "fleet_obs",
-                  "cold_start", "profiling_overhead"]
+                  "cold_start", "profiling_overhead", "qos_storm"]
     for n in names:
         elapsed = time.monotonic() - _T0
         reps = 1 if elapsed > 0.6 * budget else 2

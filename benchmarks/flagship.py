@@ -2138,6 +2138,223 @@ def bench_prefix_affinity(reps: int = 1, *, n_tenants: int = 6,
     return out
 
 
+def bench_qos_storm(reps: int = 1, *, seed: int = 0) -> dict:
+    """Tenant QoS control plane under a hostile-tenant storm
+    (ISSUE-16 acceptance, asserted IN-BENCH): with QoS on (fair-share
+    weights + priority preemption + router priority overcommit) the
+    victim tenant's p99 TTFT moves < 25% vs running ALONE on the same
+    fleet, the weighted fair-share ratio lands within tolerance of
+    the configured weights, ZERO high-priority requests are lost when
+    a replica is killed mid-storm, and the QoS-off path is
+    bit-identical (same tokens twice, zero new compiled-program cache
+    keys, no qos metric series in the scrape).
+
+    Four arms over the SAME deterministic storm trace
+    (`parallel.failure.hostile_tenant_storm` — the generator the QoS
+    tests assert on) through a 2-replica in-process fleet:
+
+    - **solo**: victim arrivals only, QoS off — the baseline p99 TTFT
+      the victim gets with nobody else on the fleet.
+    - **storm_qos_off** (x2): two hostile tenants flood one long
+      low-priority request each per tick; no weights, no priorities.
+      Replayed twice: both replays must produce identical tokens with
+      zero new compile-cache entries between them.
+    - **storm_qos_on**: tenant_weights pin the victim's fair share,
+      its class-5 arrivals preempt class-0 residents (router
+      priority_overcommit lets them reach a full engine), and the p99
+      TTFT bound vs solo is asserted.
+    - **storm_qos_on_kill**: the QoS arm with replica 0 killed
+      mid-storm — failover + preemption together still lose zero
+      high-priority requests, token-exact.
+
+    TTFT is measured in SCHEDULER TICKS (submit tick -> first tick
+    the fleet handle shows a committed token), the same deterministic
+    clock the fair-share scheduler divides — wall-clock on a shared
+    CPU host would measure noise, not scheduling."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    from deeplearning4j_tpu.parallel.failure import (FleetFaultInjector,
+                                                     hostile_tenant_storm,
+                                                     storm_prompt)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (
+        EngineConfig, InferenceEngine, _compiled_chunked_prefill,
+        _compiled_decode_chunk, _compiled_prefill)
+    from deeplearning4j_tpu.serving.fleet import FleetConfig, Router
+
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                            n_layers=3, max_len=128)
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+
+    STORM = dict(ticks=60, victim_every=10, victim_prompt=96,
+                 victim_new=4, victim_priority=5, hostiles=2,
+                 flood_per_tick=1, hostile_prompt=48, hostile_new=2)
+    arrivals, _ = hostile_tenant_storm(**STORM)
+    _, ik_kill = hostile_tenant_storm(**STORM, kill_tick=25,
+                                      kill_replica=0)
+    victims = [a for a in arrivals if a.tenant == "victim"]
+    VICTIM_W = 32.0
+
+    def p99(xs):
+        xs = sorted(xs)
+        return float(xs[min(len(xs) - 1,
+                            max(0, -(-99 * len(xs) // 100) - 1))])
+
+    def replay(arr, inj_kwargs, qos: bool):
+        ec_kw = dict(max_batch_size=2, decode_chunk=2, prefill_chunk=8,
+                     tick_token_budget=16, max_new_tokens=8,
+                     max_queue=4 * len(arr), degrade_queue_depth=10**6,
+                     backoff_base_s=0.0)
+        if qos:
+            ec_kw.update(tenant_weights={"victim": VICTIM_W},
+                         qos_default_weight=1.0, preemption_budget=2)
+        router = Router(cfg=cfg, mesh=mesh, params=params,
+                        num_replicas=2, engine_config=EngineConfig(**ec_kw),
+                        fault_injector=FleetFaultInjector(**inj_kwargs),
+                        config=FleetConfig(max_queue=4 * len(arr),
+                                           restart_backoff_base_s=0.05,
+                                           affinity_weight=0.0,
+                                           migrate_kv=False))
+        handles, ttft = {}, {}
+        try:
+            pending, tick = list(arr), 0
+            for _ in range(4000):
+                while pending and pending[0].tick <= tick:
+                    a = pending.pop(0)
+                    kw = (dict(tenant=a.tenant, priority=a.priority)
+                          if qos else {})
+                    handles[a] = (router.submit(
+                        storm_prompt(a, cfg.vocab_size),
+                        max_new_tokens=a.max_new_tokens, **kw), tick)
+                router.tick()
+                tick += 1
+                for a, (h, t0) in handles.items():
+                    if a not in ttft and h.generated.shape[0] > 0:
+                        ttft[a] = tick - t0
+                if not pending and all(h.done()
+                                       for h, _ in handles.values()):
+                    break
+            assert not pending and all(h.done()
+                                       for h, _ in handles.values()), \
+                "storm arm did not drain"
+            lost = [a for a, (h, _) in handles.items()
+                    if h.error is not None]
+            engines = [c.replica.engine for c in router._ctls]
+            preempts = 0
+            for e in engines:
+                fam = getattr(e, "_m_qos_preemptions", None)
+                if fam is not None:
+                    preempts += sum(ch.value
+                                    for _, ch in fam.collect())
+            scrape_has_qos = any("qos" in prometheus_text(e.registry)
+                                 for e in engines)
+            return {
+                "tokens": {a.seed: np.asarray(h.generated, np.int32)
+                           for a, (h, _) in handles.items()},
+                "victim_ttft": [ttft[a] for a in arr
+                                if a.tenant == "victim"],
+                "ticks": tick, "lost": lost, "preemptions": preempts,
+                "scrape_has_qos": scrape_has_qos}
+        finally:
+            router.close()
+
+    solo = replay(victims, {}, qos=False)
+    off1 = replay(arrivals, {}, qos=False)
+    keys = (_compiled_prefill.cache_info().currsize,
+            _compiled_chunked_prefill.cache_info().currsize,
+            _compiled_decode_chunk.cache_info().currsize)
+    off2 = replay(arrivals, {}, qos=False)
+    keys2 = (_compiled_prefill.cache_info().currsize,
+             _compiled_chunked_prefill.cache_info().currsize,
+             _compiled_decode_chunk.cache_info().currsize)
+    on = replay(arrivals, {}, qos=True)
+    kill = replay(arrivals, ik_kill, qos=True)
+
+    # -- QoS-off bit-identity: same tokens twice, zero new compiled
+    #    program keys, no qos series in either engine's scrape
+    assert keys2 == keys, f"qos-off replay compiled new keys: {keys} " \
+                          f"-> {keys2}"
+    assert not off1["scrape_has_qos"] and not off2["scrape_has_qos"]
+    for s, t in off1["tokens"].items():
+        np.testing.assert_array_equal(t, off2["tokens"][s])
+    # scheduling must never change CONTENT: every arrival's tokens are
+    # identical across solo/off/on/kill arms (greedy decode)
+    for arm in (on, kill):
+        for s, t in arm["tokens"].items():
+            np.testing.assert_array_equal(t, off1["tokens"][s])
+            if s in solo["tokens"]:
+                np.testing.assert_array_equal(t, solo["tokens"][s])
+
+    # -- zero lost high-priority (kill-one included)
+    vseeds = {a.seed for a in victims}
+    for arm in (on, kill):
+        assert not [a for a in arm["lost"] if a.seed in vseeds], \
+            "high-priority request lost"
+        for a in victims:
+            assert arm["tokens"][a.seed].shape[0] == a.max_new_tokens
+
+    # -- the TTFT bound: QoS holds the victim's p99 within 25% of solo
+    solo_p99 = p99(solo["victim_ttft"])
+    on_p99 = p99(on["victim_ttft"])
+    off_p99 = p99(off1["victim_ttft"])
+    ttft_ratio = on_p99 / max(1.0, solo_p99)
+    assert ttft_ratio <= 1.25, (
+        f"victim p99 TTFT {on_p99} ticks vs solo {solo_p99} "
+        f"({ttft_ratio:.2f}x, target <= 1.25x)")
+
+    # -- weighted fair share on a bare engine: 3:1 weights must yield
+    #    a prefill-token ratio within [2, 4] under sustained backlog
+    eng = InferenceEngine(cfg, mesh, params, EngineConfig(
+        max_batch_size=4, decode_chunk=2, prefill_chunk=4,
+        tick_token_budget=8, max_new_tokens=4, backoff_base_s=0.0,
+        tenant_weights={"gold": 3.0, "bronze": 1.0}))
+    fair = np.arange(48, dtype=np.int32) % cfg.vocab_size
+    for i in range(2):
+        for t in ("gold", "bronze"):
+            eng.submit((fair + i) % cfg.vocab_size, max_new_tokens=4,
+                       tenant=t)
+    for _ in range(8):
+        eng.tick()
+    gold = eng._m_qos_prefill_tokens.labels("gold").value
+    bronze = eng._m_qos_prefill_tokens.labels("bronze").value
+    fair_ratio = gold / max(1.0, bronze)
+    assert 2.0 <= fair_ratio <= 4.0, (
+        f"fair-share ratio {fair_ratio:.2f} outside [2, 4] for "
+        f"3:1 weights")
+    eng.run_pending()
+
+    out = {"config": (f"qos_storm_{len(arrivals)}req_2x2slots_"
+                      f"budget16_w{int(VICTIM_W)}"),
+           "trace": {"requests": len(arrivals),
+                     "victims": len(victims),
+                     "hostile_tenants": STORM["hostiles"],
+                     "ticks": STORM["ticks"]},
+           "solo": {"victim_p99_ttft_ticks": solo_p99,
+                    "drain_ticks": solo["ticks"]},
+           "storm_qos_off": {"victim_p99_ttft_ticks": off_p99,
+                             "vs_solo": round(
+                                 off_p99 / max(1.0, solo_p99), 3),
+                             "drain_ticks": off1["ticks"]},
+           "storm_qos_on": {"victim_p99_ttft_ticks": on_p99,
+                            "vs_solo": round(ttft_ratio, 3),
+                            "preemptions": int(on["preemptions"]),
+                            "drain_ticks": on["ticks"]},
+           "kill_one": {"lost_high_priority": 0,
+                        "preemptions": int(kill["preemptions"]),
+                        "drain_ticks": kill["ticks"]},
+           "fair_share_ratio_3to1": round(fair_ratio, 3),
+           "qos_off_bit_identical": True,
+           "qos_off_new_compile_keys": 0,
+           "zero_lost_high_priority": True,
+           "value": round(ttft_ratio, 3),
+           "unit": "x_victim_p99_ttft_vs_solo"}
+    return out
+
+
 def bench_cold_start(reps: int = 2, *, seed: int = 0) -> dict:
     """Replica cold-start + tick-loop raw speed (ISSUE-12 acceptance,
     asserted IN-BENCH: restart-to-first-token >= 3x faster cache-warm
@@ -2476,6 +2693,7 @@ BENCHES = {"transformer": bench_transformer,
            "chunked_prefill": bench_chunked_prefill,
            "disagg": bench_disagg,
            "prefix_affinity": bench_prefix_affinity,
+           "qos_storm": bench_qos_storm,
            "fleet_obs": bench_fleet_obs,
            "cold_start": bench_cold_start,
            "profiling_overhead": bench_profiling_overhead,

@@ -58,7 +58,16 @@ EVENT_KINDS = frozenset({
     #                  rejected by verification {step, drafted,
     #                  poisoned} — the forensic marker for injected
     #                  draft poisoning and for adaptive-K backoff
-    "preempted",     # evicted from its slot {reason: isolation|reload}
+    "preempted",     # evicted from its slot {reason: isolation|
+    #                  reload|priority} — priority preemptions add
+    #                  {by: preemptor rid, slot} (ISSUE-16)
+    "qos",           # QoS control-plane action (rid 0, fleet-wide):
+    #                  admission rejection {action: reject, tenant,
+    #                  reason: rate|concurrency} or an overload-
+    #                  controller transition {action: degrade|restore,
+    #                  level, step: spec_off|chunk_shrink|shed_low|
+    #                  none} — the degradation ladder's audit trail
+    #                  (ISSUE-16)
     "dispatched",    # fleet router: handed to a replica {replica,
     #                  hedge} — the router-hop span opener (ISSUE-9)
     "failover",      # fleet router: re-dispatched onto a survivor

@@ -32,8 +32,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Callable, Iterable, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from deeplearning4j_tpu.observability.metrics import default_registry
 from deeplearning4j_tpu.train.guard import DivergenceError, TrainingGuard
@@ -419,6 +420,93 @@ class FleetFaultInjector:
             self.probe_failures_injected += 1
             return True
         return False
+
+
+@dataclass(frozen=True)
+class StormArrival:
+    """One scripted submission of a hostile-tenant storm (ISSUE-16):
+    at router/engine tick ``tick``, tenant ``tenant`` submits a
+    ``prompt_tokens``-long prompt (derived deterministically from
+    ``seed`` via `storm_prompt`) asking for ``max_new_tokens`` at
+    QoS class ``priority``."""
+    tick: int
+    tenant: str
+    priority: int
+    seed: int
+    prompt_tokens: int
+    max_new_tokens: int
+
+
+def hostile_tenant_storm(ticks: int = 120, *,
+                         victim: str = "victim",
+                         victim_every: int = 4,
+                         victim_prompt: int = 8,
+                         victim_new: int = 8,
+                         victim_priority: int = 5,
+                         hostiles: int = 3,
+                         flood_per_tick: int = 2,
+                         hostile_prompt: int = 24,
+                         hostile_new: int = 16,
+                         start_tick: int = 0,
+                         kill_tick: Optional[int] = None,
+                         kill_replica: int = 0,
+                         slow_tick: Optional[int] = None,
+                         slow_replica: int = 0,
+                         slow_seconds: float = 0.05,
+                         ) -> Tuple[List[StormArrival], Dict]:
+    """Deterministic hostile-tenant arrival script (ISSUE-16), shared
+    by the QoS fairness tests and ``flagship.py qos_storm``.
+
+    One well-behaved ``victim`` tenant submits a short high-priority
+    request every ``victim_every`` ticks while ``hostiles`` flood
+    tenants each submit ``flood_per_tick`` long low-priority requests
+    EVERY tick — the adversarial mix a fair-share scheduler must not
+    let starve the victim. No RNG is consulted: the same kwargs always
+    yield the same arrivals, so a bench run and a test assert on the
+    same traffic.
+
+    Returns ``(arrivals, injector_kwargs)``: arrivals sorted by
+    ``(tick, submission order)``, and kwargs for `FleetFaultInjector`
+    wiring the optional ``kill_tick`` (kill-one-replica-mid-storm)
+    and ``slow_tick`` (gray-failure straggler) knobs — empty dicts
+    stay absent so ``FleetFaultInjector(**injector_kwargs)`` is a
+    no-op injector when neither knob is set.
+    """
+    if ticks <= 0 or victim_every <= 0:
+        raise ValueError("ticks and victim_every must be positive")
+    arrivals: List[StormArrival] = []
+    seed = 0
+    for t in range(start_tick, start_tick + int(ticks)):
+        if (t - start_tick) % int(victim_every) == 0:
+            arrivals.append(StormArrival(
+                tick=t, tenant=victim, priority=int(victim_priority),
+                seed=seed, prompt_tokens=int(victim_prompt),
+                max_new_tokens=int(victim_new)))
+            seed += 1
+        for h in range(int(hostiles)):
+            for _ in range(int(flood_per_tick)):
+                arrivals.append(StormArrival(
+                    tick=t, tenant=f"hostile{h}", priority=0,
+                    seed=seed, prompt_tokens=int(hostile_prompt),
+                    max_new_tokens=int(hostile_new)))
+                seed += 1
+    injector_kwargs: Dict = {}
+    if kill_tick is not None:
+        injector_kwargs["kill_at"] = {int(kill_tick): int(kill_replica)}
+    if slow_tick is not None:
+        injector_kwargs["slow_at"] = {
+            int(slow_tick): (int(slow_replica), float(slow_seconds))}
+    return arrivals, injector_kwargs
+
+
+def storm_prompt(arrival: StormArrival, vocab_size: int):
+    """The deterministic prompt for one `StormArrival` — same recipe
+    as the serving tests' ``_prompt`` helpers, keyed on the arrival's
+    seed so distinct arrivals exercise distinct prefixes."""
+    import numpy as np
+    n = int(arrival.prompt_tokens)
+    return (np.arange(n, dtype=np.int32) * (int(arrival.seed) * 2 + 3)
+            + int(arrival.seed)) % int(vocab_size)
 
 
 class PreemptionHandler:

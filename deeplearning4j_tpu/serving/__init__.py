@@ -121,6 +121,22 @@ the layer between callers and the compiled decode step:
   fleet-wide bill, `/profilez?seconds=N` the on-demand jax.profiler
   capture (docs/observability.md "Profiling & cost attribution").
 
+- Tenant QoS control plane (round 21, ISSUE-16): the token-budget
+  scheduler divides each tick's prefill budget across backlogged
+  tenants by configurable weight via deficit counters
+  (`EngineConfig(tenant_weights=)` — idle share rolls over, a
+  backlogged tenant can never starve), `submit(priority=)` classes
+  preempt lowest-priority residents through the committed-prefix
+  resume path under a per-tick `preemption_budget`, the Router
+  enforces per-tenant rate/concurrency caps at admission
+  (`FleetConfig(tenant_max_concurrency=, tenant_rate_per_s=)`,
+  typed `TenantCapExceeded`), and an SLO-aware overload controller
+  degrades in cost order — spec decode off, decode chunks shrunk,
+  lowest-priority shed — instead of FIFO shedding, every action a
+  typed `qos` trace event and a `serving_qos_*`/
+  `serving_fleet_qos_*` metric (docs/serving.md "Tenant QoS &
+  overload control").
+
 Lifecycle and thresholds: docs/serving.md.
 """
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
@@ -129,9 +145,10 @@ from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
     Autoscaler, AutoscalePolicy, TieredRouter)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     DeadlineExceeded, EngineConfig, EngineDraining, EngineStopped,
-    HandoffError, InferenceEngine, KVHandoff, OverloadError,
-    RequestCancelled, RequestHandle, RequestQuarantined, RequestStatus,
-    set_program_cache_size)
+    HandoffError, InferenceEngine, KVHandoff, MAX_PRIORITY,
+    OverloadError, QoSValidationError, RequestCancelled, RequestHandle,
+    RequestQuarantined, RequestStatus, set_program_cache_size,
+    validate_tenant_priority)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetHandle, InProcessReplica, ReplicaState, Router,
-    SubprocessReplica)
+    SubprocessReplica, TenantCapExceeded)

@@ -399,9 +399,11 @@ class TieredRouter(Router):
     def _pick(self, now, exclude=None, fr=None):
         tier = DECODE if fr is None else self._phase_of(fr)
         best, best_score = None, None
+        headroom = (max(0, int(self.config.priority_overcommit))
+                    if (fr is not None and fr.priority > 0) else 0)
         for ctl in self._ctls:
             if (ctl.tier != tier or ctl.id == exclude
-                    or not self._dispatchable(ctl, now)):
+                    or not self._dispatchable(ctl, now, headroom)):
                 continue
             s = self._score(ctl) - self._affinity_bonus(ctl, fr, now)
             if best_score is None or s < best_score:
@@ -424,7 +426,13 @@ class TieredRouter(Router):
         while True:
             with self._lock:
                 fr = ctl = None
-                for cand in list(self._queue):
+                # priority dispatch (ISSUE-16): scan highest class
+                # first, arrival order within a class (stable sort) —
+                # the identity permutation when every class is 0
+                scan = list(self._queue)
+                if any(f.priority for f in scan):
+                    scan.sort(key=lambda f: -f.priority)
+                for cand in scan:
                     if cand.done():
                         self._queue.remove(cand)
                         continue
@@ -478,6 +486,8 @@ class TieredRouter(Router):
             if fr.tenant is not None:         # per-tenant metering
                 kw["tenant"] = fr.tenant      # (ISSUE-15): both hops
             #                                   bill the same tenant
+            if fr.priority:                   # QoS class rides both
+                kw["priority"] = fr.priority  # hops too (ISSUE-16)
             hold = bool(getattr(ctl.replica, "supports_handoff",
                                 False))
             return ctl.replica.submit(prompt, 1, deadline_s,
@@ -489,6 +499,8 @@ class TieredRouter(Router):
         kw = {"kv": kv} if kv is not None else {}
         if fr.tenant is not None:
             kw["tenant"] = fr.tenant
+        if fr.priority:
+            kw["priority"] = fr.priority
         return ctl.replica.submit(prompt, remaining, deadline_s,
                                   fr.on_deadline, trace_ctx=ctx, **kw)
 

@@ -237,7 +237,7 @@ import time
 import weakref
 from collections import OrderedDict, deque, namedtuple
 from dataclasses import dataclass, astuple
-from typing import Callable, Iterable, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -308,6 +308,60 @@ class HandoffError(RuntimeError):
     seating. A request shed on the adoption path carries this error
     and the typed ``shed{reason="handoff"}`` trace event, and every
     page the adoption claimed is decref'd first."""
+
+
+class QoSValidationError(ValueError):
+    """submit() rejected a malformed tenant or priority (ISSUE-16):
+    tenant ids flow into metric labels and the Prometheus exposition
+    (per-tenant cost counters, QoS series), so a non-string /
+    oversized / control-character id is rejected HERE — typed, at
+    admission — instead of corrupting the scrape; priorities outside
+    [0, MAX_PRIORITY] or of non-int type are rejected the same way."""
+
+
+#: Priority classes are the closed set 0..MAX_PRIORITY (ISSUE-16):
+#: 0 = default/batch, higher preempts lower when the engine's
+#: ``preemption_budget`` allows it and dispatches first at the router.
+MAX_PRIORITY = 9
+#: Tenant ids are metric-label material: bound their length so a
+#: hostile id cannot bloat every labeled sample it lands in.
+MAX_TENANT_LEN = 64
+
+
+def validate_tenant_priority(tenant, priority):
+    """The ONE tenant/priority validation (ISSUE-16), shared by
+    `InferenceEngine.submit` and `Router.submit`: coerce-or-reject
+    BEFORE the values reach the metric-label path. Returns the
+    normalized ``(tenant, priority)`` pair; raises
+    `QoSValidationError` on anything else.
+
+    Coercions: int tenant ids (a common caller convenience) become
+    their decimal string; everything non-str is otherwise rejected —
+    a bytes/float/object id silently str()'d would mint unbounded
+    label variants for what the caller thinks is one tenant."""
+    if tenant is not None:
+        if isinstance(tenant, int) and not isinstance(tenant, bool):
+            tenant = str(tenant)
+        if not isinstance(tenant, str):
+            raise QoSValidationError(
+                f"tenant must be a str (or int), got "
+                f"{type(tenant).__name__}")
+        if not tenant or len(tenant) > MAX_TENANT_LEN:
+            raise QoSValidationError(
+                f"tenant id length must be 1..{MAX_TENANT_LEN}, got "
+                f"{len(tenant)}")
+        if any(ch in '"\\\n' or ord(ch) < 0x20 for ch in tenant):
+            raise QoSValidationError(
+                "tenant id contains control/exposition-breaking "
+                "characters (newline, quote, backslash)")
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise QoSValidationError(
+            f"priority must be an int, got "
+            f"{type(priority).__name__}")
+    if not 0 <= priority <= MAX_PRIORITY:
+        raise QoSValidationError(
+            f"priority must be in [0, {MAX_PRIORITY}], got {priority}")
+    return tenant, priority
 
 
 @dataclass
@@ -518,6 +572,26 @@ class EngineConfig:
     # profiling-disabled arm (the profiling_overhead bench).
     profile_dir: Optional[str] = None
     tenant_top_n: int = 8
+    # tenant QoS control plane (ISSUE-16). ``tenant_weights`` turns on
+    # weighted fair-share prefill scheduling (requires prefill_chunk —
+    # the token-budget scheduler is the thing being divided): each
+    # tick's prefill budget is split across BACKLOGGED tenants by
+    # weight via a deficit counter, so an idle tenant's share rolls to
+    # others within the tick but a backlogged tenant accumulates
+    # credit and can never be starved. Tenants absent from the map get
+    # ``qos_default_weight``. None (default) keeps the round-15
+    # oldest-admission-first order bit-identically.
+    # ``preemption_budget`` > 0 enables priority preemption: a queued
+    # higher-priority request with no free slot evicts the
+    # lowest-priority resident through the preempt/requeue/committed-
+    # prefix path (token-exact resume, same machinery as failover),
+    # at most ``preemption_budget`` evictions per tick so a priority
+    # storm cannot thrash the slot pool. 0 (default) disables
+    # preemption AND priority-ordered seating — scheduling stays
+    # bit-identical to the QoS-off engine.
+    tenant_weights: Optional[Dict[str, float]] = None
+    qos_default_weight: float = 1.0
+    preemption_budget: int = 0
 
 
 class RequestHandle:
@@ -538,6 +612,10 @@ class RequestHandle:
         # sum(handle.cost_flops) over a run equals the
         # serving_request_cost_flops_total counters by construction
         self.tenant: Optional[str] = None
+        # QoS priority class (ISSUE-16): 0 = default/batch; higher
+        # seats first and may preempt lower when the engine's
+        # preemption budget allows it
+        self.priority = 0
         self.cost_flops = 0.0
         self.cost_bytes = 0.0
         self._cancelled = False
@@ -1048,6 +1126,53 @@ class InferenceEngine:
                 + (self._prefill_chunk or 0)))
         self._last_tick_spent = 0
         self._seat_seq = itertools.count()
+        # tenant QoS control plane (ISSUE-16): weighted fair share
+        # divides the token-budget scheduler's prefill budget, so it
+        # requires the scheduler; preemption requires the continuous
+        # slot pool (the preempt/requeue/committed-prefix path)
+        self._qos_weights: Optional[Dict[str, float]] = None
+        if self.config.tenant_weights is not None:
+            if self._prefill_chunk is None:
+                raise ValueError(
+                    "tenant_weights requires prefill_chunk: fair "
+                    "share divides the token-budget scheduler's "
+                    "prefill budget, which only exists under chunked "
+                    "prefill")
+            w = {}
+            for t, v in self.config.tenant_weights.items():
+                if not isinstance(t, str) or not t:
+                    raise ValueError(
+                        f"tenant_weights keys must be non-empty str, "
+                        f"got {t!r}")
+                v = float(v)
+                if v <= 0:
+                    raise ValueError(
+                        f"tenant_weights[{t!r}] must be > 0, got {v}")
+                w[t] = v
+            self._qos_weights = w
+        if float(self.config.qos_default_weight) <= 0:
+            raise ValueError(
+                f"qos_default_weight must be > 0, got "
+                f"{self.config.qos_default_weight}")
+        # per-tenant deficit counters (tokens of owed prefill budget);
+        # populated lazily for backlogged tenants, dropped when a
+        # tenant goes idle (idle share rolls to others — no banking)
+        self._qos_deficit: Dict[str, float] = {}
+        self._preempt_budget = int(self.config.preemption_budget)
+        if self._preempt_budget < 0:
+            raise ValueError(
+                f"preemption_budget must be >= 0, got "
+                f"{self._preempt_budget}")
+        if self._preempt_budget and not self._continuous:
+            raise ValueError(
+                "preemption_budget requires mode='continuous' (batch "
+                "mode has no resident slots to preempt)")
+        # overload-controller degradation state (driven by the fleet
+        # Router's qos_control() calls; engine-local knobs so a solo
+        # engine stays inert): spec decode off, shrunken decode chunk
+        self._qos_spec_off = False
+        self._base_chunk = self._chunk
+        self._qos_tenants_seen: set = set()
         # double-buffered tick loop (ISSUE-12): dispatch tick N without
         # blocking, commit tick N-1's synced outputs — host scheduling
         # work overlaps device compute. _pending holds the (at most
@@ -1439,6 +1564,21 @@ class InferenceEngine:
                     "the budget)").set_function(
                 lambda: float(self._last_tick_spent)
                 / float(max(1, self._tick_budget)))
+        # tenant QoS (ISSUE-16): registered only when the relevant
+        # knob is on, so QoS-off scrapes are byte-unchanged
+        if self._qos_weights is not None:
+            self._m_qos_prefill_tokens = r.counter(
+                "serving_qos_prefill_tokens",
+                "Prefill tokens granted by the weighted fair-share "
+                "scheduler, by tenant (folds past tenant_top_n)",
+                labelnames=("tenant",))
+        if self._preempt_budget > 0:
+            self._m_qos_preemptions = r.counter(
+                "serving_qos_preemptions",
+                "Residents evicted by priority preemption, by the "
+                "evicted request's tenant (token-exact resume from "
+                "the committed prefix)",
+                labelnames=("tenant",))
 
     # ------------------------------------------------------------------
     # HBM accounting (quant subsystem; backs the serving_param_bytes /
@@ -1502,10 +1642,19 @@ class InferenceEngine:
                hold_kv: bool = False,
                kv: Optional[KVHandoff] = None,
                trace_ctx: Optional[dict] = None,
-               tenant: Optional[str] = None) -> RequestHandle:
+               tenant: Optional[str] = None,
+               priority: int = 0) -> RequestHandle:
         """Admit one prompt. Raises OverloadError when the queue is full
         or the circuit breaker is open; in degraded mode the token
         budget is silently capped (reported via health()).
+
+        ``tenant``/``priority`` (ISSUE-16) are validated HERE with a
+        typed `QoSValidationError` — tenant ids are metric-label
+        material and priorities drive preemption, so malformed values
+        never reach the registry or the scheduler. ``priority`` is
+        0..MAX_PRIORITY; on engines with ``preemption_budget`` > 0 a
+        higher class seats first and may preempt a lower-class
+        resident (token-exact resume from its committed prefix).
 
         ``trace_ctx`` (ISSUE-13) is the distributed-tracing hop
         context a fleet router stamps on each dispatch
@@ -1547,6 +1696,9 @@ class InferenceEngine:
         if on_deadline not in ("shed", "partial"):
             raise ValueError(f"on_deadline must be 'shed' or 'partial', "
                              f"got {on_deadline!r}")
+        # ISSUE-16 satellite: coerce-or-reject tenant/priority BEFORE
+        # anything touches the metric-label path or the scheduler
+        tenant, priority = validate_tenant_priority(tenant, priority)
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
@@ -1605,8 +1757,8 @@ class InferenceEngine:
             # rides the handle AND every trace event (via the submit
             # event) so the bill and the forensic trace agree on who
             # the work was for
-            handle.tenant = (str(tenant) if tenant is not None
-                             else None)
+            handle.tenant = tenant
+            handle.priority = priority
             handle.trace = self.recorder.start_trace(handle.rid,
                                                      ctx=trace_ctx)
             handle._on_terminal = self._on_terminal
@@ -1616,7 +1768,8 @@ class InferenceEngine:
                 deadline_s=(float(deadline_s)
                             if deadline_s is not None else None),
                 **({"tenant": handle.tenant}
-                   if handle.tenant is not None else {}))
+                   if handle.tenant is not None else {}),
+                **({"priority": priority} if priority else {}))
             self._queue.append(handle)
             handle.trace.add("queued", depth=len(self._queue))
             self._cv.notify()
@@ -2074,9 +2227,22 @@ class InferenceEngine:
         prefill_chunk tokens each; partial chunks spend the budget to
         the token. When decode's bill already exhausted the budget,
         the oldest admission still advances ONE chunk (progress
-        floor — prefill can never starve). Returns tokens spent."""
+        floor — prefill can never starve). Returns tokens spent.
+
+        Weighted fair share (ISSUE-16, tenant_weights set): the tick's
+        prefill budget is first CREDITED to each backlogged tenant's
+        deficit counter by weight (idle tenants get nothing — their
+        share rolls to the backlogged), then slots are served
+        highest-deficit tenant first (oldest admission within a
+        tenant) and every granted token is charged back. A tenant the
+        budget shortchanges this tick carries positive deficit into
+        the next, so a backlogged tenant can never be starved however
+        heavy its neighbors' traffic is."""
         if self._prefill_chunk is None:
             return 0
+        qos = self._qos_weights is not None
+        if qos:
+            self._qos_credit(budget)
         spent = 0
         floor_used = False
         while True:
@@ -2086,29 +2252,107 @@ class InferenceEngine:
                 key=lambda e: e[1]._seat_seq)
             if not prefilling:
                 break
+            if qos:
+                # stable sort: highest owed tenant first, admission
+                # order (the seat_seq sort above) within a tenant
+                prefilling.sort(key=lambda e: -self._qos_deficit.get(
+                    e[1].tenant or "default", 0.0))
             rem = budget - spent
+            floor = False
             if rem < 1:
                 if spent > 0 or floor_used:
                     break
                 # progress floor: one chunk for the oldest admission
-                floor_used = True
+                # (under fair share: the most-owed tenant's oldest)
+                floor_used = floor = True
                 rem = self._prefill_chunk
                 prefilling = prefilling[:1]
             plan = []
-            for i, r in prefilling:
-                if rem < 1:
-                    break
-                n = min(self._prefill_chunk,
-                        r._prefill_target - r._prefill_pos, rem)
-                plan.append((i, r, n))
-                rem -= n
+            if qos and not floor:
+                # true deficit round-robin: a tenant's grant this pass
+                # is CAPPED by what it is owed, so a heavyweight
+                # tenant drains multiple chunks (one per compiled
+                # call) before a lightweight one sees the budget —
+                # ordering alone would still split the plan evenly
+                owed = dict(self._qos_deficit)
+                for i, r in prefilling:
+                    if rem < 1:
+                        break
+                    t = r.tenant or "default"
+                    cap = owed.get(t, 0.0)
+                    if cap < 1.0:
+                        continue
+                    n = min(self._prefill_chunk,
+                            r._prefill_target - r._prefill_pos, rem,
+                            int(cap))
+                    plan.append((i, r, n))
+                    rem -= n
+                    owed[t] = cap - n
+            if not plan:
+                # every owed deficit is spent (or fair share is off):
+                # WORK CONSERVATION — the leftover budget serves
+                # slots in (deficit-, then admission-) order anyway
+                for i, r in prefilling:
+                    if rem < 1:
+                        break
+                    n = min(self._prefill_chunk,
+                            r._prefill_target - r._prefill_pos, rem)
+                    plan.append((i, r, n))
+                    rem -= n
             try:
                 self._prefill_chunk_call(plan, params)
             except _BatchDecodeFailed as e:
                 self._isolate_slots([r for _, r, _ in plan], e)
                 continue
+            if qos:
+                for i, r, n in plan:
+                    t = r.tenant or "default"
+                    self._qos_deficit[t] = (
+                        self._qos_deficit.get(t, 0.0) - n)
+                    self._m_qos_prefill_tokens.labels(
+                        self._qos_label(r.tenant)).inc(int(n))
             spent += sum(n for _, _, n in plan)
         return spent
+
+    # ------------------------------------------------------------------
+    # tenant QoS helpers (ISSUE-16)
+    # ------------------------------------------------------------------
+    def _qos_weight(self, tenant: str) -> float:
+        return self._qos_weights.get(
+            tenant, float(self.config.qos_default_weight))
+
+    def _qos_label(self, tenant: Optional[str]) -> str:
+        """Bounded metric label for a tenant id: first tenant_top_n
+        distinct ids get their own label, later ones fold into
+        "other" (same cardinality bound as the cost meter)."""
+        t = "default" if tenant is None else tenant
+        seen = self._qos_tenants_seen
+        if t in seen:
+            return t
+        if len(seen) < self.config.tenant_top_n:
+            seen.add(t)
+            return t
+        return "other"
+
+    def _qos_credit(self, budget: int) -> None:
+        """Divide this tick's prefill budget across BACKLOGGED
+        tenants by weight. A tenant with no prefilling slot loses its
+        counter entirely (no banking: an idle tenant's share rolls to
+        the backlogged within the tick it was idle), so deficits
+        measure only live, unserved demand."""
+        backlogged = {r.tenant or "default"
+                      for _, r in self._occupied()
+                      if self._is_prefilling(r) and not r.done()}
+        for t in list(self._qos_deficit):
+            if t not in backlogged:
+                del self._qos_deficit[t]
+        if not backlogged or budget <= 0:
+            return
+        total = sum(self._qos_weight(t) for t in backlogged)
+        for t in backlogged:
+            self._qos_deficit[t] = (
+                self._qos_deficit.get(t, 0.0)
+                + budget * self._qos_weight(t) / total)
 
     def _prefill_chunk_call(self, plan, params) -> None:
         """One guarded chunked-prefill call advancing ``plan``
@@ -2436,9 +2680,17 @@ class InferenceEngine:
         allocate private pages for the rest — when the free list (plus
         LRU eviction) cannot cover it, admission BLOCKS (the request
         returns to the queue head) rather than corrupting resident
-        pages. Returns [(slot, handle)]."""
+        pages. Returns [(slot, handle)].
+
+        Priority preemption (ISSUE-16, preemption_budget > 0): before
+        seating, queued higher-priority requests with no free seat
+        evict the lowest-priority residents (bounded per tick), and
+        the queue is served highest class first. preemption_budget=0
+        keeps FIFO seating bit-identically."""
         admitted = []
         with self._lock:
+            if self._preempt_budget > 0:
+                self._preempt_for_priority_locked()
             # deque cursor, not list.pop(0) (ISSUE-10 satellite): the
             # old quadratic pop also made it easy to perturb seating
             # order; the popleft cursor is order-stable by construction
@@ -2446,7 +2698,7 @@ class InferenceEngine:
                          if self._slots[i] is None)
             seated_order: List[RequestHandle] = []
             while free and self._queue:
-                r = self._queue.popleft()
+                r = self._pop_request_locked()
                 self._shed_expired([r])
                 if r.done():
                     continue
@@ -2541,6 +2793,66 @@ class InferenceEngine:
             assert [r for _, r in admitted] == seated_order, \
                 "admission order diverged from queue order"
         return admitted
+
+    def _pop_request_locked(self) -> RequestHandle:
+        """Next request to seat. FIFO unless priority preemption is on
+        (preemption_budget > 0), in which case the FIRST request of
+        the HIGHEST priority class is served — FIFO within a class,
+        and bit-identical to plain popleft when everything is class 0."""
+        q = self._queue
+        if self._preempt_budget <= 0 or len(q) <= 1:
+            return q.popleft()
+        best = max(range(len(q)), key=lambda j: (q[j].priority, -j))
+        if best == 0:
+            return q.popleft()
+        r = q[best]
+        del q[best]
+        return r
+
+    def _preempt_for_priority_locked(self) -> None:
+        """Evict low-priority residents so queued HIGHER-priority
+        requests can seat this tick, at most preemption_budget
+        evictions per tick (a priority storm degrades to ordinary
+        queueing instead of thrashing the slot pool). Eviction rides
+        the reload/failover path — freed slot, QUEUED at the head,
+        token-exact resume from the committed prefix — and picks the
+        lowest-priority resident, youngest seat first (least sunk
+        prefill work). A waiter only ever displaces a STRICTLY lower
+        class, so equal-priority traffic can never thrash."""
+        budget = self._preempt_budget
+        free_n = sum(s is None for s in self._slots)
+        waiting = sorted((r for r in self._queue
+                          if r.priority > 0 and not r.done()),
+                         key=lambda r: -r.priority)
+        for w in waiting:
+            if budget <= 0:
+                break
+            if free_n > 0:
+                free_n -= 1      # a free seat serves this waiter
+                continue
+            residents = [(i, r) for i, r in enumerate(self._slots)
+                         if r is not None and not r.done()
+                         and not r._hold_kv]
+            if not residents:
+                break
+            i, v = min(residents,
+                       key=lambda e: (e[1].priority, -e[1]._seat_seq))
+            if v.priority >= w.priority:
+                break            # nothing strictly lower to displace
+            self._free_slot(i)
+            v.status = RequestStatus.QUEUED
+            v._pending_n = 0     # dispatched-but-uncommitted tokens
+            #                      are re-decoded after the resume
+            self._leave_flight(v)
+            self._m_preempted.inc()
+            self._m_qos_preemptions.labels(
+                self._qos_label(v.tenant)).inc()
+            v.trace.add("preempted", reason="priority",
+                        by=int(w.rid), slot=i)
+            self._queue.appendleft(v)
+            budget -= 1
+            # the freed seat belongs to THIS waiter: do not count it
+            # toward free_n or the next waiter would double-spend it
 
     # ------------------------------------------------------------------
     # paged KV: host page bookkeeping (all under self._lock)
@@ -2987,7 +3299,8 @@ class InferenceEngine:
             return getattr(r, "_page_start", 0), plen
         lo = plen - 1
         span = self._chunk
-        if self._spec and self._spec_plain == 0:
+        if (self._spec and self._spec_plain == 0
+                and not self._qos_spec_off):
             # a speculative round writes the whole K+1-token verify
             # window (rejected rows included) — the COW guard must
             # privatize every page it can touch
@@ -3611,7 +3924,11 @@ class InferenceEngine:
         work was co-scheduled with (and therefore delayed) the chunk."""
         data = ({} if prefill_tokens is None
                 else {"prefill_chunk": int(prefill_tokens)})
-        if self._spec and self._spec_tick():
+        # overload-controller rung 1 (ISSUE-16): spec decode is the
+        # cheapest thing to shed — drafts burn compute the SLO-bound
+        # target pass must repeat, and plain decode is token-exact
+        if (self._spec and not self._qos_spec_off
+                and self._spec_tick()):
             self._decode_spec_slots(occupied, params, **data)
             return
         call = (self._call_chunk_paged if self._paged
@@ -3622,7 +3939,10 @@ class InferenceEngine:
             with self._lock:
                 if self._slots[i] is not r:   # preempted by a reload:
                     continue                  # uncommitted tokens drop
-            need = min(self._chunk,
+            # commit exactly the call's chunk width (== self._chunk
+            # unless qos_control resized it mid-call from another
+            # thread — the device advanced by THIS width)
+            need = min(int(toks.shape[1]),
                        r.max_new_tokens - r.generated.shape[0])
             self._commit_tokens(r, toks[i, :need].astype(np.int32),
                                 "decode_chunk", slot=i, **data)
@@ -3916,7 +4236,7 @@ class InferenceEngine:
                 self._complete(r)
                 return
             state, toks = self._call_chunk(params, state, [(0, r)])
-            need = min(self._chunk,
+            need = min(int(toks.shape[1]),
                        r.max_new_tokens - r.generated.shape[0])
             self._commit_tokens(r, toks[0, :need].astype(np.int32),
                                 "decode_chunk", scratch=True)
@@ -4135,6 +4455,7 @@ class InferenceEngine:
 
         with self._lock:
             slots = [{"slot": i, "rid": r.rid, "status": r.status,
+                      "tenant": r.tenant, "priority": r.priority,
                       "generated": int(sum(a.shape[0]
                                            for a in r._generated)),
                       "max_new_tokens": r.max_new_tokens,
@@ -4147,16 +4468,38 @@ class InferenceEngine:
                          if self._prefill_chunk is not None else {})}
                      for i, r in enumerate(self._slots)
                      if r is not None]
-            queue = [{"rid": r.rid, "queue_age_s": age(r)}
+            queue = [{"rid": r.rid, "queue_age_s": age(r),
+                      "tenant": r.tenant, "priority": r.priority}
                      for r in self._queue]
+            # per-tenant queue depths (ISSUE-16 satellite): a tenant
+            # storm is diagnosable from this endpoint alone
+            queue_by_tenant: Dict[str, int] = {}
+            for r in self._queue:
+                t = r.tenant or "default"
+                queue_by_tenant[t] = queue_by_tenant.get(t, 0) + 1
             breaker = self._breaker
             degraded = self._degraded_locked()
+            qos = None
+            if (self._qos_weights is not None
+                    or self._preempt_budget > 0
+                    or self._qos_spec_off
+                    or self._chunk != self._base_chunk):
+                qos = {"tenant_weights": (dict(self._qos_weights)
+                                          if self._qos_weights
+                                          is not None else None),
+                       "deficits": {t: round(d, 2) for t, d in
+                                    self._qos_deficit.items()},
+                       "preemption_budget": self._preempt_budget,
+                       "spec_off": self._qos_spec_off,
+                       "decode_chunk": self._chunk,
+                       "base_decode_chunk": self._base_chunk}
         out = {"mode": self.config.mode,
                "num_slots": self._num_slots,
                "slots_occupied": len(slots),
                "slots": slots,
                "queue_depth": len(queue),
                "queue": queue,
+               "queue_by_tenant": queue_by_tenant,
                "breaker": breaker,
                "degraded": degraded,
                "weights_step": self._weights_step,
@@ -4224,7 +4567,32 @@ class InferenceEngine:
                                if r is not None},
                 "drafted": int(self._m_spec_drafted.value),
                 "accepted": int(self._m_spec_accepted.value)}
+        if qos is not None:
+            out["qos"] = qos
         return out
+
+    def qos_control(self, spec_off: Optional[bool] = None,
+                    decode_chunk: Optional[int] = None) -> dict:
+        """Overload-controller actuation surface (ISSUE-16): the fleet
+        Router's SLO-aware controller degrades a replica in cost order
+        through this ONE method. ``spec_off=True`` suspends
+        speculative rounds (plain decode is token-exact, so nothing
+        but throughput changes); ``decode_chunk=N`` shrinks the decode
+        scheduling quantum (clamped to [1, configured chunk] — a
+        smaller chunk frees slots and re-checks deadlines more often
+        under pressure, at one extra compiled geometry); ``0``
+        restores the configured chunk. Both are reversible and leave
+        committed tokens untouched. Returns the live knob state."""
+        with self._lock:
+            if spec_off is not None:
+                self._qos_spec_off = bool(spec_off)
+            if decode_chunk is not None:
+                c = int(decode_chunk)
+                self._chunk = (self._base_chunk if c == 0
+                               else min(max(1, c), self._base_chunk))
+        return {"spec_off": self._qos_spec_off,
+                "decode_chunk": self._chunk,
+                "base_decode_chunk": self._base_chunk}
 
     def slo_report(self) -> dict:
         """Windowed SLO report (observability/slo.py): TTFT / TPOT /

@@ -111,7 +111,16 @@ from deeplearning4j_tpu.serving.engine import (DeadlineExceeded,
                                                EngineStopped,
                                                OverloadError,
                                                RequestQuarantined,
-                                               RequestStatus)
+                                               RequestStatus,
+                                               validate_tenant_priority)
+
+
+class TenantCapExceeded(OverloadError):
+    """Admission rejected a tenant's request at the router because the
+    tenant is over its per-tenant rate or concurrency cap (ISSUE-16).
+    Subclasses OverloadError so existing retry/backoff callers treat
+    it as the transient overload it is — but typed, so a tenant can
+    distinguish 'the fleet is full' from 'YOU are over cap'."""
 from deeplearning4j_tpu.serving.paging import (chain_hashes,
                                                digest_lookup)
 
@@ -184,6 +193,41 @@ class FleetConfig:
     affinity_digest_ttl_s: float = 10.0
     migrate_kv: bool = True
     migrate_min_tokens: int = 16     # don't ship chains smaller than
+    # tenant QoS admission caps + SLO-aware overload control
+    # (ISSUE-16). ``tenant_max_concurrency`` bounds each tenant's
+    # live (queued + in-flight) fleet requests; ``tenant_rate_per_s``
+    # is a per-tenant token-bucket admission rate (burst =
+    # ``tenant_rate_burst``, None = max(1, 2x rate)). Both None
+    # (default) = no caps, admission byte-identical. Over-cap submits
+    # raise the typed `TenantCapExceeded`.
+    # The overload controller is armed by ``overload_ttft_p99_ms``
+    # (fleet SLO tracker's TTFT p99 target) and/or
+    # ``overload_queue_depth`` (deterministic router-queue watermark —
+    # the injected-clock test trigger). Every
+    # ``overload_check_every_ticks`` ticks it walks the degradation
+    # ladder one rung in COST order: (1) drop speculative decode,
+    # (2) halve decode chunks, (3) shed queued lowest-priority /
+    # over-cap requests (at most ``overload_shed_per_tick`` per tick,
+    # shed reason "qos") — and walks back one rung after
+    # ``overload_cooldown_ticks`` ticks below the trigger. Every
+    # transition is a typed ``qos`` trace event and a
+    # serving_fleet_qos_* metric.
+    tenant_max_concurrency: Optional[int] = None
+    tenant_rate_per_s: Optional[float] = None
+    tenant_rate_burst: Optional[int] = None
+    overload_ttft_p99_ms: Optional[float] = None
+    overload_queue_depth: Optional[int] = None
+    overload_check_every_ticks: int = 5
+    overload_cooldown_ticks: int = 20
+    overload_shed_per_tick: int = 4
+    # ``priority_overcommit`` lets a priority > 0 request dispatch to
+    # a replica that is already at capacity (up to this many extra
+    # in-flight requests per replica), so the ENGINE's preemption path
+    # can actually see it and evict a lower class for its seat —
+    # without it a full fleet parks high-priority work in the router
+    # queue where no preemption can reach. Priority-0 dispatch is
+    # byte-identical (headroom 0), so QoS-off behavior is unchanged.
+    priority_overcommit: int = 1
 
 
 class FleetHandle:
@@ -205,6 +249,9 @@ class FleetHandle:
         # per-tenant cost metering (ISSUE-15): forwarded on every
         # dispatch hop so the serving replica bills the right tenant
         self.tenant: Optional[str] = None
+        # QoS priority class (ISSUE-16): forwarded on every hop;
+        # higher classes dispatch first at the router
+        self.priority = 0
         self.trace = NULL_TRACE
         self._committed = np.zeros((0,), np.int32)
         self._failover_from: Optional[int] = None
@@ -756,6 +803,7 @@ class SubprocessReplica:
         # bills the right tenant); the KV-handoff knobs still don't
         trace_ctx = kw.pop("trace_ctx", None)
         tenant = kw.pop("tenant", None)
+        priority = kw.pop("priority", 0)
         if kw:
             log.warning("subprocess replica %d ignores submit "
                         "kwargs %s (no cross-pipe KV handoff)",
@@ -773,7 +821,10 @@ class SubprocessReplica:
                     "deadline_s": deadline_s,
                     "on_deadline": on_deadline,
                     "trace_ctx": trace_ctx,
-                    "tenant": tenant})
+                    "tenant": tenant,
+                    # QoS class crosses the pipe too (ISSUE-16): the
+                    # worker's engine seats/preempts by it
+                    "priority": int(priority)})
         return h
 
     def cancel(self, inner) -> None:
@@ -1009,6 +1060,13 @@ class Router:
         # past the retention bound, live ones never are
         self._recent_handles: Dict[int, FleetHandle] = {}
         self._trace_retention = 256
+        # tenant QoS control plane (ISSUE-16): per-tenant live-request
+        # counts (concurrency cap), token buckets (rate cap, injected-
+        # clock driven), and the overload controller's ladder state
+        self._tenant_live: Dict[str, int] = {}
+        self._tenant_bucket: Dict[str, tuple] = {}
+        self._qos_level = 0
+        self._qos_level_tick = 0     # tick of the last ladder move
 
     # ------------------------------------------------------------------
     # metrics
@@ -1021,6 +1079,7 @@ class Router:
             "serving_fleet_requests_shed",
             "Fleet requests rejected or abandoned, by reason",
             labelnames=("reason",))
+        self._m_shed_family = shed
         self._m_shed_deadline = shed.labels("deadline")
         self._m_shed_overload = shed.labels("overload")
         self._m_shed_outage = shed.labels("outage")
@@ -1114,6 +1173,31 @@ class Router:
             "serving_fleet_kv_migrated_bytes",
             "Bytes of prefix-chain K/V values + scales migrated "
             "across replicas")
+        # tenant QoS (ISSUE-16): registered only when the relevant
+        # knob is configured, so QoS-off scrapes are byte-unchanged
+        cfgf = self.config
+        if (cfgf.tenant_max_concurrency is not None
+                or cfgf.tenant_rate_per_s is not None):
+            self._m_qos_rejections = r.counter(
+                "serving_fleet_qos_rejections",
+                "Admissions rejected by per-tenant QoS caps, by "
+                "reason (rate = token bucket empty, concurrency = "
+                "too many live requests)",
+                labelnames=("reason",))
+        if (cfgf.overload_ttft_p99_ms is not None
+                or cfgf.overload_queue_depth is not None):
+            self._m_qos_actions = r.counter(
+                "serving_fleet_qos_actions",
+                "Overload-controller ladder transitions, by action "
+                "(degrade_spec_off / degrade_chunk_shrink / "
+                "degrade_shed_low / restore)",
+                labelnames=("action",))
+            r.gauge("serving_fleet_qos_degradation_level",
+                    "Overload-controller ladder rung in force (0 = "
+                    "healthy, 1 = spec decode off, 2 = + decode "
+                    "chunks halved, 3 = + shedding lowest-priority)"
+                    ).set_function(lambda: float(self._qos_level))
+            self._m_shed_qos = self._m_shed_family.labels("qos")
 
     @property
     def stats(self) -> dict:
@@ -1145,7 +1229,8 @@ class Router:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_deadline: str = "shed",
-               tenant: Optional[str] = None) -> FleetHandle:
+               tenant: Optional[str] = None,
+               priority: int = 0) -> FleetHandle:
         """Admit one prompt to the fleet. The submit-time deadline is
         stamped ABSOLUTE here and every later hop — dispatch, failover,
         hedge — carries only the remaining budget, so no retry can
@@ -1155,10 +1240,20 @@ class Router:
         cost bill — `cost_report()` federates the per-tenant
         serving_request_cost_* counters across the fleet into one
         bill, failovers and hedges included (a re-dispatched request
-        bills its recompute to the same tenant)."""
+        bills its recompute to the same tenant).
+
+        ``priority`` (ISSUE-16) is the request's QoS class
+        (0..MAX_PRIORITY): the router dispatches the highest waiting
+        class first, and replicas with a preemption budget seat it
+        ahead of (or in place of) lower classes. Per-tenant admission
+        caps (`FleetConfig.tenant_max_concurrency` /
+        `tenant_rate_per_s`) reject over-cap submits with the typed
+        `TenantCapExceeded`; malformed tenant/priority values raise
+        `QoSValidationError` before touching any metric label."""
         if on_deadline not in ("shed", "partial"):
             raise ValueError(f"on_deadline must be 'shed' or "
                              f"'partial', got {on_deadline!r}")
+        tenant, priority = validate_tenant_priority(tenant, priority)
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token "
@@ -1184,14 +1279,23 @@ class Router:
                 raise ValueError(
                     f"prompt {prompt.shape[0]} + {eff} new tokens "
                     f"exceeds max_len={self.cfg.max_len}")
+            # per-tenant admission caps (ISSUE-16): checked LAST so a
+            # rejected-for-other-reasons submit never burns a rate
+            # token, and the live count only ever increments for a
+            # handle that actually exists
+            self._qos_admit_locked(tenant, now)
             fr = FleetHandle(
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
                 on_deadline)
-            fr.tenant = str(tenant) if tenant is not None else None
+            fr.tenant = tenant
+            fr.priority = priority
+            tkey = tenant or "default"
+            self._tenant_live[tkey] = (
+                self._tenant_live.get(tkey, 0) + 1)
+            fr._on_terminal = self._fleet_terminal
             fr.trace = self.recorder.start_trace(fr.rid)
             if self.recorder.enabled:
-                fr._on_terminal = self._finalize_trace
                 self._remember_locked(fr)
             fr.trace.add("submit", prompt_tokens=int(prompt.shape[0]),
                          max_new_tokens=int(eff),
@@ -1199,7 +1303,9 @@ class Router:
                                      if deadline_s is not None
                                      else None),
                          **({"tenant": fr.tenant}
-                            if fr.tenant is not None else {}))
+                            if fr.tenant is not None else {}),
+                         **({"priority": priority}
+                            if priority else {}))
             fr._queued_at = now
             self._queue.append(fr)
             fr.trace.add("queued", depth=len(self._queue))
@@ -1211,6 +1317,172 @@ class Router:
             if eng is not None:
                 return int(eng.config.max_new_tokens)
         return 32
+
+    # ------------------------------------------------------------------
+    # tenant QoS admission caps + overload control (ISSUE-16)
+    # ------------------------------------------------------------------
+    def _qos_admit_locked(self, tenant: Optional[str],
+                          now: float) -> None:
+        """Per-tenant cap enforcement at admission (caller holds the
+        lock): concurrency first (no rate token burned on a
+        concurrency reject), then the token bucket. Raises the typed
+        `TenantCapExceeded`; every rejection is a metered metric and
+        a ``qos`` trace event."""
+        cfgf = self.config
+        if (cfgf.tenant_max_concurrency is None
+                and cfgf.tenant_rate_per_s is None):
+            return
+        t = tenant or "default"
+        if (cfgf.tenant_max_concurrency is not None
+                and self._tenant_live.get(t, 0)
+                >= int(cfgf.tenant_max_concurrency)):
+            self._qos_reject(t, "concurrency")
+        if cfgf.tenant_rate_per_s is not None:
+            rate = float(cfgf.tenant_rate_per_s)
+            burst = (int(cfgf.tenant_rate_burst)
+                     if cfgf.tenant_rate_burst is not None
+                     else max(1, int(2 * rate)))
+            level, last = self._tenant_bucket.get(
+                t, (float(burst), now))
+            level = min(float(burst),
+                        level + max(0.0, now - last) * rate)
+            if level < 1.0:
+                self._tenant_bucket[t] = (level, now)
+                self._qos_reject(t, "rate")
+            self._tenant_bucket[t] = (level - 1.0, now)
+
+    def _qos_reject(self, tenant: str, reason: str) -> None:
+        m = getattr(self, "_m_qos_rejections", None)
+        if m is not None:
+            m.labels(reason).inc()
+        if self.recorder.enabled:
+            self.recorder.record("qos", action="reject",
+                                 tenant=tenant, reason=reason)
+        raise TenantCapExceeded(
+            f"tenant {tenant!r} over its {reason} cap")
+
+    def _fleet_terminal(self, fr: FleetHandle) -> None:
+        """The ONE fleet-handle terminal hook: release the tenant's
+        concurrency-cap seat, then finalize the stitched trace (when
+        recording)."""
+        t = fr.tenant or "default"
+        with self._lock:
+            n = self._tenant_live.get(t, 0) - 1
+            if n > 0:
+                self._tenant_live[t] = n
+            else:
+                self._tenant_live.pop(t, None)
+        if self.recorder.enabled:
+            self._finalize_trace(fr)
+
+    def _qos_tick(self, now: float) -> None:
+        """The SLO-aware overload controller: every
+        overload_check_every_ticks ticks, compare the fleet's TTFT
+        p99 (stitched-trace SLO tracker) and/or router queue depth
+        against their targets and walk the degradation ladder ONE
+        rung — degrading in cost order (spec decode off -> decode
+        chunks halved -> shed lowest-priority/over-cap), restoring in
+        reverse after overload_cooldown_ticks healthy ticks. Knob
+        actuation reaches in-process replicas via
+        `engine.qos_control`; every transition is a typed ``qos``
+        event + metered action."""
+        cfgf = self.config
+        if (cfgf.overload_ttft_p99_ms is None
+                and cfgf.overload_queue_depth is None):
+            return
+        if self._ticks % max(1, cfgf.overload_check_every_ticks):
+            return
+        overloaded = False
+        if (cfgf.overload_queue_depth is not None
+                and len(self._queue) > int(cfgf.overload_queue_depth)):
+            overloaded = True
+        if not overloaded and cfgf.overload_ttft_p99_ms is not None:
+            try:
+                p99 = self.slo.report().get("ttft_p99_ms")
+            except Exception:
+                p99 = None
+            if p99 is not None and p99 > float(
+                    cfgf.overload_ttft_p99_ms):
+                overloaded = True
+        if overloaded:
+            if self._qos_level < 3:
+                self._qos_level += 1
+                self._qos_level_tick = self._ticks
+                step = {1: "spec_off", 2: "chunk_shrink",
+                        3: "shed_low"}[self._qos_level]
+                self._qos_apply()
+                self._qos_record("degrade", step)
+            if self._qos_level >= 3:
+                self._qos_shed_low()
+            return
+        if (self._qos_level > 0
+                and self._ticks - self._qos_level_tick
+                >= int(cfgf.overload_cooldown_ticks)):
+            self._qos_level -= 1
+            self._qos_level_tick = self._ticks
+            self._qos_apply()
+            self._qos_record("restore", {0: "none", 1: "spec_off",
+                                         2: "chunk_shrink"}[
+                                             self._qos_level])
+
+    def _qos_record(self, action: str, step: str) -> None:
+        m = getattr(self, "_m_qos_actions", None)
+        if m is not None:
+            m.labels(f"{action}_{step}").inc()
+        if self.recorder.enabled:
+            self.recorder.record("qos", action=action, step=step,
+                                 level=self._qos_level)
+
+    def _qos_apply(self) -> None:
+        """Push the current ladder rung's knob state to every live
+        in-process replica (idempotent — qos_control sets absolute
+        state, so re-applying a rung is a no-op; subprocess replicas
+        without an engine handle are skipped)."""
+        spec_off = self._qos_level >= 1
+        shrink = self._qos_level >= 2
+        for ctl in self._ctls:
+            if ctl.dead:
+                continue
+            eng = getattr(ctl.replica, "engine", None)
+            qc = getattr(eng, "qos_control", None)
+            if qc is None:
+                continue
+            try:
+                base = eng._base_chunk
+                qc(spec_off=spec_off,
+                   decode_chunk=(max(1, base // 2) if shrink else 0))
+            except Exception:    # a degradation knob must never kill
+                log.exception("qos_control failed on replica %d",
+                              ctl.id)
+
+    def _qos_shed_low(self) -> None:
+        """Ladder rung 3: shed queued work cheapest-first — lowest
+        priority class first, over-concurrency-cap tenants first
+        within a class, newest arrival first (it has waited least) —
+        at most overload_shed_per_tick per tick, typed shed reason
+        "qos"."""
+        cap = self.config.tenant_max_concurrency
+        with self._lock:
+            entries = list(enumerate(self._queue))
+            if not entries:
+                return
+
+            def over_cap(fr):
+                return (cap is not None
+                        and self._tenant_live.get(
+                            fr.tenant or "default", 0) > int(cap))
+
+            entries.sort(key=lambda e: (e[1].priority,
+                                        0 if over_cap(e[1]) else 1,
+                                        -e[0]))
+            victims = [fr for _, fr in entries if not fr.done()][
+                :max(1, int(self.config.overload_shed_per_tick))]
+            for fr in victims:
+                self._queue.remove(fr)
+        for fr in victims:
+            self._shed(fr, "qos", OverloadError(
+                f"fleet overloaded (qos level {self._qos_level}): "
+                f"request {fr.rid} shed lowest-priority-first"))
 
     # ------------------------------------------------------------------
     # distributed tracing (ISSUE-13)
@@ -1581,6 +1853,7 @@ class Router:
                     ctl, f"step error: {e}", now)
         progressed |= self._harvest(self._clock()) > 0
         self._detect_hangs()
+        self._qos_tick(now)
         return progressed
 
     def start(self) -> "Router":
@@ -1917,10 +2190,11 @@ class Router:
     # ------------------------------------------------------------------
     # dispatch
     # ------------------------------------------------------------------
-    def _dispatchable(self, ctl: _ReplicaCtl, now: float) -> bool:
+    def _dispatchable(self, ctl: _ReplicaCtl, now: float,
+                      headroom: int = 0) -> bool:
         return (not ctl.dead and not ctl.draining and not ctl.unhealthy
                 and ctl.ready and now >= ctl.breaker_open_until
-                and ctl.n_outstanding() < ctl.capacity
+                and ctl.n_outstanding() < ctl.capacity + headroom
                 and ctl.replica.alive())
 
     def _score(self, ctl: _ReplicaCtl) -> float:
@@ -1990,8 +2264,14 @@ class Router:
         phase (serving/disagg.py) and gives affinity (ISSUE-14) the
         prompt to score cached-prefix advertisements against."""
         best, best_score = None, None
+        # priority overcommit (ISSUE-16): a priority > 0 request may
+        # dispatch past capacity so engine preemption can seat it;
+        # priority 0 keeps headroom 0 (byte-identical dispatch)
+        headroom = (max(0, int(self.config.priority_overcommit))
+                    if (fr is not None and fr.priority > 0) else 0)
         for ctl in self._ctls:
-            if ctl.id == exclude or not self._dispatchable(ctl, now):
+            if (ctl.id == exclude
+                    or not self._dispatchable(ctl, now, headroom)):
                 continue
             s = self._score(ctl) - self._affinity_bonus(ctl, fr, now)
             if best_score is None or s < best_score:
@@ -2008,13 +2288,21 @@ class Router:
             with self._lock:
                 if not self._queue:
                     return n
-                fr = self._queue[0]
+                # priority dispatch (ISSUE-16): the FIRST request of
+                # the HIGHEST waiting class goes next — identical to
+                # plain FIFO when every class is 0 (idx stays 0)
+                idx = 0
+                if any(f.priority for f in self._queue):
+                    idx = max(range(len(self._queue)),
+                              key=lambda j: (self._queue[j].priority,
+                                             -j))
+                fr = self._queue[idx]
                 if fr.done():               # e.g. cancelled upstream
-                    self._queue.popleft()
+                    del self._queue[idx]
                     continue
                 if (fr.deadline_at is not None
                         and now > fr.deadline_at):
-                    self._queue.popleft()
+                    del self._queue[idx]
                     self._shed(fr, "deadline", DeadlineExceeded(
                         f"fleet request {fr.rid} past deadline before "
                         "dispatch"))
@@ -2027,14 +2315,14 @@ class Router:
                                         for c in self._ctls)):
                         # total outage, nothing will come back: fail
                         # fast and typed instead of hanging callers
-                        self._queue.popleft()
+                        del self._queue[idx]
                         self._shed(fr, "outage", OverloadError(
                             "fleet outage: every replica is dead and "
                             "the restart budget is exhausted"))
                         n += 1
                         continue
                     return n
-                self._queue.popleft()
+                del self._queue[idx]
                 age = max(0.0, now - fr._queued_at)
                 self._m_queue_age.observe(age)
                 self._age_window.append(age)
@@ -2264,6 +2552,8 @@ class Router:
             kw["kv"] = kv
         if fr.tenant is not None:
             kw["tenant"] = fr.tenant
+        if fr.priority:
+            kw["priority"] = fr.priority
         return ctl.replica.submit(prompt, remaining, deadline_s,
                                   fr.on_deadline, trace_ctx=ctx, **kw)
 
@@ -2460,6 +2750,11 @@ class Router:
             self._m_shed_deadline.inc()
         elif reason == "outage":
             self._m_shed_outage.inc()
+        elif reason == "qos":
+            # overload-controller rung 3 (ISSUE-16): lowest-priority /
+            # over-cap shed — own label so operators can tell "the
+            # controller chose this victim" from FIFO overload
+            self._m_shed_qos.inc()
         else:
             self._m_shed_overload.inc()
         fr.trace.add("shed", reason=reason)
@@ -2555,8 +2850,31 @@ class Router:
             queue = [{"rid": fr.rid,
                       "queue_age_s": round(max(0.0,
                                                now - fr._queued_at), 6),
-                      "failovers": fr._failovers}
+                      "failovers": fr._failovers,
+                      "tenant": fr.tenant,
+                      "priority": fr.priority}
                      for fr in self._queue]
+            # per-tenant queue depths (ISSUE-16 satellite): a tenant
+            # storm is diagnosable from this endpoint alone
+            queue_by_tenant: Dict[str, int] = {}
+            for fr in self._queue:
+                t = fr.tenant or "default"
+                queue_by_tenant[t] = queue_by_tenant.get(t, 0) + 1
+            cfgf = self.config
+            qos = None
+            if (cfgf.tenant_max_concurrency is not None
+                    or cfgf.tenant_rate_per_s is not None
+                    or cfgf.overload_ttft_p99_ms is not None
+                    or cfgf.overload_queue_depth is not None):
+                qos = {"level": self._qos_level,
+                       "tenant_live": dict(self._tenant_live),
+                       "tenant_max_concurrency":
+                           cfgf.tenant_max_concurrency,
+                       "tenant_rate_per_s": cfgf.tenant_rate_per_s,
+                       "overload_ttft_p99_ms":
+                           cfgf.overload_ttft_p99_ms,
+                       "overload_queue_depth":
+                           cfgf.overload_queue_depth}
             tiers = self._tier_table_locked()
             # stitched-trace section (ISSUE-13): the last few
             # completed requests' distributed traces in summary form
@@ -2568,6 +2886,8 @@ class Router:
                 "tiers": tiers,
                 "queue_depth": len(queue),
                 "queue": queue,
+                "queue_by_tenant": queue_by_tenant,
+                **({"qos": qos} if qos is not None else {}),
                 "draining": self._draining,
                 "ticks": self._ticks,
                 "stats": self.stats,

@@ -199,7 +199,8 @@ def main() -> int:
                     deadline_s=cmd.get("deadline_s"),
                     on_deadline=cmd.get("on_deadline", "shed"),
                     trace_ctx=cmd.get("trace_ctx"),
-                    tenant=cmd.get("tenant"))
+                    tenant=cmd.get("tenant"),
+                    priority=int(cmd.get("priority") or 0))
             except Exception as e:
                 emit({"ev": "rejected", "rid": rid,
                       "etype": type(e).__name__, "msg": str(e)})

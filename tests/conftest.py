@@ -29,6 +29,66 @@ import pytest  # noqa: E402
 # inputs to their configured dtype).
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache for the serving-suite modules:
+# tier-1 compiles thousands of tiny CPU programs, and on a slow 1-core
+# container the aggregate compile time alone can blow the driver's
+# wall-clock budget. The cache is scoped to an ALLOWLIST of modules
+# whose programs are single-device engine computations — serializing
+# the 8-virtual-device sharded executables (fsdp/megatron style)
+# segfaults this jaxlib on CPU, so those modules run with the cache
+# disabled (toggled per module via reset_cache(); entries are keyed
+# by jaxlib version + backend + program hash, so a stale cache misses
+# instead of misbehaving). The directory is repo-local (gitignored) so
+# one warm run speeds every later run. Engine-level compile accounting
+# (serving_compiles_total, assert_no_recompiles, the AOT CompileCache
+# tests) sits ABOVE jax's dispatch layer and is unaffected. Opt out:
+# DL4J_TEST_JAX_CACHE=0.
+_JAX_CACHE_ENV_OK = os.environ.get(
+    "DL4J_TEST_JAX_CACHE", "1") not in ("0", "false")
+_JAX_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".cache", "jax")
+_JAX_CACHE_MODULES = ("test_serving_", "test_fleet_", "test_megatron",
+                      "test_flash_", "test_training", "test_gradients",
+                      "test_quant", "test_nlp")
+
+
+def _jax_cache_toggle(enable):
+    from jax.experimental.compilation_cache import (
+        compilation_cache as _jcc)
+    if enable:
+        os.makedirs(_JAX_CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _JAX_CACHE_DIR)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1)
+    else:
+        jax.config.update("jax_compilation_cache_dir", None)
+    _jcc.reset_cache()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _scoped_jax_compile_cache(request):
+    if not _JAX_CACHE_ENV_OK:
+        yield
+        return
+    name = os.path.basename(str(request.fspath))
+    want = name.startswith(_JAX_CACHE_MODULES)
+    try:
+        _jax_cache_toggle(want)
+    except Exception:  # pragma: no cover - old jaxlib without the knob
+        yield
+        return
+    try:
+        yield
+    finally:
+        if want:
+            try:
+                _jax_cache_toggle(False)
+            except Exception:  # pragma: no cover
+                pass
+
 
 @pytest.fixture(scope="session")
 def devices8():

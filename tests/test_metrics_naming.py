@@ -601,6 +601,104 @@ def test_profiling_and_cost_series_pass_the_lint():
     check_cardinality(snap, budget=64)
 
 
+def test_qos_series_pass_the_lint():
+    """The tenant-QoS series (ISSUE-16: tenant-labeled
+    serving_qos_{prefill_tokens,preemptions}_total on the engine;
+    reason-labeled serving_fleet_qos_rejections_total, action-labeled
+    serving_fleet_qos_actions_total, the
+    serving_fleet_qos_degradation_level gauge, and the reason="qos"
+    arm of serving_fleet_requests_shed_total on the router) over REAL
+    QoS traffic — a weighted-fair-share prefill, a priority
+    preemption, an admission rejection, and a full ladder walk — then
+    the same naming rules over the engine exposition, the router
+    exposition, AND the federated merge (cardinality budget
+    included)."""
+    from deeplearning4j_tpu.observability.federation import (
+        check_cardinality)
+    from deeplearning4j_tpu.serving import (FleetConfig, Router,
+                                            TenantCapExceeded)
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    router = Router(
+        cfg=cfg, mesh=mesh, params=params, num_replicas=1,
+        engine_config=EngineConfig(
+            decode_chunk=2, max_new_tokens=6, backoff_base_s=0.0,
+            max_batch_size=1, prefill_chunk=4, tick_token_budget=8,
+            tenant_weights={"gold": 3.0}, preemption_budget=1),
+        config=FleetConfig(tenant_max_concurrency=3,
+                           overload_queue_depth=1,
+                           overload_check_every_ticks=1,
+                           overload_cooldown_ticks=2,
+                           overload_shed_per_tick=1))
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        hs = [router.submit(prompt, tenant="gold",
+                            priority=i % 2) for i in range(3)]
+        with pytest.raises(TenantCapExceeded):
+            router.submit(prompt, tenant="gold")   # rejection sample
+        hs.append(router.submit(prompt, tenant="bronze"))
+        for _ in range(4):                         # ladder walks
+            router.tick()
+        router.run_pending()
+        assert all(h.done() for h in hs)
+        eng = router._ctls[0].replica.engine
+        from deeplearning4j_tpu.observability.export import \
+            prometheus_text
+        text = prometheus_text(eng.registry)
+        rtext = prometheus_text(router.registry)
+        snap = router.federate()
+        fed = router.federated_text()
+    finally:
+        router.close()
+    # engine-side QoS families present, correctly typed, with samples
+    types = _types(text)
+    assert types["serving_qos_prefill_tokens_total"] == "counter"
+    assert types["serving_qos_preemptions_total"] == "counter"
+    assert 'serving_qos_prefill_tokens_total{tenant="gold"} 0' \
+        not in text
+    assert 'tenant="gold"' in text
+    # router-side QoS families present, correctly typed, with samples
+    rtypes = _types(rtext)
+    assert rtypes["serving_fleet_qos_rejections_total"] == "counter"
+    assert rtypes["serving_fleet_qos_actions_total"] == "counter"
+    assert rtypes["serving_fleet_qos_degradation_level"] == "gauge"
+    assert rtypes["serving_fleet_requests_shed_total"] == "counter"
+    assert 'serving_fleet_qos_rejections_total{reason="concurrency"}' \
+        in rtext
+    assert 'action="degrade_spec_off"' in rtext
+    # full-lint pass over every exposition, federated merge included
+    for scrape_text in (text, rtext, fed):
+        tps = _types(scrape_text)
+        for name, kind in tps.items():
+            assert SNAKE.match(name), f"{name}: not snake_case"
+            assert (kind == "counter") == name.endswith("_total"), name
+            if kind == "histogram":
+                assert (name.endswith(HIST_UNITS)
+                        or name in UNITLESS_HISTOGRAMS), name
+            if kind == "gauge":
+                assert not name.endswith(
+                    ("_bucket", "_sum", "_count")), \
+                    f"{name}: gauge name collides with histogram " \
+                    "samples"
+        for line in scrape_text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            m = SAMPLE.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            for lab in LABEL.findall(m.group(3) or ""):
+                assert SNAKE.match(lab), \
+                    f"label {lab!r} not snake_case"
+    # the federated merge carries the engine QoS series and the tenant
+    # label bound holds fleet-wide
+    fed_types = _types(fed)
+    assert fed_types["serving_qos_prefill_tokens_total"] == "counter"
+    assert fed_types["serving_fleet_qos_degradation_level"] == "gauge"
+    check_cardinality(snap, budget=64)
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):
