@@ -2355,6 +2355,179 @@ def bench_qos_storm(reps: int = 1, *, seed: int = 0) -> dict:
     return out
 
 
+def bench_kvwire_storm(reps: int = 1, *, seed: int = 0) -> dict:
+    """KV wire transport across REAL process boundaries (ISSUE-17
+    acceptance, asserted IN-BENCH): a 2-prefill + 1-decode tiered
+    fleet of SUBPROCESS replicas serving a long-prompt trace moves
+    every cross-tier handoff over the worker pipes as kvwire frames
+    and beats the same fleet forced into re-prefill fallback on
+    goodput — token-identical across arms, with one deterministically
+    injected corrupt frame degrading gracefully to re-prefill (CRC
+    catches it; zero lost requests, zero wrong tokens).
+
+    Two arms over the SAME trace, each on a fresh 3-worker fleet
+    (four CONCURRENT warmup requests per arm before the clock
+    starts, so every batch geometry the timed run hits is compiled
+    up front and neither arm bills the other's compiles):
+
+    - **wire**: the default path — prefill workers hold + export
+      their finished slots as CRC32-checked frames, the router
+      decodes/re-ships them, the decode worker adopts; a
+      `FleetFaultInjector(corrupt_frame_at=[1, 5])` flips one
+      payload byte of one WARMUP export (so the decode worker's
+      re-prefill program is compiled before the clock starts, same
+      as the fallback arm's warmup compiles it) and one byte of the
+      second TIMED export (handoff seqs 0-3 are the warmups), which
+      the frame CRC rejects.
+    - **fallback**: `supports_handoff = False` pinned on the prefill
+      replicas — every request re-prefills its full prompt on the
+      decode tier, the pre-wire behavior for subprocess fleets.
+
+    Goodput is generated tokens per second of serve wall time; the
+    wire arm must be >= the fallback arm (it skips one full
+    long-prompt prefill per request on the decode tier's critical
+    path). Handoff bytes/s of the wire arm is reported alongside."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving import (EngineConfig, FleetConfig,
+                                            InferenceEngine,
+                                            SubprocessReplica,
+                                            TieredRouter)
+
+    CFG_KW = dict(vocab_size=128, d_model=128, n_heads=8, n_layers=4,
+                  max_len=256)
+    ENGINE_KW = dict(decode_chunk=2, max_new_tokens=8,
+                     backoff_base_s=0.0, max_batch_size=2, paged=True)
+    SPEC = {"cfg": CFG_KW, "engine": ENGINE_KW, "params_seed": seed,
+            "progress_interval_s": 0.01}
+    N_REQ, PROMPT, MAX_NEW = 24, 160, 8
+    cfg = TransformerConfig(**CFG_KW)
+
+    def _prompt(i):
+        return (np.arange(PROMPT, dtype=np.int32) * (i + 3)
+                ) % cfg.vocab_size
+
+    def run_arm(wire: bool):
+        inj = (FleetFaultInjector(corrupt_frame_at=[1, 5]) if wire
+               else None)
+        replicas = [SubprocessReplica(i, SPEC, startup_timeout_s=240)
+                    for i in range(3)]
+        router = None
+        try:
+            if not wire:
+                for rep in replicas[:2]:
+                    rep.supports_handoff = False
+            router = TieredRouter(
+                cfg=cfg, replicas=replicas,
+                tiers=["prefill", "prefill", "decode"],
+                fault_injector=inj,
+                config=FleetConfig(max_restarts=0, hang_min_s=60.0))
+
+            def drain(handles, bound_s=240.0):
+                dl = time.monotonic() + bound_s
+                while router.pending() and time.monotonic() < dl:
+                    router.tick()
+                assert all(h.done() for h in handles), \
+                    "arm did not drain"
+
+            # warm every geometry the timed run will hit: concurrent
+            # warmups compile the batch-2 prefill/decode programs on
+            # all three workers (a single warmup request would leave
+            # batch-2 to JIT mid-measurement, a ~7 s straggler that
+            # drowns the handoff signal in both arms)
+            warm = [router.submit(_prompt(99 + j), max_new_tokens=MAX_NEW)
+                    for j in range(4)]
+            drain(warm)
+            s0 = dict(router.stats)        # exclude the warmup
+            t0 = time.perf_counter()
+            hs = [router.submit(_prompt(i), max_new_tokens=MAX_NEW)
+                  for i in range(N_REQ)]
+            drain(hs)
+            dt = time.perf_counter() - t0
+            tokens = [np.asarray(h.result(0), np.int32) for h in hs]
+            generated = sum(t.shape[0] - PROMPT for t in tokens)
+            s = router.stats
+            wire_bytes = 0
+            m = getattr(router, "_m_kvwire", None)
+            if m is not None:
+                wire_bytes = int(m["bytes"].value)
+            return {"tokens": tokens, "seconds": dt,
+                    "goodput": generated / max(dt, 1e-9),
+                    "handoffs_ok": (s["handoffs_ok"]
+                                    - s0["handoffs_ok"]),
+                    "handoffs_fallback": (s["handoffs_fallback"]
+                                          - s0["handoffs_fallback"]),
+                    "handoffs_failed": (s["handoffs_failed"]
+                                        - s0["handoffs_failed"]),
+                    "wire_bytes": wire_bytes,
+                    "frames_corrupted": (inj.frames_corrupted
+                                         if inj else 0)}
+        finally:
+            if router is not None:
+                router.close()
+            for rep in replicas:
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+
+    wire = run_arm(wire=True)
+    fallback = run_arm(wire=False)
+
+    # -- exactness: both arms match an uninterrupted in-process run
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    eng = InferenceEngine(cfg, mesh, params, EngineConfig(**ENGINE_KW))
+    for i in range(N_REQ):
+        h = eng.submit(_prompt(i), max_new_tokens=MAX_NEW)
+        eng.run_pending()
+        want = np.asarray(h.result(0), np.int32)
+        np.testing.assert_array_equal(wire["tokens"][i], want)
+        np.testing.assert_array_equal(fallback["tokens"][i], want)
+
+    # -- the wire really carried the happy path, and the ONE corrupt
+    #    frame degraded to a counted re-prefill, not a loss
+    assert wire["frames_corrupted"] == 2   # one warmup + one timed
+    assert wire["handoffs_failed"] == 1
+    assert wire["handoffs_ok"] == N_REQ - 1
+    assert wire["handoffs_fallback"] == 0
+    assert wire["wire_bytes"] > 0
+    # -- the fallback arm re-prefilled everything
+    assert fallback["handoffs_ok"] == 0
+    assert fallback["handoffs_fallback"] == N_REQ
+    # -- goodput: moving KV beats recomputing it
+    ratio = wire["goodput"] / max(fallback["goodput"], 1e-9)
+    assert ratio >= 1.0, (
+        f"wire goodput {wire['goodput']:.1f} tok/s < fallback "
+        f"{fallback['goodput']:.1f} tok/s ({ratio:.2f}x)")
+
+    return {"config": (f"kvwire_storm_{N_REQ}req_prompt{PROMPT}_"
+                       f"2p1d_subprocess"),
+            "wire": {"goodput_tokens_per_sec":
+                     round(wire["goodput"], 1),
+                     "serve_seconds": round(wire["seconds"], 3),
+                     "handoffs_ok": wire["handoffs_ok"],
+                     "handoffs_failed_corrupt":
+                     wire["handoffs_failed"],
+                     "handoff_bytes": wire["wire_bytes"],
+                     "handoff_bytes_per_sec": round(
+                         wire["wire_bytes"] / max(wire["seconds"],
+                                                  1e-9))},
+            "fallback": {"goodput_tokens_per_sec":
+                         round(fallback["goodput"], 1),
+                         "serve_seconds": round(
+                             fallback["seconds"], 3),
+                         "reprefills": fallback["handoffs_fallback"]},
+            "token_exact_across_arms": True,
+            "corrupt_frame_degraded_gracefully": True,
+            "value": round(ratio, 3),
+            "unit": "x_wire_goodput_vs_reprefill_fallback"}
+
+
 def bench_cold_start(reps: int = 2, *, seed: int = 0) -> dict:
     """Replica cold-start + tick-loop raw speed (ISSUE-12 acceptance,
     asserted IN-BENCH: restart-to-first-token >= 3x faster cache-warm
@@ -2694,6 +2867,7 @@ BENCHES = {"transformer": bench_transformer,
            "disagg": bench_disagg,
            "prefix_affinity": bench_prefix_affinity,
            "qos_storm": bench_qos_storm,
+           "kvwire_storm": bench_kvwire_storm,
            "fleet_obs": bench_fleet_obs,
            "cold_start": bench_cold_start,
            "profiling_overhead": bench_profiling_overhead,

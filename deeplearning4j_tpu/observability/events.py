@@ -88,7 +88,16 @@ EVENT_KINDS = frozenset({
     #                  tokens, bytes, outcome: ok|stale|failed} —
     #                  "stale" means the advertised chain was evicted
     #                  before export, "failed" an export error; both
-    #                  degrade to a normal prefill (ISSUE-14)
+    #                  degrade to a normal prefill (ISSUE-14).
+    #                  Proactive pushes at autoscale-up add
+    #                  {proactive: True} (ISSUE-17)
+    "kvwire",        # KV wire transport (ISSUE-17): one kvwire frame
+    #                  crossed (or failed to cross) a process boundary
+    #                  {direction: export|adopt|seed|control, outcome:
+    #                  ok|magic|version|crc|truncated|type|error,
+    #                  bytes, seconds} — every failure outcome
+    #                  degrades to the re-prefill path, never a lost
+    #                  request
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

@@ -357,13 +357,22 @@ class FleetFaultInjector:
       the request is never lost — the decode dispatch falls back to
       re-prefilling the committed prefix, token-exactly, and the
       handoff is counted ``outcome="failed"``.
+    - ``corrupt_frame_at``: handoff sequence indices whose EXPORTED
+      kvwire frame is corrupted in flight (ISSUE-17): the tiered
+      router runs the exported handoff through a real encode ->
+      flip-one-payload-byte -> decode round trip, so the frame's
+      CRC32 check — not a mock — rejects it. Contract under test:
+      typed ``WireError(kind="crc")``, a ``kvwire`` trace event, the
+      handoff counted ``outcome="failed"``, and the request completes
+      token-exactly via re-prefill.
     """
 
     def __init__(self, kill_at: Optional[dict] = None,
                  hang_at: Optional[dict] = None,
                  slow_at: Optional[dict] = None,
                  fail_probe: Optional[dict] = None,
-                 handoff_fail_at: Iterable[int] = ()):
+                 handoff_fail_at: Iterable[int] = (),
+                 corrupt_frame_at: Iterable[int] = ()):
         self.kill_at = {int(k): int(v)
                         for k, v in (kill_at or {}).items()}
         self.hang_at = {int(k): int(v)
@@ -373,11 +382,13 @@ class FleetFaultInjector:
         self.fail_probe = {int(k): int(v)
                            for k, v in (fail_probe or {}).items()}
         self.handoff_fail_at = set(int(i) for i in handoff_fail_at)
+        self.corrupt_frame_at = set(int(i) for i in corrupt_frame_at)
         self.kills_injected = 0
         self.hangs_injected = 0
         self.slows_injected = 0
         self.probe_failures_injected = 0
         self.handoffs_failed = 0
+        self.frames_corrupted = 0
 
     def check_kill(self, tick: int) -> Optional[int]:
         """One-shot: the replica id to crash at ``tick``, else None."""
@@ -408,6 +419,16 @@ class FleetFaultInjector:
         if int(seq) in self.handoff_fail_at:
             self.handoff_fail_at.discard(int(seq))
             self.handoffs_failed += 1
+            return True
+        return False
+
+    def check_corrupt_frame(self, seq: int) -> bool:
+        """One-shot: True when the ``seq``-th handoff's exported
+        kvwire frame should be corrupted in flight (the CRC check
+        rejects it and the decode tier re-prefills)."""
+        if int(seq) in self.corrupt_frame_at:
+            self.corrupt_frame_at.discard(int(seq))
+            self.frames_corrupted += 1
             return True
         return False
 

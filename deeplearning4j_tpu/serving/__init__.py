@@ -137,6 +137,23 @@ the layer between callers and the compiled decode step:
   `serving_fleet_qos_*` metric (docs/serving.md "Tenant QoS &
   overload control").
 
+- KV wire transport (round 22, ISSUE-17): `serving/kvwire.py`
+  defines ONE versioned, length-framed, CRC32-checked binary
+  encoding of `KVHandoff` (dtype/quantization tag, per-row scales,
+  committed-token prefix, weights-step) and ships it over the
+  `SubprocessReplica` worker pipe (base64 on the JSON lines) and
+  over plain sockets (`WireServer`) — so cross-tier handoff, chain
+  migration, and spillover seeding all work across REAL process
+  boundaries instead of silently re-prefilling. Quantize-on-adopt
+  lets an int8 decode tier adopt from a float prefill tier (per-row
+  scales computed at encode time); autoscale-up proactively pushes
+  the fleet's hottest advertised chains to the new replica; replica
+  LRU eviction is biased away from fleet-advertised chains; and
+  `qos_control` actuates over the same framing. Every decode/CRC/
+  version failure degrades to re-prefill (typed `WireError`, a
+  `kvwire` trace event, `serving_kvwire_*` metrics) — never a lost
+  request — docs/serving.md "KV wire transport".
+
 Lifecycle and thresholds: docs/serving.md.
 """
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
@@ -152,3 +169,8 @@ from deeplearning4j_tpu.serving.engine import (  # noqa: F401
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetHandle, InProcessReplica, ReplicaState, Router,
     SubprocessReplica, TenantCapExceeded)
+from deeplearning4j_tpu.serving.kvwire import (  # noqa: F401
+    WIRE_VERSION, WireError, WireServer, decode_control,
+    decode_handoff, encode_control, encode_handoff, frame_from_text,
+    frame_to_text, recv_frame, requantize_handoff, send_frame,
+    wire_call)

@@ -67,8 +67,10 @@ on request traces, a per-tier table in ``debugz()``.
 
 Deterministic on CPU via `parallel.failure.FleetFaultInjector`
 (kill/hang/probe knobs tier-agnostic, ``handoff_fail_at`` for the
-export path) and `ServingFaultInjector.adopt_fail_requests` for the
-decode-side seating path — tests/test_serving_disagg.py.
+export path, ``corrupt_frame_at`` for the kvwire frame path —
+ISSUE-17) and `ServingFaultInjector.adopt_fail_requests` for the
+decode-side seating path — tests/test_serving_disagg.py and
+tests/test_serving_kvwire.py.
 """
 from __future__ import annotations
 
@@ -199,6 +201,18 @@ def _validate_tier_configs(pc: EngineConfig, dc: EngineConfig) -> None:
     for f in ("temperature", "top_k", "top_p", "seed", "quantize",
               "kv_quantize"):
         if getattr(pc, f) != getattr(dc, f):
+            if (f == "kv_quantize" and not pc.kv_quantize
+                    and dc.kv_quantize):
+                # quantize-on-adopt (ISSUE-17): a FLOAT prefill tier
+                # may feed a quantized decode tier — the handoff is
+                # row-quantized at encode time (kvwire), per-row
+                # scales riding with the rows, so the continuation
+                # matches the decode tier's own numerics exactly as
+                # if it had prefilled there itself
+                log.info("heterogeneous tiers: float prefill KV will "
+                         "be quantized to %r on adopt",
+                         dc.kv_quantize)
+                continue
             raise ValueError(
                 f"prefill/decode tier configs disagree on {f!r} "
                 f"({getattr(pc, f)!r} vs {getattr(dc, f)!r}) — the "
@@ -241,9 +255,12 @@ class TieredRouter(Router):
             # the caller assigns each to a tier. No factories exist,
             # so the autoscaler (which builds/revives replicas) is
             # unsupported here, and config parity across tiers is the
-            # caller's contract. Handoff-incapable replicas degrade
-            # to re-prefill on the decode tier, exactly like a failed
-            # export — slower, never wrong.
+            # caller's contract. KV crosses process boundaries as
+            # versioned CRC-checked kvwire frames (ISSUE-17), so
+            # subprocess tiers hand off for real; only a replica that
+            # cannot export at all — or a frame that fails its
+            # checks — degrades to re-prefill on the decode tier,
+            # the explicit DEGRADED mode: slower, never wrong.
             if tiers is None or len(tiers) != len(replicas):
                 raise ValueError("pass tiers=[...] naming each "
                                  "pre-built replica's tier")
@@ -315,8 +332,10 @@ class TieredRouter(Router):
         self._m_handoffs = r.counter(
             "serving_handoff_transfers",
             "Prefill->decode handoff resolutions, by outcome: ok "
-            "(KV adopted), fallback (target re-prefilled), failed "
-            "(export error; target re-prefilled)",
+            "(KV moved — by reference in-process, as a kvwire frame "
+            "across a process boundary), fallback (handoff-incapable "
+            "target re-prefilled: the degraded mode), failed "
+            "(export/wire error; target re-prefilled)",
             labelnames=("outcome",))
         self._m_handoff_ok = self._m_handoffs.labels("ok")
         self._m_handoff_fallback = self._m_handoffs.labels("fallback")
@@ -496,13 +515,58 @@ class TieredRouter(Router):
         kv, fr._handoff = fr._handoff, None   # consumed: a redispatch
         #                                       after any failure
         #                                       re-prefills instead
+        if kv is not None:
+            kv = self._match_target_kv(kv, ctl, fr)
         kw = {"kv": kv} if kv is not None else {}
         if fr.tenant is not None:
             kw["tenant"] = fr.tenant
         if fr.priority:
             kw["priority"] = fr.priority
-        return ctl.replica.submit(prompt, remaining, deadline_s,
-                                  fr.on_deadline, trace_ctx=ctx, **kw)
+        rep = ctl.replica
+        if kv is not None:
+            rep.last_wire = None
+        inner = rep.submit(prompt, remaining, deadline_s,
+                           fr.on_deadline, trace_ctx=ctx, **kw)
+        lw = getattr(rep, "last_wire", None) if kv is not None else None
+        if lw:  # the handoff crossed the pipe as a kvwire frame
+            self._kvwire_count("adopt", "ok", lw["bytes"],
+                               lw["seconds"])
+            fr.trace.add("kvwire", direction="adopt", outcome="ok",
+                         bytes=lw["bytes"], seconds=lw["seconds"])
+        return inner
+
+    def _target_kv_mode(self, ctl) -> Optional[str]:
+        """The decode target's KV quantization mode: read off the
+        in-process engine directly, else the last health probe's
+        kv_quantize, else the decode tier's own EngineConfig."""
+        eng = getattr(ctl.replica, "engine", None)
+        if eng is not None:
+            return eng._kv_mode
+        h = ctl.last_health or {}
+        if "kv_quantize" in h:
+            return h["kv_quantize"]
+        dc = self._tier_cfgs.get(DECODE)
+        return getattr(dc, "kv_quantize", None) if dc else None
+
+    def _match_target_kv(self, kv, ctl, fr):
+        """Quantize-on-adopt (ISSUE-17): a FLOAT handoff headed for a
+        quantized decode replica is row-quantized HERE, at encode
+        time — per-row absmax scales computed on the float rows ride
+        with them — so heterogeneous tiers adopt instead of
+        re-prefilling. Anything else passes through unchanged (the
+        engine's own adoptability check still guards it), and a
+        failed requantize just leaves the float handoff to be dropped
+        there — re-prefill, never wrong."""
+        from deeplearning4j_tpu.serving import kvwire
+        want = self._target_kv_mode(ctl)
+        if not want or kv.kv_mode is not None or kv.kv_mode == want:
+            return kv
+        try:
+            return kvwire.requantize_handoff(kv, want)
+        except Exception as e:
+            log.warning("quantize-on-adopt to %r failed (%s); "
+                        "request %d re-prefills", want, e, fr.rid)
+            return kv
 
     # ------------------------------------------------------------------
     # the handoff
@@ -533,6 +597,7 @@ class TieredRouter(Router):
         self._handoff_seq += 1
         handoff = None
         outcome = "fallback"
+        wire = None                  # kvwire audit (ISSUE-17)
         t0 = _perf()
         try:
             inj = self._injector
@@ -545,21 +610,47 @@ class TieredRouter(Router):
                     and getattr(ctl.replica, "supports_handoff",
                                 False)):
                 handoff = ctl.replica.export_kv(hop.inner)
+                lw = getattr(ctl.replica, "last_wire", None)
+                if lw:   # the export crossed the pipe as a frame
+                    wire = {"direction": "export", "outcome": "ok",
+                            **lw}
+                if (handoff is not None and inj is not None
+                        and hasattr(inj, "check_corrupt_frame")
+                        and inj.check_corrupt_frame(seq)):
+                    # deterministic wire-fault realism: run the
+                    # handoff through a REAL encode -> flip one
+                    # payload byte -> decode round trip; the frame's
+                    # CRC32 — not a mock — rejects it and the request
+                    # degrades to re-prefill
+                    from deeplearning4j_tpu.serving import kvwire
+                    frame = bytearray(kvwire.encode_handoff(handoff))
+                    frame[-1] ^= 0xFF
+                    wire = {"direction": "export",
+                            "bytes": len(frame)}
+                    handoff = kvwire.decode_handoff(bytes(frame))
                 outcome = "ok"
         except Exception as e:
             outcome = "failed"
+            handoff = None   # a corrupt frame's rows are never kept
+            kind = getattr(e, "kind", None)   # typed WireError
+            if kind is not None:
+                wire = {**(wire or {"direction": "export"}),
+                        "outcome": kind}
             log.warning("KV export from replica %d failed (%s); "
                         "request %d will re-prefill on the decode "
                         "tier", hop.replica_id, e, fr.rid)
             # the injected/raised-before-export case: release the held
-            # slot so the prefill replica's pages (and seat) free
-            try:
-                if (ctl is not None and not ctl.dead
-                        and hasattr(ctl.replica, "engine")):
-                    ctl.replica.engine.release_held(hop.inner)
-            except Exception:
-                pass
+            # slot so the prefill replica's pages (and seat) free —
+            # engine directly in-process, over the pipe for subprocess
+            # replicas (ISSUE-17)
+            self._release_hold(ctl, hop.inner)
         dt = _perf() - t0
+        if wire is not None:
+            wire.setdefault("outcome", "error")
+            wire.setdefault("seconds", round(dt, 6))
+            self._kvwire_count(wire["direction"], wire["outcome"],
+                               wire.get("bytes", 0), wire["seconds"])
+            fr.trace.add("kvwire", **wire)
         if handoff is not None:
             self._m_handoff_ok.inc()
             self._m_handoff_tokens.inc(int(handoff.pos))
@@ -587,6 +678,24 @@ class TieredRouter(Router):
             fr.status = RequestStatus.QUEUED
             fr._queued_at = now
             self._queue.appendleft(fr)
+
+    def _release_hold(self, ctl, inner) -> None:
+        """Free a held prefill slot this router will never export:
+        the engine directly when we hold one, the replica's own
+        release path (op over the pipe, ISSUE-17) otherwise. Always
+        best-effort — the hold also dies with its process."""
+        try:
+            if ctl is None or ctl.dead:
+                return
+            eng = getattr(ctl.replica, "engine", None)
+            if eng is not None:
+                eng.release_held(inner)
+                return
+            rel = getattr(ctl.replica, "release_held", None)
+            if rel is not None:
+                rel(inner)
+        except Exception:
+            pass
 
     def _prepare_failover(self, fr: FleetHandle, ctl) -> None:
         """A lost DECODE replica took the request's adopted KV with
@@ -625,6 +734,19 @@ class TieredRouter(Router):
                 continue
             eng = getattr(ctl.replica, "engine", None)
             if eng is None:
+                # subprocess replicas (ISSUE-17): the replica proxy
+                # tracks which submits held their slot; any done one
+                # no hop still points at is an orphan to release
+                # over the pipe
+                holds = getattr(ctl.replica, "held_handles", None)
+                if holds is None:
+                    continue
+                for h in holds():
+                    if h.done() and id(h) not in live:
+                        log.info("releasing orphaned held slot for "
+                                 "worker request %d on replica %d",
+                                 h.rid, ctl.id)
+                        ctl.replica.release_held(h)
                 continue
             with eng._lock:
                 orphans = [s for s in eng._slots
@@ -711,6 +833,7 @@ class TieredRouter(Router):
                     tier, "up", now,
                     cold_start_s=getattr(ctl.replica, "cold_start_s",
                                          None))
+                self._proactive_seed(ctl)
                 return True
         replica = InProcessReplica(self._next_id,
                                    self._factories[tier],
@@ -723,7 +846,68 @@ class TieredRouter(Router):
         self._log_autoscale(tier, "up", now,
                             cold_start_s=getattr(replica,
                                                  "cold_start_s", None))
+        self._proactive_seed(ctl)
         return True
+
+    def _proactive_seed(self, ctl) -> None:
+        """Proactive KV migration (ISSUE-17): before any traffic
+        lands on a just-scaled-up replica, push the fleet's hottest
+        advertised chains into its radix cache — its first dispatches
+        then hit the prefix cache instead of prefilling from zero,
+        which is the whole point of scaling up under prefix-heavy
+        load. Takes the ``proactive_chains`` largest chains across
+        every live digest (0 disables). Best-effort end to end: a
+        stale or failed push costs nothing but itself, counted with
+        the same kv_migration metrics/events as demand migration
+        (marked ``proactive``)."""
+        k = max(0, int(getattr(self.config, "proactive_chains", 0)))
+        seeder = getattr(ctl.replica, "seed_chain", None)
+        if k == 0 or seeder is None:
+            return
+        cands = []
+        for src in self._ctls:
+            if (src is ctl or src.dead or not src.digest
+                    or not hasattr(src.replica, "export_cached_chain")):
+                continue
+            for h, toks in src.digest.get("top", ()):
+                cands.append((int(toks), int(h), src))
+        cands.sort(key=lambda t: -t[0])
+        pushed = 0
+        seen = set()
+        for toks, h, src in cands:
+            if pushed >= k:
+                break
+            if h in seen:
+                continue
+            seen.add(h)
+            outcome, nbytes = "stale", 0
+            try:
+                kvh = src.replica.export_cached_chain(h)
+                if kvh is not None:
+                    nbytes = int(kvh.nbytes)
+                    outcome = "ok" if seeder(kvh) else "failed"
+            except Exception as e:
+                outcome = "failed"
+                log.warning("proactive chain push %x from replica %d "
+                            "failed (%s)", h, src.id, e)
+            if outcome == "ok":
+                pushed += 1
+                self._m_migrations_ok.inc()
+                self._m_migrated_tokens.inc(toks)
+                self._m_migrated_bytes.inc(nbytes)
+            elif outcome == "failed":
+                self._m_migrations_failed.inc()
+            else:
+                self._m_migrations_stale.inc()
+            self.recorder.record(
+                "kv_migration", rid=0, outcome=outcome,
+                proactive=True, **{"from": int(src.id),
+                                   "to": int(ctl.id),
+                                   "tokens": int(toks),
+                                   "bytes": nbytes})
+        if pushed:
+            log.info("proactively seeded %d chain(s) into replica %d",
+                     pushed, ctl.id)
 
     def _scale_down(self, tier: str, now: float) -> bool:
         """Pick the emptiest replica of the tier and drain it out of

@@ -3237,6 +3237,31 @@ class InferenceEngine:
         self._m_adoptions.labels("seeded").inc()
         return True
 
+    def seed_cached_chain(self, kv: KVHandoff) -> bool:
+        """Public cache-seed entry (ISSUE-17): adopt a
+        ``source="cache"`` handoff — decoded off the wire or exported
+        by a peer — into this engine's radix prefix cache. The fleet
+        router's proactive-migration sink: autoscale-up pushes the
+        fleet's hottest chains here before traffic lands. Returns
+        False (nothing claimed, next request prefills normally) when
+        this engine cannot host cached chains or the seed fails."""
+        if not (self._continuous and self._paged
+                and self._prefix_cache is not None):
+            return False
+        with self._lock:
+            return self._seed_cached_chain(kv)
+
+    def set_advertised_chains(self, hashes) -> int:
+        """Install the fleet-advertised chain-hash set (ISSUE-17):
+        the radix cache biases LRU eviction away from these, so a
+        chain the router is actively routing by is not the first
+        casualty of a local pool squeeze. Returns the set size
+        installed (0 when there is no prefix cache)."""
+        if self._prefix_cache is None:
+            return 0
+        with self._lock:
+            return self._prefix_cache.set_advertised(hashes)
+
     def committed_kv_pages(self, handle: RequestHandle) -> int:
         """KV pages request ``handle``'s slot currently references —
         what fleet_worker.py reports in its progress lines (0 for

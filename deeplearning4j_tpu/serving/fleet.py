@@ -106,9 +106,11 @@ from deeplearning4j_tpu.observability.metrics import (
 from deeplearning4j_tpu.observability.slo import NULL_SLO, SLOTracker
 from deeplearning4j_tpu.observability.stitch import (fleet_timeline_json,
                                                      stitch)
+from deeplearning4j_tpu.serving import kvwire
 from deeplearning4j_tpu.serving.engine import (DeadlineExceeded,
                                                EngineDraining,
                                                EngineStopped,
+                                               HandoffError,
                                                OverloadError,
                                                RequestQuarantined,
                                                RequestStatus,
@@ -228,6 +230,16 @@ class FleetConfig:
     # queue where no preemption can reach. Priority-0 dispatch is
     # byte-identical (headroom 0), so QoS-off behavior is unchanged.
     priority_overcommit: int = 1
+    # KV wire transport (ISSUE-17). At autoscale-up the tiered router
+    # PUSHES the fleet's ``proactive_chains`` hottest advertised
+    # chains into the new replica's radix cache before traffic lands
+    # (0 disables the push). Every ``advertise_every_ticks`` ticks the
+    # router unions the live digests' top chains and installs the set
+    # on every replica, biasing their LRU eviction away from chains
+    # the fleet is actively routing by (pushed only when the set
+    # changed — an idle fleet costs the pipes nothing).
+    proactive_chains: int = 4
+    advertise_every_ticks: int = 16
 
 
 class FleetHandle:
@@ -361,9 +373,11 @@ class InProcessReplica:
     routes `probe()` through real HTTP `/healthz` semantics."""
 
     kind = "inprocess"
-    #: in-process replicas can export/adopt KV handoffs by reference
-    #: (ISSUE-11); subprocess ones would need the rows serialized over
-    #: the pipe — the tiered router falls back to re-prefill there
+    #: replicas export/adopt KV handoffs — by reference in-process
+    #: (ISSUE-11), as versioned CRC-checked kvwire frames over the
+    #: worker pipe for subprocess replicas (ISSUE-17); the tiered
+    #: router re-prefills only as the DEGRADED mode, when a target
+    #: cannot take KV at all or the wire itself fails
     supports_handoff = True
     #: same process, same perf_counter: replica trace timestamps are
     #: already in the router's clock domain (ISSUE-13)
@@ -471,6 +485,27 @@ class InProcessReplica:
         if self._dead:
             raise ReplicaCrashed(f"replica {self.id} is dead")
         return self.engine.export_slot_kv(inner, release=release)
+
+    def export_cached_chain(self, chain_hash: int):
+        """Cached-chain migration source (ISSUE-14/17): the engine's
+        host-gathered ``source="cache"`` handoff, or None when the
+        chain was evicted since its advertisement."""
+        if self._dead:
+            raise ReplicaCrashed(f"replica {self.id} is dead")
+        return self.engine.export_cached_chain(chain_hash)
+
+    def seed_chain(self, kv) -> bool:
+        """Cached-chain migration sink (ISSUE-17): adopt a peer's
+        exported chain into this engine's radix cache."""
+        if self._dead:
+            return False
+        return self.engine.seed_cached_chain(kv)
+
+    def set_advertised(self, hashes) -> None:
+        """Fleet-advertised chain hashes: bias this engine's cache
+        eviction away from them (ISSUE-17)."""
+        if not self._dead:
+            self.engine.set_advertised_chains(hashes)
 
     def cancel(self, inner) -> None:
         if not self._dead:
@@ -609,7 +644,11 @@ class SubprocessReplica:
     in-process engine built the same way."""
 
     kind = "subprocess"
-    supports_handoff = False     # KV stays behind the process boundary
+    #: ISSUE-17: KV crosses the process boundary as versioned,
+    #: length-framed, CRC32-checked kvwire frames (serving/kvwire.py)
+    #: — base64 on this JSON pipe, raw on sockets. Re-prefill is the
+    #: DEGRADED mode now, taken only when a frame fails its checks.
+    supports_handoff = True
 
     #: probe-RTT pings per clock handshake; min-RTT midpoint wins
     _CLOCK_PINGS = 5
@@ -631,6 +670,12 @@ class SubprocessReplica:
         # progress lines (ISSUE-14): the router's probe loop reads it
         # here between HTTP probes
         self.prefix_digest: Optional[dict] = None
+        # KV wire state (ISSUE-17): the worker's frame version (from
+        # hello), the last wire transfer's {bytes, seconds} audit, and
+        # the last qos_applied ack off the pipe
+        self.wire_version: Optional[int] = None
+        self.last_wire: Optional[dict] = None
+        self.last_qos: Optional[dict] = None
         self._spawn()
 
     # -- process lifecycle ---------------------------------------------
@@ -638,6 +683,12 @@ class SubprocessReplica:
         self._handles: Dict[int, _ProxyHandle] = {}
         self._acks: Dict[str, threading.Event] = {}
         self._ack_payload: Dict[str, dict] = {}
+        # kvwire rpc plumbing (ISSUE-17): call-id -> (Event, payload)
+        # for the synchronous wire ops, plus the held-slot handles a
+        # later export_kv/release_held will name by rid
+        self._rpc: Dict[int, tuple] = {}
+        self._rpc_seq = itertools.count(1)
+        self._held_handles: Dict[int, "_ProxyHandle"] = {}
         self._eof = threading.Event()
         self._hello = threading.Event()
         self._port = None
@@ -729,7 +780,19 @@ class SubprocessReplica:
                                          self.last_warmup))
             if ev.get("prefix_digest"):
                 self.prefix_digest = ev["prefix_digest"]
+            self.wire_version = ev.get("kv_wire")
             self._hello.set()
+            return
+        if kind == "wire":
+            # one kvwire rpc answered (ISSUE-17)
+            with self._lock:
+                ent = self._rpc.get(ev.get("call"))
+            if ent is not None:
+                ent[1].update(ev)
+                ent[0].set()
+            return
+        if kind == "qos_applied":
+            self.last_qos = ev.get("state") or {"error": ev.get("error")}
             return
         if kind == "clock":
             t1 = time.perf_counter()
@@ -800,32 +863,163 @@ class SubprocessReplica:
                **kw):
         # the hop's trace context DOES cross the pipe (ISSUE-13), and
         # so does the tenant label (ISSUE-15: the worker's engine
-        # bills the right tenant); the KV-handoff knobs still don't
+        # bills the right tenant). The KV-handoff knobs cross it too
+        # now (ISSUE-17): hold_kv as a flag, kv as one base64 kvwire
+        # frame the worker decodes and adopts — any decode failure
+        # over there degrades to a plain (re-prefill) submit.
         trace_ctx = kw.pop("trace_ctx", None)
         tenant = kw.pop("tenant", None)
         priority = kw.pop("priority", 0)
+        hold_kv = bool(kw.pop("hold_kv", False))
+        kv = kw.pop("kv", None)
         if kw:
             log.warning("subprocess replica %d ignores submit "
-                        "kwargs %s (no cross-pipe KV handoff)",
-                        self.id, sorted(kw))
+                        "kwargs %s", self.id, sorted(kw))
         if not self.alive():
             raise ReplicaCrashed(f"replica {self.id} is dead")
         lrid = next(self._lrids)
         h = _ProxyHandle(lrid, np.asarray(prompt, np.int32),
                          max_new_tokens)
+        msg = {"op": "submit", "rid": lrid,
+               "prompt": np.asarray(prompt).tolist(),
+               "max_new_tokens": max_new_tokens,
+               "deadline_s": deadline_s,
+               "on_deadline": on_deadline,
+               "trace_ctx": trace_ctx,
+               "tenant": tenant,
+               # QoS class crosses the pipe too (ISSUE-16): the
+               # worker's engine seats/preempts by it
+               "priority": int(priority)}
+        if hold_kv:
+            msg["hold_kv"] = True
+        if kv is not None:
+            t0 = time.perf_counter()
+            frame = kvwire.encode_handoff(kv)
+            msg["kvframe"] = kvwire.frame_to_text(frame)
+            self.last_wire = {"bytes": len(frame),
+                              "seconds": time.perf_counter() - t0}
         with self._lock:
             self._handles[lrid] = h
-        self._send({"op": "submit", "rid": lrid,
-                    "prompt": np.asarray(prompt).tolist(),
-                    "max_new_tokens": max_new_tokens,
-                    "deadline_s": deadline_s,
-                    "on_deadline": on_deadline,
-                    "trace_ctx": trace_ctx,
-                    "tenant": tenant,
-                    # QoS class crosses the pipe too (ISSUE-16): the
-                    # worker's engine seats/preempts by it
-                    "priority": int(priority)})
+        self._send(msg)
+        if hold_kv:
+            self._held_handles[lrid] = h
         return h
+
+    # -- KV wire surface (ISSUE-17) ------------------------------------
+    def _wire_rpc(self, msg: dict, timeout: float) -> dict:
+        """One synchronous kvwire op over the pipe: send with a call
+        id, wait for the worker's matching ``wire`` event."""
+        call = next(self._rpc_seq)
+        ev = threading.Event()
+        payload: dict = {}
+        with self._lock:
+            self._rpc[call] = (ev, payload)
+        try:
+            self._send({**msg, "call": call})
+            if not ev.wait(timeout):
+                raise kvwire.WireError(
+                    "error", f"replica {self.id}: no answer to "
+                             f"{msg.get('op')} within {timeout}s")
+        finally:
+            with self._lock:
+                self._rpc.pop(call, None)
+        return payload
+
+    def export_kv(self, inner, release: bool = True,
+                  timeout: float = 60.0):
+        """Pull ``inner``'s held committed KV across the pipe as one
+        kvwire frame and decode it ROUTER-side (the CRC/version checks
+        run here, where a failure can still degrade to re-prefill).
+        Sets ``last_wire`` to the transfer's {bytes, seconds}."""
+        self.last_wire = None
+        t0 = time.perf_counter()
+        p = self._wire_rpc({"op": "export_kv", "rid": inner.rid},
+                           timeout)
+        self._held_handles.pop(inner.rid, None)
+        if p.get("error") or not p.get("frame"):
+            raise HandoffError(
+                f"replica {self.id}: wire export failed: "
+                f"{p.get('error', 'no frame returned')}")
+        frame = kvwire.frame_from_text(p["frame"])
+        kv = kvwire.decode_handoff(frame)
+        self.last_wire = {"bytes": len(frame),
+                          "seconds": time.perf_counter() - t0}
+        return kv
+
+    def export_cached_chain(self, chain_hash: int,
+                            timeout: float = 30.0):
+        """Cached-chain migration source over the wire: None when the
+        worker no longer caches the chain (stale advertisement)."""
+        self.last_wire = None
+        t0 = time.perf_counter()
+        p = self._wire_rpc({"op": "export_chain",
+                            "hash": int(chain_hash)}, timeout)
+        if p.get("error"):
+            raise HandoffError(
+                f"replica {self.id}: chain export failed: {p['error']}")
+        if not p.get("frame"):
+            return None
+        frame = kvwire.frame_from_text(p["frame"])
+        kv = kvwire.decode_handoff(frame)
+        self.last_wire = {"bytes": len(frame),
+                          "seconds": time.perf_counter() - t0}
+        return kv
+
+    def seed_chain(self, kv, timeout: float = 30.0) -> bool:
+        """Cached-chain migration sink over the wire."""
+        self.last_wire = None
+        t0 = time.perf_counter()
+        frame = kvwire.encode_handoff(kv)
+        p = self._wire_rpc({"op": "seed_chain",
+                            "frame": kvwire.frame_to_text(frame)},
+                           timeout)
+        ok = bool(p.get("ok"))
+        if ok:
+            self.last_wire = {"bytes": len(frame),
+                              "seconds": time.perf_counter() - t0}
+        return ok
+
+    def release_held(self, inner) -> bool:
+        """Drop a held slot the router will never export (fallback or
+        failed handoff): fire-and-forget across the pipe."""
+        self._held_handles.pop(inner.rid, None)
+        try:
+            self._send({"op": "release_held", "rid": inner.rid})
+        except ReplicaCrashed:
+            return False
+        return True
+
+    def held_handles(self):
+        """Handles whose worker slot is still held for export — the
+        tiered router's orphan-hold sweep reads this (ISSUE-17)."""
+        return list(self._held_handles.values())
+
+    def set_advertised(self, hashes) -> None:
+        """Fleet-advertised chain hashes -> worker eviction bias."""
+        try:
+            self._send({"op": "advertised",
+                        "hashes": [int(h) for h in hashes]})
+        except ReplicaCrashed:
+            pass
+
+    def qos_control(self, spec_off=None, decode_chunk=None,
+                    chunk_shrink=None) -> int:
+        """Actuate the worker engine's qos_control over the pipe as
+        one kvwire CONTROL frame (ISSUE-17 satellite). chunk_shrink
+        lets the WORKER halve against its own base chunk, which the
+        router cannot see. Fire-and-forget: the worker's qos_applied
+        ack lands on ``last_qos``. Returns the frame size sent."""
+        payload: dict = {}
+        if spec_off is not None:
+            payload["spec_off"] = bool(spec_off)
+        if decode_chunk is not None:
+            payload["decode_chunk"] = int(decode_chunk)
+        if chunk_shrink is not None:
+            payload["chunk_shrink"] = bool(chunk_shrink)
+        frame = kvwire.encode_control(payload)
+        self._send({"op": "qos",
+                    "frame": kvwire.frame_to_text(frame)})
+        return len(frame)
 
     def cancel(self, inner) -> None:
         if self.alive():
@@ -1224,6 +1418,49 @@ class Router:
             "kv_migrated_tokens": int(self._m_migrated_tokens.value)}
 
     # ------------------------------------------------------------------
+    # KV wire accounting (ISSUE-17)
+    # ------------------------------------------------------------------
+    def _kvwire_metrics(self) -> dict:
+        """The serving_kvwire_* families, registered LAZILY on first
+        wire activity: a wire-off fleet (all-in-process, no faults)
+        never touches them, so its scrape stays byte-identical."""
+        m = getattr(self, "_m_kvwire", None)
+        if m is None:
+            r = self.registry
+            self._m_kvwire = m = {
+                "frames": r.counter(
+                    "serving_kvwire_frames",
+                    "KV wire frames moved (or refused) across a "
+                    "process boundary, by direction (export = "
+                    "prefill-tier handoff out, adopt = decode-tier "
+                    "handoff in, seed = cached-chain migration, "
+                    "control = qos actuation) and outcome (ok, or "
+                    "the typed decode failure: magic | version | "
+                    "crc | truncated | type | error — every failure "
+                    "degrades to re-prefill)",
+                    labelnames=("direction", "outcome")),
+                "bytes": r.counter(
+                    "serving_kvwire_bytes",
+                    "Encoded kvwire frame bytes moved across process "
+                    "boundaries (header + payload, pre-base64)"),
+                "seconds": r.histogram(
+                    "serving_kvwire_seconds",
+                    "One kvwire encode + transfer + decode round "
+                    "trip",
+                    buckets=DECODE_LATENCY_BUCKETS)}
+        return m
+
+    def _kvwire_count(self, direction: str, outcome: str,
+                      nbytes: int = 0,
+                      seconds: Optional[float] = None) -> None:
+        m = self._kvwire_metrics()
+        m["frames"].labels(direction, outcome).inc()
+        if nbytes:
+            m["bytes"].inc(int(nbytes))
+        if seconds is not None:
+            m["seconds"].observe(float(seconds))
+
+    # ------------------------------------------------------------------
     # admission
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -1435,9 +1672,12 @@ class Router:
 
     def _qos_apply(self) -> None:
         """Push the current ladder rung's knob state to every live
-        in-process replica (idempotent — qos_control sets absolute
-        state, so re-applying a rung is a no-op; subprocess replicas
-        without an engine handle are skipped)."""
+        replica (idempotent — qos_control sets absolute state, so
+        re-applying a rung is a no-op). In-process engines are called
+        directly; subprocess replicas actuate over the worker pipe as
+        one kvwire CONTROL frame (ISSUE-17 satellite) — chunk_shrink
+        resolves against the WORKER's base chunk, which this side
+        cannot see."""
         spec_off = self._qos_level >= 1
         shrink = self._qos_level >= 2
         for ctl in self._ctls:
@@ -1446,6 +1686,16 @@ class Router:
             eng = getattr(ctl.replica, "engine", None)
             qc = getattr(eng, "qos_control", None)
             if qc is None:
+                rqc = getattr(ctl.replica, "qos_control", None)
+                if rqc is None:
+                    continue
+                try:
+                    nbytes = rqc(spec_off=spec_off,
+                                 chunk_shrink=shrink)
+                    self._kvwire_count("control", "ok", nbytes)
+                except Exception:
+                    log.exception("wire qos_control failed on "
+                                  "replica %d", ctl.id)
                 continue
             try:
                 base = eng._base_chunk
@@ -1838,6 +2088,8 @@ class Router:
         progressed |= self._tick_restarts(now)
         if tick % max(1, self.config.probe_every_ticks) == 0:
             self._probe_all(now)
+        if tick % max(1, self.config.advertise_every_ticks) == 0:
+            self._push_advertised()
         progressed |= self._dispatch(now) > 0
         for ctl in self._ctls:
             if ctl.dead or not ctl.replica.alive():
@@ -1855,6 +2107,34 @@ class Router:
         self._detect_hangs()
         self._qos_tick(now)
         return progressed
+
+    def _push_advertised(self) -> None:
+        """Eviction bias for advertised chains (ISSUE-17): union the
+        live digests' top chains and install the set on every replica
+        — their radix caches then evict advertised chains LAST, so a
+        chain the fleet is actively routing by (or about to migrate)
+        is not the first casualty of a local pool squeeze. Pushed
+        only when the set changed; an idle fleet costs the pipes
+        nothing."""
+        hot: set = set()
+        for ctl in self._ctls:
+            if ctl.dead or not ctl.digest:
+                continue
+            hot.update(int(h) for h, _ in ctl.digest.get("top", ()))
+        if hot == getattr(self, "_advertised_pushed", None):
+            return
+        self._advertised_pushed = hot
+        for ctl in self._ctls:
+            if ctl.dead:
+                continue
+            setter = getattr(ctl.replica, "set_advertised", None)
+            if setter is None:
+                continue
+            try:
+                setter(hot)
+            except Exception:
+                log.debug("advertised-set push to replica %d failed",
+                          ctl.id, exc_info=True)
 
     def start(self) -> "Router":
         with self._lock:
@@ -2471,36 +2751,44 @@ class Router:
                            or 0)
         return pred, ps
 
-    def _migration_target_engine(self, ctl: _ReplicaCtl):
-        """The chosen replica's engine when it can ADOPT a migrated
-        chain (in-process, paged, radix cache on) — None otherwise
-        (subprocess replicas can't take KV across the pipe yet)."""
+    def _migration_target_ok(self, ctl: _ReplicaCtl) -> bool:
+        """Can the chosen replica ADOPT a migrated chain? In-process:
+        its engine is paged with the radix cache on. Subprocess
+        (ISSUE-17): the chain crosses the pipe as a kvwire frame —
+        the capability shows as the digest advertisement the worker's
+        hello/progress/probes carry (only a paged engine with a radix
+        cache ever advertises one)."""
         eng = getattr(ctl.replica, "engine", None)
-        if (eng is not None and getattr(eng, "_paged", False)
-                and getattr(eng, "_prefix_cache", None) is not None):
-            return eng
-        return None
+        if eng is not None:
+            return (getattr(eng, "_paged", False)
+                    and getattr(eng, "_prefix_cache", None) is not None)
+        return ("prefix_digest" in (ctl.last_health or {})
+                or getattr(ctl.replica, "prefix_digest", None)
+                is not None)
 
     def _maybe_migrate(self, fr: FleetHandle, ctl: _ReplicaCtl,
                        pred: int, now: float) -> int:
         """Move bytes, don't recompute: when capacity (or the
         anti-herd cap) forced ``fr`` onto a replica missing its
         prefix while another replica advertises it, pull the chain
-        from the advertiser (engine.export_cached_chain) and ship it
-        on this dispatch as a cache-source KVHandoff. Misprediction —
-        the chain evicted between advertisement and export (stale),
-        or an export error (failed) — degrades to a normal prefill.
-        Returns the migrated token count (0 = no migration)."""
+        from the advertiser (replica.export_cached_chain — direct
+        in-process, a kvwire frame over the pipe for subprocess
+        sources, ISSUE-17) and ship it on this dispatch as a
+        cache-source KVHandoff. Misprediction — the chain evicted
+        between advertisement and export (stale), or an export error
+        (failed) — degrades to a normal prefill. Returns the migrated
+        token count (0 = no migration)."""
         cfgf = self.config
         if not cfgf.migrate_kv or fr._migrate_kv is not None:
             return 0
-        if self._migration_target_engine(ctl) is None:
+        if not self._migration_target_ok(ctl):
             return 0
         best_toks, best_hash, best_ctl = 0, None, None
         for cand in self._ctls:
             if (cand is ctl or cand.dead
                     or not cand.replica.alive()
-                    or getattr(cand.replica, "engine", None) is None):
+                    or not hasattr(cand.replica,
+                                   "export_cached_chain")):
                 continue
             toks, h = self._affinity_tokens(cand, fr, now)
             if h is not None and toks > best_toks:
@@ -2511,10 +2799,13 @@ class Router:
             return 0
         outcome, kvh = "stale", None
         try:
-            kvh = best_ctl.replica.engine.export_cached_chain(
-                best_hash)
+            kvh = best_ctl.replica.export_cached_chain(best_hash)
             if kvh is not None:
                 outcome = "ok"
+                lw = getattr(best_ctl.replica, "last_wire", None)
+                if lw:   # the chain crossed a pipe as a kvwire frame
+                    self._kvwire_count("seed", "ok", lw["bytes"],
+                                       lw["seconds"])
         except Exception as e:
             outcome = "failed"
             log.warning("KV migration export from replica %d failed "
@@ -2554,8 +2845,18 @@ class Router:
             kw["tenant"] = fr.tenant
         if fr.priority:
             kw["priority"] = fr.priority
-        return ctl.replica.submit(prompt, remaining, deadline_s,
-                                  fr.on_deadline, trace_ctx=ctx, **kw)
+        rep = ctl.replica
+        if kv is not None:
+            rep.last_wire = None
+        inner = rep.submit(prompt, remaining, deadline_s,
+                           fr.on_deadline, trace_ctx=ctx, **kw)
+        lw = getattr(rep, "last_wire", None) if kv is not None else None
+        if lw:    # the migrated chain crossed a pipe (ISSUE-17)
+            self._kvwire_count("seed", "ok", lw["bytes"],
+                               lw["seconds"])
+            fr.trace.add("kvwire", direction="seed", outcome="ok",
+                         bytes=lw["bytes"], seconds=lw["seconds"])
+        return inner
 
     def _prepare_failover(self, fr: FleetHandle,
                           ctl: _ReplicaCtl) -> None:
@@ -2846,6 +3147,14 @@ class Router:
                 "budget_utilization": c.last_health.get(
                     "tick_budget_utilization"),
                 "weights_step": c.last_health.get("weights_step"),
+                # KV transport mode (ISSUE-17): "wire" replicas move
+                # handoffs/chains across boundaries (by reference
+                # in-process, kvwire frames over the pipe);
+                # "fallback" replicas force the re-prefill degraded
+                # mode on every handoff that targets them
+                "handoff_mode": ("wire" if getattr(
+                    c.replica, "supports_handoff", False)
+                    else "fallback"),
             } for c in self._ctls]
             queue = [{"rid": fr.rid,
                       "queue_age_s": round(max(0.0,

@@ -16,10 +16,16 @@ tests/test_multihost.py's pattern from training to serving. Protocol:
   `/healthz`/`/readyz`/`/metrics`/`/debugz` endpoints
   (observability.MetricsServer); the router probes them over HTTP.
 - stdin thereafter: one JSON command per line — ``submit`` (carrying
-  the router's distributed-tracing hop context, ISSUE-13) /
-  ``cancel`` / ``clock`` (clock-offset handshake: echoed back with
-  this process's perf_counter) / ``drain`` / ``resume`` / ``reload``
-  / ``stop``.
+  the router's distributed-tracing hop context, ISSUE-13, and
+  optionally ``hold_kv`` plus a base64 kvwire handoff frame to adopt,
+  ISSUE-17) / ``cancel`` / ``clock`` (clock-offset handshake: echoed
+  back with this process's perf_counter) / ``drain`` / ``resume`` /
+  ``reload`` / the kvwire ops ``export_kv`` / ``export_chain`` /
+  ``seed_chain`` / ``release_held`` (KV handoffs and cached-chain
+  migration cross the pipe as versioned CRC-checked frames —
+  serving/kvwire.py) / ``qos`` (qos_control actuation carried as one
+  kvwire CONTROL frame) / ``advertised`` (fleet-advertised chain
+  hashes for eviction bias) / ``stop``.
 - stdout thereafter: streamed request events — ``accepted`` /
   ``rejected`` / ``progress`` (the committed tokens so far — the
   router's failover substrate when this process is SIGKILLed — plus
@@ -69,6 +75,7 @@ def main() -> int:
                                                        init_params)
     from deeplearning4j_tpu.observability.export import MetricsServer
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving import kvwire
     from deeplearning4j_tpu.serving.engine import (EngineConfig,
                                                    InferenceEngine)
 
@@ -122,10 +129,19 @@ def main() -> int:
           # prefix-affinity advertisement (ISSUE-14): empty at birth,
           # but the key's presence tells the router this worker
           # piggybacks digests on its progress lines too
-          "prefix_digest": eng.health().get("prefix_digest")})
+          "prefix_digest": eng.health().get("prefix_digest"),
+          # KV wire capability (ISSUE-17): the frame version this
+          # worker speaks — handoffs/migration cross the pipe instead
+          # of degrading to re-prefill
+          "kv_wire": kvwire.WIRE_VERSION})
 
     handles: dict = {}
     h_lock = threading.Lock()
+    # held-slot handles (ISSUE-17): hold_kv submits park their handle
+    # here — the progress loop pops `handles` entries at done, but an
+    # export_kv/release_held for the slot arrives AFTER that. Only the
+    # command-loop thread touches this dict.
+    held: dict = {}
     stop = threading.Event()
 
     # digest piggyback state (ISSUE-14): re-emit the radix-cache
@@ -192,12 +208,28 @@ def main() -> int:
         op = cmd.get("op")
         if op == "submit":
             rid = cmd["rid"]
+            # KV adoption off the wire (ISSUE-17): a decode-tier
+            # submit may carry the prefill tier's handoff as a kvwire
+            # frame. Any decode failure degrades to a plain submit —
+            # the prompt already contains the committed prefix, so
+            # re-prefill is slower, never wrong.
+            kv = None
+            kvinfo = None
+            if cmd.get("kvframe"):
+                try:
+                    kv = kvwire.decode_handoff(
+                        kvwire.frame_from_text(cmd["kvframe"]))
+                except Exception as e:
+                    kvinfo = {"outcome": getattr(e, "kind", "error"),
+                              "error": f"{type(e).__name__}: {e}"}
+            hold = bool(cmd.get("hold_kv"))
             try:
                 h = eng.submit(
                     np.asarray(cmd["prompt"], np.int32),
                     max_new_tokens=cmd.get("max_new_tokens"),
                     deadline_s=cmd.get("deadline_s"),
                     on_deadline=cmd.get("on_deadline", "shed"),
+                    hold_kv=hold, kv=kv,
                     trace_ctx=cmd.get("trace_ctx"),
                     tenant=cmd.get("tenant"),
                     priority=int(cmd.get("priority") or 0))
@@ -207,7 +239,85 @@ def main() -> int:
                 continue
             with h_lock:
                 handles[rid] = h
-            emit({"ev": "accepted", "rid": rid})
+            if hold:
+                held[rid] = h
+            msg = {"ev": "accepted", "rid": rid}
+            if kvinfo is not None:
+                msg["kvwire"] = kvinfo
+            emit(msg)
+        elif op == "export_kv":
+            # held-slot KV export (ISSUE-17): gather the committed
+            # rows, release the hold, ship them back as one frame
+            call = cmd.get("call")
+            h = held.pop(cmd.get("rid"), None)
+            if h is None:
+                emit({"ev": "wire", "call": call,
+                      "error": "no held handle for rid "
+                               f"{cmd.get('rid')}"})
+                continue
+            try:
+                frame = kvwire.encode_handoff(
+                    eng.export_slot_kv(h, release=True))
+                emit({"ev": "wire", "call": call,
+                      "frame": kvwire.frame_to_text(frame),
+                      "nbytes": len(frame)})
+            except Exception as e:
+                emit({"ev": "wire", "call": call,
+                      "error": f"{type(e).__name__}: {e}"})
+        elif op == "export_chain":
+            # cached-chain migration source (ISSUE-17): None frame =
+            # chain evicted since advertisement — the router counts
+            # it stale and moves on
+            call = cmd.get("call")
+            try:
+                kvh = eng.export_cached_chain(int(cmd["hash"]))
+                if kvh is None:
+                    emit({"ev": "wire", "call": call, "frame": None})
+                else:
+                    frame = kvwire.encode_handoff(kvh)
+                    emit({"ev": "wire", "call": call,
+                          "frame": kvwire.frame_to_text(frame),
+                          "nbytes": len(frame)})
+            except Exception as e:
+                emit({"ev": "wire", "call": call,
+                      "error": f"{type(e).__name__}: {e}"})
+        elif op == "seed_chain":
+            # cached-chain migration sink (ISSUE-17)
+            call = cmd.get("call")
+            try:
+                kvh = kvwire.decode_handoff(
+                    kvwire.frame_from_text(cmd["frame"]))
+                emit({"ev": "wire", "call": call,
+                      "ok": bool(eng.seed_cached_chain(kvh))})
+            except Exception as e:
+                emit({"ev": "wire", "call": call,
+                      "error": f"{type(e).__name__}: {e}"})
+        elif op == "release_held":
+            h = held.pop(cmd.get("rid"), None)
+            if h is not None:
+                eng.release_held(h)
+        elif op == "qos":
+            # qos_control actuation over the pipe (ISSUE-17): one
+            # kvwire CONTROL frame; chunk_shrink resolves against OUR
+            # base chunk, which the router cannot see
+            try:
+                p = kvwire.decode_control(
+                    kvwire.frame_from_text(cmd["frame"]))
+                chunk = p.get("decode_chunk")
+                if chunk is None and "chunk_shrink" in p:
+                    chunk = (max(1, eng._base_chunk // 2)
+                             if p["chunk_shrink"] else 0)
+                state = eng.qos_control(spec_off=p.get("spec_off"),
+                                        decode_chunk=chunk)
+                emit({"ev": "qos_applied", "state": state})
+            except Exception as e:
+                emit({"ev": "qos_applied",
+                      "error": f"{type(e).__name__}: {e}"})
+        elif op == "advertised":
+            try:
+                eng.set_advertised_chains(cmd.get("hashes") or ())
+            except Exception:
+                pass
         elif op == "cancel":
             with h_lock:
                 h = handles.get(cmd.get("rid"))

@@ -1,0 +1,396 @@
+"""KV wire transport: one versioned handoff format for every process
+boundary (ISSUE-17).
+
+`KVHandoff` (serving/engine.py) is an exact host-side struct — float or
+quantized rows, per-row scales, committed-token prefix, weights step —
+but until this module it only ever moved BY REFERENCE inside one
+process: a `SubprocessReplica` target silently degraded to re-prefill
+for the cross-tier handoff, for chain migration, and for spillover
+seeding. This module defines the wire form once, so every tier
+topology (worker pipe today, plain socket for remote targets, a
+device-to-device fast path later) speaks the same frames; the
+portable-redistribution design of arXiv 2112.01075 motivates treating
+this host-bounce encoding as the universal fallback beneath faster
+transports.
+
+Frame layout (all integers little-endian)::
+
+    offset  size  field
+    0       4     magic  b"KVWR"
+    4       2     version (WIRE_VERSION)
+    6       1     frame type (1 = HANDOFF, 2 = CONTROL)
+    7       1     reserved (0)
+    8       4     payload length
+    12      4     CRC32 of the payload
+    16      ...   payload
+
+HANDOFF payload: a u32-length-prefixed JSON header (``pos``, ``tok``,
+``kv_mode``, ``n_layers``, ``d_model``, ``source``, ``weights_step``,
+and an ``arrays`` manifest of ``[name, dtype, shape]``) followed by
+the arrays' raw bytes in manifest order — K/V rows, per-row scales
+(which travel with their rows, exactly as they travel with their page
+through share/COW remaps), and the cache-source committed-token
+prefix. CONTROL payload: bare JSON — the one extra message type the
+worker pipe needs (qos_control actuation) rides the same header.
+
+Failure contract: every decode problem raises a typed `WireError`
+(``kind`` in magic | version | crc | truncated | type | error) and
+EVERY caller degrades to the existing re-prefill path — a corrupt
+frame costs latency, never a request and never correctness. Version
+skew is refused, not guessed at: a decoder never interprets bytes
+whose version it does not know.
+
+Quantize-on-adopt: `requantize_handoff` converts a FLOAT handoff to a
+quantized one at encode time — per-row absmax scales computed here,
+numerically identical to quant/kv.py's quantize-on-write — so an int8
+decode tier can adopt from a float prefill tier instead of
+re-prefilling (the continuation then matches the decode tier's own
+numerics, within quantization error of the float run).
+
+Transports: `frame_to_text`/`frame_from_text` wrap frames in base64
+for the JSON-lines worker pipe (CRC still validates the decoded
+bytes); `send_frame`/`recv_frame` move raw frames over a plain
+socket, and `WireServer` is the minimal one-frame-per-connection
+request/response server a remote tier target would mount.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+from dataclasses import replace
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"KVWR"
+WIRE_VERSION = 1
+
+FRAME_HANDOFF = 1
+FRAME_CONTROL = 2
+
+#: magic, version, frame type, reserved, payload length, payload CRC32
+_HEADER = struct.Struct("<4sHBBII")
+HEADER_SIZE = _HEADER.size
+
+#: refuse absurd payload lengths BEFORE allocating for them — a
+#: corrupted length field must not turn into an allocation bomb
+MAX_PAYLOAD = 1 << 31
+
+
+class WireError(RuntimeError):
+    """Typed frame decode failure. ``kind`` names the check that
+    failed: ``magic`` | ``version`` | ``crc`` | ``truncated`` |
+    ``type`` | ``error``. Every caller degrades to re-prefill."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def encode_frame(ftype: int, payload: bytes) -> bytes:
+    """One length-framed, CRC32-checked frame around ``payload``."""
+    payload = bytes(payload)
+    if len(payload) > MAX_PAYLOAD:
+        raise ValueError(f"payload too large ({len(payload)} bytes)")
+    hdr = _HEADER.pack(MAGIC, WIRE_VERSION, int(ftype), 0,
+                       len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+    return hdr + payload
+
+
+def decode_frame(frame: bytes) -> Tuple[int, int, memoryview]:
+    """Validate one frame; returns ``(version, ftype, payload)``.
+    Raises `WireError` — never returns partially-checked bytes."""
+    buf = memoryview(bytes(frame))
+    if len(buf) < HEADER_SIZE:
+        raise WireError("truncated",
+                        f"frame shorter than its header "
+                        f"({len(buf)} < {HEADER_SIZE} bytes)")
+    magic, version, ftype, _res, plen, crc = _HEADER.unpack_from(buf)
+    if magic != MAGIC:
+        raise WireError("magic", f"bad frame magic {bytes(magic)!r}")
+    if version > WIRE_VERSION:
+        # forward skew is REFUSED, not guessed at: a decoder must
+        # never interpret bytes whose layout it does not know
+        raise WireError("version",
+                        f"frame version {version} is newer than this "
+                        f"decoder ({WIRE_VERSION})")
+    if plen > MAX_PAYLOAD or len(buf) != HEADER_SIZE + plen:
+        raise WireError("truncated",
+                        f"frame length mismatch (declared {plen} "
+                        f"payload bytes, got {len(buf) - HEADER_SIZE})")
+    payload = buf[HEADER_SIZE:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("crc", "frame payload failed its CRC32 check")
+    return version, ftype, payload
+
+
+# ---------------------------------------------------------------------------
+# handoff frames
+# ---------------------------------------------------------------------------
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Manifest dtype -> numpy dtype; quantized pools may carry
+    ml_dtypes names (float8_e4m3fn) plain numpy cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_handoff(kv) -> bytes:
+    """Encode one `KVHandoff` (slot or cache source, float or
+    quantized) into a HANDOFF frame. Arrays are raw C-order bytes —
+    bit-preserving, so decode -> adopt is exactly as token-exact as
+    the in-process by-reference handoff."""
+    arrays = []
+    blobs = []
+    for name in ("k", "v", "k_scale", "v_scale", "tokens"):
+        a = getattr(kv, name, None)
+        if a is None:
+            continue
+        a = np.ascontiguousarray(a)
+        arrays.append([name, str(a.dtype), list(a.shape)])
+        blobs.append(a.tobytes())
+    head = json.dumps({
+        "pos": int(kv.pos), "tok": int(kv.tok),
+        "kv_mode": kv.kv_mode,
+        "n_layers": int(kv.n_layers), "d_model": int(kv.d_model),
+        "source": getattr(kv, "source", "slot"),
+        "weights_step": (int(kv.weights_step)
+                         if kv.weights_step is not None else None),
+        "arrays": arrays,
+    }).encode()
+    payload = b"".join([struct.pack("<I", len(head)), head, *blobs])
+    return encode_frame(FRAME_HANDOFF, payload)
+
+
+def decode_handoff(frame: bytes):
+    """Decode a HANDOFF frame back into a `KVHandoff`. Raises
+    `WireError` on any framing/CRC/version/shape problem — the caller
+    degrades to re-prefill, never adopts suspect rows."""
+    from deeplearning4j_tpu.serving.engine import KVHandoff
+    _, ftype, payload = decode_frame(frame)
+    if ftype != FRAME_HANDOFF:
+        raise WireError("type",
+                        f"expected a HANDOFF frame, got type {ftype}")
+    try:
+        (hlen,) = struct.unpack_from("<I", payload)
+        head = json.loads(bytes(payload[4:4 + hlen]).decode())
+        off = 4 + hlen
+        out = {}
+        for name, dtype_name, shape in head["arrays"]:
+            dt = _resolve_dtype(dtype_name)
+            n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            if off + n > len(payload):
+                raise WireError("truncated",
+                                f"array {name!r} overruns the payload")
+            out[name] = np.frombuffer(
+                payload[off:off + n], dtype=dt).reshape(shape)
+            off += n
+        if off != len(payload):
+            raise WireError("truncated",
+                            f"{len(payload) - off} trailing payload "
+                            "bytes after the declared arrays")
+        if "k" not in out or "v" not in out:
+            raise WireError("error", "handoff frame is missing its "
+                                     "K/V row arrays")
+        return KVHandoff(
+            pos=int(head["pos"]), tok=int(head["tok"]),
+            k=out["k"], v=out["v"],
+            k_scale=out.get("k_scale"), v_scale=out.get("v_scale"),
+            kv_mode=head.get("kv_mode"),
+            n_layers=int(head.get("n_layers", 0)),
+            d_model=int(head.get("d_model", 0)),
+            source=head.get("source", "slot"),
+            tokens=out.get("tokens"),
+            weights_step=head.get("weights_step"))
+    except WireError:
+        raise
+    except Exception as e:
+        raise WireError("error",
+                        f"malformed handoff payload: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# control frames (the qos actuation satellite)
+# ---------------------------------------------------------------------------
+
+def encode_control(payload: dict) -> bytes:
+    """One CONTROL frame around a small JSON payload — the worker
+    pipe's qos_control actuation reuses the kvwire header instead of
+    inventing a second envelope."""
+    return encode_frame(FRAME_CONTROL, json.dumps(payload).encode())
+
+
+def decode_control(frame: bytes) -> dict:
+    _, ftype, payload = decode_frame(frame)
+    if ftype != FRAME_CONTROL:
+        raise WireError("type",
+                        f"expected a CONTROL frame, got type {ftype}")
+    try:
+        out = json.loads(bytes(payload).decode())
+    except Exception as e:
+        raise WireError("error",
+                        f"malformed control payload: {e}") from e
+    if not isinstance(out, dict):
+        raise WireError("error", "control payload must be an object")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quantize-on-adopt
+# ---------------------------------------------------------------------------
+
+def _np_quantize_rows(x: np.ndarray,
+                      kv_mode: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Row-wise absmax quantization of ``x [..., D]`` on the host —
+    numerically identical to quant/kv.py's `quantize_rows` (absmax /
+    qmax scales, zero rows get scale 1.0) without touching jax: the
+    codec must work wherever the wire does. Returns
+    ``(values [..., D], scales [..., 1] float32)``."""
+    from deeplearning4j_tpu.quant.core import FP8_QMAX, INT8_QMAX
+    qmax = INT8_QMAX if kv_mode == "int8" else FP8_QMAX
+    xf = np.asarray(x, np.float32)
+    amax = np.max(np.abs(xf), axis=-1, keepdims=True)
+    scale = np.where(amax > 0.0, amax / qmax, 1.0).astype(np.float32)
+    if kv_mode == "int8":
+        q = np.clip(np.rint(xf / scale),
+                    -INT8_QMAX, INT8_QMAX).astype(np.int8)
+    else:
+        import ml_dtypes
+        q = (xf / scale).astype(ml_dtypes.float8_e4m3fn)
+    return q, scale
+
+
+def requantize_handoff(kv, kv_mode: str):
+    """Quantize-on-adopt (ISSUE-17): convert a FLOAT handoff into a
+    ``kv_mode`` one so a quantized decode tier can adopt from a float
+    prefill tier. Per-row scales are computed HERE, at encode time —
+    the adopting engine sees exactly what its own quantize-on-write
+    would have produced for these rows. Already-matching handoffs pass
+    through untouched; a quantized source cannot be converted (the
+    information is gone) and raises `WireError` so the caller
+    degrades to re-prefill."""
+    from deeplearning4j_tpu.quant.core import resolve_mode
+    mode = resolve_mode(kv_mode)
+    if kv.kv_mode == mode:
+        return kv
+    if kv.kv_mode is not None:
+        raise WireError("error",
+                        f"cannot requantize a {kv.kv_mode!r} handoff "
+                        f"to {mode!r}: only float sources carry full "
+                        "precision")
+    k, ksc = _np_quantize_rows(kv.k, mode)
+    v, vsc = _np_quantize_rows(kv.v, mode)
+    return replace(kv, k=k, v=v, k_scale=ksc, v_scale=vsc,
+                   kv_mode=mode)
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+def frame_to_text(frame: bytes) -> str:
+    """Base64-wrap a frame for the JSON-lines worker pipe. The CRC
+    still validates the DECODED bytes, so pipe corruption is caught by
+    the same check as socket corruption."""
+    return base64.b64encode(frame).decode("ascii")
+
+
+def frame_from_text(text: str) -> bytes:
+    try:
+        return base64.b64decode(text.encode("ascii"), validate=True)
+    except Exception as e:
+        raise WireError("truncated",
+                        f"undecodable base64 frame: {e}") from e
+
+
+def send_frame(sock: socket.socket, frame: bytes) -> None:
+    """Ship one frame over a plain socket (remote tier targets)."""
+    sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(1 << 20, n - got))
+        if not c:
+            raise WireError("truncated",
+                            f"socket closed after {got}/{n} bytes")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    """Read exactly one frame off a socket (header first, then the
+    declared payload). Returns the raw frame bytes; validation —
+    including the CRC — happens in `decode_frame`, so a tampered
+    length field surfaces as a typed `WireError`, not a hang."""
+    hdr = _recv_exact(sock, HEADER_SIZE)
+    _magic, _ver, _ftype, _res, plen, _crc = _HEADER.unpack(hdr)
+    if plen > MAX_PAYLOAD:
+        raise WireError("truncated",
+                        f"declared payload of {plen} bytes exceeds "
+                        f"the {MAX_PAYLOAD}-byte bound")
+    return hdr + _recv_exact(sock, plen)
+
+
+class WireServer:
+    """Minimal request/response frame server over a plain socket: one
+    frame in, ``handler(frame) -> frame`` out, per connection — what a
+    REMOTE tier target mounts next to its health endpoints. Binds an
+    ephemeral port by default; `.address` is the dial target."""
+
+    def __init__(self, handler: Callable[[bytes], bytes],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        outer = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    frame = recv_frame(self.request)
+                    send_frame(self.request, outer._handler(frame))
+                except Exception:
+                    # a broken peer/frame must never kill the server;
+                    # the DIALER sees the short read and degrades
+                    pass
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv((host, port), _Conn)
+        self.address: Tuple[str, int] = self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="kvwire-server")
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return int(self.address[1])
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def wire_call(address: Tuple[str, int], frame: bytes,
+              timeout: float = 10.0) -> bytes:
+    """Dial a `WireServer`, send one frame, read one frame back."""
+    with socket.create_connection(address, timeout=timeout) as sock:
+        send_frame(sock, frame)
+        return recv_frame(sock)

@@ -262,6 +262,18 @@ class RadixPrefixCache:
         self._by_hash: Dict[int, _Node] = {}
         self._gen = 0
         self._digest_cache: Optional[tuple] = None
+        # ISSUE-17: chain hashes the FLEET is actively advertising
+        # (routing by); eviction is biased away from them so a chain
+        # another replica may migrate in is not the first thing a
+        # local pool squeeze throws away
+        self._advertised: frozenset = frozenset()
+
+    def set_advertised(self, hashes) -> int:
+        """Replace the fleet-advertised chain-hash set (ISSUE-17).
+        Entries need not exist locally — the set protects whatever
+        subset IS cached here. Returns the set's size."""
+        self._advertised = frozenset(int(h) for h in hashes)
+        return len(self._advertised)
 
     @property
     def generation(self) -> int:
@@ -325,15 +337,27 @@ class RadixPrefixCache:
     def evict(self, n_pages: int) -> int:
         """Free up to ``n_pages`` pages by dropping LRU leaf entries
         whose page only the cache references (refcount 1 — pages a
-        live slot shares are never touched). Returns pages freed."""
+        live slot shares are never touched). Eviction is BIASED away
+        from fleet-advertised chains (ISSUE-17): an advertised leaf
+        is taken only when no unadvertised candidate exists — a bias,
+        not immunity, so a squeezed pool still makes progress.
+        Returns pages freed."""
         freed = 0
         while freed < n_pages:
             victim = None
+            shielded = None
             for node in self._iter_leaves():
                 if self.alloc.refcount(node.page) != 1:
                     continue
+                if node.chain_hash in self._advertised:
+                    if (shielded is None
+                            or node.last_used < shielded.last_used):
+                        shielded = node
+                    continue
                 if victim is None or node.last_used < victim.last_used:
                     victim = node
+            if victim is None:
+                victim = shielded
             if victim is None:
                 break
             self._drop(victim)

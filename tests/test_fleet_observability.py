@@ -525,9 +525,11 @@ def test_subprocess_tiered_stitch_and_federation(params, mesh1,
     """Acceptance (the real process boundary): a TieredRouter over
     SUBPROCESS replicas still yields ONE stitched trace per request —
     worker traces ship back over the pipe, clock-offset aligned, the
-    handoff degrades to outcome="fallback" (no cross-pipe KV) but its
-    span is in the trace — and the federated counters equal the sum
-    of the workers' own /metrics.json scrapes."""
+    handoff crosses the pipe as a kvwire frame (outcome="ok" since
+    ISSUE-17; this spec's unpaged decode engine still re-prefills on
+    adopt, which is the engine's own degraded path) and its span is
+    in the trace — and the federated counters equal the sum of the
+    workers' own /metrics.json scrapes."""
     import urllib.request
     import json as _json
     reps = [SubprocessReplica(i, SUB_SPEC,
@@ -551,7 +553,7 @@ def test_subprocess_tiered_stitch_and_federation(params, mesh1,
     assert names[0] == ("queue", None)
     assert ("hop", "prefill") in names and ("hop", "decode") in names
     handoff = [s for s in dt["spans"] if s["name"] == "handoff"]
-    assert len(handoff) == 1 and handoff[0]["outcome"] == "fallback"
+    assert len(handoff) == 1 and handoff[0]["outcome"] == "ok"
     _assert_monotonic(dt)
     repl = [e for e in dt["events"] if e.get("src") == "replica"]
     assert repl, "no worker trace events shipped over the pipe"

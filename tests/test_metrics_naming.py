@@ -699,6 +699,75 @@ def test_qos_series_pass_the_lint():
     check_cardinality(snap, budget=64)
 
 
+def test_kvwire_series_pass_the_lint():
+    """The KV wire-transport series (ISSUE-17: direction/outcome-
+    labeled serving_kvwire_frames_total, serving_kvwire_bytes_total,
+    the serving_kvwire_seconds histogram) register LAZILY on first
+    wire activity — a wire-off fleet's scrape must not carry them at
+    all, and once a deterministically injected corrupt frame
+    materializes them they pass the same naming rules as everything
+    else."""
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+    from deeplearning4j_tpu.serving import FleetConfig, TieredRouter
+
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    ec = EngineConfig(decode_chunk=2, max_new_tokens=8,
+                      backoff_base_s=0.0, max_batch_size=2, paged=True)
+
+    def _run(inj):
+        router = TieredRouter(
+            cfg=cfg, mesh=mesh, params=params,
+            prefill_replicas=1, decode_replicas=1,
+            prefill_engine_config=ec, decode_engine_config=ec,
+            fault_injector=inj,
+            config=FleetConfig(restart_backoff_base_s=0.01))
+        try:
+            hs = [router.submit(np.arange(8, dtype=np.int32),
+                                max_new_tokens=8) for _ in range(2)]
+            router.run_pending()
+            assert all(h.done() for h in hs)
+            return prometheus_text(router.registry)
+        finally:
+            router.close()
+
+    # wire-off: the lazy families never register — byte-identical
+    # scrape shape, zero new compile keys, zero new series
+    off = _run(None)
+    assert "serving_kvwire" not in off
+    # one injected corrupt frame materializes every kvwire family
+    text = _run(FleetFaultInjector(corrupt_frame_at=[0]))
+    types = _types(text)
+    assert types["serving_kvwire_frames_total"] == "counter"
+    assert types["serving_kvwire_bytes_total"] == "counter"
+    assert types["serving_kvwire_seconds"] == "histogram"
+    assert 'direction="export"' in text and 'outcome="crc"' in text
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):
