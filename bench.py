@@ -180,7 +180,8 @@ def flagship_lines(which: str) -> None:
                   "engine_slo", "ckpt_async", "quant_decode",
                   "kv_paged", "spec_decode", "fleet_failover",
                   "chunked_prefill", "disagg", "fleet_obs",
-                  "cold_start", "profiling_overhead", "qos_storm"]
+                  "cold_start", "profiling_overhead", "qos_storm",
+                  "elastic_train"]
     for n in names:
         elapsed = time.monotonic() - _T0
         reps = 1 if elapsed > 0.6 * budget else 2
@@ -193,7 +194,126 @@ def flagship_lines(which: str) -> None:
                   flush=True)
 
 
+# ---------------------------------------------------------------------------
+# MFU regression gate (ISSUE-18 satellite)
+# ---------------------------------------------------------------------------
+
+#: gated line-config name -> flagship BENCHES key (to re-measure when
+#: `--check` / `--update-gate` run without a captured-lines file)
+GATE_BENCHES = {"transformer_lm_12L512d_T2048": "transformer",
+                "elastic_train": "elastic_train"}
+
+GATE_TOLERANCE = 0.2
+
+
+def check_gate(lines, baseline, tolerance: float = GATE_TOLERANCE):
+    """Compare achieved model FLOP/s against BASELINE.json's
+    ``flops_gate`` floor: a gated config whose ``flops_per_sec`` drops
+    more than ``tolerance`` below its recorded baseline is a failure.
+    ``lines`` is the bench output (list of per-config dicts);
+    ``baseline`` is the parsed BASELINE.json. Returns the list of
+    failure strings — empty means the gate passes. Pure function so
+    the gate itself is unit-testable without running a single bench."""
+    gate = (baseline or {}).get("flops_gate") or {}
+    by_config = {ln.get("config"): ln for ln in lines
+                 if isinstance(ln, dict) and ln.get("config")}
+    failures = []
+    for name in sorted(gate):
+        want = gate[name]
+        if not want:
+            continue                 # null floor: recorded but not gated
+        ln = by_config.get(name)
+        if ln is None:
+            failures.append(f"{name}: gated config missing from the "
+                            "bench lines")
+            continue
+        if "error" in ln:
+            failures.append(f"{name}: bench errored: {ln['error']}")
+            continue
+        got = ln.get("flops_per_sec")
+        if not got:
+            failures.append(f"{name}: bench line carries no "
+                            "flops_per_sec")
+            continue
+        floor = float(want) * (1.0 - float(tolerance))
+        if float(got) < floor:
+            failures.append(
+                f"{name}: flops_per_sec {float(got):.3e} is below the "
+                f"gate floor {floor:.3e} (baseline {float(want):.3e}, "
+                f"tolerance {tolerance:.0%})")
+    return failures
+
+
+def _baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+
+
+def _gate_lines(path):
+    """Bench lines for the gate: parsed from a captured file when
+    given, else measured fresh (gated configs only)."""
+    if path is not None:
+        lines = []
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    lines.append(json.loads(raw))
+                except ValueError:
+                    continue         # driver logs interleave non-JSON
+        return lines
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmarks"))
+    import flagship
+    lines = []
+    for bench_key in sorted(set(GATE_BENCHES.values())):
+        try:
+            lines.append(flagship.BENCHES[bench_key](reps=1))
+        except Exception as e:
+            lines.append({"config": bench_key, "error":
+                          f"{type(e).__name__}: {e}"[:200]})
+    return lines
+
+
+def gate_main(argv) -> int:
+    """``--check [FILE]`` fails (rc 1) when any gated flagship arm's
+    FLOP/s dropped >20% vs BASELINE.json's ``flops_gate``;
+    ``--update-gate [FILE]`` records the measured values as the new
+    floor."""
+    mode = argv[0]
+    path = argv[1] if len(argv) > 1 else None
+    with open(_baseline_path()) as f:
+        baseline = json.load(f)
+    lines = _gate_lines(path)
+    if mode == "--update-gate":
+        gate = dict(baseline.get("flops_gate") or {})
+        for ln in lines:
+            name = ln.get("config") if isinstance(ln, dict) else None
+            if name in GATE_BENCHES and ln.get("flops_per_sec"):
+                gate[name] = ln["flops_per_sec"]
+        baseline["flops_gate"] = gate
+        with open(_baseline_path(), "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"gate": "updated", "flops_gate": gate}),
+              flush=True)
+        return 0
+    failures = check_gate(lines, baseline)
+    print(json.dumps({"gate": "fail" if failures else "pass",
+                      "tolerance": GATE_TOLERANCE,
+                      "failures": failures}), flush=True)
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
+    import sys
+    _argv = sys.argv[1:]
+    if _argv and _argv[0] in ("--check", "--update-gate"):
+        _enable_compile_cache()
+        sys.exit(gate_main(_argv))
     _enable_compile_cache()
     main()
     _fl = os.environ.get("BENCH_FLAGSHIP", "1").lower()

@@ -98,6 +98,13 @@ EVENT_KINDS = frozenset({
     #                  bytes, seconds} — every failure outcome
     #                  degrades to the re-prefill path, never a lost
     #                  request
+    "elastic",       # elastic training membership/sync transition
+    #                  (rid 0, fleet-wide; ISSUE-18): {action: join|
+    #                  leave|kill_detected|resize|replay|loose_enter|
+    #                  resync|evict, worker, step, ...} — the elastic
+    #                  coordinator's audit trail (resize adds
+    #                  {workers, reason}; loose_enter/resync add
+    #                  {pending}; replay adds {from_step, to_step})
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

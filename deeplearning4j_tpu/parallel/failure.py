@@ -37,7 +37,8 @@ from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from deeplearning4j_tpu.observability.metrics import default_registry
-from deeplearning4j_tpu.train.guard import DivergenceError, TrainingGuard
+from deeplearning4j_tpu.train.guard import (DivergenceError, StepTimeout,
+                                            TrainingGuard)
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -443,6 +444,84 @@ class FleetFaultInjector:
         return False
 
 
+class ElasticFaultInjector:
+    """Elastic-training deterministic fault injection (ISSUE-18) —
+    the training analog of `FleetFaultInjector`: the elastic
+    coordinator (`train/elastic.py`) consults it at the start of every
+    global step, so membership churn that would need real crashed
+    hosts replays deterministically on the CPU backend
+    (tests/test_elastic_training.py, ``flagship.py elastic_train``).
+
+    All knobs are keyed by GLOBAL step index and fire one-shot: after
+    a lossy resize rewinds the step counter, replayed steps do not
+    re-fire an already-consumed injection.
+
+    - ``kill_at``: ``{step: worker_id}`` — the worker takes a real
+      SIGKILL at the start of that step. Contract under test: the
+      coordinator detects the loss (pipe EOF / barrier miss), resizes
+      from the last published checksummed checkpoint, replays the data
+      cursor, and the final state is bit-identical to an uninterrupted
+      run.
+    - ``hang_at``: ``{step: worker_id}`` — the worker is SIGSTOPped:
+      alive to the OS, silent on the pipe. The straggler path must
+      escalate (loose sync) and eventually evict it.
+    - ``slow_at``: ``{step: (worker_id, seconds)}`` — from that step
+      on, the worker sleeps ``seconds`` before answering each command
+      (worker-side, over the pipe). ``seconds=0`` clears the slowdown
+      — the straggler that recovers.
+    - ``join_at``: ``{step: worker_id}`` — a new worker (or a killed
+      one's replacement, same id) is spawned and adopted at that
+      step's resize barrier.
+    """
+
+    def __init__(self, kill_at: Optional[dict] = None,
+                 hang_at: Optional[dict] = None,
+                 slow_at: Optional[dict] = None,
+                 join_at: Optional[dict] = None):
+        self.kill_at = {int(k): int(v)
+                        for k, v in (kill_at or {}).items()}
+        self.hang_at = {int(k): int(v)
+                        for k, v in (hang_at or {}).items()}
+        self.slow_at = {int(k): (int(v[0]), float(v[1]))
+                        for k, v in (slow_at or {}).items()}
+        self.join_at = {int(k): int(v)
+                        for k, v in (join_at or {}).items()}
+        self.kills_injected = 0
+        self.hangs_injected = 0
+        self.slows_injected = 0
+        self.joins_injected = 0
+
+    def check_kill(self, step: int) -> Optional[int]:
+        """One-shot: the worker id to SIGKILL at ``step``, else None."""
+        wid = self.kill_at.pop(int(step), None)
+        if wid is not None:
+            self.kills_injected += 1
+        return wid
+
+    def check_hang(self, step: int) -> Optional[int]:
+        """One-shot: the worker id to SIGSTOP at ``step``, else None."""
+        wid = self.hang_at.pop(int(step), None)
+        if wid is not None:
+            self.hangs_injected += 1
+        return wid
+
+    def check_slow(self, step: int) -> Optional[tuple]:
+        """One-shot: ``(worker_id, seconds)`` per-command slowdown to
+        apply from ``step`` on (0 clears), else None."""
+        v = self.slow_at.pop(int(step), None)
+        if v is not None:
+            self.slows_injected += 1
+        return v
+
+    def check_join(self, step: int) -> Optional[int]:
+        """One-shot: the worker id to spawn+adopt at ``step``, else
+        None."""
+        wid = self.join_at.pop(int(step), None)
+        if wid is not None:
+            self.joins_injected += 1
+        return wid
+
+
 @dataclass(frozen=True)
 class StormArrival:
     """One scripted submission of a hostile-tenant storm (ISSUE-16):
@@ -614,16 +693,27 @@ class StepWatchdog:
     a step still armed past ``deadline_s`` is flagged once (logged,
     `watchdog_hung_steps_total` bumped, ``on_hung(iteration,
     elapsed_s)`` called if given — e.g. a PreemptionHandler's
-    request_stop for checkpoint-and-exit policies)."""
+    request_stop for checkpoint-and-exit policies).
+
+    ISSUE-18 escalation: ``escalate`` receives a typed
+    `train.guard.StepTimeout` for the same flagging (the elastic
+    coordinator's loose-sync downgrade consumes it; usable standalone).
+    ``clock`` is injectable and `check()` is the synchronous detection
+    step the monitor thread runs — unit tests drive it directly with a
+    fake clock, no thread, fully deterministic."""
 
     def __init__(self, deadline_s: float,
                  on_hung: Optional[Callable[[int, float], None]] = None,
                  poll_s: Optional[float] = None,
+                 escalate: Optional[Callable[..., None]] = None,
+                 clock: Callable[[], float] = time.perf_counter,
                  registry=None):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = float(deadline_s)
         self.on_hung = on_hung
+        self.escalate = escalate
+        self.clock = clock
         self.poll_s = (max(0.005, min(self.deadline_s / 4.0, 0.25))
                        if poll_s is None else float(poll_s))
         self._lock = threading.Lock()
@@ -633,6 +723,7 @@ class StepWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.hung_iterations: list = []
+        self.timeouts: list = []
         reg = registry if registry is not None else default_registry()
         self._m_hung = reg.counter(
             "watchdog_hung_steps_total",
@@ -657,7 +748,7 @@ class StepWatchdog:
 
     def arm(self, iteration: int) -> None:
         with self._lock:
-            self._armed_at = time.perf_counter()
+            self._armed_at = self.clock()
             self._iteration = int(iteration)
             self._flagged = False
 
@@ -665,23 +756,38 @@ class StepWatchdog:
         with self._lock:
             self._armed_at = None
 
+    def check(self) -> Optional["StepTimeout"]:
+        """One synchronous detection pass: flag the armed step if it
+        is past deadline (once per arm), run the callbacks, and return
+        the typed `StepTimeout` — or None when nothing fired. The
+        monitor thread calls this every ``poll_s``; callers with their
+        own event loop (or a fake clock in tests) call it directly."""
+        cb = esc = None
+        with self._lock:
+            if self._armed_at is None or self._flagged:
+                return None
+            elapsed = self.clock() - self._armed_at
+            if elapsed <= self.deadline_s:
+                return None
+            self._flagged = True
+            self.hung_iterations.append(self._iteration)
+            self._m_hung.inc()
+            it, cb, esc = self._iteration, self.on_hung, self.escalate
+            log.error("watchdog: step %d exceeded %.3fs "
+                      "deadline (%.3fs elapsed and counting)",
+                      self._iteration, self.deadline_s, elapsed)
+        timeout = StepTimeout(iteration=it, deadline_s=self.deadline_s,
+                              elapsed_s=elapsed)
+        self.timeouts.append(timeout)
+        if cb is not None:
+            cb(it, elapsed)
+        if esc is not None:
+            esc(timeout)
+        return timeout
+
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
-            cb = None
-            with self._lock:
-                if self._armed_at is None or self._flagged:
-                    continue
-                elapsed = time.perf_counter() - self._armed_at
-                if elapsed > self.deadline_s:
-                    self._flagged = True
-                    self.hung_iterations.append(self._iteration)
-                    self._m_hung.inc()
-                    it, cb = self._iteration, self.on_hung
-                    log.error("watchdog: step %d exceeded %.3fs "
-                              "deadline (%.3fs elapsed and counting)",
-                              self._iteration, self.deadline_s, elapsed)
-            if cb is not None:
-                cb(it, elapsed)
+            self.check()
 
     def __enter__(self) -> "StepWatchdog":
         return self.start()

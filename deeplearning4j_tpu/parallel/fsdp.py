@@ -20,10 +20,11 @@ in/out shardings.
 """
 from __future__ import annotations
 
-from typing import Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.models.transformer import (TransformerConfig,
@@ -66,6 +67,60 @@ def init_fsdp_adam_state(params) -> AdamState:
     ZeRO-1 half of the memory win). Same AdamState as the composite
     step (parallel/optim.py)."""
     return init_adam_state(params)
+
+
+def zero1_partition(n_params: int, n_shards: int) -> List[Tuple[int, int]]:
+    """Contiguous ZeRO-1 shard boundaries over a flattened parameter
+    vector: ``n_shards`` half-open ``(lo, hi)`` ranges covering
+    ``[0, n_params)``, remainder spread over the FIRST shards (the
+    np.array_split convention). Deterministic in its inputs — the
+    elastic coordinator's resharding contract (ISSUE-18) is that the
+    same ``(n_params, n_shards)`` always yields the same cut points,
+    so which workers hold which ranges is a pure function of live
+    membership SIZE, never of join order or failure history."""
+    if n_params < 0:
+        raise ValueError(f"n_params must be >= 0, got {n_params}")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    base, extra = divmod(int(n_params), int(n_shards))
+    bounds: List[Tuple[int, int]] = []
+    lo = 0
+    for i in range(int(n_shards)):
+        hi = lo + base + (1 if i < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def flatten_tree(tree) -> np.ndarray:
+    """Flatten a float pytree into ONE contiguous float32 vector in
+    canonical (tree_flatten) leaf order — the byte layout the ZeRO-1
+    shards slice. Deterministic: dict leaves flatten in sorted-key
+    order, so coordinator and every worker agree on offsets."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return np.zeros((0,), dtype=np.float32)
+    return np.concatenate(
+        [np.asarray(leaf, dtype=np.float32).ravel() for leaf in leaves])
+
+
+def unflatten_tree(vec: np.ndarray, template):
+    """Inverse of `flatten_tree` given a same-structure ``template``
+    tree (shapes read from its leaves): split the flat vector back
+    into a pytree of float32 numpy arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    vec = np.asarray(vec, dtype=np.float32)
+    total = sum(int(np.prod(jnp.shape(leaf))) for leaf in leaves)
+    if vec.size != total:
+        raise ValueError(f"flat vector has {vec.size} elements; "
+                         f"template needs {total}")
+    out, off = [], 0
+    for leaf in leaves:
+        shape = jnp.shape(leaf)
+        n = int(np.prod(shape)) if shape else 1
+        out.append(vec[off:off + n].reshape(shape))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def make_fsdp_train_step(cfg: TransformerConfig, mesh: Mesh, *,

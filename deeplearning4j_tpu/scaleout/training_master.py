@@ -91,6 +91,101 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         return data
 
 
+@dataclass
+class ElasticTrainingMaster(TrainingMaster):
+    """TrainingMaster over the elastic process fleet (ISSUE-18): where
+    ParameterAveragingTrainingMaster configures in-process replicas,
+    this one drives `train/elastic.ElasticCoordinator` — N worker
+    PROCESSES each owning a contiguous ZeRO-1 shard of the Adam state,
+    with membership allowed to change mid-fit. Deterministic contract:
+    the result of `fit` is bit-identical to `elastic.reference_run`
+    for ANY membership trajectory that stays in strict sync.
+
+    `configure`/`batches` are not part of this master's path — the
+    elastic fleet derives batches from the deterministic data cursor
+    (`elastic.data_batch`), so there is no driver-side dataset to
+    split (the reference's rddTrainingApproach has no analog here)."""
+
+    checkpoint_dir: str = ""
+    workers: int = 3
+    microbatches_per_step: int = 6
+    microbatch_size: int = 4
+    seq_len: int = 8
+    learning_rate: float = 1e-3
+    checkpoint_every: int = 2
+    sync_every: int = 2
+    stale_bound: int = 4
+    step_timeout_s: float = 30.0
+    fault_injector: Any = None
+    registry: Any = None
+    recorder: Any = None
+
+    class Builder:
+        def __init__(self, checkpoint_dir: str):
+            self._kw: dict = {"checkpoint_dir": checkpoint_dir}
+
+        def workers(self, n: int):
+            self._kw["workers"] = n
+            return self
+
+        def microbatches_per_step(self, n: int):
+            self._kw["microbatches_per_step"] = n
+            return self
+
+        def microbatch_size(self, n: int):
+            self._kw["microbatch_size"] = n
+            return self
+
+        def sync_every(self, n: int):
+            self._kw["sync_every"] = n
+            return self
+
+        def stale_bound(self, n: int):
+            self._kw["stale_bound"] = n
+            return self
+
+        def build(self) -> "ElasticTrainingMaster":
+            return ElasticTrainingMaster(**self._kw)
+
+    def elastic_config(self):
+        from deeplearning4j_tpu.train.elastic import ElasticConfig
+        if not self.checkpoint_dir:
+            raise ValueError("ElasticTrainingMaster needs a "
+                             "checkpoint_dir (resizes reshard from the "
+                             "last published checkpoint)")
+        return ElasticConfig(
+            checkpoint_dir=self.checkpoint_dir,
+            num_workers=self.workers,
+            microbatches_per_step=self.microbatches_per_step,
+            microbatch_size=self.microbatch_size,
+            seq_len=self.seq_len,
+            learning_rate=self.learning_rate,
+            checkpoint_every=self.checkpoint_every,
+            sync_every=self.sync_every,
+            stale_bound=self.stale_bound,
+            step_timeout_s=self.step_timeout_s)
+
+    def configure(self, model):
+        raise NotImplementedError(
+            "ElasticTrainingMaster trains through worker processes, "
+            "not ParallelWrapper — call fit(cfg, num_steps)")
+
+    def batches(self, data):
+        return data
+
+    def fit(self, model_cfg, num_steps: int) -> dict:
+        """Run ``num_steps`` elastic steps of the transformer described
+        by ``model_cfg`` (a TransformerConfig); returns the
+        coordinator's result dict (final params/loss, membership and
+        replay counters)."""
+        from deeplearning4j_tpu.train.elastic import ElasticCoordinator
+        with ElasticCoordinator(model_cfg, self.elastic_config(),
+                                fault_injector=self.fault_injector,
+                                registry=self.registry,
+                                recorder=self.recorder) as co:
+            return co.run(num_steps)
+
+
 class _DistributedModelBase:
     """Shared driver for the Spark-wrapper analogs."""
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import logging
 import math
+from dataclasses import dataclass
 from typing import Optional
 
 from deeplearning4j_tpu.observability.metrics import default_registry
@@ -50,6 +51,21 @@ class DivergenceError(RuntimeError):
     trajectory is diverging (K consecutive bad steps). RuntimeError
     subclass so checkpoint-restore wrappers (FaultTolerantTrainer)
     catch it on their normal recovery path."""
+
+
+@dataclass(frozen=True)
+class StepTimeout:
+    """Typed escalation payload from `parallel.failure.StepWatchdog`
+    (ISSUE-18): one step exceeded its wall-clock deadline. Handed to
+    the watchdog's ``escalate`` callback so policy layers (the elastic
+    coordinator's loose-sync downgrade, a preemption handler's
+    checkpoint-and-exit) can react to the *event*, not a log line.
+
+    ``elapsed_s`` is measured at flag time — the step is still running
+    (or wedged), so it only grows after this snapshot."""
+    iteration: int
+    deadline_s: float
+    elapsed_s: float
 
 
 class TrainingGuard:

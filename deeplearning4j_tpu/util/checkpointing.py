@@ -483,25 +483,40 @@ class CheckpointManager:
 
 
     # -- arbitrary-pytree API (distributed/FSDP training states) -----------
-    def save_tree(self, tree, step: int) -> int:
+    def save_tree(self, tree, step: int,
+                  meta: Optional[Dict] = None) -> int:
         """Checkpoint an arbitrary pytree — e.g. FSDP/composite-parallel
         (params, AdamState) from parallel/fsdp.py or parallel/megatron.py.
         With orbax, sharded jax.Arrays are written distributed-safe
         (each host persists its shards; multi-host coordination via the
-        PJRT runtime)."""
-        self._write_payload({"tree": tree}, int(step))
+        PJRT runtime). ``meta`` (JSON dict) is published atomically
+        beside the step — the elastic coordinator stores its data
+        cursor there (ISSUE-18)."""
+        self._write_payload({"tree": tree}, int(step), meta=meta)
         return int(step)
 
-    def restore_tree(self, template, step: Optional[int] = None):
+    def read_meta(self, step: int) -> Optional[Dict]:
+        """The meta dict published with ``step`` (save/save_tree
+        ``meta=``), or None when the step has none."""
+        p = self.directory / f"meta_{int(step)}.json"
+        if not p.exists():
+            return None
+        return json.loads(p.read_text())
+
+    def restore_tree(self, template, step: Optional[int] = None,
+                     with_step: bool = False):
         """Restore a pytree saved by save_tree. ``template`` supplies
         structure, dtypes, AND shardings: restoring an FSDP state with a
         sharded template re-places each leaf into its shards (orbax), so
         a job can resume on a different mesh layout by passing the new
-        mesh's template. Returns None if no checkpoint exists."""
+        mesh's template. Returns None if no checkpoint exists.
+        ``with_step=True`` returns ``(tree, step)`` instead — callers
+        resuming a data cursor need to know WHICH step they got (the
+        newest-verified fallback may skip a torn newest step)."""
         self.wait()
         payload, step = self._resolve_readable({"tree": template}, step)
         if payload is None:
-            return None
+            return (None, None) if with_step else None
         out = payload["tree"]
         if not self.use_orbax:
             # npz fallback loads host arrays; re-place onto the
@@ -515,7 +530,7 @@ class CheckpointManager:
                 return v
 
             out = jax.tree_util.tree_map(_replace, template, out)
-        return out
+        return (out, step) if with_step else out
 
 
 class CheckpointListener(IterationListener):

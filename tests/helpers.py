@@ -10,8 +10,58 @@ nothing — occupancy, budgets, block tables, chunk boundaries, and
 acceptance are all runtime data — so the default ``allow_new=0`` is
 the property under test; a warm-up wave that legitimately compiles its
 first bucket passes an explicit ``allow_new``.
+
+`child_killing_watchdog` is the ONE hard per-test bound for suites
+that spawn real child processes (ISSUE-18 satellite — extracted from
+test_serving_fleet.py's fleet_watchdog so the serving-fleet and
+elastic-training suites share it): any object with ``.kill()``/
+``.close()`` registered with the yielded callable is SIGKILLed if the
+timer fires (turning a would-be hang into a fast, visible failure)
+and closed on teardown either way — a wedged child can never hang
+tier-1.
 """
+import threading
 from contextlib import contextmanager
+
+
+@contextmanager
+def child_killing_watchdog(hard_timeout_s: float):
+    """Yield a ``register(child)`` callable; every registered child is
+    killed when ``hard_timeout_s`` elapses and closed at exit. Raises
+    at exit if the watchdog fired.
+
+    Usage::
+
+        with child_killing_watchdog(60.0) as register:
+            rep = SubprocessReplica(...)
+            register(rep)
+            ...
+    """
+    children = []
+    fired = threading.Event()
+
+    def _fire():
+        fired.set()
+        for child in children:
+            try:
+                child.kill()
+            except Exception:
+                pass
+
+    timer = threading.Timer(hard_timeout_s, _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield children.append
+    finally:
+        timer.cancel()
+        for child in children:
+            try:
+                child.close()
+            except Exception:
+                pass
+    assert not fired.is_set(), (
+        f"child watchdog fired after {hard_timeout_s}s")
 
 
 @contextmanager

@@ -351,6 +351,105 @@ def test_watchdog_quiet_for_fast_steps():
     assert wd.hung_iterations == []
 
 
+def test_watchdog_injected_clock_escalates_typed_timeout():
+    """ISSUE-18 satellite: `check()` driven directly with an injected
+    clock — no thread, no sleeps — fires the typed `StepTimeout`
+    escalation exactly once per armed step, with the iteration,
+    deadline, and elapsed time the elastic coordinator's loose-sync
+    downgrade keys on."""
+    from deeplearning4j_tpu.train.guard import StepTimeout
+
+    now = [100.0]
+    escalated = []
+    wd = StepWatchdog(5.0, clock=lambda: now[0],
+                      escalate=escalated.append,
+                      registry=MetricsRegistry())
+    # never start()ed: detection is the synchronous check() alone
+    wd.arm(3)
+    now[0] = 104.9                       # inside the deadline
+    assert wd.check() is None and escalated == []
+    now[0] = 105.5                       # 5.5s elapsed > 5.0s deadline
+    t = wd.check()
+    assert isinstance(t, StepTimeout)
+    assert t.iteration == 3 and t.deadline_s == 5.0
+    assert t.elapsed_s == pytest.approx(5.5)
+    assert escalated == [t] and wd.timeouts == [t]
+    # flag-once per arm: the monitor loop polling again must not spam
+    now[0] = 200.0
+    assert wd.check() is None and len(escalated) == 1
+    # a fresh arm re-enables detection
+    wd.arm(4)                            # armed at t=200
+    now[0] = 206.0
+    t2 = wd.check()
+    assert t2 is not None and t2.iteration == 4
+    assert wd.hung_iterations == [3, 4]
+    # disarmed steps never fire
+    wd.arm(5)
+    wd.disarm()
+    now[0] = 999.0
+    assert wd.check() is None
+
+
+@pytest.mark.skipif(os.name != "posix",
+                    reason="raise_signal/SIGTERM semantics need posix")
+def test_sigterm_during_inflight_async_write_drains_before_publish(
+        tmp_path):
+    """ISSUE-18 satellite regression: a real SIGTERM landing while an
+    `async_save=True` background checkpoint write is STILL IN FLIGHT
+    (writer stalled via the injector's write_delay_s) must drain the
+    writer before the resumable publish — when fit returns False, the
+    preemption checkpoint is fully published, CRC-verifiable, and no
+    staging dir or in-flight future remains."""
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal handlers need the main thread")
+    x, y = _data(96, seed=14)
+    net = _net(seed=15)
+    inj = FaultInjector(write_delay_s=0.4)   # every write stalls 0.4s
+
+    class SignalingIterator:
+        """SIGTERM on batch 3 — while the periodic async write from
+        the step-2 boundary is still sitting in the stalled writer."""
+
+        def __init__(self):
+            self.inner = _iter(x, y)
+            self.count = 0
+
+        def __iter__(self):
+            for b in self.inner:
+                self.count += 1
+                if self.count == 3:
+                    signal.raise_signal(signal.SIGTERM)
+                yield b
+
+        def reset(self):
+            self.inner.reset()
+
+    reg = MetricsRegistry()
+    with PreemptionHandler(registry=reg) as ph:
+        assert ph.installed
+        trainer = FaultTolerantTrainer(net, str(tmp_path / "ckpt"),
+                                       checkpoint_frequency=2,
+                                       fault_injector=inj,
+                                       use_orbax=False, async_save=True,
+                                       preemption=ph, registry=reg)
+        assert trainer.fit(SignalingIterator(), epochs=2) is False
+        assert trainer.preempted and ph.signals_seen == 1
+        stop_iter = net.iteration_count
+        mgr = trainer.manager
+        # the writer is drained: nothing in flight, the resumable
+        # checkpoint is the latest PUBLISHED step and verifies clean
+        assert mgr._inflight is None
+        assert mgr.latest_step() == stop_iter
+        assert mgr.verify_step(stop_iter)
+        assert not list((tmp_path / "ckpt").glob("step_*.tmp"))
+        assert reg.get("checkpoint_async_pending").value == 0
+        # and it really is resumable
+        ph.clear()
+        assert trainer.fit(_iter(x, y), epochs=1) is True
+        assert net.iteration_count > stop_iter
+    assert not ph.installed
+
+
 def test_trainer_arms_watchdog_around_steps(tmp_path):
     """step_deadline_s wires a watchdog through the trainer; fast CPU
     steps never trip it and the thread is stopped at exit."""

@@ -768,6 +768,81 @@ def test_kvwire_series_pass_the_lint():
             assert SNAKE.match(lab), f"label {lab!r} not snake_case"
 
 
+def test_elastic_series_pass_the_lint():
+    """The elastic-training series (ISSUE-18: the
+    training_elastic_workers gauge, reason-labeled
+    training_elastic_resizes_total, training_elastic_stale_steps_total
+    / training_elastic_replayed_steps_total, and the
+    training_elastic_resync_seconds histogram) register LAZILY from
+    the coordinator constructor — an elastic-off process's scrape is
+    byte-identical with the module imported — and once registered they
+    pass the same naming rules plus the federation cardinality
+    budget."""
+    from deeplearning4j_tpu.observability.export import (
+        json_snapshot, prometheus_text)
+    from deeplearning4j_tpu.observability.federation import (
+        check_cardinality, merge_snapshots)
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.train import elastic
+
+    # elastic-off: importing the module (done above) and building its
+    # config must leave a scrape byte-identical — registration happens
+    # in the coordinator constructor, never at import
+    reg = MetricsRegistry()
+    before = prometheus_text(reg)
+    elastic.ElasticConfig(checkpoint_dir="/tmp/unused")
+    assert prometheus_text(reg) == before
+    assert "training_elastic" not in before
+
+    # registered + exercised exactly the way the coordinator does
+    fams = elastic.register_elastic_metrics(reg)
+    # get-or-create: a second coordinator against the same registry
+    # re-binds the SAME instruments rather than fighting
+    assert elastic.register_elastic_metrics(reg)["workers"] \
+        is fams["workers"]
+    fams["workers"].set(3)
+    for reason in ("kill_detected", "join", "evict", "drain_timeout"):
+        fams["resizes"].labels(reason).inc()
+    fams["stale"].inc()
+    fams["replayed"].inc(3)
+    fams["resync"].observe(0.25)
+
+    text = prometheus_text(reg)
+    types = _types(text)
+    assert types["training_elastic_workers"] == "gauge"
+    assert types["training_elastic_resizes_total"] == "counter"
+    assert types["training_elastic_stale_steps_total"] == "counter"
+    assert types["training_elastic_replayed_steps_total"] == "counter"
+    assert types["training_elastic_resync_seconds"] == "histogram"
+    assert 'reason="kill_detected"' in text
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+    # two coordinators federate duplicate-free and inside the budget
+    snap = merge_snapshots([({"tier": "train", "replica": i},
+                             json_snapshot(reg)) for i in range(2)])
+    check_cardinality(snap, budget=64)
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):

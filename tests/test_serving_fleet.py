@@ -26,7 +26,6 @@ tier-1-eligible but hard-bounded — every wait carries a timeout and
 the watchdog fixture kills child processes on teardown, so a wedged
 replica can never hang the suite.
 """
-import threading
 import time
 import urllib.request
 
@@ -46,6 +45,7 @@ from deeplearning4j_tpu.serving import (DeadlineExceeded, EngineConfig,
                                         RequestStatus, Router,
                                         SubprocessReplica)
 from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+from helpers import child_killing_watchdog
 
 CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
                         n_layers=2, max_len=64)
@@ -634,35 +634,13 @@ SUB_SPEC = {
 
 @pytest.fixture
 def fleet_watchdog():
-    """Hard per-test bound for subprocess fleets: registered replicas
-    are SIGKILLed when the watchdog fires (turning any would-be hang
-    into a fast, visible failure) and closed on teardown either way —
-    a wedged replica can never hang tier-1."""
-    replicas = []
-    fired = threading.Event()
-
-    def _fire():
-        fired.set()
-        for rep in replicas:
-            try:
-                rep.kill()
-            except Exception:
-                pass
-
-    timer = threading.Timer(HARD_TIMEOUT_S, _fire)
-    timer.daemon = True
-    timer.start()
-    try:
-        yield replicas.append
-    finally:
-        timer.cancel()
-        for rep in replicas:
-            try:
-                rep.close()
-            except Exception:
-                pass
-    assert not fired.is_set(), \
-        f"fleet watchdog fired after {HARD_TIMEOUT_S}s"
+    """Hard per-test bound for subprocess fleets — the shared
+    `helpers.child_killing_watchdog` (also used by the elastic
+    training suite): registered replicas are SIGKILLed when the
+    watchdog fires and closed on teardown either way, so a wedged
+    replica can never hang tier-1."""
+    with child_killing_watchdog(HARD_TIMEOUT_S) as register:
+        yield register
 
 
 @pytest.mark.multiproc
