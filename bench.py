@@ -201,25 +201,34 @@ def flagship_lines(which: str) -> None:
 #: gated line-config name -> flagship BENCHES key (to re-measure when
 #: `--check` / `--update-gate` run without a captured-lines file)
 GATE_BENCHES = {"transformer_lm_12L512d_T2048": "transformer",
-                "elastic_train": "elastic_train"}
+                "elastic_train": "elastic_train",
+                "spec_pipeline_4L192d_Ns8_K7": "spec_pipeline"}
 
 GATE_TOLERANCE = 0.2
 
 
 def check_gate(lines, baseline, tolerance: float = GATE_TOLERANCE):
-    """Compare achieved model FLOP/s against BASELINE.json's
-    ``flops_gate`` floor: a gated config whose ``flops_per_sec`` drops
-    more than ``tolerance`` below its recorded baseline is a failure.
-    ``lines`` is the bench output (list of per-config dicts);
-    ``baseline`` is the parsed BASELINE.json. Returns the list of
-    failure strings — empty means the gate passes. Pure function so
-    the gate itself is unit-testable without running a single bench."""
+    """Compare achieved throughput against BASELINE.json's
+    ``flops_gate`` floor: a gated config whose metric drops more than
+    ``tolerance`` below its recorded baseline is a failure. A gate
+    entry is either a bare number (legacy: gates ``flops_per_sec``) or
+    ``{"metric": <line key>, "value": <floor>}`` — the ISSUE-19 spec
+    throughput gate uses the dict form with
+    ``tokens_per_sec_pipelined_spec``. ``lines`` is the bench output
+    (list of per-config dicts); ``baseline`` is the parsed
+    BASELINE.json. Returns the list of failure strings — empty means
+    the gate passes. Pure function so the gate itself is unit-testable
+    without running a single bench."""
     gate = (baseline or {}).get("flops_gate") or {}
     by_config = {ln.get("config"): ln for ln in lines
                  if isinstance(ln, dict) and ln.get("config")}
     failures = []
     for name in sorted(gate):
         want = gate[name]
+        metric = "flops_per_sec"
+        if isinstance(want, dict):
+            metric = want.get("metric", metric)
+            want = want.get("value")
         if not want:
             continue                 # null floor: recorded but not gated
         ln = by_config.get(name)
@@ -230,15 +239,15 @@ def check_gate(lines, baseline, tolerance: float = GATE_TOLERANCE):
         if "error" in ln:
             failures.append(f"{name}: bench errored: {ln['error']}")
             continue
-        got = ln.get("flops_per_sec")
+        got = ln.get(metric)
         if not got:
             failures.append(f"{name}: bench line carries no "
-                            "flops_per_sec")
+                            f"{metric}")
             continue
         floor = float(want) * (1.0 - float(tolerance))
         if float(got) < floor:
             failures.append(
-                f"{name}: flops_per_sec {float(got):.3e} is below the "
+                f"{name}: {metric} {float(got):.3e} is below the "
                 f"gate floor {floor:.3e} (baseline {float(want):.3e}, "
                 f"tolerance {tolerance:.0%})")
     return failures
@@ -292,7 +301,14 @@ def gate_main(argv) -> int:
         gate = dict(baseline.get("flops_gate") or {})
         for ln in lines:
             name = ln.get("config") if isinstance(ln, dict) else None
-            if name in GATE_BENCHES and ln.get("flops_per_sec"):
+            if name not in GATE_BENCHES:
+                continue
+            cur = gate.get(name)
+            if isinstance(cur, dict):    # metric-keyed entry: keep the
+                metric = cur.get("metric", "flops_per_sec")
+                if ln.get(metric):       # metric, refresh the floor
+                    gate[name] = {**cur, "value": ln[metric]}
+            elif ln.get("flops_per_sec"):
                 gate[name] = ln["flops_per_sec"]
         baseline["flops_gate"] = gate
         with open(_baseline_path(), "w") as f:

@@ -136,6 +136,54 @@ def reference_decode_attention(q: Array, k_cache: Array, v_cache: Array,
     return a.astype(q.dtype)
 
 
+def reference_window_attention(q: Array, k_cache: Array, v_cache: Array,
+                               pos, n_heads: int,
+                               scale: Optional[float] = None,
+                               k_scale: Optional[Array] = None,
+                               v_scale: Optional[Array] = None) -> Array:
+    """jnp reference for the speculative-verify WINDOW: q [B, T, H, Dh]
+    holds T = K+1 query rows per batch row; row t sits at position
+    ``pos[b] + t`` and attends cache rows 0..pos[b]+t of k/v
+    [B, S, D=H*Dh]. Returns [B, T, H, Dh].
+
+    This is the spec verify pass's inline masked-softmax algebra,
+    copied EXACTLY — same einsum contractions ("bthd,bshd->bhts" /
+    "bhts,bshd->bthd"), same cast order (float path: einsum in the
+    activation dtype then ``.astype(f32) * scale``; quantized path:
+    f32 einsum ``* k_scale * scale``, probabilities ``* v_scale``, PV
+    cast back to ``q.dtype``), same clipped per-row bound — so routing
+    parallel/serving.py's verify_phase call sites through this one
+    primitive is bit-identical, which is what keeps speculative decode
+    token-exact against sequential decode (and the pipelined spec
+    engine token-exact against the sync one)."""
+    b, t, h, dh = q.shape
+    s = k_cache.shape[-2]
+    if scale is None:
+        scale = 1.0 / (dh ** 0.5)
+    pos = jnp.asarray(pos)
+    posw = pos[:, None] + jnp.arange(t, dtype=pos.dtype)[None, :]
+    wp = jnp.clip(posw, 0, s - 1)
+    if k_scale is None:
+        kh = k_cache.reshape(b, s, h, dh)
+        vh = v_cache.reshape(b, s, h, dh)
+        sc = jnp.einsum("bthd,bshd->bhts", q, kh) \
+            .astype(jnp.float32) * scale
+        sc = jnp.where(jnp.arange(s)[None, None, None, :]
+                       <= wp[:, None, :, None], sc, NEG_INF)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype), vh)
+    kh = k_cache.astype(jnp.float32).reshape(b, s, h, dh)
+    vh = v_cache.astype(jnp.float32).reshape(b, s, h, dh)
+    sc = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), kh) \
+        * k_scale[:, None, None, :] * scale
+    sc = jnp.where(jnp.arange(s)[None, None, None, :]
+                   <= wp[:, None, :, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhts,bshd->bthd", pr * v_scale[:, None, None, :],
+                   vh)
+    return a.astype(q.dtype)
+
+
 def _decode_kernel(blk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
                    l_scr, acc_scr, *, scale: float, h: int, bs: int,
                    bb: int, n_blocks: int):
@@ -342,3 +390,197 @@ def decode_attention(q: Array, k_cache: Array, v_cache: Array, pos,
         interpret=os.environ.get("DL4JTPU_FLASH") == "interpret",
     )(pos_blk, pos_rows, q, k_cache, v_cache)
     return out
+
+
+def _window_kernel(blk_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
+                   l_scr, acc_scr, *, scale: float, h: int, t: int,
+                   bs: int, bb: int, n_blocks: int):
+    """_decode_kernel generalized to a T-row speculative-verify window
+    per batch row, by flattening the window into the head axis: q
+    arrives [bb, T*H, Dh] where pseudo-head p = t*H + hh is window row
+    t of real head hh. Each pseudo-head's score row slices the SAME
+    H-head cache block (hh = p % h) but masks to its own bound
+    pos + p // h — a static per-pseudo-head offset riding the existing
+    per-row vector-pos mask. Everything else (online softmax, per-head
+    mul-reduce, slice-store accumulators) is the decode kernel
+    verbatim, so one cache-block DMA serves all T window rows — the
+    T-fold read amplification of calling the decode kernel per window
+    row is exactly what this variant removes."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    # DMA clamp: blk_ref already includes the +T-1 window reach (the
+    # dispatch adds it), so a block covers its furthest WINDOW row.
+    last = blk_ref[i] // bs
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j <= last)
+    def _block():
+        q = q_ref[...]                     # [bb, T*H, Dh]
+        k = k_ref[...]                     # [bb, bs, D]
+        v = v_ref[...]
+        if k.ndim == 4:                    # stacked-cache block [1,...]
+            k, v = k[0], v[0]
+        _, th, dh = q.shape
+        sc = []
+        for p_i in range(th):
+            hh = p_i % h                   # real head of pseudo-head
+            kh = k[:, :, hh * dh:(hh + 1) * dh]
+            qh = q[:, p_i:p_i + 1, :]                      # [bb, 1, Dh]
+            sc.append(jnp.sum(kh * qh, axis=-1,
+                              dtype=jnp.float32))          # [bb, bs]
+        s = jnp.stack(sc, axis=-1) * scale              # [bb, bs, T*H]
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        # static window offset per pseudo-head: row t attends to
+        # pos + t. Unclipped bound == the reference's clip(pos+t, s-1)
+        # bound — ki never exceeds s-1, so the masks are identical.
+        off = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) // h
+        rows_pos = pl.load(pos_ref, (pl.dslice(i * bb, bb),))  # [bb]
+        s = jnp.where(ki <= rows_pos[:, None, None] + off, s, NEG_INF)
+        # blocks wholly past a row's bound are exact no-ops under the
+        # running stats: all-NEG_INF scores leave m unchanged (finite
+        # -1e30 < any live max), p underflows to 0, corr = 1.
+        m_prev = m_scr[...]                              # [bb, T*H]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None, :])               # [bb, bs, T*H]
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=1)
+        m_scr[...] = m_new
+        for p_i in range(th):
+            hh = p_i % h
+            vh = v[:, :, hh * dh:(hh + 1) * dh]
+            pv = jnp.sum(p[:, :, p_i:p_i + 1].astype(v.dtype) * vh,
+                         axis=1, dtype=jnp.float32)        # [bb, Dh]
+            acc_scr[:, p_i:p_i + 1, :] = (
+                acc_scr[:, p_i:p_i + 1, :]
+                * corr[:, p_i:p_i + 1][..., None]
+                + pv[:, None, :])
+
+    @pl.when(j == n_blocks - 1)
+    def _out():
+        o_ref[...] = (acc_scr[...]
+                      / l_scr[...][..., None]).astype(o_ref.dtype)
+
+
+def window_attention_available(q: Array, k_cache: Array) -> bool:
+    """Kernel eligibility for the verify window: decode_attention's
+    gates with a 4-D q [B, T, H, Dh] (T = K+1 window rows)."""
+    env = os.environ.get("DL4JTPU_FLASH", "auto")
+    if env == "0":
+        return False
+    if q.ndim != 4 or k_cache.ndim not in (3, 4):
+        return False
+    if q.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        return False
+    b, t, h, dh = q.shape
+    s = k_cache.shape[-2]
+    if dh % 8 != 0 or s < 128:
+        return False
+    if env == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def decode_window_attention(q: Array, k_cache: Array, v_cache: Array,
+                            pos, n_heads: int,
+                            scale: Optional[float] = None,
+                            layer: int = 0,
+                            k_scale: Optional[Array] = None,
+                            v_scale: Optional[Array] = None) -> Array:
+    """Dispatching K+1-window attention for the speculative verify
+    pass: q [B, T, H, Dh] — window row t of batch row b sits at
+    position ``pos[b] + t`` (its cache row already written) and
+    attends rows 0..pos[b]+t. Returns [B, T, H, Dh].
+
+    The kernel path flattens the window into the head axis (q ->
+    [B, T*H, Dh]) so every cache block is DMA'd ONCE for all T window
+    rows — same split-K geometry, prefetched-scalar DMA clamp
+    (extended by T-1 rows of window reach), and per-head mul-reduce as
+    decode_attention, with a static per-pseudo-head position offset in
+    the mask. Off-TPU (and for quantized caches, which fold
+    ``k_scale``/``v_scale`` per-row exactly like
+    reference_decode_attention) it takes the jnp reference, which
+    reproduces the verify pass's historical inline algebra bit-for-
+    bit. Caches may be [B, S, D] or stacked [L, B, S, D] with a static
+    ``layer`` (plane selected in the BlockSpec index_map on the kernel
+    path, never materialized)."""
+    if k_scale is not None or not window_attention_available(q, k_cache):
+        if k_cache.ndim == 4:
+            k_cache, v_cache = k_cache[layer], v_cache[layer]
+        return reference_window_attention(q, k_cache, v_cache, pos,
+                                          n_heads, scale,
+                                          k_scale=k_scale,
+                                          v_scale=v_scale)
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, dh = q.shape
+    th = t * h
+    s, d = k_cache.shape[-2], k_cache.shape[-1]
+    stacked = k_cache.ndim == 4
+    if scale is None:
+        scale = 1.0 / (dh ** 0.5)
+
+    def _env_pos_int(name: str, default: int) -> int:
+        try:
+            v = int(os.environ.get(name, ""))
+        except ValueError:
+            return default
+        return v if v > 0 else default
+
+    bs_cap = _env_pos_int("DL4JTPU_DECODE_BS", 128)
+    blk_bytes = _env_pos_int("DL4JTPU_DECODE_BLOCK_BYTES", 1 << 21)
+    bs = _largest_divisor(s, bs_cap)
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    bb = _largest_divisor(
+        b, max(1, blk_bytes // max(1, bs * d * itemsize)))
+    n_blocks = s // bs
+    kernel = functools.partial(_window_kernel, scale=float(scale), h=h,
+                               t=t, bs=bs, bb=bb, n_blocks=n_blocks)
+    qf = q.reshape(b, th, dh)
+    pos_rows = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    # the per-batch-block DMA clamp must cover each block's furthest
+    # WINDOW row: max base pos in the block + the T-1 window reach
+    pos_blk = jnp.minimum(
+        jnp.max(pos_rows.reshape(b // bb, bb), axis=1) + (t - 1),
+        s - 1)
+
+    if stacked:
+        kv_block = (1, bb, bs, d)
+
+        def kv_map(i, j, blk_ref, pos_ref):
+            return (layer, i, jnp.minimum(j, blk_ref[i] // bs), 0)
+    else:
+        kv_block = (bb, bs, d)
+
+        def kv_map(i, j, blk_ref, pos_ref):
+            return (i, jnp.minimum(j, blk_ref[i] // bs), 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b // bb, n_blocks),
+            in_specs=[
+                pl.BlockSpec((bb, th, dh), lambda i, j, *_: (i, 0, 0)),
+                pl.BlockSpec(kv_block, kv_map),
+                pl.BlockSpec(kv_block, kv_map),
+            ],
+            out_specs=pl.BlockSpec((bb, th, dh),
+                                   lambda i, j, *_: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bb, th), jnp.float32),
+                pltpu.VMEM((bb, th), jnp.float32),
+                pltpu.VMEM((bb, th, dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, th, dh), q.dtype),
+        interpret=os.environ.get("DL4JTPU_FLASH") == "interpret",
+    )(pos_blk, pos_rows, qf, k_cache, v_cache)
+    return out.reshape(b, t, h, dh)

@@ -1679,7 +1679,8 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
     ``quantized``/``draft_quantized`` mark the respective param trees;
     ``kv_mode`` selects the quantized slot pool exactly as
     make_continuous_decode."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    from deeplearning4j_tpu.ops.flash_decode import \
+        decode_window_attention
     tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
     quantized, kv_mode = _resolve_quant(quantized, kv_mode)
     draft_quantized, _ = _resolve_quant(draft_quantized, None)
@@ -1773,15 +1774,13 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                     k_wr, mode="drop")
                 cv = cv.at[layer, rows[:, None], posw].set(
                     v_wr, mode="drop")
-                kh = ck[layer].reshape(ns, s_max, h_loc, cfg.d_head)
-                vh = cv[layer].reshape(ns, s_max, h_loc, cfg.d_head)
-                sc = jnp.einsum("bthd,bshd->bhts", q, kh) \
-                    .astype(jnp.float32) * scale
-                sc = jnp.where(jnp.arange(s_max)[None, None, None, :]
-                               <= wp[:, None, :, None], sc, NEG_INF)
-                pr = jax.nn.softmax(sc, axis=-1)
-                a = jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype),
-                               vh)
+                # fused K+1-window attention: the STACKED caches ride
+                # into the primitive (kernel picks the layer plane in
+                # its BlockSpec; jnp reference reproduces the old
+                # inline masked-softmax bit-for-bit — flash_decode
+                # .reference_window_attention holds the algebra)
+                a = decode_window_attention(q, ck, cv, pos, h_loc,
+                                            scale, layer=layer)
             else:
                 from deeplearning4j_tpu.quant.kv import quantize_rows
                 kq, ksr = quantize_rows(kw, kv_mode)
@@ -1802,20 +1801,13 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                     ks_wr, mode="drop")
                 vsc = vsc.at[layer, rows[:, None], posw, 0].set(
                     vs_wr, mode="drop")
-                kh = ck[layer].astype(jnp.float32) \
-                    .reshape(ns, s_max, h_loc, cfg.d_head)
-                vh = cv[layer].astype(jnp.float32) \
-                    .reshape(ns, s_max, h_loc, cfg.d_head)
-                sc = jnp.einsum("bthd,bshd->bhts",
-                                q.astype(jnp.float32), kh) \
-                    * ksc[layer, :, :, 0][:, None, None, :] * scale
-                sc = jnp.where(jnp.arange(s_max)[None, None, None, :]
-                               <= wp[:, None, :, None], sc, NEG_INF)
-                pr = jax.nn.softmax(sc, axis=-1)
-                a = jnp.einsum("bhts,bshd->bthd",
-                               pr * vsc[layer, :, :, 0][:, None,
-                                                        None, :],
-                               vh).astype(x.dtype)
+                # per-row scale folds travel into the fused window
+                # primitive (scores * kscale_s, probs * vscale_s —
+                # identical multiplication order)
+                a = decode_window_attention(
+                    q, ck, cv, pos, h_loc, scale, layer=layer,
+                    k_scale=ksc[layer, :, :, 0],
+                    v_scale=vsc[layer, :, :, 0])
             h = h + g_model(jnp.matmul(a.reshape(ns, k1, d_loc),
                                        p["Wo"].astype(h.dtype)))
             x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)
@@ -1899,7 +1891,8 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
     routed to the scratch page (never attended). The engine's
     copy-on-write guard privatizes the whole window's pages before
     the call, so speculative writes are COW-safe by construction."""
-    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    from deeplearning4j_tpu.ops.flash_decode import \
+        decode_window_attention
     tp = _check_paged_mesh(cfg, mesh, top_k, top_p, page_size,
                            num_pages, max_pages)
     dp = 1
@@ -1956,7 +1949,6 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
             kp, vp, ksc, vsc = st
         win = jnp.concatenate([tok[:, None], drafts], axis=1)
         posw = pos[:, None] + jnp.arange(k1, dtype=pos.dtype)[None, :]
-        wp = jnp.clip(posw, 0, s_view - 1)
         # write routing: inactive slots and positions past the block
         # table land on the scratch page (page 0), like the paged
         # decode/prefill write paths
@@ -1977,17 +1969,14 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
             if kv_mode is None:
                 kp = kp.at[layer, pgw, offw].set(kw.astype(kp.dtype))
                 vp = vp.at[layer, pgw, offw].set(vw.astype(vp.dtype))
-                kh = _gather_pages(kp[layer], bt, ns, s_view) \
-                    .reshape(ns, s_view, h_loc, cfg.d_head)
-                vh = _gather_pages(vp[layer], bt, ns, s_view) \
-                    .reshape(ns, s_view, h_loc, cfg.d_head)
-                sc = jnp.einsum("bthd,bshd->bhts", q, kh) \
-                    .astype(jnp.float32) * scale
-                sc = jnp.where(jnp.arange(s_view)[None, None, None, :]
-                               <= wp[:, None, :, None], sc, NEG_INF)
-                pr = jax.nn.softmax(sc, axis=-1)
-                a = jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype),
-                               vh)
+                # fused K+1-window attention over the gathered logical
+                # view (jnp reference off-TPU reproduces the old
+                # inline masked-softmax bit-for-bit; the kernel path
+                # DMAs each gathered block once for all window rows)
+                kh = _gather_pages(kp[layer], bt, ns, s_view)
+                vh = _gather_pages(vp[layer], bt, ns, s_view)
+                a = decode_window_attention(q, kh, vh, pos, h_loc,
+                                            scale)
             else:
                 from deeplearning4j_tpu.quant.kv import quantize_rows
                 kq, ksr = quantize_rows(kw, kv_mode)
@@ -1997,22 +1986,14 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                 ksc = ksc.at[layer, pgw, offw, 0].set(ksr)
                 vsc = vsc.at[layer, pgw, offw, 0].set(vsr)
                 kh = _gather_pages(kp[layer].astype(jnp.float32), bt,
-                                   ns, s_view) \
-                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                                   ns, s_view)
                 vh = _gather_pages(vp[layer].astype(jnp.float32), bt,
-                                   ns, s_view) \
-                    .reshape(ns, s_view, h_loc, cfg.d_head)
+                                   ns, s_view)
                 ksg = _gather_pages(ksc[layer], bt, ns, s_view)[..., 0]
                 vsg = _gather_pages(vsc[layer], bt, ns, s_view)[..., 0]
-                sc = jnp.einsum("bthd,bshd->bhts",
-                                q.astype(jnp.float32), kh) \
-                    * ksg[:, None, None, :] * scale
-                sc = jnp.where(jnp.arange(s_view)[None, None, None, :]
-                               <= wp[:, None, :, None], sc, NEG_INF)
-                pr = jax.nn.softmax(sc, axis=-1)
-                a = jnp.einsum("bhts,bshd->bthd",
-                               pr * vsg[:, None, None, :], vh) \
-                    .astype(x.dtype)
+                a = decode_window_attention(q, kh, vh, pos, h_loc,
+                                            scale, k_scale=ksg,
+                                            v_scale=vsg)
             h = h + g_model(jnp.matmul(a.reshape(ns, k1, d_loc),
                                        p["Wo"].astype(h.dtype)))
             x = layer_norm(h, p["ln2g"], p["ln2b"], cfg.eps)

@@ -164,6 +164,48 @@ def paged_pool_bytes(cfg, num_slots: int, page_size: int,
     return pool + scales + bt + 2 * num_slots * 4
 
 
+def handoff_page_bucket(npages: int, max_pages: int) -> int:
+    """Power-of-two page-count bucket for one handoff's row/gather
+    geometry (ISSUE-19): the batched adopt/export programs pad their
+    row buffers and index vectors to this, so host<->device transfer
+    scales with the CHAIN length (within a 2x bucket) while the
+    compiled-program count stays log2-bounded at
+    ceil(log2(max_pages)) + 1 geometries."""
+    b = 1
+    while b < max(1, int(npages)):
+        b *= 2
+    return min(b, int(max_pages))
+
+
+def handoff_row_buffers(kv, n_layers: int, npages: int,
+                        page_size: int, value_dtype) -> list:
+    """Pad a `KVHandoff`'s rows — and the per-row scales, which
+    TRAVEL WITH their rows — to the bucketed
+    [L, npages * page_size, ...] geometry and reshape to page
+    granularity: the runtime-data form the engine's batched all-layer
+    adopt programs scatter from in ONE launch (ISSUE-19). Unwritten
+    value rows are zero; unwritten scale rows are 1.0 (the
+    never-written-row convention of the quantized pools), so a
+    partially filled tail page adopts cleanly."""
+    import numpy as np
+    cap = npages * page_size
+    if kv.pos > cap:
+        raise ValueError(
+            f"handoff bucket too small: {kv.pos} rows > "
+            f"{npages} pages x {page_size}")
+    rows = []
+    for src in (kv.k, kv.v):
+        buf = np.zeros((n_layers, cap, src.shape[-1]), value_dtype)
+        buf[:, :kv.pos] = src
+        rows.append(buf.reshape(n_layers, npages, page_size, -1))
+    if kv.kv_mode:
+        for src in (kv.k_scale, kv.v_scale):
+            buf = np.ones((n_layers, cap, src.shape[-1]), np.float32)
+            buf[:, :kv.pos] = src
+            rows.append(buf.reshape(n_layers, npages, page_size, -1))
+    return rows
+
+
 def handoff_bytes(cfg, tokens: int, kv_mode: Optional[str] = None,
                   tp: int = 1, cache_dtype=None) -> int:
     """Analytic bytes one cross-tier KV handoff moves for a committed

@@ -1000,11 +1000,15 @@ def _compiled_page_poison(n_pool_arrays: int):
 
 
 @_program_cache
-def _compiled_page_gather(n_pool_arrays: int):
-    """Gather a page chain out of the pool (all layers, values +
-    scales) — the KV-export half of the cross-tier handoff (ISSUE-11).
-    One fixed-shape program per pool arity; the (max_pages-padded)
-    index vector is runtime data, so exporting never recompiles."""
+def _compiled_page_gather(n_pool_arrays: int, mesh=None, geom=None):
+    """Gather a page chain out of the pool — ALL layers, values AND
+    scales, in ONE batched program (the KV-export half of the
+    cross-tier handoff, ISSUE-11). The index vector is runtime data
+    padded to a power-of-two bucket (ISSUE-19), so exporting never
+    recompiles and the device->host transfer scales with the chain,
+    not the pool's max_pages capacity. ``mesh``/``geom`` are
+    cache-key-only: they pin the AOT executable resolved through
+    `_resolve_program` to one pool geometry."""
     import jax
 
     def gather(idx, *pool):
@@ -1014,10 +1018,11 @@ def _compiled_page_gather(n_pool_arrays: int):
 
 
 @_program_cache
-def _compiled_slot_gather(n_pool_arrays: int):
+def _compiled_slot_gather(n_pool_arrays: int, mesh=None, geom=None):
     """Contiguous twin of _compiled_page_gather: one slot's full
     [L, S, ...] planes out of the slot pool (slot index is runtime
-    data)."""
+    data). ``mesh``/``geom`` are cache-key-only (see
+    _compiled_page_gather)."""
     import jax
 
     def gather(slot, *pool):
@@ -1027,12 +1032,15 @@ def _compiled_slot_gather(n_pool_arrays: int):
 
 
 @_program_cache
-def _compiled_kv_adopt(n_pool_arrays: int):
+def _compiled_kv_adopt(n_pool_arrays: int, mesh=None, geom=None):
     """Scatter a handed-off row chain INTO freshly allocated pages and
     point the slot's pos/tok at the committed prefix — the device-put
-    half of the handoff. ``idx`` is max_pages-padded; invalid entries
-    are routed to the scratch page 0 (never attended), so the scatter
-    shape stays static and adoption never recompiles."""
+    half of the handoff, ONE batched all-layer scatter per adoption
+    (one launch, not n_layers). ``idx`` is bucket-padded; invalid
+    entries are routed to the scratch page 0 (never attended), so the
+    scatter shape stays static within a bucket and adoption never
+    recompiles. ``mesh``/``geom`` are cache-key-only (see
+    _compiled_page_gather)."""
     import jax
     import jax.numpy as jnp
 
@@ -1051,13 +1059,15 @@ def _compiled_kv_adopt(n_pool_arrays: int):
 
 
 @_program_cache
-def _compiled_chain_adopt(n_pool_arrays: int):
+def _compiled_chain_adopt(n_pool_arrays: int, mesh=None, geom=None):
     """Pool-only twin of _compiled_kv_adopt (ISSUE-14): scatter a
     migrated prefix-cache chain into freshly allocated pages WITHOUT
     touching any slot's pos/tok — the chain seeds the radix cache, not
     a seated request, so per-slot state must stay untouched. Page
     indices are runtime data; invalid entries route to the scratch
-    page 0, so seeding never recompiles."""
+    page 0, so seeding never recompiles within a bucket.
+    ``mesh``/``geom`` are cache-key-only (see
+    _compiled_page_gather)."""
     import jax
     import jax.numpy as jnp
 
@@ -1181,6 +1191,15 @@ class InferenceEngine:
         # site (isolation solo re-runs, batch mode, spec rounds) keeps
         # its synchronous semantics untouched.
         self._pipe = bool(self.config.pipeline)
+        # typed fallback surface (ISSUE-19 satellite): the reason a
+        # pipelined config dropped to the synchronous loop, surfaced
+        # in debugz()'s tick_pipeline section and counted into the
+        # lazily registered serving_pipeline_fallbacks_total{reason}.
+        # Speculative decoding no longer falls back: the scheduler
+        # dispatches one tick ahead against a worst-case K+1 window
+        # per slot and reconciles actual acceptance at the commit
+        # boundary (schedule-ahead spec, ISSUE-19 tentpole).
+        self._pipe_fallback: Optional[str] = None
         if self._pipe and not self._continuous:
             # auto-fallback, not rejection (ISSUE-14 satellite):
             # pipeline became the default once it soaked, so configs
@@ -1191,14 +1210,7 @@ class InferenceEngine:
                 "has no persistent slot state to schedule ahead "
                 "over); falling back to the synchronous loop")
             self._pipe = False
-        if self._pipe and self.config.spec_decode:
-            log.warning(
-                "pipeline is incompatible with spec_decode: "
-                "acceptance makes per-round commit counts "
-                "nondeterministic, so the scheduler cannot run one "
-                "tick ahead of the committed values; falling back to "
-                "the synchronous loop")
-            self._pipe = False
+            self._pipe_fallback = "batch"
         self._pending: deque = deque()
         self._pipe_defer = False
         self._pipe_items: Optional[list] = None
@@ -1498,6 +1510,32 @@ class InferenceEngine:
                 "tick wall time): the double-buffered tick loop's "
                 "target metric").set_function(
             lambda: float(self._last_idle))
+        # pipelined-tick fallback surface (ISSUE-19 satellite):
+        # registered only when a fallback actually happened, so
+        # scrapes of engines that pipeline (or never asked to) stay
+        # byte-identical
+        self._m_pipe_fallbacks = None
+        if self._pipe_fallback is not None:
+            self._m_pipe_fallbacks = r.counter(
+                "serving_pipeline_fallbacks",
+                "Pipelined tick-loop configurations dropped to the "
+                "synchronous loop at construction, by reason",
+                labelnames=("reason",))
+            self._m_pipe_fallbacks.labels(self._pipe_fallback).inc()
+        # forced pipeline flushes (ISSUE-19 satellite): KV export and
+        # cache-chain migration must drain the in-flight tick before
+        # reading slot state — the wait is billed here by reason
+        # instead of vanishing into the caller's latency
+        self._last_flush: Optional[dict] = None
+        self._m_flush_seconds = None
+        if self._pipe:
+            self._m_flush_seconds = r.histogram(
+                "serving_pipeline_flush_seconds",
+                "Wall time a committed-view consumer (KV export, "
+                "cache-chain migration, drain) spent draining the "
+                "in-flight pipelined tick, by reason",
+                labelnames=("reason",),
+                buckets=DECODE_LATENCY_BUCKETS)
         # paged KV + prefix sharing (ISSUE-7): registered only on
         # paged engines, so unpaged scrapes are byte-unchanged
         if self._paged:
@@ -1550,6 +1588,17 @@ class InferenceEngine:
                     ).set_function(lambda: float(
                         0 if self._spec_plain > 0
                         else self._spec_cur_k))
+        # schedule-ahead reservation waste (ISSUE-19 satellite):
+        # registered only on PIPELINED spec engines, so synchronous
+        # spec scrapes (and every spec-off scrape) are byte-unchanged
+        self._m_spec_waste = None
+        if self._spec and self._pipe:
+            self._m_spec_waste = r.counter(
+                "serving_spec_schedule_waste_tokens",
+                "Worst-case K+1 window slots the schedule-ahead "
+                "dispatch reserved that verification then rejected "
+                "(the price of pipelining a nondeterministic commit "
+                "count)")
         # chunked prefill (ISSUE-10): registered only on chunked
         # engines, so legacy scrapes are byte-unchanged
         if self._prefill_chunk is not None:
@@ -2566,6 +2615,10 @@ class InferenceEngine:
         return len(decoding) or len(admitted)
 
     def _dispatch_decode(self, decoding, params, data: dict) -> None:
+        if (self._spec and not self._qos_spec_off
+                and self._spec_tick()):
+            self._dispatch_spec(decoding, params, data)
+            return
         try:
             call = (self._call_chunk_paged if self._paged
                     else self._call_chunk)
@@ -2583,16 +2636,64 @@ class InferenceEngine:
         self._pipe_items.append(
             ("decode", list(decoding), toks, needs, data))
 
+    def _dispatch_spec(self, decoding, params, data: dict) -> None:
+        """Schedule-ahead speculative dispatch (ISSUE-19): acceptance
+        makes a round's commit COUNT nondeterministic, so the one-
+        ahead schedule reserves the WORST CASE — K+1 tokens per slot
+        charged to `_pending_n`, so rem/budget masks and the next
+        tick's eligibility treat the whole window as spent — and the
+        commit boundary reconciles actual acceptance, releasing the
+        unused reservation. Token VALUES stay bit-identical to the
+        synchronous spec engine because sampling is position-keyed:
+        a conservative rem mask can only move a round boundary, never
+        change the concatenated stream. K is whatever the LAST commit
+        decided (`_spec_update` runs at commit), so this dispatch
+        never depends on uncommitted values."""
+        call = (self._call_spec_paged if self._paged
+                else self._call_spec)
+        k1 = self._spec_cur_k + 1
+        try:
+            state, toks, nc, drafted, accepted, poison = call(
+                params, self._slot_state, decoding)
+        except _BatchDecodeFailed as e:
+            self._isolate_slots([r for _, r in decoding], e)
+            return
+        self._slot_state = state
+        reserved = []
+        for i, r in decoding:
+            n = min(k1, r.max_new_tokens
+                    - r.generated.shape[0] - r._pending_n)
+            reserved.append(int(n))
+            r._pending_n += int(n)
+        self._pipe_items.append(
+            ("spec", list(decoding), (toks, nc, drafted, accepted),
+             reserved,
+             dict(data, poison=poison, step=self._step_counter - 1,
+                  bill=self._decode_bill_label)))
+
     def _commit_tick(self, prev: "_PendingTick") -> None:
         """Sync a pending tick's outputs (the ONE blocking sync) and
         commit them in dispatch order: prefill first tokens, then
         decode chunks — exactly what the synchronous tick would have
         committed, one tick later."""
+        # a speculative item's deferred outputs are a TUPLE (toks,
+        # ncommit, drafted, accepted); flatten across items so the
+        # whole tick still drains through ONE blocking sync
+        flat, spans = [], []
+        for it in prev.items:
+            out = it[2] if isinstance(it[2], tuple) else (it[2],)
+            spans.append(len(out))
+            flat.extend(out)
         try:
-            synced = self._block_on_many([it[2] for it in prev.items])
+            drained = self._block_on_many(flat)
         except RuntimeError as e:
             self._recover_failed_tick(prev, e)
             return
+        synced, at = [], 0
+        for n in spans:
+            synced.append(tuple(drained[at:at + n]) if n > 1
+                          else drained[at])
+            at += n
         for it, arr in zip(prev.items, synced):
             kind = it[0]
             if kind == "prefill":
@@ -2620,6 +2721,53 @@ class InferenceEngine:
                         prefill_chunk=self._prefill_chunk)
                     if r.generated.shape[0] >= r.max_new_tokens:
                         self._complete(r)
+            elif kind == "spec":
+                # schedule-ahead reconcile (ISSUE-19): the dispatch
+                # reserved a worst-case K+1 window per slot; the
+                # actual acceptance commits 1..K+1 tokens, and the
+                # unused reservation is released here — priced into
+                # serving_spec_schedule_waste_tokens_total. The
+                # adaptive-K controller (and its plain-decode
+                # fallback) also runs HERE, so the NEXT dispatch's K
+                # was always decided at a commit boundary and the
+                # one-ahead schedule stays deterministic.
+                entries, reserved = it[1], it[3]
+                toks, nc, drafted, accepted = arr
+                data = dict(it[4])
+                poison = data.pop("poison")
+                step = data.pop("step")
+                bill = data.pop("bill")
+                cur_bill = self._decode_bill_label
+                self._decode_bill_label = bill
+                try:
+                    for (i, r), n_res in zip(entries, reserved):
+                        with self._lock:
+                            live = self._slots[i] is r
+                        r._pending_n = max(0, r._pending_n - n_res)
+                        if not live or r.done() or n_res <= 0:
+                            continue
+                        d_i = int(drafted[i])
+                        a_i = int(accepted[i])
+                        self._m_spec_drafted.inc(d_i)
+                        self._m_spec_accepted.inc(a_i)
+                        if d_i and a_i == 0:
+                            r.trace.add("draft_rejected", step=step,
+                                        drafted=d_i,
+                                        poisoned=bool(poison[i]))
+                        need = min(int(nc[i]), r.max_new_tokens
+                                   - r.generated.shape[0])
+                        if self._m_spec_waste is not None:
+                            self._m_spec_waste.inc(
+                                max(0, n_res - need))
+                        self._commit_tokens(
+                            r, toks[i, :need].astype(np.int32),
+                            "decode_chunk", slot=i, drafted=d_i,
+                            accepted=a_i, **data)
+                        if r.generated.shape[0] >= r.max_new_tokens:
+                            self._complete(r)
+                finally:
+                    self._decode_bill_label = cur_bill
+                self._spec_update(entries, drafted, accepted, poison)
             else:                    # ("decode", entries, _, needs, d)
                 entries, needs, data = it[1], it[3], it[4]
                 for (i, r), n in zip(entries, needs):
@@ -2665,12 +2813,25 @@ class InferenceEngine:
                 self._m_prefix_evictions.inc(flushed)
         self._isolate_slots(reqs, _BatchDecodeFailed(str(err)))
 
-    def _flush_pipeline(self) -> None:
+    def _flush_pipeline(self, reason: Optional[str] = None) -> None:
         """Commit any dispatched-but-uncommitted tick NOW — KV export
         and other committed-view consumers call this before reading
-        slot state."""
+        slot state. A ``reason`` stamps the forced sync (ISSUE-19
+        satellite): the blocking wait the CALLER caused is recorded
+        into serving_pipeline_flush_seconds{reason} and surfaced as
+        tick_pipeline.last_flush in debugz(), so cross-tier handoff
+        cost under pipelining is attributable instead of invisible."""
+        if not self._pending:
+            return
+        t0 = _perf()
         while self._pending:
             self._commit_tick(self._pending.popleft())
+        if reason is not None:
+            dt = _perf() - t0
+            if self._m_flush_seconds is not None:
+                self._m_flush_seconds.labels(reason).observe(dt)
+            self._last_flush = {"reason": reason,
+                                "seconds": round(dt, 6)}
 
     def _fill_slots(self) -> List[tuple]:
         """Admission at a chunk boundary: seat queued requests into
@@ -3027,49 +3188,64 @@ class InferenceEngine:
             self._prefix_cache.insert(prefix[:kv.pos], fresh)
         return True
 
-    def _handoff_row_buffers(self, kv: KVHandoff) -> List[np.ndarray]:
-        """Pad a handoff's rows (and scales, which travel with their
-        rows) to the fixed [L, max_pages * page_size, ...] geometry and
-        reshape to page granularity — the runtime-data form both adopt
-        programs scatter from."""
-        mp, ps = self._max_pages, self._page_size
-        cap = mp * ps
-        pool, _ = self._pool_arrays()
-        rows = []
-        for src, plane in zip((kv.k, kv.v), pool[:2]):
-            buf = np.zeros((self.cfg.n_layers, cap, src.shape[-1]),
-                           np.asarray(plane).dtype)
-            buf[:, :kv.pos] = src
-            rows.append(buf.reshape(self.cfg.n_layers, mp, ps, -1))
-        if self._kv_mode:
-            for src, plane in zip((kv.k_scale, kv.v_scale), pool[2:]):
-                buf = np.ones((self.cfg.n_layers, cap, src.shape[-1]),
-                              np.float32)    # unwritten rows: scale 1
-                buf[:, :kv.pos] = src
-                rows.append(buf.reshape(self.cfg.n_layers, mp, ps, -1))
-        return rows
+    def _handoff_bucket(self, npages: int) -> int:
+        """Power-of-two page-count bucket for one handoff's geometry
+        (quant/kv.py `handoff_page_bucket`): transfer and scatter cost
+        scale with the chain, program count stays log2-bounded."""
+        from deeplearning4j_tpu.quant.kv import handoff_page_bucket
+        return handoff_page_bucket(npages, self._max_pages)
 
-    def _page_index_vectors(self, pages: List[int]) -> tuple:
-        idx = np.zeros((self._max_pages,), np.int32)
+    def _handoff_row_buffers(self, kv: KVHandoff,
+                             npages: int) -> List[np.ndarray]:
+        """Pad a handoff's rows (and scales, which travel with their
+        rows) to the bucketed [L, npages * page_size, ...] geometry
+        and reshape to page granularity — the runtime-data form both
+        adopt programs scatter from (quant/kv.py owns the layout)."""
+        from deeplearning4j_tpu.quant.kv import handoff_row_buffers
+        pool, _ = self._pool_arrays()
+        return handoff_row_buffers(kv, self.cfg.n_layers, npages,
+                                   self._page_size, pool[0].dtype)
+
+    def _state_geom(self, npages: int = 0) -> tuple:
+        """Shape/dtype signature of the live slot state plus the
+        handoff bucket — the geometry component of the adopt/export
+        program cache keys, so AOT executables resolved through
+        `_resolve_program` never collide across engines with
+        different pools in one process."""
+        return (npages,) + tuple(
+            (tuple(a.shape), str(a.dtype)) for a in self._slot_state)
+
+    def _page_index_vectors(self, pages: List[int],
+                            size: int) -> tuple:
+        idx = np.zeros((size,), np.int32)
         idx[:len(pages)] = pages
-        valid = np.zeros((self._max_pages,), bool)
+        valid = np.zeros((size,), bool)
         valid[:len(pages)] = True
         return idx, valid
 
     def _adopt_rows(self, pages: List[int], kv: KVHandoff,
                     slot: int) -> None:
-        """Device-put the handed-off rows into ``pages``: rows (and
-        scales, which travel with their rows) are padded to the fixed
-        [L, max_pages * page_size, ...] geometry, reshaped to page
-        granularity, and scattered through one compiled program whose
-        page indices are runtime data — adoption never recompiles."""
+        """Device-put the handed-off rows into the prefix's pages:
+        rows (and scales, which travel with their rows) are padded to
+        the bucketed page-granular geometry and scattered through ONE
+        batched all-layer program — resolved via `_resolve_program`,
+        so the launch is visible in serving_compiles_total{program=
+        "kv_adopt"}, AOT-cacheable, and costed by the profiler.
+        Page indices are runtime data — adoption never recompiles
+        within a bucket. Pages past the committed prefix are left for
+        decode to write (a row is always rewritten before it is
+        attended, the same invariant plain decode relies on)."""
         pool, _ = self._pool_arrays()
-        rows = self._handoff_row_buffers(kv)
-        idx, valid = self._page_index_vectors(pages)
-        out = _compiled_kv_adopt(len(pool))(
-            idx, valid, np.int32(slot), np.int32(kv.pos),
-            np.int32(kv.tok), *rows, *self._slot_state)
-        self._slot_state = tuple(out)
+        nb = self._handoff_bucket(
+            pages_for(max(int(kv.pos), 1), self._page_size))
+        rows = self._handoff_row_buffers(kv, nb)
+        idx, valid = self._page_index_vectors(pages[:nb], nb)
+        args = (idx, valid, np.int32(slot), np.int32(kv.pos),
+                np.int32(kv.tok), *rows, *self._slot_state)
+        fn = self._resolve_program(
+            "kv_adopt", _compiled_kv_adopt,
+            (len(pool), self.mesh, self._state_geom(nb)), {}, args)
+        self._slot_state = tuple(fn(*args))
 
     def export_slot_kv(self, handle: RequestHandle,
                        release: bool = True) -> KVHandoff:
@@ -3082,8 +3258,9 @@ class InferenceEngine:
         handle is not resident or still mid-prefill."""
         try:
             # a pipelined engine's committed view trails one tick:
-            # commit the pending dispatch before gathering
-            self._flush_pipeline()
+            # commit the pending dispatch before gathering (the wait
+            # is billed to serving_pipeline_flush_seconds{reason})
+            self._flush_pipeline(reason="export_slot_kv")
             with self._lock:
                 slot = next((i for i, r in enumerate(self._slots)
                              if r is handle), None)
@@ -3106,17 +3283,28 @@ class InferenceEngine:
             tok = int(np.asarray(state[-1])[slot])
             pool = state[:-2]
             if self._paged:
-                idx = np.zeros((self._max_pages,), np.int32)
+                nb = self._handoff_bucket(len(pages))
+                idx = np.zeros((nb,), np.int32)
                 idx[:len(pages)] = pages
-                planes = _compiled_page_gather(len(pool))(
-                    jnp.asarray(idx), *pool)
-                # [L, mp, ps, X] -> [L, mp*ps, X] -> the committed rows
+                args = (jnp.asarray(idx), *pool)
+                fn = self._resolve_program(
+                    "page_gather", _compiled_page_gather,
+                    (len(pool), self.mesh, self._state_geom(nb)),
+                    {}, args)
+                planes = fn(*args)
+                # [L, nb, ps, X] -> [L, nb*ps, X] -> the committed
+                # rows (the bucketed gather moves ~chain bytes, not
+                # the pool's max_pages capacity)
                 planes = [np.asarray(a).reshape(
                     self.cfg.n_layers, -1, a.shape[-1])[:, :pos]
                     for a in planes]
             else:
-                planes = _compiled_slot_gather(len(pool))(
-                    np.int32(slot), *pool)
+                args = (np.int32(slot), *pool)
+                fn = self._resolve_program(
+                    "slot_gather", _compiled_slot_gather,
+                    (len(pool), self.mesh, self._state_geom()),
+                    {}, args)
+                planes = fn(*args)
                 planes = [np.asarray(a)[:, :pos] for a in planes]
             k, v = planes[0], planes[1]
             ksc = planes[2] if self._kv_mode else None
@@ -3155,7 +3343,7 @@ class InferenceEngine:
         if not (self._continuous and self._paged
                 and self._prefix_cache is not None):
             return None
-        self._flush_pipeline()
+        self._flush_pipeline(reason="export_cached_chain")
         with self._lock:
             node = self._prefix_cache.node_for_hash(chain_hash)
             if node is None or self._slot_state is None:
@@ -3165,10 +3353,15 @@ class InferenceEngine:
             import jax.numpy as jnp
             pos = len(pages) * self._page_size
             pool = self._slot_state[:-2]
-            idx = np.zeros((self._max_pages,), np.int32)
+            nb = self._handoff_bucket(len(pages))
+            idx = np.zeros((nb,), np.int32)
             idx[:len(pages)] = pages
-            planes = _compiled_page_gather(len(pool))(
-                jnp.asarray(idx), *pool)
+            args = (jnp.asarray(idx), *pool)
+            fn = self._resolve_program(
+                "page_gather", _compiled_page_gather,
+                (len(pool), self.mesh, self._state_geom(nb)),
+                {}, args)
+            planes = fn(*args)
             planes = [np.asarray(a).reshape(
                 self.cfg.n_layers, -1, a.shape[-1])[:, :pos]
                 for a in planes]
@@ -3220,10 +3413,14 @@ class InferenceEngine:
             pages.append(p)
         try:
             pool_n = len(self._slot_state) - 2
-            rows = self._handoff_row_buffers(kv)
-            idx, valid = self._page_index_vectors(pages)
-            out = _compiled_chain_adopt(pool_n)(
-                idx, valid, *rows, *self._slot_state[:-2])
+            nb = self._handoff_bucket(npages)
+            rows = self._handoff_row_buffers(kv, nb)
+            idx, valid = self._page_index_vectors(pages, nb)
+            args = (idx, valid, *rows, *self._slot_state[:-2])
+            fn = self._resolve_program(
+                "chain_adopt", _compiled_chain_adopt,
+                (pool_n, self.mesh, self._state_geom(nb)), {}, args)
+            out = fn(*args)
             self._slot_state = (*out, *self._slot_state[-2:])
         except Exception as e:
             self._allocator.release_chain(pages)
@@ -3328,8 +3525,16 @@ class InferenceEngine:
                 and not self._qos_spec_off):
             # a speculative round writes the whole K+1-token verify
             # window (rejected rows included) — the COW guard must
-            # privatize every page it can touch
+            # privatize every page it can touch. Under schedule-ahead
+            # dispatch (ISSUE-19) the round's start position is only
+            # known to within the in-flight reservation: the device
+            # may have advanced by anywhere from 1 to _pending_n
+            # tokens when this round executes, so the guard widens to
+            # the worst-case union of every possible window.
             span = self._spec_cur_k + 1
+            if r._pending_n > 0:
+                lo = max(0, plen - 1 - r._pending_n)
+                span = r._pending_n + self._spec_cur_k + 1
         return lo, min(lo + span,
                        int(r.prompt.shape[0]) + r.max_new_tokens)
 
@@ -3461,6 +3666,16 @@ class InferenceEngine:
         if self._pipe_defer:
             return x
         return self._block_on(x)
+
+    def _out_sync_many(self, xs) -> list:
+        """`_out_sync` for a compiled call with several host-bound
+        outputs (the speculative round's toks/ncommit/drafted/
+        accepted): ONE blocking sync when synchronous, the raw device
+        values under a pipelined dispatch — the next tick's commit
+        drains them with the rest of the tick."""
+        if self._pipe_defer:
+            return list(xs)
+        return self._block_on_many(xs)
 
     def _resolve_program(self, program: str, factory, fargs: tuple,
                          fkw: dict, example_args: Optional[tuple]):
@@ -4059,7 +4274,13 @@ class InferenceEngine:
         rem = np.zeros((self._num_slots,), np.int32)
         for i, r in entries:
             active[i] = True
-            rem[i] = r.max_new_tokens - r.generated.shape[0]
+            # schedule-ahead budget mask (ISSUE-19): tokens already in
+            # flight count as SPENT (zero-delta on the synchronous
+            # path, where _pending_n is always 0). Conservative rem
+            # can only move a round boundary — sampling is position-
+            # keyed, so the token stream is unchanged.
+            rem[i] = (r.max_new_tokens - r.generated.shape[0]
+                      - r._pending_n)
         poison = self._spec_poison(entries)
         key = self._root_key()
         dparams = self._draft_params
@@ -4078,7 +4299,7 @@ class InferenceEngine:
         def call():
             o = fn(params, dparams, *state, active, rem, poison, key)
             return (tuple(o[:n_state]),
-                    *self._block_on_many(o[n_state:n_state + 4]))
+                    *self._out_sync_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
@@ -4098,7 +4319,9 @@ class InferenceEngine:
         rem = np.zeros((self._num_slots,), np.int32)
         for i, r in entries:
             active[i] = True
-            rem[i] = r.max_new_tokens - r.generated.shape[0]
+            # schedule-ahead budget mask (ISSUE-19): see _call_spec
+            rem[i] = (r.max_new_tokens - r.generated.shape[0]
+                      - r._pending_n)
         poison = self._spec_poison(entries)
         key = self._root_key()
         dparams = self._draft_params
@@ -4120,7 +4343,7 @@ class InferenceEngine:
             o = fn(params, dparams, *state, bt, active, rem, poison,
                    key)
             return (tuple(o[:n_state]),
-                    *self._block_on_many(o[n_state:n_state + 4]))
+                    *self._out_sync_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
@@ -4549,16 +4772,22 @@ class InferenceEngine:
                          "shared_tokens": int(
                              self._m_prefix_shared_tokens.value)}
                         if self._prefix_cache is not None else None)}
-        if self._continuous:
+        if self._continuous or self._pipe_fallback is not None:
             # tick-pipeline + compile-cache state (ISSUE-12): the
-            # raw-speed section of the "why is it slow" snapshot
+            # raw-speed section of the "why is it slow" snapshot.
+            # Also emitted for an engine that FELL BACK out of the
+            # pipeline (batch mode) so the fallback reason is
+            # inspectable where the pipeline state would have been
+            # (ISSUE-19 satellite).
             out["tick_pipeline"] = {
                 "pipeline": self._pipe,
+                "fallback_reason": self._pipe_fallback,
                 "in_flight_ticks": len(self._pending),
                 "last_sync_s": round(self._last_sync_s, 6),
                 "syncs_last_tick": self._last_tick_syncs,
                 "syncs_total": self._syncs_total,
-                "device_idle_fraction": round(self._last_idle, 4)}
+                "device_idle_fraction": round(self._last_idle, 4),
+                "last_flush": self._last_flush}
             out["compile_cache"] = {
                 "program_cache_size": _PROGRAM_CACHE_SIZE[0],
                 "aot": (self._aot.stats() if self._aot is not None

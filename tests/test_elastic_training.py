@@ -189,12 +189,37 @@ def test_bench_mfu_regression_gate():
         baseline)
     assert len(errs) == 2
 
+    # metric-keyed dict entries (ISSUE-19): the spec-throughput gate
+    # reads its own bench-line key, with the config and floor named
+    # in the failure
+    mbase = {"flops_gate": {"spec_pipeline_x": {
+        "metric": "tokens_per_sec_pipelined_spec", "value": 1000.0}}}
+    assert bench.check_gate(
+        [{"config": "spec_pipeline_x",
+          "tokens_per_sec_pipelined_spec": 850.0}],
+        mbase, tolerance=0.2) == []
+    mfails = bench.check_gate(
+        [{"config": "spec_pipeline_x",
+          "tokens_per_sec_pipelined_spec": 750.0}],
+        mbase, tolerance=0.2)
+    assert len(mfails) == 1
+    assert mfails[0].startswith("spec_pipeline_x")
+    assert "tokens_per_sec_pipelined_spec" in mfails[0]
+    assert "8.000e+02" in mfails[0]          # the floor, by value
+    # a dict line missing the keyed metric fails loudly too
+    assert len(bench.check_gate(
+        [{"config": "spec_pipeline_x", "flops_per_sec": 1e12}],
+        mbase)) == 1
+
     # the shipped BASELINE.json actually carries the gate, and the
     # elastic bench reports through it
     shipped = json.loads((root / "BASELINE.json").read_text())
     assert "elastic_train" in shipped["flops_gate"]
     assert "transformer_lm_12L512d_T2048" in shipped["flops_gate"]
-    assert all((v or 0) > 0 for v in shipped["flops_gate"].values())
+    assert "spec_pipeline_4L192d_Ns8_K7" in shipped["flops_gate"]
+    for v in shipped["flops_gate"].values():
+        floor = v.get("value") if isinstance(v, dict) else v
+        assert (floor or 0) > 0
 
 
 # ---------------------------------------------------------------------------

@@ -191,3 +191,122 @@ def test_reference_matches_block_decode_semantics():
     np.testing.assert_allclose(np.asarray(ref),
                                np.asarray(old[:, 0]), rtol=1e-6,
                                atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# K+1-window verify attention (ISSUE-19): the spec verify pass routes
+# its [B, T, H, Dh] window through the vector-pos kernel with the
+# window folded into pseudo-heads
+# ---------------------------------------------------------------------------
+
+def _mk_window(b, t, h, dh, s, dtype, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    d = h * dh
+    q = jax.random.normal(kq, (b, t, h, dh), dtype)
+    k = jax.random.normal(kk, (b, s, d), dtype)
+    v = jax.random.normal(kv, (b, s, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("pos", [[0, 5, 255, 500], [250, 251, 252, 253],
+                                 [508, 509, 510, 511]])
+def test_window_kernel_matches_reference(interpret_mode, pos):
+    """The window-as-pseudo-heads kernel must equal the jnp window
+    reference at every per-row prefix — including rows whose K+1
+    window straddles a block boundary and rows clipped at the cache
+    end (pos + t - 1 > s - 1)."""
+    from deeplearning4j_tpu.ops.flash_decode import (
+        decode_window_attention, reference_window_attention,
+        window_attention_available)
+    b, t, h, dh, s = 4, 5, 4, 16, 512
+    q, k, v = _mk_window(b, t, h, dh, s, jnp.float32)
+    assert window_attention_available(q, k)
+    pv = jnp.asarray(pos, jnp.int32)
+    out = decode_window_attention(q, k, v, pv, n_heads=h)
+    ref = reference_window_attention(q, k, v, pv, n_heads=h)
+    assert out.shape == (b, t, h, dh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_window_kernel_stacked_cache_layer_select(interpret_mode):
+    """The verify pass hands the kernel the STACKED [L, B, S, D] pool
+    and a layer index (no-copy plane select in the BlockSpec)."""
+    from deeplearning4j_tpu.ops.flash_decode import (
+        decode_window_attention, reference_window_attention)
+    L, b, t, h, dh, s = 2, 2, 3, 4, 16, 256
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(kq, (b, t, h, dh), jnp.float32)
+    ks = jax.random.normal(kk, (L, b, s, h * dh), jnp.float32)
+    vs = jax.random.normal(kv, (L, b, s, h * dh), jnp.float32)
+    pos = jnp.asarray([30, 200], jnp.int32)
+    out = decode_window_attention(q, ks, vs, pos, n_heads=h, layer=1)
+    ref = reference_window_attention(q, ks[1], vs[1], pos, n_heads=h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_window_kernel_scale_folded_quant(interpret_mode):
+    """Per-row int8 K/V scales fold through the window kernel exactly
+    as they do in the scalar decode kernel: row scale applied before
+    1/sqrt(d), value scale on the probabilities."""
+    from deeplearning4j_tpu.ops.flash_decode import (
+        decode_window_attention, reference_window_attention)
+    from deeplearning4j_tpu.quant.kv import quantize_rows
+    b, t, h, dh, s = 2, 3, 4, 16, 256
+    q, kf, vf = _mk_window(b, t, h, dh, s, jnp.float32, seed=21)
+    kq8, ksc = quantize_rows(kf, "int8")
+    vq8, vsc = quantize_rows(vf, "int8")
+    pos = jnp.asarray([17, 250], jnp.int32)
+    kqf = kq8.astype(jnp.float32)
+    vqf = vq8.astype(jnp.float32)
+    out = decode_window_attention(q, kqf, vqf, pos, n_heads=h,
+                                  k_scale=ksc, v_scale=vsc)
+    ref = reference_window_attention(q, kqf, vqf, pos, n_heads=h,
+                                     k_scale=ksc, v_scale=vsc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # and close to the float window attention after dequantization
+    fref = reference_window_attention(q, kf, vf, pos, n_heads=h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(fref),
+                               rtol=0.05, atol=0.05)
+
+
+def test_window_reference_matches_verify_phase_formula():
+    """PORTED parity: reference_window_attention reproduces the
+    hand-rolled masked softmax the spec verify pass used before
+    ISSUE-19, bit for bit — this is what keeps the fused verify
+    token-identical to the sync engine."""
+    from deeplearning4j_tpu.ops.flash_decode import (
+        NEG_INF, reference_window_attention)
+    b, t, h, dh, s = 3, 4, 4, 16, 96
+    q, k, v = _mk_window(b, t, h, dh, s, jnp.float32, seed=8)
+    pos = jnp.asarray([0, 40, 93], jnp.int32)
+    out = reference_window_attention(q, k, v, pos, n_heads=h)
+    kh = k.reshape(b, s, h, dh)
+    vh = v.reshape(b, s, h, dh)
+    posw = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    wp = jnp.clip(posw, 0, s - 1)
+    sc = jnp.einsum("bthd,bshd->bhts", q, kh).astype(jnp.float32) \
+        * (1.0 / dh ** 0.5)
+    sc = jnp.where(jnp.arange(s)[None, None, None, :]
+                   <= wp[:, None, :, None], sc, NEG_INF)
+    pr = jax.nn.softmax(sc, axis=-1)
+    want = jnp.einsum("bhts,bshd->bthd", pr.astype(q.dtype), vh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_window_fallback_when_unavailable(monkeypatch):
+    """Short caches drop to the jnp window reference, same availability
+    contract as scalar decode."""
+    monkeypatch.delenv("DL4JTPU_FLASH", raising=False)
+    from deeplearning4j_tpu.ops.flash_decode import (
+        decode_window_attention, reference_window_attention,
+        window_attention_available)
+    q, k, v = _mk_window(2, 3, 2, 12, 64, jnp.float32, seed=3)
+    assert not window_attention_available(q, k)
+    out = decode_window_attention(q, k, v, jnp.asarray([5, 30]),
+                                  n_heads=2)
+    ref = reference_window_attention(q, k, v, jnp.asarray([5, 30]),
+                                     n_heads=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

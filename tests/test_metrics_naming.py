@@ -182,6 +182,67 @@ def test_spec_series_pass_the_lint():
                     or name in UNITLESS_HISTOGRAMS), name
 
 
+def test_spec_pipeline_series_pass_the_lint():
+    """The schedule-ahead series (ISSUE-19:
+    serving_spec_schedule_waste_tokens_total on pipelined spec
+    engines, serving_pipeline_fallbacks_total{reason} on engines that
+    actually fell back, serving_pipeline_flush_seconds{reason} on a
+    forced pipeline flush) obey the naming rules — and a spec-off
+    engine's scrape stays clean of every one of the spec series, so
+    existing dashboards see byte-identical expositions."""
+    cfg = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    from deeplearning4j_tpu.observability.export import prometheus_text
+
+    # pipelined spec engine + a KV-export flush while a co-resident's
+    # tick is still in flight (so the flush histogram gets a sample)
+    eng = InferenceEngine(
+        cfg, mesh, params,
+        EngineConfig(max_new_tokens=6, spec_decode=True, spec_k=2,
+                     draft="self", num_slots=2))
+    h = eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=1,
+                   hold_kv=True)
+    eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=6)
+    while not h.done():
+        assert eng.tick()
+    eng.export_slot_kv(h)         # forces a stamped pipeline flush
+    eng.run_pending()
+    text = prometheus_text(eng.registry)
+    types = _types(text)
+    assert types["serving_spec_schedule_waste_tokens_total"] == "counter"
+    assert types["serving_pipeline_flush_seconds"] == "histogram"
+    assert 'reason="export_slot_kv"' in text
+    assert "serving_pipeline_fallbacks" not in text   # never fell back
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+
+    # batch mode is the one remaining fallback — counted, lint-clean
+    batch = InferenceEngine(cfg, mesh, params,
+                            EngineConfig(mode="batch", max_new_tokens=4))
+    btext = prometheus_text(batch.registry)
+    btypes = _types(btext)
+    assert btypes["serving_pipeline_fallbacks_total"] == "counter"
+    assert 'reason="batch"' in btext
+    for name, kind in btypes.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+
+    # spec-off pipelined engine: no spec series leak into the scrape
+    off = InferenceEngine(cfg, mesh, params,
+                          EngineConfig(max_new_tokens=4))
+    off.submit(np.arange(8, dtype=np.int32))
+    off.run_pending()
+    offtext = prometheus_text(off.registry)
+    assert "serving_spec" not in offtext
+    assert "serving_pipeline_fallbacks" not in offtext
+
+
 def test_fleet_series_pass_the_lint():
     """The fleet-router series (ISSUE-9: serving_fleet_replicas{state}
     / serving_fleet_queue_depth gauges, serving_fleet_{failovers,

@@ -20,10 +20,13 @@ The overlap guarantees, each proven deterministically on CPU:
   restores the last committed state and isolates token-exactly,
   deadline/cancel shed at the commit boundary, and a hot reload
   discards in-flight uncommitted tokens exactly as documented;
-- spec_decode and batch mode auto-fall-back to the synchronous loop
-  (commit counts must be deterministic to schedule ahead) — with
-  pipeline the DEFAULT since ISSUE-14, bit-identically and with a
-  warning instead of a constructor rejection.
+- spec_decode PIPELINES (ISSUE-19): the scheduler reserves a
+  worst-case K+1 window per slot and reconciles acceptance at the
+  commit boundary, bit-identically to the sync spec engine (the
+  deeper sweeps live in test_serving_spec_pipeline.py); batch mode
+  still auto-falls-back to the synchronous loop — with pipeline the
+  DEFAULT since ISSUE-14, bit-identically, warned, and now counted
+  in serving_pipeline_fallbacks_total{reason} + debugz.
 """
 import numpy as np
 import jax
@@ -337,10 +340,12 @@ def test_worker_thread_drives_pipelined_engine(params, mesh1):
 
 def test_pipeline_default_on_with_auto_fallback(params, mesh1,
                                                 caplog):
-    """ISSUE-14 satellite: pipeline defaults ON now that it has
-    soaked, and the spec_decode / batch-mode incompatibilities
-    AUTO-FALL-BACK to the synchronous loop with a warning instead of
-    rejecting the constructor."""
+    """ISSUE-14 satellite, reshaped by ISSUE-19: pipeline defaults ON,
+    spec_decode now PIPELINES (no fallback, no warning, no fallback
+    series in the scrape), and the one genuinely-incompatible mode
+    (batch) still auto-falls-back — warned AND typed/counted:
+    serving_pipeline_fallbacks_total{reason="batch"} plus the reason
+    in debugz()'s tick_pipeline section."""
     assert EngineConfig().pipeline is True
     eng = InferenceEngine(CFG, mesh1, params, _config())
     assert eng.health()["pipeline"] is True
@@ -351,22 +356,29 @@ def test_pipeline_default_on_with_auto_fallback(params, mesh1,
         spec = InferenceEngine(CFG, mesh1, params,
                                _config(pipeline=True, spec_decode=True,
                                        spec_k=2, draft="self"))
-    assert batch._pipe is False and spec._pipe is False
-    assert spec.health()["pipeline"] is False
-    text = caplog.text
-    assert "falling back to the synchronous loop" in text
-    assert "spec_decode" in text
+    assert batch._pipe is False
+    assert "falling back to the synchronous loop" in caplog.text
+    c = batch.registry.get("serving_pipeline_fallbacks")
+    assert c.labels("batch").value == 1
+    assert batch.debugz()["tick_pipeline"]["fallback_reason"] == "batch"
+    # spec engines pipeline: no fallback, and the fallback counter is
+    # never registered (spec scrapes stay byte-identical to ISSUE-14)
+    assert spec._pipe is True and spec.health()["pipeline"] is True
+    assert spec.registry.get("serving_pipeline_fallbacks") is None
+    assert spec.debugz()["tick_pipeline"]["fallback_reason"] is None
 
 
-def test_spec_fallback_bit_identical_to_sync(params, mesh1):
-    """ISSUE-14 satellite regression: a spec_decode engine built with
-    the (now-default) pipeline=True falls back to the synchronous loop
-    BIT-identically to one built with pipeline=False."""
+def test_spec_pipelined_bit_identical_to_sync(params, mesh1):
+    """ISSUE-19 tentpole, smoke form: a spec_decode engine with the
+    default pipeline=True SCHEDULES AHEAD (no sync fallback) and
+    stays BIT-identical to the synchronous spec engine. The full
+    3-seed × dtype × layout sweep lives in
+    test_serving_spec_pipeline.py."""
     outs = {}
     for pipeline in (False, True):
         eng, hs = _run(mesh1, params, PROMPTS(), pipeline=pipeline,
                        spec_decode=True, spec_k=2, draft="self")
-        assert eng._pipe is False
+        assert eng._pipe is pipeline
         outs[pipeline] = [h.result(0) for h in hs]
     for a, b in zip(outs[False], outs[True]):
         np.testing.assert_array_equal(a, b)

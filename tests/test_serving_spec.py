@@ -261,6 +261,10 @@ def test_draft_poison_never_corrupts_committed_kv(params, mesh1):
     h = eng.submit(_prompt())
     eng.tick()            # prefill (step 0) + the poisoned round (1)
     assert inj.drafts_poisoned == 1
+    # the engine pipelines speculative rounds (ISSUE-19): the poisoned
+    # round was DISPATCHED above; its forensics land at the commit
+    # boundary one tick later
+    eng.tick()
     ev = [e for e in h.trace.events if e.kind == "draft_rejected"]
     assert len(ev) == 1
     assert ev[0].data["poisoned"] is True and ev[0].data["drafted"] == 4

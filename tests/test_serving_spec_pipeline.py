@@ -1,0 +1,267 @@
+"""Schedule-ahead speculative decoding (ISSUE-19 tentpole).
+
+Speculative decoding now COMPOSES with the double-buffered tick loop
+instead of falling back: the pipelined scheduler dispatches tick N+1
+against a worst-case K+1-token reservation per speculating slot
+(rem/budget masks treat the reservation as spent; paged COW privatizes
+the full window) and the commit boundary reconciles actual acceptance
+— refunding the unaccepted remainder and pricing it in
+serving_spec_schedule_waste_tokens_total. Proven deterministically on
+CPU:
+
+- EXACTNESS under schedule-ahead: the pipelined speculative engine is
+  TOKEN-IDENTICAL to the synchronous speculative engine (itself proven
+  identical to plain decode in test_serving_spec.py) across a 3-seed
+  sampled sweep — float AND int8 KV, contiguous AND paged, imperfect
+  early-exit drafters, prefix-hit admissions mid-stream;
+- host-sync discipline survives speculation: at most ONE blocking
+  device->host sync per tick, same as non-speculative pipelining;
+- the adaptive-K controller still walks a CLOSED program set (no
+  steady-state recompiles) even though K changes land one tick late
+  (they are decided at commit, applied at the next dispatch);
+- schedule waste is observable and honest: a full-acceptance
+  budget-aligned run wastes ZERO reserved tokens; an imperfect drafter
+  wastes > 0; the series never exists on sync-spec or spec-off
+  engines;
+- forensics: a poisoned draft round in flight when a SYNC-time device
+  failure lands never corrupts the committed prefix — recovery
+  restores the last committed snapshot, every surviving token is a
+  prefix of the clean stream, and isolation completes the requests
+  token-exactly.
+"""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.parallel.failure import ServingFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (EngineConfig, InferenceEngine,
+                                        RequestStatus)
+from deeplearning4j_tpu.serving.engine import (_compiled_paged_spec_decode,
+                                               _compiled_spec_decode)
+from helpers import assert_no_recompiles
+
+CFG = TransformerConfig(vocab_size=32, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=8, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % CFG.vocab_size
+
+
+def _config(pipeline, **kw):
+    base = dict(max_new_tokens=11, backoff_base_s=0.0,
+                spec_decode=True, spec_k=4, draft="self",
+                pipeline=pipeline)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _run(params, mesh, pipeline, prompts, max_new=11, **kw):
+    eng = InferenceEngine(CFG, mesh, params, _config(pipeline, **kw))
+    assert eng._pipe is pipeline           # spec no longer falls back
+    hs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run_pending()
+    return eng, [h.result(0) for h in hs]
+
+
+# ---------------------------------------------------------------------------
+# exactness: pipelined spec == sync spec, everywhere
+# ---------------------------------------------------------------------------
+
+# contiguous/paged x float/int8-KV; spec_k=2 keeps the adaptive-K
+# program ladder short (K in {2, 1}) so the sweep stays cheap
+MATRIX = [
+    dict(),
+    dict(kv_quantize="int8"),
+    dict(paged=True, page_size=8),
+    dict(paged=True, page_size=8, kv_quantize="int8"),
+]
+
+
+@pytest.mark.parametrize("kw", MATRIX,
+                         ids=["contig-f32", "contig-int8",
+                              "paged-f32", "paged-int8"])
+def test_sampled_sweep_pipelined_equals_sync(params, mesh1, kw):
+    """The tentpole exactness claim: an early-exit drafter (genuine
+    mid-window rejections) under temperature/top-k sampling produces
+    byte-identical streams whether speculation runs synchronously or
+    one tick ahead — across 3 seeds, because the reservation only
+    moves ROUND boundaries (rem masks are conservative) while token
+    values stay position-keyed."""
+    for seed in (0, 1, 2):
+        prompts = [_prompt(8, seed), _prompt(6, seed + 3)]
+        sample = dict(draft="layers:1", spec_k=2, temperature=0.9,
+                      top_k=5, seed=seed, **kw)
+        _, want = _run(params, mesh1, False, prompts, **sample)
+        _, got = _run(params, mesh1, True, prompts, **sample)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+
+def test_prefix_hit_admission_mid_stream_stays_exact(params, mesh1):
+    """A second request admitted MID-PIPELINE onto a cached prefix
+    chain (COW-shared pages) decodes bit-identically to the sync spec
+    engine — the K+1 reservation privatizes the full worst-case
+    window, so the sharer's pages are never perturbed even when the
+    in-flight round is later truncated by rejection."""
+    sysp = (np.arange(16, dtype=np.int32) * 5) % CFG.vocab_size
+    pa = np.concatenate([sysp, np.array([1, 2], np.int32)])
+    pb = np.concatenate([sysp, np.array([3, 4], np.int32)])
+
+    def staggered(pipeline):
+        eng = InferenceEngine(
+            CFG, mesh1, params,
+            _config(pipeline, draft="layers:1", spec_k=3,
+                    max_new_tokens=8, paged=True, page_size=8,
+                    max_batch_size=2))
+        ha = eng.submit(pa, max_new_tokens=8)
+        eng.tick()                       # A is decoding when B lands
+        hb = eng.submit(pb, max_new_tokens=8)
+        eng.run_pending()
+        hits = eng.registry.get("serving_prefix_cache_hits")
+        return ha.result(0), hb.result(0), int(hits._unlabeled().value)
+
+    wa, wb, _ = staggered(False)
+    ga, gb, hits = staggered(True)
+    assert hits >= 1                     # B actually shared the prefix
+    np.testing.assert_array_equal(ga, wa)
+    np.testing.assert_array_equal(gb, wb)
+
+
+# ---------------------------------------------------------------------------
+# host-sync discipline + compile discipline
+# ---------------------------------------------------------------------------
+
+def test_at_most_one_blocking_sync_per_tick_with_spec(params, mesh1):
+    """The ISSUE-12 sync discipline survives speculation: every tick
+    of the pipelined speculative engine blocks on the device at most
+    once (the previous tick's commit) — the draft+verify round rides
+    the same async dispatch as plain decode."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(True, draft="layers:1"))
+    for s in range(4):
+        eng.submit(_prompt(8, s))
+    deltas = []
+    while True:
+        s0 = eng._syncs_total
+        if not eng.tick():
+            break
+        deltas.append(eng._syncs_total - s0)
+    assert deltas and max(deltas) <= 1, \
+        f"pipelined spec engine synced {max(deltas)}x in one tick"
+
+
+def test_steady_state_walks_a_closed_program_set(params, mesh1):
+    """After a first wave warms the adaptive-K ladder, a second wave
+    of pipelined speculative traffic compiles NOTHING new — commit-lag
+    K updates reuse the same programs the sync engine compiled."""
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(True, draft="layers:1"))
+    hs = [eng.submit(_prompt(8, s)) for s in range(3)]
+    eng.run_pending()
+    assert all(h.status == RequestStatus.COMPLETED for h in hs)
+    with assert_no_recompiles(_compiled_spec_decode):
+        hs = [eng.submit(_prompt(8, 10 + s)) for s in range(3)]
+        eng.run_pending()
+    assert all(h.status == RequestStatus.COMPLETED for h in hs)
+
+
+# ---------------------------------------------------------------------------
+# schedule waste accounting
+# ---------------------------------------------------------------------------
+
+def test_schedule_waste_zero_on_full_acceptance(params, mesh1):
+    """draft='self' + greedy accepts every proposal and
+    max_new_tokens=11 aligns the budget to whole K+1 windows, so the
+    worst-case reservation is ALWAYS exactly consumed: the waste
+    counter exists (pipelined spec engine) but stays at zero."""
+    eng, _ = _run(params, mesh1, True, [_prompt()])
+    fam = eng.registry.get("serving_spec_schedule_waste_tokens")
+    assert fam is not None
+    assert fam._unlabeled().value == 0
+
+
+def test_schedule_waste_prices_rejected_windows(params, mesh1):
+    """An imperfect drafter rejects mid-window, so commits reconcile
+    below the K+1 reservation — the refunded tokens are priced in
+    serving_spec_schedule_waste_tokens_total. Sync-spec and spec-off
+    engines never register the series (their scrapes are
+    byte-unchanged)."""
+    eng, _ = _run(params, mesh1, True, [_prompt(8, s) for s in range(3)],
+                  draft="layers:1", temperature=0.9, top_k=5, seed=0)
+    fam = eng.registry.get("serving_spec_schedule_waste_tokens")
+    assert fam is not None and fam._unlabeled().value > 0
+
+    sync_eng, _ = _run(params, mesh1, False, [_prompt()])
+    assert sync_eng.registry.get(
+        "serving_spec_schedule_waste_tokens") is None
+
+    plain = InferenceEngine(CFG, mesh1, params,
+                            EngineConfig(max_new_tokens=8,
+                                         backoff_base_s=0.0))
+    assert plain._pipe is True
+    assert plain.registry.get(
+        "serving_spec_schedule_waste_tokens") is None
+
+
+# ---------------------------------------------------------------------------
+# forensics: poisoned draft in flight + sync-time failure
+# ---------------------------------------------------------------------------
+
+def test_poison_mid_pipeline_committed_prefix_stays_clean(params,
+                                                          mesh1):
+    """The compound failure the schedule-ahead design must survive: a
+    POISONED draft round is dispatched (in flight, uncommitted) when
+    the previous round's SYNC fails. _recover_failed_tick restores the
+    last committed snapshot and drops the poisoned dispatch — so every
+    request's committed prefix is provably a prefix of the clean
+    stream, and isolation finishes the runs token-exactly."""
+    prompts = [_prompt(6, s) for s in range(3)]
+    _, want = _run(params, mesh1, False, prompts)
+
+    # poison rid 1's draft pass at step 2 (the second spec round): at
+    # that moment the pipeline holds a committed prefill prefix, round
+    # 1 in flight, and the poisoned round being dispatched — the sync
+    # failure then lands on round 1's commit, inside the same tick
+    inj = ServingFaultInjector(draft_poison_at={2: 1})
+    eng = InferenceEngine(CFG, mesh1, params, _config(True),
+                          fault_injector=inj)
+    orig = eng._block_on_many
+    fired = []
+
+    def flaky(xs):
+        if not fired and inj.drafts_poisoned:
+            fired.append(True)
+            raise RuntimeError("injected sync failure under poison")
+        return orig(xs)
+
+    eng._block_on_many = flaky
+    hs = [eng.submit(p, max_new_tokens=11) for p in prompts]
+    while not fired and eng.tick():
+        pass
+    assert fired, "the poisoned-tick sync failure never fired"
+
+    # forensics: whatever survived recovery is a clean prefix
+    for h, w in zip(hs, want):
+        g = h.generated
+        np.testing.assert_array_equal(
+            g, w[len(h.prompt):len(h.prompt) + g.shape[0]])
+    assert not eng._pending              # in-flight dispatch dropped
+
+    eng.run_pending()                    # isolation completes them
+    for h, w in zip(hs, want):
+        np.testing.assert_array_equal(h.result(0), w)
+    assert eng.stats["preempted"] > 0
