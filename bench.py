@@ -181,7 +181,7 @@ def flagship_lines(which: str) -> None:
                   "kv_paged", "spec_decode", "fleet_failover",
                   "chunked_prefill", "disagg", "fleet_obs",
                   "cold_start", "profiling_overhead", "qos_storm",
-                  "elastic_train"]
+                  "elastic_train", "constrained_decode"]
     for n in names:
         elapsed = time.monotonic() - _T0
         reps = 1 if elapsed > 0.6 * budget else 2
@@ -202,7 +202,8 @@ def flagship_lines(which: str) -> None:
 #: `--check` / `--update-gate` run without a captured-lines file)
 GATE_BENCHES = {"transformer_lm_12L512d_T2048": "transformer",
                 "elastic_train": "elastic_train",
-                "spec_pipeline_4L192d_Ns8_K7": "spec_pipeline"}
+                "spec_pipeline_4L192d_Ns8_K7": "spec_pipeline",
+                "constrained_decode_4L192d_Ns8": "constrained_decode"}
 
 GATE_TOLERANCE = 0.2
 

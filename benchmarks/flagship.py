@@ -1420,6 +1420,177 @@ def bench_spec_pipeline(reps: int = 2, *, n_requests: int = 16,
     return out
 
 
+def bench_constrained_decode(reps: int = 2, *, n_requests: int = 24,
+                             num_slots: int = 8, new_tokens: int = 33,
+                             mean_interarrival_s: float = 0.002,
+                             seed: int = 0) -> dict:
+    """Grammar-constrained decoding on the continuous engine (ISSUE-20
+    acceptance): constrained vs unconstrained arms on the standard
+    mixed-length Poisson trace. The allow-masks and DFA transition
+    rows are pure runtime data, so the constrained arm runs the SAME
+    compiled-program set shape-for-shape — the bench measures what the
+    per-step mask gather + the host-side DFA walk actually cost.
+
+    Arms (identical EngineConfig, identical trace):
+    - ``unconstrained``: the baseline tokens/sec.
+    - ``constrained_regex``: every request constrained by ``[ab]+`` —
+      accepting-but-never-terminal, so every request decodes its full
+      token budget and the tokens/sec comparison is per-step
+      apples-to-apples (no early-termination amortization skew).
+    - ``constrained_schema``: every request constrained by a JSON
+      schema (enum + integer + boolean object); requests truncate at
+      the grammar terminal, i.e. when the object closes.
+
+    Asserted IN-BENCH (raises on violation):
+    - throughput floor: constrained_regex tokens/sec >= 0.9x
+      unconstrained (the ISSUE-20 <=10% overhead bar);
+    - 100% schema-valid: every constrained_schema output round-trips
+      ``json.loads`` and its keys are a subset of the declared
+      properties (the byte-level token map makes outputs UTF-8 text);
+    - 100% grammar-legal: every constrained_regex token is an ``a`` or
+      a ``b`` byte;
+    - zero steady-state recompiles: warm constrained replays add no
+      masked DECODE-program cache entries (masks walk a closed
+      compiled set; prefill buckets are excluded because which bucket
+      a co-admitted batch rounds to is arrival-timing-dependent).
+
+    CPU-container honest: legality, schema validity, and the closed
+    program set are backend-invariant; the overhead pct re-lands with
+    the next driver chip capture (on accelerators the [C, V] mask
+    gather rides the logits' last-mile elementwise work, so the pct
+    should shrink)."""
+    import json as _json
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       init_params)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.serving.engine import (EngineConfig,
+                                                   InferenceEngine,
+                                                   _compiled_decode_chunk_c)
+
+    cfg = TransformerConfig(vocab_size=256, d_model=192, n_heads=8,
+                            n_layers=4, max_len=256)
+    mesh = make_mesh(MeshSpec())
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+
+    schema = {"type": "object",
+              "properties": {"status": {"enum": ["ok", "retry", "dead"]},
+                             "attempts": {"type": "integer"},
+                             "fatal": {"type": "boolean"}}}
+    # worst-case compact emission of the schema object is ~51 bytes;
+    # 64 guarantees every schema request reaches its grammar terminal
+    schema_tokens = 64
+
+    def make_trace(trace_seed):
+        r = np.random.default_rng(trace_seed)
+        events, t = [], 0.0
+        for _ in range(n_requests):
+            t += float(r.exponential(mean_interarrival_s))
+            plen = int(r.integers(8, 49))
+            events.append((t, r.integers(
+                0, cfg.vocab_size, plen).astype(np.int32)))
+        return events
+
+    def replay(eng, events, constrain=None, max_new=new_tokens):
+        recs, pending, i = [], [], 0
+        t0 = _t.perf_counter()
+        while i < len(events) or pending:
+            now = _t.perf_counter() - t0
+            while i < len(events) and events[i][0] <= now:
+                pending.append(eng.submit(events[i][1],
+                                          max_new_tokens=max_new,
+                                          constrain=constrain))
+                i += 1
+            worked = eng.tick()
+            pending, done = [h for h in pending if not h.done()], \
+                [h for h in pending if h.done()]
+            recs.extend(done)
+            if not worked and i < len(events):
+                _t.sleep(max(0.0, min(
+                    0.002, events[i][0] - (_t.perf_counter() - t0))))
+        elapsed = _t.perf_counter() - t0
+        toks = sum(h.generated.shape[0] for h in recs)
+        return round(toks / elapsed, 1), recs
+
+    def arm_cfg() -> EngineConfig:
+        return EngineConfig(max_batch_size=num_slots,
+                            max_queue=4 * n_requests,
+                            max_new_tokens=schema_tokens,
+                            degrade_queue_depth=10 ** 6,
+                            decode_chunk=8)
+
+    events = make_trace(seed + 1)
+    out: dict = {"config": f"constrained_decode_{cfg.n_layers}L"
+                           f"{cfg.d_model}d_Ns{num_slots}"}
+    arms = (("unconstrained", None, new_tokens),
+            ("constrained_regex", "[ab]+", new_tokens),
+            ("constrained_schema",
+             {"type": "json_schema", "schema": schema}, schema_tokens))
+    for _, constrain, max_new in arms:       # cold: compile everything
+        replay(InferenceEngine(cfg, mesh, params, arm_cfg()),
+               events, constrain, max_new)
+    n0 = _compiled_decode_chunk_c.cache_info().currsize
+    # warm reps, floored at best-of-3 and INTERLEAVED round-robin: the
+    # <=10% overhead assert compares two measured arms, and a shared
+    # CPU container's noise bursts (~15%) last longer than one ~1s
+    # replay — arm-blocked reps would let one burst poison an entire
+    # arm's best-of, interleaving decorrelates it
+    best: dict = {a: (0.0, None) for a, _, _ in arms}
+    for rep in range(max(3, reps)):
+        # rotate the start arm too — whichever replay runs first in a
+        # round pays a systematic allocator/GC warmup penalty
+        for k in range(len(arms)):
+            arm_name, constrain, max_new = arms[(rep + k) % len(arms)]
+            eng = InferenceEngine(cfg, mesh, params, arm_cfg())
+            tps, recs = replay(eng, events, constrain, max_new)
+            if tps > best[arm_name][0]:
+                best[arm_name] = (tps, recs)
+    # masks are runtime data: warm replays recompile nothing on the
+    # steady-state decode path (prefill bucket choice is
+    # arrival-timing-dependent, see docstring)
+    assert (_compiled_decode_chunk_c.cache_info().currsize
+            == n0), "constrained replay recompiled decode"
+    for arm_name, _, _ in arms:
+        out[arm_name] = {"tokens_per_sec": best[arm_name][0]}
+
+    # 100% grammar-legal: the regex arm emits only a/b bytes, and
+    # never-terminal means every request decoded its full budget
+    for h in best["constrained_regex"][1]:
+        gen = h.generated
+        if gen.shape[0] != new_tokens or not all(
+                int(t) in (ord("a"), ord("b")) for t in gen):
+            raise AssertionError("regex-constrained tokens illegal")
+
+    # 100% schema-valid: every schema output parses and keys subset
+    n_valid = 0
+    for h in best["constrained_schema"][1]:
+        text = bytes(int(t) for t in h.generated).decode()
+        doc = _json.loads(text)        # raises if not valid JSON
+        if not set(doc) <= set(schema["properties"]):
+            raise AssertionError(f"schema keys escaped: {text!r}")
+        n_valid += 1
+    out["schema_valid_pct"] = round(100.0 * n_valid
+                                    / max(1, n_requests), 1)
+    if n_valid != n_requests:
+        raise AssertionError("schema-valid outputs below 100%")
+
+    plain_tps = best["unconstrained"][0]
+    rx_tps = best["constrained_regex"][0]
+    out["constrained_overhead_pct"] = round(
+        100.0 * (1 - rx_tps / plain_tps), 1)
+    if rx_tps < 0.9 * plain_tps:
+        raise AssertionError(
+            f"constrained overhead {out['constrained_overhead_pct']}% "
+            "exceeds the 10% ISSUE-20 bar")
+    out["tokens_per_sec_constrained"] = rx_tps
+    out["value"] = rx_tps
+    out["unit"] = "tokens_per_sec_constrained_regex"
+    return out
+
+
 def bench_fleet_failover(reps: int = 2, *, n_requests: int = 30,
                          mean_interarrival_s: float = 0.002,
                          seed: int = 0) -> dict:
@@ -3161,6 +3332,7 @@ BENCHES = {"transformer": bench_transformer,
            "kv_paged": bench_kv_paged,
            "spec_decode": bench_spec_decode,
            "spec_pipeline": bench_spec_pipeline,
+           "constrained_decode": bench_constrained_decode,
            "fleet_failover": bench_fleet_failover,
            "chunked_prefill": bench_chunked_prefill,
            "disagg": bench_disagg,

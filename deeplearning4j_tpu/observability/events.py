@@ -105,6 +105,12 @@ EVENT_KINDS = frozenset({
     #                  coordinator's audit trail (resize adds
     #                  {workers, reason}; loose_enter/resync add
     #                  {pending}; replay adds {from_step, to_step})
+    "constraint",    # grammar-constrained decoding (ISSUE-20): the
+    #                  request's DFA reached a terminal accepting
+    #                  state {terminal: True, state} — the EOS-forcing
+    #                  audit mark; only constrained requests ever
+    #                  record it, so constrain-off traces are
+    #                  byte-unchanged
     "retry",         # a compiled call containing it failed and is
     #                  being retried {step, attempt, prefill}
     "quarantined",   # terminal: failed persistently after solo retries

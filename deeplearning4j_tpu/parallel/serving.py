@@ -427,6 +427,36 @@ def _sample_slots(logits, posidx, key, dp: int, temperature: float,
                                top_k, top_p)
 
 
+# constrained-decoding runtime operands (ISSUE-20): every masked
+# program variant takes five extra operands AFTER its regular runtime
+# vectors — callow [C, V] bool + ctrans [C, V] int32 (the engine's
+# ConstraintTable, replicated), cstate [Ns] int32 (each slot's global
+# DFA state, chained call-to-call), cseed [Ns] bool + cseedval [Ns]
+# int32 (host seat-time reseeds) — and returns the advanced cstate as
+# one extra LAST output. Mask contents, transitions, and states are
+# pure runtime data: the [C, V] table shape is fixed per engine, so
+# the compiled-program set stays closed (zero steady-state recompiles).
+_CTAB_SPEC = P(None, None)
+
+
+def _c_start(cstate, cseed, cseedval):
+    """Seed-or-carry: slots the host just (re)seated read their seeded
+    DFA state (0 = the unconstrained all-allow row); everyone else
+    carries the device-chained state."""
+    return jnp.where(cseed, cseedval, cstate)
+
+
+def _mask_allow(logits, allow):
+    """Additive grammar fence before sampling: disallowed vocab
+    entries drop to NEG_INF, allowed entries add 0.0 — an all-allow
+    row (unconstrained slots / terminal states) is numerically inert,
+    so co-resident unconstrained slots sample the same tokens a
+    maskless program would."""
+    from deeplearning4j_tpu.ops.flash_decode import NEG_INF
+    return logits + jnp.where(allow, jnp.asarray(0.0, logits.dtype),
+                              jnp.asarray(NEG_INF, logits.dtype))
+
+
 def _local_block_decode_slotted(h, p, ck_all, cv_all, layer: int, pos,
                                 act, cfg: TransformerConfig, tp: int,
                                 dp: int):
@@ -565,10 +595,16 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
                             bucket_len: int, num_slots: int,
                             temperature: float = 0.0,
                             top_k: int = 0, top_p: float = 1.0,
-                            quantized=None, kv_mode=None):
+                            quantized=None, kv_mode=None,
+                            constrain: bool = False):
     """Compiled slot-pool prefill: (params, ck, cv, pos, tok,
     prompts [Ns, Tb], plen [Ns], key) -> (ck, cv, pos, tok,
     first [Ns]).
+
+    ``constrain=True`` (ISSUE-20) inserts the five constraint operands
+    before ``key`` and appends the advanced DFA-state vector as the
+    last output: the admitted slot's first token samples under its
+    seeded state's allow row and advances the state through it.
 
     Slots with plen[i] > 0 are ADMISSIONS: their prompt (right-padded
     to the Tb bucket) is prefilled in one batched pass, their cache
@@ -596,7 +632,7 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
                          f"(0, {cfg.max_len}]")
     specs = _serving_specs(cfg, quantized)
 
-    def compute(params, prompts, plen, key):
+    def compute(params, prompts, plen, key, allow=None):
         """Shared prefill math: block scan + first-token sampling.
         Returns (admit, ks, vs, first, pos_new-ready pieces)."""
         dt = cfg.activation_dtype()
@@ -614,6 +650,8 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         last = h[jnp.arange(ns), jnp.clip(plen - 1, 0, tb - 1)]
         logits = jnp.matmul(last, params["Wout"].astype(last.dtype))
+        if allow is not None:
+            logits = _mask_allow(logits, allow)
         first = _sample_slots(logits, plen, key, dp, temperature,
                               top_k, top_p)
         return admit, tb, ks, vs, first
@@ -625,28 +663,53 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
                                    jnp.asarray(-1, jnp.int32))
 
     if kv_mode is None:
-        def run(params, ck, cv, pos, tok, prompts, plen, key):
-            admit, tb, ks, vs, first = compute(params, prompts, plen,
-                                               key)
+        def base(params, ck, cv, pos, tok, prompts, plen, key,
+                 callow=None, ctrans=None, ds0=None):
+            admit, tb, ks, vs, first = compute(
+                params, prompts, plen, key,
+                allow=None if callow is None else callow[ds0])
             keep = admit[None, :, None, None]
             ck = ck.at[:, :, :tb, :].set(
                 jnp.where(keep, ks.astype(ck.dtype), ck[:, :, :tb, :]))
             cv = cv.at[:, :, :tb, :].set(
                 jnp.where(keep, vs.astype(cv.dtype), cv[:, :, :tb, :]))
             pos, tok, first = finish(admit, first, plen, pos, tok)
-            return ck, cv, pos, tok, first
+            if callow is None:
+                return ck, cv, pos, tok, first
+            ds = jnp.where(admit,
+                           ctrans[ds0, jnp.maximum(first, 0)], ds0)
+            return ck, cv, pos, tok, first, ds
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                    _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        if constrain:
+            def run(params, ck, cv, pos, tok, prompts, plen, callow,
+                    ctrans, cstate, cseed, cseedval, key):
+                return base(params, ck, cv, pos, tok, prompts, plen,
+                            key, callow, ctrans,
+                            _c_start(cstate, cseed, cseedval))
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        else:
+            run = base
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
     else:
-        def run(params, ck, cv, ksc, vsc, pos, tok, prompts, plen,
-                key):
+        def base(params, ck, cv, ksc, vsc, pos, tok, prompts, plen,
+                 key, callow=None, ctrans=None, ds0=None):
             from deeplearning4j_tpu.quant.kv import quantize_rows
-            admit, tb, ks, vs, first = compute(params, prompts, plen,
-                                               key)
+            admit, tb, ks, vs, first = compute(
+                params, prompts, plen, key,
+                allow=None if callow is None else callow[ds0])
             kq, ksr = quantize_rows(ks, kv_mode)   # [L, Ns, Tb, D_loc]
             vq, vsr = quantize_rows(vs, kv_mode)
             keep = admit[None, :, None, None]
@@ -660,15 +723,39 @@ def make_continuous_prefill(cfg: TransformerConfig, mesh: Mesh,
             vsc = vsc.at[:, :, :tb, 0].set(
                 jnp.where(keep3, vsr, vsc[:, :, :tb, 0]))
             pos, tok, first = finish(admit, first, plen, pos, tok)
-            return ck, cv, ksc, vsc, pos, tok, first
+            if callow is None:
+                return ck, cv, ksc, vsc, pos, tok, first
+            ds = jnp.where(admit,
+                           ctrans[ds0, jnp.maximum(first, 0)], ds0)
+            return ck, cv, ksc, vsc, pos, tok, first, ds
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                    _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        if constrain:
+            def run(params, ck, cv, ksc, vsc, pos, tok, prompts, plen,
+                    callow, ctrans, cstate, cseed, cseedval, key):
+                return base(params, ck, cv, ksc, vsc, pos, tok,
+                            prompts, plen, key, callow, ctrans,
+                            _c_start(cstate, cseed, cseedval))
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        else:
+            run = base
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -679,10 +766,18 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
                            chunk: int, num_slots: int,
                            temperature: float = 0.0,
                            top_k: int = 0, top_p: float = 1.0,
-                           quantized=None, kv_mode=None):
+                           quantized=None, kv_mode=None,
+                           constrain: bool = False):
     """Compiled slot-pool decode chunk: (params, ck, cv, pos, tok,
     active [Ns] bool, rem [Ns] int32, key) -> (ck, cv, pos, tok,
     toks [Ns, chunk]).
+
+    ``constrain=True`` (ISSUE-20): five constraint operands before
+    ``key``, the chained DFA-state vector appended as the last output;
+    each scanned step gathers its slot's allow row, masks the logits
+    before sampling, and advances the state through the sampled
+    token — mask and transitions are runtime data, the program is one
+    more fixed geometry.
 
     Advances every active slot up to ``chunk`` tokens from its own
     position: each scanned step embeds the slot's pending token at its
@@ -708,16 +803,21 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     specs = _serving_specs(cfg, quantized)
 
-    def sample_and_advance(params, h, act, pos, tok, rem, key):
+    def sample_and_advance(params, h, act, pos, tok, rem, key,
+                           ds=None, callow=None, ctrans=None):
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
+        if callow is not None:
+            logits = _mask_allow(logits, callow[ds])
         nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
                             top_k, top_p)
+        if callow is not None:
+            ds = jnp.where(act, ctrans[ds, nxt], ds)
         tok = jnp.where(act, nxt, tok)
         emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
         pos = jnp.where(act, pos + 1, pos)
         rem = jnp.where(act, rem - 1, rem)
-        return pos, tok, rem, emit
+        return pos, tok, rem, emit, ds
 
     def embed_step(params, pos, tok):
         dt = cfg.activation_dtype()
@@ -727,58 +827,134 @@ def make_continuous_decode(cfg: TransformerConfig, mesh: Mesh,
         return (emb + pv)[:, None, :]
 
     if kv_mode is None:
-        def run(params, ck, cv, pos, tok, active, rem, key):
-            def step(carry, _):
-                ck, cv, pos, tok, rem = carry
-                act = active & (rem > 0)
-                h = embed_step(params, pos, tok)
-                for layer in range(cfg.n_layers):
-                    p_l = {kk: vv[layer]
-                           for kk, vv in params["blocks"].items()}
-                    h, ck, cv = _local_block_decode_slotted(
-                        h, p_l, ck, cv, layer, pos, act, cfg, tp, dp)
-                pos, tok, rem, emit = sample_and_advance(
-                    params, h, act, pos, tok, rem, key)
-                return (ck, cv, pos, tok, rem), emit
+        if constrain:
+            def run(params, ck, cv, pos, tok, active, rem, callow,
+                    ctrans, cstate, cseed, cseedval, key):
+                def step(carry, _):
+                    ck, cv, pos, tok, rem, ds = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, ck, cv = _local_block_decode_slotted(
+                            h, p_l, ck, cv, layer, pos, act, cfg, tp,
+                            dp)
+                    pos, tok, rem, emit, ds = sample_and_advance(
+                        params, h, act, pos, tok, rem, key, ds,
+                        callow, ctrans)
+                    return (ck, cv, pos, tok, rem, ds), emit
 
-            (ck, cv, pos, tok, _), toks = lax.scan(
-                step, (ck, cv, pos, tok, rem), None, length=chunk)
-            return ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1)
+                ds0 = _c_start(cstate, cseed, cseedval)
+                (ck, cv, pos, tok, _, ds), toks = lax.scan(
+                    step, (ck, cv, pos, tok, rem, ds0), None,
+                    length=chunk)
+                return (ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1),
+                        ds)
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None))
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC)
+        else:
+            def run(params, ck, cv, pos, tok, active, rem, key):
+                def step(carry, _):
+                    ck, cv, pos, tok, rem = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, ck, cv = _local_block_decode_slotted(
+                            h, p_l, ck, cv, layer, pos, act, cfg, tp,
+                            dp)
+                    pos, tok, rem, emit, _ = sample_and_advance(
+                        params, h, act, pos, tok, rem, key)
+                    return (ck, cv, pos, tok, rem), emit
+
+                (ck, cv, pos, tok, _), toks = lax.scan(
+                    step, (ck, cv, pos, tok, rem), None, length=chunk)
+                return ck, cv, pos, tok, jnp.swapaxes(toks, 0, 1)
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None))
     else:
-        def run(params, ck, cv, ksc, vsc, pos, tok, active, rem, key):
-            def step(carry, _):
-                ck, cv, ksc, vsc, pos, tok, rem = carry
-                act = active & (rem > 0)
-                h = embed_step(params, pos, tok)
-                for layer in range(cfg.n_layers):
-                    p_l = {kk: vv[layer]
-                           for kk, vv in params["blocks"].items()}
-                    h, ck, cv, ksc, vsc = _local_block_decode_slotted_q(
-                        h, p_l, ck, cv, ksc, vsc, layer, pos, act,
-                        cfg, tp, dp, kv_mode)
-                pos, tok, rem, emit = sample_and_advance(
-                    params, h, act, pos, tok, rem, key)
-                return (ck, cv, ksc, vsc, pos, tok, rem), emit
+        if constrain:
+            def run(params, ck, cv, ksc, vsc, pos, tok, active, rem,
+                    callow, ctrans, cstate, cseed, cseedval, key):
+                def step(carry, _):
+                    ck, cv, ksc, vsc, pos, tok, rem, ds = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, ck, cv, ksc, vsc = \
+                            _local_block_decode_slotted_q(
+                                h, p_l, ck, cv, ksc, vsc, layer, pos,
+                                act, cfg, tp, dp, kv_mode)
+                    pos, tok, rem, emit, ds = sample_and_advance(
+                        params, h, act, pos, tok, rem, key, ds,
+                        callow, ctrans)
+                    return (ck, cv, ksc, vsc, pos, tok, rem, ds), emit
 
-            (ck, cv, ksc, vsc, pos, tok, _), toks = lax.scan(
-                step, (ck, cv, ksc, vsc, pos, tok, rem), None,
-                length=chunk)
-            return (ck, cv, ksc, vsc, pos, tok,
-                    jnp.swapaxes(toks, 0, 1))
+                ds0 = _c_start(cstate, cseed, cseedval)
+                (ck, cv, ksc, vsc, pos, tok, _, ds), toks = lax.scan(
+                    step, (ck, cv, ksc, vsc, pos, tok, rem, ds0),
+                    None, length=chunk)
+                return (ck, cv, ksc, vsc, pos, tok,
+                        jnp.swapaxes(toks, 0, 1), ds)
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None))
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC)
+        else:
+            def run(params, ck, cv, ksc, vsc, pos, tok, active, rem,
+                    key):
+                def step(carry, _):
+                    ck, cv, ksc, vsc, pos, tok, rem = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, ck, cv, ksc, vsc = \
+                            _local_block_decode_slotted_q(
+                                h, p_l, ck, cv, ksc, vsc, layer, pos,
+                                act, cfg, tp, dp, kv_mode)
+                    pos, tok, rem, emit, _ = sample_and_advance(
+                        params, h, act, pos, tok, rem, key)
+                    return (ck, cv, ksc, vsc, pos, tok, rem), emit
+
+                (ck, cv, ksc, vsc, pos, tok, _), toks = lax.scan(
+                    step, (ck, cv, ksc, vsc, pos, tok, rem), None,
+                    length=chunk)
+                return (ck, cv, ksc, vsc, pos, tok,
+                        jnp.swapaxes(toks, 0, 1))
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None))
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -789,7 +965,7 @@ def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
                          chunk_len: int, num_slots: int,
                          temperature: float = 0.0, top_k: int = 0,
                          top_p: float = 1.0, quantized=None,
-                         kv_mode=None):
+                         kv_mode=None, constrain: bool = False):
     """Compiled CHUNKED admission prefill over the contiguous slot
     pool: (params, ck, cv, pos, tok, toks [Ns, C], clen [Ns],
     start [Ns], last [Ns] bool, key) -> (ck, cv, pos, tok,
@@ -819,7 +995,12 @@ def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
     vscale, pos, tok, toks, clen, start, last, key) -> (..., first))
     and chunk rows quantize on write while the chunk still attends
     itself in float (the cached prefix re-reads through its
-    quantization — the int8 decode envelope)."""
+    quantization — the int8 decode envelope).
+
+    ``constrain=True`` (ISSUE-20): five constraint operands before
+    ``key``, the DFA-state vector appended last; only a FINAL chunk
+    (last[i]) samples, so only final chunks mask and advance —
+    mid-prompt chunks carry the seeded state unchanged."""
     from deeplearning4j_tpu.ops.flash_decode import NEG_INF
     tp, dp = _check_serving_mesh(cfg, mesh, top_k, top_p)
     quantized, kv_mode = _resolve_quant(quantized, kv_mode)
@@ -834,7 +1015,8 @@ def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
     d_loc = h_loc * cfg.d_head
     scale = cfg.d_head ** -0.5
 
-    def body(params, ck, cv, ksc, vsc, toks, clen, start, key):
+    def body(params, ck, cv, ksc, vsc, toks, clen, start, key,
+             allow=None):
         dt = cfg.activation_dtype()
         acc = jnp.promote_types(dt, jnp.float32)
         ns, c = toks.shape
@@ -941,6 +1123,8 @@ def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
         logits = jnp.matmul(lastrow, params["Wout"].astype(
             lastrow.dtype))
         plen = start + clen
+        if allow is not None:
+            logits = _mask_allow(logits, allow)
         first = _sample_slots(logits, plen, key, dp, temperature,
                               top_k, top_p)
         return adv, plen, first, ck, cv, ksc, vsc
@@ -952,36 +1136,95 @@ def make_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
         return pos, tok, jnp.where(take, first,
                                    jnp.asarray(-1, jnp.int32))
 
+    def c_advance(take, ds0, ctrans, first):
+        """Final-chunk DFA advance: only slots that SAMPLED (take)
+        step their state through the first generated token;
+        mid-prompt chunks carry the seeded state forward."""
+        return jnp.where(take, ctrans[ds0, jnp.maximum(first, 0)],
+                         ds0)
+
     if kv_mode is None:
-        def run(params, ck, cv, pos, tok, toks, clen, start, last,
-                key):
-            adv, plen, first, ck, cv, _, _ = body(
-                params, ck, cv, None, None, toks, clen, start, key)
-            pos, tok, first = finish(adv, last, plen, first, pos, tok)
-            return ck, cv, pos, tok, first
+        if constrain:
+            def run(params, ck, cv, pos, tok, toks, clen, start, last,
+                    callow, ctrans, cstate, cseed, cseedval, key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                adv, plen, first, ck, cv, _, _ = body(
+                    params, ck, cv, None, None, toks, clen, start,
+                    key, allow=callow[ds0])
+                pos, tok, first = finish(adv, last, plen, first, pos,
+                                         tok)
+                ds = c_advance(adv & last, ds0, ctrans, first)
+                return ck, cv, pos, tok, first, ds
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        else:
+            def run(params, ck, cv, pos, tok, toks, clen, start, last,
+                    key):
+                adv, plen, first, ck, cv, _, _ = body(
+                    params, ck, cv, None, None, toks, clen, start,
+                    key)
+                pos, tok, first = finish(adv, last, plen, first, pos,
+                                         tok)
+                return ck, cv, pos, tok, first
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
     else:
-        def run(params, ck, cv, ksc, vsc, pos, tok, toks, clen, start,
-                last, key):
-            adv, plen, first, ck, cv, ksc, vsc = body(
-                params, ck, cv, ksc, vsc, toks, clen, start, key)
-            pos, tok, first = finish(adv, last, plen, first, pos, tok)
-            return ck, cv, ksc, vsc, pos, tok, first
+        if constrain:
+            def run(params, ck, cv, ksc, vsc, pos, tok, toks, clen,
+                    start, last, callow, ctrans, cstate, cseed,
+                    cseedval, key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                adv, plen, first, ck, cv, ksc, vsc = body(
+                    params, ck, cv, ksc, vsc, toks, clen, start, key,
+                    allow=callow[ds0])
+                pos, tok, first = finish(adv, last, plen, first, pos,
+                                         tok)
+                ds = c_advance(adv & last, ds0, ctrans, first)
+                return ck, cv, ksc, vsc, pos, tok, first, ds
 
-        in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+        else:
+            def run(params, ck, cv, ksc, vsc, pos, tok, toks, clen,
+                    start, last, key):
+                adv, plen, first, ck, cv, ksc, vsc = body(
+                    params, ck, cv, ksc, vsc, toks, clen, start, key)
+                pos, tok, first = finish(adv, last, plen, first, pos,
+                                         tok)
+                return ck, cv, ksc, vsc, pos, tok, first
+
+            in_specs = (specs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        P("data", None), _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -1189,7 +1432,8 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
                        max_pages: int, num_pages: int,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, quantized=None,
-                       kv_mode=None, chunked: bool = False):
+                       kv_mode=None, chunked: bool = False,
+                       constrain: bool = False):
     """Compiled PAGED admission prefill: (params, kp, vp, pos, tok,
     bt [Ns, max_pages], suffix [Ns, Tb], slen [Ns], start [Ns], key)
     -> (kp, vp, pos, tok, first [Ns]).
@@ -1237,7 +1481,8 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
     s_view = max_pages * page_size
     scale = cfg.d_head ** -0.5
 
-    def body(params, kp, vp, ksc, vsc, bt, suffix, slen, start, key):
+    def body(params, kp, vp, ksc, vsc, bt, suffix, slen, start, key,
+             allow=None):
         dt = cfg.activation_dtype()
         acc = jnp.promote_types(dt, jnp.float32)
         ns, tb = suffix.shape
@@ -1328,6 +1573,8 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         last = h[jnp.arange(ns), jnp.clip(slen - 1, 0, tb - 1)]
         logits = jnp.matmul(last, params["Wout"].astype(last.dtype))
+        if allow is not None:
+            logits = _mask_allow(logits, allow)
         plen = start + slen
         first = _sample_slots(logits, plen, key, dp, temperature,
                               top_k, top_p)
@@ -1342,69 +1589,161 @@ def make_paged_prefill(cfg: TransformerConfig, mesh: Mesh,
         return pos, tok, jnp.where(take, first,
                                    jnp.asarray(-1, jnp.int32))
 
+    def c_advance(take, ds0, ctrans, first):
+        # advance the DFA only where a first token was committed; the
+        # sample was already masked by callow[ds0], so first is legal
+        return jnp.where(take, ctrans[ds0, jnp.maximum(first, 0)], ds0)
+
+    _CEXT = (_CTAB_SPEC, _CTAB_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+             _PAGE_VEC_SPEC)
+
     if kv_mode is None:
         if chunked:
-            def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
-                    last, key):
-                admit, plen, first, kp, vp, _, _ = body(
-                    params, kp, vp, None, None, bt, suffix, slen,
-                    start, key)
-                pos, tok, first = finish(admit, plen, first, pos, tok,
-                                         last)
-                return kp, vp, pos, tok, first
+            if constrain:
+                def run(params, kp, vp, pos, tok, bt, suffix, slen,
+                        start, last, callow, ctrans, cstate, cseed,
+                        cseedval, key):
+                    ds0 = _c_start(cstate, cseed, cseedval)
+                    admit, plen, first, kp, vp, _, _ = body(
+                        params, kp, vp, None, None, bt, suffix, slen,
+                        start, key, allow=callow[ds0])
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok, last)
+                    ds = c_advance(admit & last, ds0, ctrans, first)
+                    return kp, vp, pos, tok, first, ds
 
-            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                        _PAGE_VEC_SPEC, P())
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_VEC_SPEC) + _CEXT + (P(),)
+            else:
+                def run(params, kp, vp, pos, tok, bt, suffix, slen,
+                        start, last, key):
+                    admit, plen, first, kp, vp, _, _ = body(
+                        params, kp, vp, None, None, bt, suffix, slen,
+                        start, key)
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok, last)
+                    return kp, vp, pos, tok, first
+
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_VEC_SPEC, P())
         else:
-            def run(params, kp, vp, pos, tok, bt, suffix, slen, start,
-                    key):
-                admit, plen, first, kp, vp, _, _ = body(
-                    params, kp, vp, None, None, bt, suffix, slen,
-                    start, key)
-                pos, tok, first = finish(admit, plen, first, pos, tok)
-                return kp, vp, pos, tok, first
+            if constrain:
+                def run(params, kp, vp, pos, tok, bt, suffix, slen,
+                        start, callow, ctrans, cstate, cseed, cseedval,
+                        key):
+                    ds0 = _c_start(cstate, cseed, cseedval)
+                    admit, plen, first, kp, vp, _, _ = body(
+                        params, kp, vp, None, None, bt, suffix, slen,
+                        start, key, allow=callow[ds0])
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok)
+                    ds = c_advance(admit, ds0, ctrans, first)
+                    return kp, vp, pos, tok, first, ds
 
-            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                        P())
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC) \
+                    + _CEXT + (P(),)
+            else:
+                def run(params, kp, vp, pos, tok, bt, suffix, slen,
+                        start, key):
+                    admit, plen, first, kp, vp, _, _ = body(
+                        params, kp, vp, None, None, bt, suffix, slen,
+                        start, key)
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok)
+                    return kp, vp, pos, tok, first
+
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
         out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
                      _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
     else:
         if chunked:
-            def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix,
-                    slen, start, last, key):
-                admit, plen, first, kp, vp, ksc, vsc = body(
-                    params, kp, vp, ksc, vsc, bt, suffix, slen, start,
-                    key)
-                pos, tok, first = finish(admit, plen, first, pos, tok,
-                                         last)
-                return kp, vp, ksc, vsc, pos, tok, first
+            if constrain:
+                def run(params, kp, vp, ksc, vsc, pos, tok, bt,
+                        suffix, slen, start, last, callow, ctrans,
+                        cstate, cseed, cseedval, key):
+                    ds0 = _c_start(cstate, cseed, cseedval)
+                    admit, plen, first, kp, vp, ksc, vsc = body(
+                        params, kp, vp, ksc, vsc, bt, suffix, slen,
+                        start, key, allow=callow[ds0])
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok, last)
+                    ds = c_advance(admit & last, ds0, ctrans, first)
+                    return kp, vp, ksc, vsc, pos, tok, first, ds
 
-            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                        _PAGE_VEC_SPEC, P())
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_VEC_SPEC) + _CEXT + (P(),)
+            else:
+                def run(params, kp, vp, ksc, vsc, pos, tok, bt,
+                        suffix, slen, start, last, key):
+                    admit, plen, first, kp, vp, ksc, vsc = body(
+                        params, kp, vp, ksc, vsc, bt, suffix, slen,
+                        start, key)
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok, last)
+                    return kp, vp, ksc, vsc, pos, tok, first
+
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_VEC_SPEC, P())
         else:
-            def run(params, kp, vp, ksc, vsc, pos, tok, bt, suffix,
-                    slen, start, key):
-                admit, plen, first, kp, vp, ksc, vsc = body(
-                    params, kp, vp, ksc, vsc, bt, suffix, slen, start,
-                    key)
-                pos, tok, first = finish(admit, plen, first, pos, tok)
-                return kp, vp, ksc, vsc, pos, tok, first
+            if constrain:
+                def run(params, kp, vp, ksc, vsc, pos, tok, bt,
+                        suffix, slen, start, callow, ctrans, cstate,
+                        cseed, cseedval, key):
+                    ds0 = _c_start(cstate, cseed, cseedval)
+                    admit, plen, first, kp, vp, ksc, vsc = body(
+                        params, kp, vp, ksc, vsc, bt, suffix, slen,
+                        start, key, allow=callow[ds0])
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok)
+                    ds = c_advance(admit, ds0, ctrans, first)
+                    return kp, vp, ksc, vsc, pos, tok, first, ds
 
-            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                        P(None, None), _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                        P())
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC) \
+                    + _CEXT + (P(),)
+            else:
+                def run(params, kp, vp, ksc, vsc, pos, tok, bt,
+                        suffix, slen, start, key):
+                    admit, plen, first, kp, vp, ksc, vsc = body(
+                        params, kp, vp, ksc, vsc, bt, suffix, slen,
+                        start, key)
+                    pos, tok, first = finish(admit, plen, first, pos,
+                                             tok)
+                    return kp, vp, ksc, vsc, pos, tok, first
+
+                in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                            _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                            _PAGE_BT_SPEC, P(None, None),
+                            _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
         out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
                      _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
                      _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+    if constrain:
+        out_specs = out_specs + (_PAGE_VEC_SPEC,)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -1416,7 +1755,8 @@ def make_paged_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
                                page_size: int, max_pages: int,
                                num_pages: int, temperature: float = 0.0,
                                top_k: int = 0, top_p: float = 1.0,
-                               quantized=None, kv_mode=None):
+                               quantized=None, kv_mode=None,
+                               constrain: bool = False):
     """Paged twin of `make_chunked_prefill`: (params, kp, vp[, kscale,
     vscale], pos, tok, bt [Ns, max_pages], toks [Ns, C], clen [Ns],
     start [Ns], last [Ns] bool, key) -> (state', pos, tok, first).
@@ -1433,14 +1773,16 @@ def make_paged_chunked_prefill(cfg: TransformerConfig, mesh: Mesh,
                               page_size, max_pages, num_pages,
                               temperature=temperature, top_k=top_k,
                               top_p=top_p, quantized=quantized,
-                              kv_mode=kv_mode, chunked=True)
+                              kv_mode=kv_mode, chunked=True,
+                              constrain=constrain)
 
 
 def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,
                       num_slots: int, page_size: int, max_pages: int,
                       num_pages: int, temperature: float = 0.0,
                       top_k: int = 0, top_p: float = 1.0,
-                      quantized=None, kv_mode=None):
+                      quantized=None, kv_mode=None,
+                      constrain: bool = False):
     """Compiled PAGED decode chunk: (params, kp, vp, pos, tok,
     bt [Ns, max_pages], active [Ns], rem [Ns], key) -> (kp, vp, pos,
     tok, toks [Ns, chunk]). Contract identical to
@@ -1457,16 +1799,21 @@ def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     specs = _serving_specs(cfg, quantized)
 
-    def sample_and_advance(params, h, act, pos, tok, rem, key):
+    def sample_and_advance(params, h, act, pos, tok, rem, key,
+                           ds=None, callow=None, ctrans=None):
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         logits = jnp.matmul(h[:, 0], params["Wout"].astype(h.dtype))
+        if callow is not None:
+            logits = _mask_allow(logits, callow[ds])
         nxt = _sample_slots(logits, pos + 1, key, dp, temperature,
                             top_k, top_p)
+        if callow is not None:
+            ds = jnp.where(act, ctrans[ds, nxt], ds)
         tok = jnp.where(act, nxt, tok)
         emit = jnp.where(act, nxt, jnp.asarray(-1, jnp.int32))
         pos = jnp.where(act, pos + 1, pos)
         rem = jnp.where(act, rem - 1, rem)
-        return pos, tok, rem, emit
+        return pos, tok, rem, emit, ds
 
     def embed_step(params, pos, tok):
         dt = cfg.activation_dtype()
@@ -1475,61 +1822,135 @@ def make_paged_decode(cfg: TransformerConfig, mesh: Mesh, chunk: int,
             jnp.clip(pos, 0, cfg.max_len - 1)]
         return (emb + pv)[:, None, :]
 
+    _CEXT = (_CTAB_SPEC, _CTAB_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+             _PAGE_VEC_SPEC)
+
     if kv_mode is None:
-        def run(params, kp, vp, pos, tok, bt, active, rem, key):
-            def step(carry, _):
-                kp, vp, pos, tok, rem = carry
-                act = active & (rem > 0)
-                h = embed_step(params, pos, tok)
-                for layer in range(cfg.n_layers):
-                    p_l = {kk: vv[layer]
-                           for kk, vv in params["blocks"].items()}
-                    h, kp, vp = _local_block_decode_paged(
-                        h, p_l, kp, vp, bt, layer, pos, act, cfg, tp,
-                        dp, page_size)
-                pos, tok, rem, emit = sample_and_advance(
-                    params, h, act, pos, tok, rem, key)
-                return (kp, vp, pos, tok, rem), emit
+        if constrain:
+            def run(params, kp, vp, pos, tok, bt, active, rem, callow,
+                    ctrans, cstate, cseed, cseedval, key):
+                def step(carry, _):
+                    kp, vp, pos, tok, rem, ds = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, kp, vp = _local_block_decode_paged(
+                            h, p_l, kp, vp, bt, layer, pos, act, cfg,
+                            tp, dp, page_size)
+                    pos, tok, rem, emit, ds = sample_and_advance(
+                        params, h, act, pos, tok, rem, key, ds=ds,
+                        callow=callow, ctrans=ctrans)
+                    return (kp, vp, pos, tok, rem, ds), emit
 
-            (kp, vp, pos, tok, _), toks = lax.scan(
-                step, (kp, vp, pos, tok, rem), None, length=chunk)
-            return kp, vp, pos, tok, jnp.swapaxes(toks, 0, 1)
+                ds0 = _c_start(cstate, cseed, cseedval)
+                (kp, vp, pos, tok, _, ds), toks = lax.scan(
+                    step, (kp, vp, pos, tok, rem, ds0), None,
+                    length=chunk)
+                return kp, vp, pos, tok, jnp.swapaxes(toks, 0, 1), ds
 
-        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
-        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
-                     _PAGE_VEC_SPEC, P(None, None))
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC) \
+                + _CEXT + (P(),)
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC)
+        else:
+            def run(params, kp, vp, pos, tok, bt, active, rem, key):
+                def step(carry, _):
+                    kp, vp, pos, tok, rem = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, kp, vp = _local_block_decode_paged(
+                            h, p_l, kp, vp, bt, layer, pos, act, cfg,
+                            tp, dp, page_size)
+                    pos, tok, rem, emit, _ = sample_and_advance(
+                        params, h, act, pos, tok, rem, key)
+                    return (kp, vp, pos, tok, rem), emit
+
+                (kp, vp, pos, tok, _), toks = lax.scan(
+                    step, (kp, vp, pos, tok, rem), None, length=chunk)
+                return kp, vp, pos, tok, jnp.swapaxes(toks, 0, 1)
+
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None))
     else:
-        def run(params, kp, vp, ksc, vsc, pos, tok, bt, active, rem,
-                key):
-            def step(carry, _):
-                kp, vp, ksc, vsc, pos, tok, rem = carry
-                act = active & (rem > 0)
-                h = embed_step(params, pos, tok)
-                for layer in range(cfg.n_layers):
-                    p_l = {kk: vv[layer]
-                           for kk, vv in params["blocks"].items()}
-                    h, kp, vp, ksc, vsc = _local_block_decode_paged_q(
-                        h, p_l, kp, vp, ksc, vsc, bt, layer, pos, act,
-                        cfg, tp, dp, page_size, kv_mode)
-                pos, tok, rem, emit = sample_and_advance(
-                    params, h, act, pos, tok, rem, key)
-                return (kp, vp, ksc, vsc, pos, tok, rem), emit
+        if constrain:
+            def run(params, kp, vp, ksc, vsc, pos, tok, bt, active,
+                    rem, callow, ctrans, cstate, cseed, cseedval, key):
+                def step(carry, _):
+                    kp, vp, ksc, vsc, pos, tok, rem, ds = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, kp, vp, ksc, vsc = \
+                            _local_block_decode_paged_q(
+                                h, p_l, kp, vp, ksc, vsc, bt, layer,
+                                pos, act, cfg, tp, dp, page_size,
+                                kv_mode)
+                    pos, tok, rem, emit, ds = sample_and_advance(
+                        params, h, act, pos, tok, rem, key, ds=ds,
+                        callow=callow, ctrans=ctrans)
+                    return (kp, vp, ksc, vsc, pos, tok, rem, ds), emit
 
-            (kp, vp, ksc, vsc, pos, tok, _), toks = lax.scan(
-                step, (kp, vp, ksc, vsc, pos, tok, rem), None,
-                length=chunk)
-            return (kp, vp, ksc, vsc, pos, tok,
-                    jnp.swapaxes(toks, 0, 1))
+                ds0 = _c_start(cstate, cseed, cseedval)
+                (kp, vp, ksc, vsc, pos, tok, _, ds), toks = lax.scan(
+                    step, (kp, vp, ksc, vsc, pos, tok, rem, ds0), None,
+                    length=chunk)
+                return (kp, vp, ksc, vsc, pos, tok,
+                        jnp.swapaxes(toks, 0, 1), ds)
 
-        in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
-        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                     _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None))
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC) \
+                + _CEXT + (P(),)
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC)
+        else:
+            def run(params, kp, vp, ksc, vsc, pos, tok, bt, active,
+                    rem, key):
+                def step(carry, _):
+                    kp, vp, ksc, vsc, pos, tok, rem = carry
+                    act = active & (rem > 0)
+                    h = embed_step(params, pos, tok)
+                    for layer in range(cfg.n_layers):
+                        p_l = {kk: vv[layer]
+                               for kk, vv in params["blocks"].items()}
+                        h, kp, vp, ksc, vsc = \
+                            _local_block_decode_paged_q(
+                                h, p_l, kp, vp, ksc, vsc, bt, layer,
+                                pos, act, cfg, tp, dp, page_size,
+                                kv_mode)
+                    pos, tok, rem, emit, _ = sample_and_advance(
+                        params, h, act, pos, tok, rem, key)
+                    return (kp, vp, ksc, vsc, pos, tok, rem), emit
+
+                (kp, vp, ksc, vsc, pos, tok, _), toks = lax.scan(
+                    step, (kp, vp, ksc, vsc, pos, tok, rem), None,
+                    length=chunk)
+                return (kp, vp, ksc, vsc, pos, tok,
+                        jnp.swapaxes(toks, 0, 1))
+
+            in_specs = (specs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None))
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -1655,12 +2076,39 @@ def _spec_accept_commit(spec_k: int, drafts, tgt, pos, tok, rem, act):
     return pos, tok, rem, emit, c, drafted, accepted
 
 
+def _c_spec_window(spec_k: int, ds0, ctrans, drafts):
+    """Constraint states for the K+1 verify-window positions: entry j
+    is the DFA state after consuming drafts[:, :j] from ds0, so the
+    target sample at window position j is masked by the state the
+    masked sequential engine would hold there. Walked from the POST-
+    poison drafts: on the accepted prefix drafts equal the committed
+    tokens (so the states agree with the sequential walk by
+    construction), and positions past the first divergence are never
+    committed — a poisoned draft merely yields a scratch state whose
+    masked sample the acceptance test then rejects."""
+    sw = [ds0]
+    for j in range(spec_k):
+        sw.append(ctrans[sw[-1], drafts[:, j]])
+    return jnp.stack(sw, axis=1)                         # [Ns, K+1]
+
+
+def _c_spec_final(spec_k: int, swin, ctrans, tgt, c, act, ds0):
+    """DFA state after a speculative commit: the state at the last
+    committed window position (column c-1 of the window walk) advanced
+    by the committed token there (tgt at c-1 — _spec_accept_commit's
+    ``last``). Inactive slots keep ds0."""
+    rows = jnp.arange(tgt.shape[0])
+    j = jnp.clip(c - 1, 0, spec_k)
+    return jnp.where(act, ctrans[swin[rows, j], tgt[rows, j]], ds0)
+
+
 def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                             spec_k: int, num_slots: int,
                             temperature: float = 0.0, top_k: int = 0,
                             top_p: float = 1.0, quantized=None,
                             kv_mode=None, draft_quantized=None,
-                            draft_layers: int = 0):
+                            draft_layers: int = 0,
+                            constrain: bool = False):
     """Compiled speculative decode round over the CONTIGUOUS slot
     pool: (params, draft_params, ck, cv[, kscale, vscale], pos, tok,
     active [Ns], rem [Ns], poison [Ns], key) -> (state', toks
@@ -1695,12 +2143,20 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
     k1 = spec_k + 1
     scale = cfg.d_head ** -0.5
 
-    def draft_phase(dparams, st, pos, tok, act, key):
+    def draft_phase(dparams, st, pos, tok, act, key, ds0=None,
+                    callow=None, ctrans=None):
         """K sequential draft steps through the ordinary slotted block
         fns (draft K/V rows land in the live cache; verify rewrites
-        them with target K/V before any of them is attended)."""
+        them with target K/V before any of them is attended). With a
+        constraint table, each step masks its proposal by the slot's
+        DFA state and advances the state per drafted token — the final
+        draft state is scratch (verify recomputes the committed one
+        from the accepted prefix)."""
         def dstep(carry, _):
-            st, dpos, dtok = carry
+            if callow is None:
+                st, dpos, dtok = carry
+            else:
+                st, dpos, dtok, ds = carry
             h = _embed_pending(dparams, cfg, dpos, dtok)
             for layer in range(nd):
                 p_l = {kk: vv[layer]
@@ -1719,24 +2175,38 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                            cfg.eps)
             logits = jnp.matmul(h[:, 0],
                                 dparams["Wout"].astype(h.dtype))
+            if callow is not None:
+                logits = _mask_allow(logits, callow[ds])
             nxt = _sample_slots(logits, dpos + 1, key, dp, temperature,
                                 top_k, top_p)
             dtok = jnp.where(act, nxt, dtok)
             dpos = jnp.where(act, dpos + 1, dpos)
-            return (st, dpos, dtok), nxt
+            if callow is None:
+                return (st, dpos, dtok), nxt
+            ds = jnp.where(act, ctrans[ds, nxt], ds)
+            return (st, dpos, dtok, ds), nxt
 
-        (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
-                                      length=spec_k)
+        if callow is None:
+            (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
+                                          length=spec_k)
+        else:
+            (st, _, _, _), drafts = lax.scan(
+                dstep, (st, pos, tok, ds0), None, length=spec_k)
         return st, jnp.swapaxes(drafts, 0, 1)            # [Ns, K]
 
-    def verify_phase(params, st, pos, tok, act, drafts, key):
+    def verify_phase(params, st, pos, tok, act, drafts, key,
+                     allow_w=None):
         """ONE target pass over the K+1-token window [pending,
         d_1..d_K]: per-layer it rewrites the window's cache rows with
         target K/V, then attends each window position to s <= pos+j —
         element-for-element the slotted sequential decode's numerics
         (same einsum contraction, NEG_INF mask, f32 softmax, scale
         folds), batched over the window instead of scanned, which is
-        the whole bandwidth win."""
+        the whole bandwidth win. ``allow_w`` [Ns, K+1, V] re-applies
+        the constraint mask per window position (the state reached
+        after the preceding window tokens), so acceptance compares
+        masked target samples against masked drafts — bit-identical to
+        the masked sequential engine."""
         g_model = _g_sync("model")
         ns = tok.shape[0]
         rows = jnp.arange(ns)
@@ -1814,6 +2284,8 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
             h = _local_mlp(h, x, p, cfg, dp, g_model)
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         logits = jnp.matmul(h, params["Wout"].astype(h.dtype))
+        if allow_w is not None:
+            logits = _mask_allow(logits, allow_w)
         tgt = _sample_slots(
             logits.reshape(ns * k1, logits.shape[-1]),
             (posw + 1).reshape(-1), key, dp, temperature, top_k,
@@ -1821,51 +2293,116 @@ def make_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
         st = (ck, cv) if kv_mode is None else (ck, cv, ksc, vsc)
         return st, tgt
 
-    def body(params, dparams, st, pos, tok, active, rem, poison, key):
+    def body(params, dparams, st, pos, tok, active, rem, poison, key,
+             callow=None, ctrans=None, ds0=None):
         act = active & (rem > 0)
-        st, drafts = draft_phase(dparams, st, pos, tok, act, key)
+        st, drafts = draft_phase(dparams, st, pos, tok, act, key,
+                                 ds0=ds0, callow=callow,
+                                 ctrans=ctrans)
         # deterministic draft poisoning (runtime data): (d+1) mod V is
         # guaranteed to differ from the model's own proposal, so
         # verification MUST reject — the fault-injection proof that a
         # bad draft pass cannot corrupt committed state
         drafts = jnp.where(poison[:, None],
                            (drafts + 1) % cfg.vocab_size, drafts)
-        st, tgt = verify_phase(params, st, pos, tok, act, drafts, key)
+        if callow is None:
+            st, tgt = verify_phase(params, st, pos, tok, act, drafts,
+                                   key)
+            pos, tok, rem, emit, c, drafted, accepted = \
+                _spec_accept_commit(spec_k, drafts, tgt, pos, tok,
+                                    rem, act)
+            return st, pos, tok, emit, c, drafted, accepted
+        swin = _c_spec_window(spec_k, ds0, ctrans, drafts)
+        st, tgt = verify_phase(params, st, pos, tok, act, drafts, key,
+                               allow_w=callow[swin])
         pos, tok, rem, emit, c, drafted, accepted = \
             _spec_accept_commit(spec_k, drafts, tgt, pos, tok, rem,
                                 act)
-        return st, pos, tok, emit, c, drafted, accepted
+        ds = _c_spec_final(spec_k, swin, ctrans, tgt, c, act, ds0)
+        return st, pos, tok, emit, c, drafted, accepted, ds
 
     if kv_mode is None:
-        def run(params, dparams, ck, cv, pos, tok, active, rem,
-                poison, key):
-            st, pos, tok, emit, c, drafted, accepted = body(
-                params, dparams, (ck, cv), pos, tok, active, rem,
-                poison, key)
-            return (*st, pos, tok, emit, c, drafted, accepted)
+        if constrain:
+            def run(params, dparams, ck, cv, pos, tok, active, rem,
+                    poison, callow, ctrans, cstate, cseed, cseedval,
+                    key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                st, pos, tok, emit, c, drafted, accepted, ds = body(
+                    params, dparams, (ck, cv), pos, tok, active, rem,
+                    poison, key, callow=callow, ctrans=ctrans,
+                    ds0=ds0)
+                return (*st, pos, tok, emit, c, drafted, accepted, ds)
 
-        in_specs = (specs, dspecs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+            in_specs = (specs, dspecs, _SLOT_CACHE_SPEC,
+                        _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
+        else:
+            def run(params, dparams, ck, cv, pos, tok, active, rem,
+                    poison, key):
+                st, pos, tok, emit, c, drafted, accepted = body(
+                    params, dparams, (ck, cv), pos, tok, active, rem,
+                    poison, key)
+                return (*st, pos, tok, emit, c, drafted, accepted)
+
+            in_specs = (specs, dspecs, _SLOT_CACHE_SPEC,
+                        _SLOT_CACHE_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
     else:
-        def run(params, dparams, ck, cv, ksc, vsc, pos, tok, active,
-                rem, poison, key):
-            st, pos, tok, emit, c, drafted, accepted = body(
-                params, dparams, (ck, cv, ksc, vsc), pos, tok, active,
-                rem, poison, key)
-            return (*st, pos, tok, emit, c, drafted, accepted)
+        if constrain:
+            def run(params, dparams, ck, cv, ksc, vsc, pos, tok,
+                    active, rem, poison, callow, ctrans, cstate,
+                    cseed, cseedval, key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                st, pos, tok, emit, c, drafted, accepted, ds = body(
+                    params, dparams, (ck, cv, ksc, vsc), pos, tok,
+                    active, rem, poison, key, callow=callow,
+                    ctrans=ctrans, ds0=ds0)
+                return (*st, pos, tok, emit, c, drafted, accepted, ds)
 
-        in_specs = (specs, dspecs, _SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                    _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
-                    _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
-        out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
-                     _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P("data", None),
-                     _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
+            in_specs = (specs, dspecs, _SLOT_CACHE_SPEC,
+                        _SLOT_CACHE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC)
+        else:
+            def run(params, dparams, ck, cv, ksc, vsc, pos, tok,
+                    active, rem, poison, key):
+                st, pos, tok, emit, c, drafted, accepted = body(
+                    params, dparams, (ck, cv, ksc, vsc), pos, tok,
+                    active, rem, poison, key)
+                return (*st, pos, tok, emit, c, drafted, accepted)
+
+            in_specs = (specs, dspecs, _SLOT_CACHE_SPEC,
+                        _SLOT_CACHE_SPEC, _SLOT_SCALE_SPEC,
+                        _SLOT_SCALE_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                        _SLOT_VEC_SPEC, _SLOT_VEC_SPEC, P())
+            out_specs = (_SLOT_CACHE_SPEC, _SLOT_CACHE_SPEC,
+                         _SLOT_SCALE_SPEC, _SLOT_SCALE_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC,
+                         P("data", None), _SLOT_VEC_SPEC,
+                         _SLOT_VEC_SPEC, _SLOT_VEC_SPEC)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)
@@ -1880,7 +2417,8 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                                   top_k: int = 0, top_p: float = 1.0,
                                   quantized=None, kv_mode=None,
                                   draft_quantized=None,
-                                  draft_layers: int = 0):
+                                  draft_layers: int = 0,
+                                  constrain: bool = False):
     """Paged-pool speculative round: make_speculative_decode's
     contract with the block table as runtime data — (params,
     draft_params, kp, vp[, kscale, vscale], pos, tok, bt, active, rem,
@@ -1907,9 +2445,13 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
     s_view = max_pages * page_size
     scale = cfg.d_head ** -0.5
 
-    def draft_phase(dparams, st, bt, pos, tok, act, key):
+    def draft_phase(dparams, st, bt, pos, tok, act, key, ds0=None,
+                    callow=None, ctrans=None):
         def dstep(carry, _):
-            st, dpos, dtok = carry
+            if callow is None:
+                st, dpos, dtok = carry
+            else:
+                st, dpos, dtok, ds = carry
             h = _embed_pending(dparams, cfg, dpos, dtok)
             for layer in range(nd):
                 p_l = {kk: vv[layer]
@@ -1928,17 +2470,27 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
                            cfg.eps)
             logits = jnp.matmul(h[:, 0],
                                 dparams["Wout"].astype(h.dtype))
+            if callow is not None:
+                logits = _mask_allow(logits, callow[ds])
             nxt = _sample_slots(logits, dpos + 1, key, dp, temperature,
                                 top_k, top_p)
             dtok = jnp.where(act, nxt, dtok)
             dpos = jnp.where(act, dpos + 1, dpos)
-            return (st, dpos, dtok), nxt
+            if callow is None:
+                return (st, dpos, dtok), nxt
+            ds = jnp.where(act, ctrans[ds, nxt], ds)
+            return (st, dpos, dtok, ds), nxt
 
-        (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
-                                      length=spec_k)
+        if callow is None:
+            (st, _, _), drafts = lax.scan(dstep, (st, pos, tok), None,
+                                          length=spec_k)
+        else:
+            (st, _, _, _), drafts = lax.scan(
+                dstep, (st, pos, tok, ds0), None, length=spec_k)
         return st, jnp.swapaxes(drafts, 0, 1)
 
-    def verify_phase(params, st, bt, pos, tok, act, drafts, key):
+    def verify_phase(params, st, bt, pos, tok, act, drafts, key,
+                     allow_w=None):
         g_model = _g_sync("model")
         ns = tok.shape[0]
         mp = bt.shape[1]
@@ -2000,6 +2552,8 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
             h = _local_mlp(h, x, p, cfg, dp, g_model)
         h = layer_norm(h, params["lnfg"], params["lnfb"], cfg.eps)
         logits = jnp.matmul(h, params["Wout"].astype(h.dtype))
+        if allow_w is not None:
+            logits = _mask_allow(logits, allow_w)
         tgt = _sample_slots(
             logits.reshape(ns * k1, logits.shape[-1]),
             (posw + 1).reshape(-1), key, dp, temperature, top_k,
@@ -2008,50 +2562,109 @@ def make_paged_speculative_decode(cfg: TransformerConfig, mesh: Mesh,
         return st, tgt
 
     def body(params, dparams, st, pos, tok, bt, active, rem, poison,
-             key):
+             key, callow=None, ctrans=None, ds0=None):
         act = active & (rem > 0)
-        st, drafts = draft_phase(dparams, st, bt, pos, tok, act, key)
+        st, drafts = draft_phase(dparams, st, bt, pos, tok, act, key,
+                                 ds0=ds0, callow=callow,
+                                 ctrans=ctrans)
         drafts = jnp.where(poison[:, None],
                            (drafts + 1) % cfg.vocab_size, drafts)
+        if callow is None:
+            st, tgt = verify_phase(params, st, bt, pos, tok, act,
+                                   drafts, key)
+            pos, tok, rem, emit, c, drafted, accepted = \
+                _spec_accept_commit(spec_k, drafts, tgt, pos, tok,
+                                    rem, act)
+            return st, pos, tok, emit, c, drafted, accepted
+        swin = _c_spec_window(spec_k, ds0, ctrans, drafts)
         st, tgt = verify_phase(params, st, bt, pos, tok, act, drafts,
-                               key)
+                               key, allow_w=callow[swin])
         pos, tok, rem, emit, c, drafted, accepted = \
             _spec_accept_commit(spec_k, drafts, tgt, pos, tok, rem,
                                 act)
-        return st, pos, tok, emit, c, drafted, accepted
+        ds = _c_spec_final(spec_k, swin, ctrans, tgt, c, act, ds0)
+        return st, pos, tok, emit, c, drafted, accepted, ds
 
     if kv_mode is None:
-        def run(params, dparams, kp, vp, pos, tok, bt, active, rem,
-                poison, key):
-            st, pos, tok, emit, c, drafted, accepted = body(
-                params, dparams, (kp, vp), pos, tok, bt, active, rem,
-                poison, key)
-            return (*st, pos, tok, emit, c, drafted, accepted)
+        if constrain:
+            def run(params, dparams, kp, vp, pos, tok, bt, active,
+                    rem, poison, callow, ctrans, cstate, cseed,
+                    cseedval, key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                st, pos, tok, emit, c, drafted, accepted, ds = body(
+                    params, dparams, (kp, vp), pos, tok, bt, active,
+                    rem, poison, key, callow=callow, ctrans=ctrans,
+                    ds0=ds0)
+                return (*st, pos, tok, emit, c, drafted, accepted, ds)
 
-        in_specs = (specs, dspecs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                    P())
-        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
-                     _PAGE_VEC_SPEC, P(None, None), _PAGE_VEC_SPEC,
-                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+            in_specs = (specs, dspecs, _PAGE_POOL_SPEC,
+                        _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_BT_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+        else:
+            def run(params, dparams, kp, vp, pos, tok, bt, active,
+                    rem, poison, key):
+                st, pos, tok, emit, c, drafted, accepted = body(
+                    params, dparams, (kp, vp), pos, tok, bt, active,
+                    rem, poison, key)
+                return (*st, pos, tok, emit, c, drafted, accepted)
+
+            in_specs = (specs, dspecs, _PAGE_POOL_SPEC,
+                        _PAGE_POOL_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_BT_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                         _PAGE_VEC_SPEC)
     else:
-        def run(params, dparams, kp, vp, ksc, vsc, pos, tok, bt,
-                active, rem, poison, key):
-            st, pos, tok, emit, c, drafted, accepted = body(
-                params, dparams, (kp, vp, ksc, vsc), pos, tok, bt,
-                active, rem, poison, key)
-            return (*st, pos, tok, emit, c, drafted, accepted)
+        if constrain:
+            def run(params, dparams, kp, vp, ksc, vsc, pos, tok, bt,
+                    active, rem, poison, callow, ctrans, cstate,
+                    cseed, cseedval, key):
+                ds0 = _c_start(cstate, cseed, cseedval)
+                st, pos, tok, emit, c, drafted, accepted, ds = body(
+                    params, dparams, (kp, vp, ksc, vsc), pos, tok, bt,
+                    active, rem, poison, key, callow=callow,
+                    ctrans=ctrans, ds0=ds0)
+                return (*st, pos, tok, emit, c, drafted, accepted, ds)
 
-        in_specs = (specs, dspecs, _PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                    _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_BT_SPEC,
-                    _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
-                    P())
-        out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
-                     _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
-                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
-                     _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+            in_specs = (specs, dspecs, _PAGE_POOL_SPEC,
+                        _PAGE_POOL_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_BT_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, _CTAB_SPEC,
+                        _CTAB_SPEC, _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC)
+        else:
+            def run(params, dparams, kp, vp, ksc, vsc, pos, tok, bt,
+                    active, rem, poison, key):
+                st, pos, tok, emit, c, drafted, accepted = body(
+                    params, dparams, (kp, vp, ksc, vsc), pos, tok, bt,
+                    active, rem, poison, key)
+                return (*st, pos, tok, emit, c, drafted, accepted)
+
+            in_specs = (specs, dspecs, _PAGE_POOL_SPEC,
+                        _PAGE_POOL_SPEC, _PAGE_SCALE_SPEC,
+                        _PAGE_SCALE_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_BT_SPEC, _PAGE_VEC_SPEC,
+                        _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P())
+            out_specs = (_PAGE_POOL_SPEC, _PAGE_POOL_SPEC,
+                         _PAGE_SCALE_SPEC, _PAGE_SCALE_SPEC,
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC, P(None, None),
+                         _PAGE_VEC_SPEC, _PAGE_VEC_SPEC,
+                         _PAGE_VEC_SPEC)
 
     sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=True)

@@ -154,10 +154,34 @@ the layer between callers and the compiled decode step:
   `kvwire` trace event, `serving_kvwire_*` metrics) — never a lost
   request — docs/serving.md "KV wire transport".
 
+- Grammar-constrained decoding (round 25, ISSUE-20):
+  `submit(constrain=...)` takes a regex or a JSON-schema subset,
+  compiles it (`serving/constrain.py`: regex/schema -> byte-level
+  FSM -> token-level DFA over the model vocab, hash-keyed cache)
+  into rows of a fixed-shape `[constrain_state_cap, V]` allow/
+  transition table, and every decode path — contiguous, paged,
+  chunked, speculative, pipelined — gathers its slot's mask from
+  that table as PURE RUNTIME DATA: the compiled-program set stays
+  closed (masked variants register under separate cache names, so
+  constrain=None keeps today's compile keys byte-identically), spec
+  drafts propose masked and the verify pass re-applies the target
+  mask per window position (acceptance stays bit-exact), the host
+  walks its own DFA at commit (truncate-at-terminal -> early
+  completion), and fleet dispatch/failover forwards the spec with a
+  `consumed` count so a failover target replays the committed
+  prefix to the exact DFA state. Typed `ConstraintError` rejects
+  unsupported grammars, oversized tables, and batch-mode engines at
+  submit() — never mid-decode (docs/serving.md "Constrained
+  decoding").
+
 Lifecycle and thresholds: docs/serving.md.
 """
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
     CompileCache)
+from deeplearning4j_tpu.serving.constrain import (  # noqa: F401
+    CompiledGrammar, ConstraintError, ConstraintTable, compile_grammar,
+    grammar_cache_clear, grammar_cache_info, normalize_constraint,
+    schema_to_regex)
 from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
     Autoscaler, AutoscalePolicy, TieredRouter)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401

@@ -507,6 +507,9 @@ class TieredRouter(Router):
             #                                   bill the same tenant
             if fr.priority:                   # QoS class rides both
                 kw["priority"] = fr.priority  # hops too (ISSUE-16)
+            kw.update(self._constrain_kw(fr, prompt))  # ISSUE-20:
+            #                                   the first token is
+            #                                   grammar-masked too
             hold = bool(getattr(ctl.replica, "supports_handoff",
                                 False))
             return ctl.replica.submit(prompt, 1, deadline_s,
@@ -522,6 +525,9 @@ class TieredRouter(Router):
             kw["tenant"] = fr.tenant
         if fr.priority:
             kw["priority"] = fr.priority
+        kw.update(self._constrain_kw(fr, prompt))   # ISSUE-20: the
+        #                                   decode hop replays the
+        #                                   whole committed prefix
         rep = ctl.replica
         if kv is not None:
             rep.last_wire = None

@@ -592,6 +592,16 @@ class EngineConfig:
     tenant_weights: Optional[Dict[str, float]] = None
     qos_default_weight: float = 1.0
     preemption_budget: int = 0
+    # ``constrain_state_cap`` bounds the per-engine constraint table:
+    # the dense [cap, V] allow/transition planes shipped to the device
+    # are a fixed shape (so grammars are pure runtime data — swapping
+    # one never recompiles), and every resident grammar's DFA must fit
+    # inside cap-1 rows (row 0 is the unconstrained all-allow state).
+    # A submit() whose compiled grammar exceeds the free rows is
+    # rejected with ConstraintError(reason="oversize"); the documented
+    # device-memory bound is cap * vocab_size * 5 bytes (bool allow +
+    # int32 trans). 512 states x 32k vocab ~ 80 MB.
+    constrain_state_cap: int = 512
 
 
 class RequestHandle:
@@ -634,6 +644,20 @@ class RequestHandle:
         # constructions (and disabled recording) zero-cost
         self.trace = NULL_TRACE
         self._on_terminal: Optional[Callable] = None
+        # grammar-constrained decoding (ISSUE-20): the compiled
+        # grammar, its base row in the engine's device table, the
+        # normalized spec dict (forwarded across fleet hops), how many
+        # prompt-tail tokens the grammar has already consumed, and the
+        # HOST-authoritative DFA state after every committed token —
+        # device states are scratch that reseeds from this on every
+        # (re)seat, which is what makes failover/preemption resume
+        # token-exact for free
+        self._grammar = None
+        self._cbase = 0
+        self._constrain: Optional[dict] = None
+        self._consumed = 0
+        self._cinit = 0        # local state after the consumed tail
+        self._cstate_host = 0
 
     @property
     def generated(self) -> np.ndarray:
@@ -688,6 +712,10 @@ class _PendingTick:
     items: list
     in_state: Optional[tuple]
     n_active: int
+    # constrained engines: (device cstate snapshot, dict of pending
+    # per-slot seeds) captured BEFORE dispatch — restoring both is
+    # what makes a failed pipelined tick invisible to the DFA walk
+    c_in_state: Optional[tuple] = None
 
 
 # ---------------------------------------------------------------------------
@@ -963,6 +991,137 @@ def _compiled_paged_spec_decode(cfg_fields: tuple, mesh, spec_k: int,
         temperature=temperature, top_k=top_k, top_p=top_p,
         quantized=quantized, kv_mode=kv_mode,
         draft_quantized=draft_quantized, draft_layers=draft_layers)
+
+
+# --- constrained (grammar-masked) program factories -------------------
+# Registered SEPARATELY from their unmasked twins so constrain=None
+# engines keep their compile-cache keys byte-unchanged (the ISSUE-20
+# bit-identity guarantee counts these caches staying empty). Mask
+# tables, per-slot DFA states, and seed vectors are runtime operands —
+# every grammar shares one compiled program per geometry.
+
+@_program_cache
+def _compiled_prefill_c(cfg_fields: tuple, mesh, bucket_len: int,
+                        num_slots: int, temperature: float, top_k: int,
+                        top_p: float, quantized=None, kv_mode=None,
+                        constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_continuous_prefill(cfg, mesh, bucket_len, num_slots,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p,
+                                   quantized=quantized,
+                                   kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_decode_chunk_c(cfg_fields: tuple, mesh, chunk: int,
+                             num_slots: int, temperature: float,
+                             top_k: int, top_p: float, quantized=None,
+                             kv_mode=None, constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_continuous_decode(cfg, mesh, chunk, num_slots,
+                                  temperature=temperature,
+                                  top_k=top_k, top_p=top_p,
+                                  quantized=quantized,
+                                  kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_chunked_prefill_c(cfg_fields: tuple, mesh,
+                                chunk_len: int, num_slots: int,
+                                temperature: float, top_k: int,
+                                top_p: float, quantized=None,
+                                kv_mode=None,
+                                constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_chunked_prefill(cfg, mesh, chunk_len, num_slots,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p, quantized=quantized,
+                                kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_paged_prefill_c(cfg_fields: tuple, mesh,
+                              bucket_len: int, num_slots: int,
+                              page_size: int, max_pages: int,
+                              num_pages: int, temperature: float,
+                              top_k: int, top_p: float,
+                              quantized=None, kv_mode=None,
+                              constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_prefill(cfg, mesh, bucket_len, num_slots,
+                              page_size, max_pages, num_pages,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, quantized=quantized,
+                              kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_paged_chunked_prefill_c(cfg_fields: tuple, mesh,
+                                      chunk_len: int, num_slots: int,
+                                      page_size: int, max_pages: int,
+                                      num_pages: int,
+                                      temperature: float, top_k: int,
+                                      top_p: float, quantized=None,
+                                      kv_mode=None,
+                                      constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_chunked_prefill(
+        cfg, mesh, chunk_len, num_slots, page_size, max_pages,
+        num_pages, temperature=temperature, top_k=top_k, top_p=top_p,
+        quantized=quantized, kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_paged_decode_c(cfg_fields: tuple, mesh, chunk: int,
+                             num_slots: int, page_size: int,
+                             max_pages: int, num_pages: int,
+                             temperature: float, top_k: int,
+                             top_p: float, quantized=None,
+                             kv_mode=None, constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_decode(cfg, mesh, chunk, num_slots, page_size,
+                             max_pages, num_pages,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, quantized=quantized,
+                             kv_mode=kv_mode, constrain=True)
+
+
+@_program_cache
+def _compiled_spec_decode_c(cfg_fields: tuple, mesh, spec_k: int,
+                            num_slots: int, temperature: float,
+                            top_k: int, top_p: float, quantized=None,
+                            kv_mode=None, draft_quantized=None,
+                            draft_layers: int = 0,
+                            constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_speculative_decode(cfg, mesh, spec_k, num_slots,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p,
+                                   quantized=quantized,
+                                   kv_mode=kv_mode,
+                                   draft_quantized=draft_quantized,
+                                   draft_layers=draft_layers,
+                                   constrain=True)
+
+
+@_program_cache
+def _compiled_paged_spec_decode_c(cfg_fields: tuple, mesh,
+                                  spec_k: int, num_slots: int,
+                                  page_size: int, max_pages: int,
+                                  num_pages: int, temperature: float,
+                                  top_k: int, top_p: float,
+                                  quantized=None, kv_mode=None,
+                                  draft_quantized=None,
+                                  draft_layers: int = 0,
+                                  constrain_cap: int = 0):
+    cfg = TransformerConfig(*cfg_fields)
+    return make_paged_speculative_decode(
+        cfg, mesh, spec_k, num_slots, page_size, max_pages, num_pages,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        quantized=quantized, kv_mode=kv_mode,
+        draft_quantized=draft_quantized, draft_layers=draft_layers,
+        constrain=True)
 
 
 @_program_cache
@@ -1272,6 +1431,17 @@ class InferenceEngine:
             [None] * self._num_slots
         self._slot_state = None
         self._key = None
+        # grammar-constrained decoding (ISSUE-20): everything here is
+        # lazy — the mask table, the per-slot DFA-state vector, and
+        # the serving_constrained_* metrics exist only once the first
+        # submit(constrain=...) lands, so constrain-off engines are
+        # byte-identical to the pre-constraint engine (compile keys,
+        # scrapes, traces)
+        self._constrain_active = False
+        self._ctab = None                 # ConstraintTable (lazy)
+        self._cstate = None               # np.int32 [num_slots]
+        self._cseed_pending: Dict[int, int] = {}
+        self._cgrammar_keys: set = set()
         # paged slot KV + radix prefix sharing (ISSUE-7): page indices
         # are host-owned — the allocator/radix cache here, the block
         # table as a numpy array passed to every compiled call — so
@@ -1628,6 +1798,140 @@ class InferenceEngine:
                 "evicted request's tenant (token-exact resume from "
                 "the committed prefix)",
                 labelnames=("tenant",))
+        # grammar constraints (ISSUE-20): registered lazily by
+        # _ensure_constrain_metrics on the first submit(constrain=...),
+        # so a constrain-off engine's scrape is byte-unchanged
+        self._m_c_requests = None
+        self._m_c_rejections = None
+        self._m_c_compiles = None
+        self._m_c_terminal = None
+
+    # ------------------------------------------------------------------
+    # grammar-constrained decoding (ISSUE-20): lazy activation
+    # ------------------------------------------------------------------
+    def _ensure_constrain_metrics(self) -> None:
+        """Register the serving_constrained_* series on first use (a
+        constrain-off engine's /metrics scrape must stay
+        byte-identical — see tests/test_metrics_naming.py)."""
+        if self._m_c_requests is not None:
+            return
+        r = self.registry
+        self._m_c_requests = r.counter(
+            "serving_constrained_requests",
+            "Requests admitted with a grammar constraint")
+        self._m_c_rejections = r.counter(
+            "serving_constrained_rejections",
+            "Constrained submissions rejected at submit() with a "
+            "typed ConstraintError, by reason (never mid-decode)",
+            labelnames=("reason",))
+        self._m_c_compiles = r.counter(
+            "serving_constrained_grammar_compiles",
+            "Distinct compiled grammars this engine has admitted "
+            "(cache hits on the same grammar hash do not count)")
+        self._m_c_terminal = r.counter(
+            "serving_constrained_terminal_completions",
+            "Constrained requests completed early because their DFA "
+            "reached a terminal accepting state")
+        r.gauge("serving_constrained_states",
+                "DFA states resident in the constraint mask table "
+                "(bound: constrain_state_cap)").set_function(
+            lambda: float(self._ctab.rows_used
+                          if self._ctab is not None else 0))
+
+    def _ensure_constrain(self) -> None:
+        """First constrained admission: allocate the fixed-geometry
+        mask table and the per-slot DFA-state vector and flip the
+        engine into constrain-aware mode. From here on every
+        continuous-batching call uses the masked program variants —
+        registered under SEPARATE cache names, so the unmasked
+        programs (and any engine that never sees a constraint) keep
+        their compile keys byte-unchanged."""
+        if self._constrain_active:
+            return
+        from deeplearning4j_tpu.serving.constrain import ConstraintTable
+        self._ctab = ConstraintTable(
+            int(self.config.constrain_state_cap),
+            int(self.cfg.vocab_size))
+        self._ensure_constrain_metrics()
+        self._cstate = np.zeros((self._num_slots,), np.int32)
+        self._constrain_active = True
+
+    def _c_state_for(self, r: RequestHandle) -> int:
+        """Device-table row for a (re)seated request: replay the
+        committed prefix through the host DFA — this is what makes
+        failover/requeue token-exact, the state is always derivable
+        from committed bytes — and offset into the request's table
+        slab. Row 0 (all-allow) for unconstrained requests."""
+        if r._grammar is None:
+            return 0
+        g = r._grammar
+        st = r._cinit
+        for t in np.asarray(r.generated, np.int32).tolist():
+            st = g.advance(st, int(t))
+        r._cstate_host = st
+        return int(r._cbase) + int(st)
+
+    def _c_advance_commit(self, r: RequestHandle,
+                          toks: np.ndarray):
+        """Host-authoritative DFA advance at commit time. Walks the
+        committed tokens through the request's grammar; returns the
+        (possibly truncated) token array plus whether the walk reached
+        a terminal accepting state. Tokens past a terminal state — or
+        past a (defensive, should-be-impossible) illegal token — are
+        dropped: the device mask guarantees legality, so truncation
+        only ever fires at grammar completion."""
+        g = r._grammar
+        st = r._cstate_host
+        keep = 0
+        terminal = False
+        for t in np.asarray(toks, np.int32).tolist():
+            if not g.legal(st, int(t)):
+                log.error("request %d: committed token %d illegal in "
+                          "DFA state %d (truncating)", r.rid, int(t),
+                          st)
+                break
+            st = g.advance(st, int(t))
+            keep += 1
+            if g.is_terminal(st):
+                terminal = True
+                break
+        r._cstate_host = st
+        return toks[:keep], terminal
+
+    def _cmask_begin(self):
+        """Snapshot the constraint operands for one compiled call:
+        the device mask/transition planes, the current per-slot state
+        vector, and the pending reseat seeds (as dense vectors — a
+        seed overrides the stale device state for slots that changed
+        occupants since the last call). Returns an operand jar the
+        call site threads through `_cmask_commit` on success; a
+        `_guarded` retry reuses the same snapshot, so retries are
+        bit-exact."""
+        allow_d, trans_d = self._ctab.device(self.mesh)
+        ns = self._num_slots
+        cseed = np.zeros((ns,), bool)
+        cseedval = np.zeros((ns,), np.int32)
+        for i, v in self._cseed_pending.items():
+            cseed[i] = True
+            cseedval[i] = np.int32(v)
+
+        class _Jar:
+            pass
+        jar = _Jar()
+        jar.ops = (allow_d, trans_d, self._cstate, cseed, cseedval)
+        jar.taken = tuple(self._cseed_pending.keys())
+        jar.out = None
+        return jar
+
+    def _cmask_commit(self, jar) -> None:
+        """Adopt the call's updated per-slot DFA-state vector and
+        retire the seeds it consumed (seeds recorded AFTER the
+        snapshot — e.g. by a reseat racing a pipelined dispatch —
+        survive for the next call)."""
+        if jar.out is not None:
+            self._cstate = jar.out
+        for i in jar.taken:
+            self._cseed_pending.pop(i, None)
 
     # ------------------------------------------------------------------
     # HBM accounting (quant subsystem; backs the serving_param_bytes /
@@ -1692,7 +1996,8 @@ class InferenceEngine:
                kv: Optional[KVHandoff] = None,
                trace_ctx: Optional[dict] = None,
                tenant: Optional[str] = None,
-               priority: int = 0) -> RequestHandle:
+               priority: int = 0,
+               constrain=None) -> RequestHandle:
         """Admit one prompt. Raises OverloadError when the queue is full
         or the circuit breaker is open; in degraded mode the token
         budget is silently capped (reported via health()).
@@ -1722,6 +2027,46 @@ class InferenceEngine:
         continuous engines only — an engine that cannot adopt drops
         the handoff with a warning and re-prefills, which is slower
         but token-identical)."""
+        # grammar-constrained decoding (ISSUE-20): compile + validate
+        # OUTSIDE the admission lock (DFA construction is pure CPU
+        # work keyed by the grammar hash). Every failure mode is a
+        # typed ConstraintError raised HERE — a constrained request
+        # that admits never fails mid-decode for grammar reasons.
+        cgrammar = cspec = None
+        cconsumed = cstart = 0
+        if constrain is not None:
+            from deeplearning4j_tpu.serving.constrain import (
+                ConstraintError, compile_grammar, normalize_constraint)
+            prompt_a = np.asarray(prompt, np.int32)
+            try:
+                if not self._continuous:
+                    raise ConstraintError(
+                        "constrain= requires mode='continuous' (batch "
+                        "mode has no per-slot DFA state to carry "
+                        "across steps)", "mode")
+                cspec, cconsumed = normalize_constraint(constrain)
+                cgrammar = compile_grammar(
+                    cspec, int(self.cfg.vocab_size),
+                    state_cap=int(self.config.constrain_state_cap))
+                if cconsumed > int(prompt_a.size):
+                    raise ConstraintError(
+                        f"constrain consumed={cconsumed} exceeds the "
+                        f"prompt length {int(prompt_a.size)}",
+                        "invalid")
+                # a failover hop folds committed tokens into the
+                # prompt and reports them consumed: replaying the
+                # tail both validates it and recovers the DFA state
+                cstart = cgrammar.replay(
+                    prompt_a[prompt_a.size - cconsumed:]
+                    if cconsumed else ())
+                if cgrammar.is_terminal(cstart):
+                    raise ConstraintError(
+                        "grammar is already terminal at the start "
+                        "state: it would emit zero tokens", "empty")
+            except ConstraintError as e:
+                self._ensure_constrain_metrics()
+                self._m_c_rejections.labels(reason=e.reason).inc()
+                raise
         if kv is not None:
             adoptable = (self._continuous and self._paged
                          and kv.kv_mode == self._kv_mode
@@ -1796,6 +2141,24 @@ class InferenceEngine:
                         f"(kv_pages={self._num_pages}, page_size="
                         f"{self._page_size}) — it could never be "
                         "admitted")
+            cbase = 0
+            if cgrammar is not None:
+                # last admission check: reserve grammar rows in the
+                # fixed-shape mask table (refcounted — a resubmit of
+                # the same grammar is free). An overflow is the typed
+                # `oversize` reject, still at submit() time.
+                self._ensure_constrain()
+                from deeplearning4j_tpu.serving.constrain import \
+                    ConstraintError
+                try:
+                    cbase = self._ctab.acquire(cgrammar)
+                except ConstraintError as e:
+                    self._m_c_rejections.labels(reason=e.reason).inc()
+                    raise
+                if cgrammar.key not in self._cgrammar_keys:
+                    self._cgrammar_keys.add(cgrammar.key)
+                    self._m_c_compiles.inc()
+                self._m_c_requests.inc()
             handle = RequestHandle(
                 next(self._rids), prompt, eff,
                 now + deadline_s if deadline_s is not None else None,
@@ -1808,6 +2171,13 @@ class InferenceEngine:
             # the work was for
             handle.tenant = tenant
             handle.priority = priority
+            if cgrammar is not None:
+                handle._grammar = cgrammar
+                handle._cbase = cbase
+                handle._constrain = cspec   # JSON-able, consumed-free
+                handle._consumed = int(cconsumed)
+                handle._cinit = int(cstart)
+                handle._cstate_host = int(cstart)
             handle.trace = self.recorder.start_trace(handle.rid,
                                                      ctx=trace_ctx)
             handle._on_terminal = self._on_terminal
@@ -1818,7 +2188,11 @@ class InferenceEngine:
                             if deadline_s is not None else None),
                 **({"tenant": handle.tenant}
                    if handle.tenant is not None else {}),
-                **({"priority": priority} if priority else {}))
+                **({"priority": priority} if priority else {}),
+                **({"constrained": True,
+                    "grammar": cgrammar.key[:12],
+                    "dfa_states": cgrammar.num_states}
+                   if cgrammar is not None else {}))
             self._queue.append(handle)
             handle.trace.add("queued", depth=len(self._queue))
             self._cv.notify()
@@ -1850,6 +2224,10 @@ class InferenceEngine:
                 else "overload"), **bill)
         elif r.status == RequestStatus.QUARANTINED:
             r.trace.add("quarantined", **bill)
+        if r._grammar is not None and self._ctab is not None:
+            # drop the grammar's table refcount (rows stay resident
+            # for cache-friendly resubmits until space is needed)
+            self._ctab.release(r._grammar.key)
         self.slo.finished(r.trace)
 
     # ------------------------------------------------------------------
@@ -2137,6 +2515,14 @@ class InferenceEngine:
         (batch mode's first chunk is its first-token moment; without
         this, batch-mode TTFT would simply not exist)."""
         first = not r._generated
+        hit_terminal = False
+        if r._grammar is not None:
+            # host-authoritative DFA advance (ISSUE-20): the device
+            # mask made every token legal; the host walk is what
+            # DECIDES — it truncates past the accepting terminal and
+            # keeps r._cstate_host the single source of truth for
+            # reseat/failover replay
+            toks, hit_terminal = self._c_advance_commit(r, toks)
         r._generated.append(toks)
         ev = r.trace.add(kind, tokens=int(toks.shape[0]), **data)
         if first:
@@ -2148,6 +2534,15 @@ class InferenceEngine:
             # sites — a prefill_done's sampled token is prefill work)
             self.profiler.bill_tokens(r, self._decode_bill_label,
                                       int(toks.shape[0]), "decode")
+        if hit_terminal and not r.done():
+            r.trace.add("constraint", terminal=True,
+                        state=int(r._cstate_host))
+            self._m_c_terminal.inc()
+            # grammar complete -> EOS: finish now unless the caller's
+            # own `>= max_new_tokens` check is about to (then this
+            # _complete would double-fire — it is not idempotent)
+            if r.generated.shape[0] < r.max_new_tokens:
+                self._complete(r)
 
     # ------------------------------------------------------------------
     # continuous batching: slot-pool scheduling
@@ -2425,40 +2820,60 @@ class InferenceEngine:
             lastm[i] = (r._prefill_pos + n >= r._prefill_target)
         state = self._slot_state
         key = self._root_key()
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        cext = () if cjar is None else cjar.ops
+        fkw = (self._quant_kwargs() if cjar is None
+               else {**self._quant_kwargs(), **self._ckey_kw()})
         if self._paged:
             with self._lock:
                 self._ensure_writable(entries, prefill=True)
                 self._maybe_corrupt_page(entries, prefill=True)
                 bt = self._bt.copy()
                 state = self._slot_state
+            name, factory = (
+                ("paged_chunked_prefill", _compiled_paged_chunked_prefill)
+                if cjar is None else
+                ("paged_chunked_prefill_c",
+                 _compiled_paged_chunked_prefill_c))
             fn = self._resolve_program(
-                "paged_chunked_prefill", _compiled_paged_chunked_prefill,
+                name, factory,
                 (astuple(self.cfg), self.mesh, c, self._num_slots,
                  self._page_size, self._max_pages, self._num_pages,
                  float(self.config.temperature),
                  int(self.config.top_k), float(self.config.top_p)),
-                self._quant_kwargs(),
-                (params, *state, bt, toks, clen, start, lastm, key))
+                fkw,
+                (params, *state, bt, toks, clen, start, lastm, *cext,
+                 key))
             extra = (bt,)
         else:
+            name, factory = (
+                ("chunked_prefill", _compiled_chunked_prefill)
+                if cjar is None else
+                ("chunked_prefill_c", _compiled_chunked_prefill_c))
             fn = self._resolve_program(
-                "chunked_prefill", _compiled_chunked_prefill,
+                name, factory,
                 (astuple(self.cfg), self.mesh, c, self._num_slots,
                  float(self.config.temperature),
                  int(self.config.top_k), float(self.config.top_p)),
-                self._quant_kwargs(),
-                (params, *state, toks, clen, start, lastm, key))
+                fkw,
+                (params, *state, toks, clen, start, lastm, *cext,
+                 key))
             extra = ()
         n_state = len(state)
 
         def call():
             o = fn(params, *state, *extra, toks, clen, start, lastm,
-                   key)
+                   *cext, key)
+            if cjar is not None:
+                cjar.out, o = o[-1], o[:-1]
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         state, first = self._guarded(call, [r for _, r in entries],
                                      self._m_prefill_seconds,
                                      prefill=True, chunked=True)
+        if cjar is not None:
+            self._cmask_commit(cjar)
         self._slot_state = state
         # per-tenant prefill billing (ISSUE-15): the chunk tokens each
         # slot actually advanced this call (partial chunks bill to the
@@ -2547,6 +2962,13 @@ class InferenceEngine:
         outputs; returns the pending record to commit next tick (None
         when there was nothing to dispatch)."""
         self._pipe_in_state = self._slot_state
+        # constraint-state snapshot (ISSUE-20 x ISSUE-12): the DFA
+        # vector + unconsumed seeds BEFORE this tick's dispatches —
+        # the recovery point `_recover_failed_tick` restores alongside
+        # the KV snapshot, so a failed pipelined tick rolls the device
+        # DFA back to the last committed-consistent view
+        c_in = ((self._cstate, dict(self._cseed_pending))
+                if self._constrain_active else None)
         self._pipe_items = []
         self._pipe_defer = True
         try:
@@ -2560,7 +2982,7 @@ class InferenceEngine:
         if not items:
             return None
         return _PendingTick(items=items, in_state=self._pipe_in_state,
-                            n_active=n_active)
+                            n_active=n_active, c_in_state=c_in)
 
     def _sched_decoding(self) -> List[tuple]:
         """Slots eligible for this tick's decode dispatch under the
@@ -2807,6 +3229,16 @@ class InferenceEngine:
                         seen.add(id(r))
                         reqs.append(r)
         self._slot_state = prev.in_state
+        if prev.c_in_state is not None:
+            # roll the device DFA back with the KV: restore the
+            # pre-tick state vector, then merge seeds — snapshot
+            # first, so a seed recorded AFTER the dispatch (a reseat
+            # racing the failure) still wins
+            cstate, seeds = prev.c_in_state
+            self._cstate = cstate
+            merged = dict(seeds)
+            merged.update(self._cseed_pending)
+            self._cseed_pending = merged
         if self._prefix_cache is not None:
             flushed = self._prefix_cache.flush()
             if flushed:
@@ -2906,6 +3338,13 @@ class InferenceEngine:
                 if not adopted:
                     seated_order.append(r)
                 self._slots[i] = r
+                if self._constrain_active:
+                    # (re)seat: overwrite whatever DFA state the
+                    # slot's previous occupant left on device. The
+                    # seed is replayed from the COMMITTED prefix, so
+                    # requeue/failover/adoption resume token-exact;
+                    # unconstrained occupants seed row 0 (all-allow)
+                    self._cseed_pending[i] = self._c_state_for(r)
                 if self._spec:
                     # seat with the engine's CURRENT belief, not blind
                     # optimism: under adversarial traffic a stream of
@@ -3614,6 +4053,13 @@ class InferenceEngine:
             kw["kv_mode"] = self._kv_mode
         return kw
 
+    def _ckey_kw(self) -> dict:
+        """Masked-program cache key extension: masked programs lower
+        against this engine's ``[constrain_state_cap, V]`` tables, so
+        the cap is geometry — an engine with a custom cap must never
+        reuse an executable compiled for another cap's table shape."""
+        return {"constrain_cap": int(self.config.constrain_state_cap)}
+
     def _root_key(self):
         if self._key is None:
             import jax
@@ -3770,9 +4216,11 @@ class InferenceEngine:
         the program name, plus the bucket for admission prefills (the
         bucket ladder is log2-bounded) and K for speculative rounds —
         the geometries whose per-invocation cost genuinely differs."""
-        if program in ("prefill", "paged_prefill"):
+        if program in ("prefill", "paged_prefill", "prefill_c",
+                       "paged_prefill_c"):
             return f"{program}_b{int(fargs[2])}"
-        if program in ("spec_decode", "paged_spec_decode"):
+        if program in ("spec_decode", "paged_spec_decode",
+                       "spec_decode_c", "paged_spec_decode_c"):
             return f"{program}_k{int(fargs[2])}"
         return program
 
@@ -3785,9 +4233,13 @@ class InferenceEngine:
         K+1 window positions per slot."""
         if program in ("decode", "paged_decode", "prefill",
                        "paged_prefill", "chunked_prefill",
-                       "paged_chunked_prefill"):
+                       "paged_chunked_prefill", "decode_c",
+                       "paged_decode_c", "prefill_c",
+                       "paged_prefill_c", "chunked_prefill_c",
+                       "paged_chunked_prefill_c"):
             return int(fargs[2]) * int(fargs[3])
-        if program in ("spec_decode", "paged_spec_decode"):
+        if program in ("spec_decode", "paged_spec_decode",
+                       "spec_decode_c", "paged_spec_decode_c"):
             return (int(fargs[2]) + 1) * int(fargs[3])
         return None
 
@@ -3974,20 +4426,35 @@ class InferenceEngine:
             prompts[i, :pre.shape[0]] = pre
             plen[i] = pre.shape[0]
         key = self._root_key()
-        fn = self._resolve_program(
-            "prefill", _compiled_prefill,
-            (astuple(self.cfg), self.mesh, int(tb), self._num_slots,
-             float(self.config.temperature), int(self.config.top_k),
-             float(self.config.top_p)), self._quant_kwargs(),
-            (params, *state, prompts, plen, key))
+        fargs = (astuple(self.cfg), self.mesh, int(tb),
+                 self._num_slots, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "prefill", _compiled_prefill, fargs,
+                self._quant_kwargs(),
+                (params, *state, prompts, plen, key))
+        else:
+            fn = self._resolve_program(
+                "prefill_c", _compiled_prefill_c, fargs,
+                {**self._quant_kwargs(), **self._ckey_kw()},
+                (params, *state, prompts, plen, *cjar.ops, key))
         n_state = len(state)
 
         def call():
-            o = fn(params, *state, prompts, plen, key)
+            if cjar is None:
+                o = fn(params, *state, prompts, plen, key)
+            else:
+                o = fn(params, *state, prompts, plen, *cjar.ops, key)
+                cjar.out, o = o[-1], o[:-1]
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         out = self._guarded(call, [r for _, r in entries],
                             self._m_prefill_seconds, prefill=True)
+        if cjar is not None:
+            self._cmask_commit(cjar)
         # per-tenant prefill billing (ISSUE-15): every prompt token
         # this call actually computed, at this bucket's analytic rate
         for i, r in entries:
@@ -4010,21 +4477,37 @@ class InferenceEngine:
             rem[i] = (r.max_new_tokens - r.generated.shape[0]
                       - r._pending_n)
         key = self._root_key()
-        fn = self._resolve_program(
-            "decode", _compiled_decode_chunk,
-            (astuple(self.cfg), self.mesh, self._chunk,
-             self._num_slots, float(self.config.temperature),
-             int(self.config.top_k), float(self.config.top_p)),
-            self._quant_kwargs(), (params, *state, active, rem, key))
+        fargs = (astuple(self.cfg), self.mesh, self._chunk,
+                 self._num_slots, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "decode", _compiled_decode_chunk, fargs,
+                self._quant_kwargs(),
+                (params, *state, active, rem, key))
+        else:
+            fn = self._resolve_program(
+                "decode_c", _compiled_decode_chunk_c, fargs,
+                {**self._quant_kwargs(), **self._ckey_kw()},
+                (params, *state, active, rem, *cjar.ops, key))
         self._decode_bill_label = "decode"
         n_state = len(state)
 
         def call():
-            o = fn(params, *state, active, rem, key)
+            if cjar is None:
+                o = fn(params, *state, active, rem, key)
+            else:
+                o = fn(params, *state, active, rem, *cjar.ops, key)
+                cjar.out, o = o[-1], o[:-1]
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
-        return self._guarded(call, [r for _, r in entries],
-                             self._m_step_seconds)
+        out = self._guarded(call, [r for _, r in entries],
+                            self._m_step_seconds)
+        if cjar is not None:
+            self._cmask_commit(cjar)
+        return out
 
     def _call_prefill_paged(self, params, state, entries):
         """Paged admission prefill: each entry's NOT-YET-CACHED suffix
@@ -4053,21 +4536,38 @@ class InferenceEngine:
             slen[i] = tail.shape[0]
             start[i] = st
         key = self._root_key()
-        fn = self._resolve_program(
-            "paged_prefill", _compiled_paged_prefill,
-            (astuple(self.cfg), self.mesh, int(tb), self._num_slots,
-             self._page_size, self._max_pages, self._num_pages,
-             float(self.config.temperature), int(self.config.top_k),
-             float(self.config.top_p)), self._quant_kwargs(),
-            (params, *state, bt, suffix, slen, start, key))
+        fargs = (astuple(self.cfg), self.mesh, int(tb),
+                 self._num_slots, self._page_size, self._max_pages,
+                 self._num_pages, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "paged_prefill", _compiled_paged_prefill, fargs,
+                self._quant_kwargs(),
+                (params, *state, bt, suffix, slen, start, key))
+        else:
+            fn = self._resolve_program(
+                "paged_prefill_c", _compiled_paged_prefill_c, fargs,
+                {**self._quant_kwargs(), **self._ckey_kw()},
+                (params, *state, bt, suffix, slen, start, *cjar.ops,
+                 key))
         n_state = len(state)
 
         def call():
-            o = fn(params, *state, bt, suffix, slen, start, key)
+            if cjar is None:
+                o = fn(params, *state, bt, suffix, slen, start, key)
+            else:
+                o = fn(params, *state, bt, suffix, slen, start,
+                       *cjar.ops, key)
+                cjar.out, o = o[-1], o[:-1]
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
         out = self._guarded(call, [r for _, r in entries],
                             self._m_prefill_seconds, prefill=True)
+        if cjar is not None:
+            self._cmask_commit(cjar)
         # per-tenant prefill billing (ISSUE-15): the SUFFIX lengths —
         # prefix-cache hits bill only the tokens actually recomputed
         for i, r in entries:
@@ -4090,23 +4590,39 @@ class InferenceEngine:
             rem[i] = (r.max_new_tokens - r.generated.shape[0]
                       - r._pending_n)
         key = self._root_key()
-        fn = self._resolve_program(
-            "paged_decode", _compiled_paged_decode,
-            (astuple(self.cfg), self.mesh, self._chunk,
-             self._num_slots, self._page_size, self._max_pages,
-             self._num_pages, float(self.config.temperature),
-             int(self.config.top_k), float(self.config.top_p)),
-            self._quant_kwargs(), (params, *state, bt, active, rem,
-                                   key))
+        fargs = (astuple(self.cfg), self.mesh, self._chunk,
+                 self._num_slots, self._page_size, self._max_pages,
+                 self._num_pages, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "paged_decode", _compiled_paged_decode, fargs,
+                self._quant_kwargs(),
+                (params, *state, bt, active, rem, key))
+        else:
+            fn = self._resolve_program(
+                "paged_decode_c", _compiled_paged_decode_c, fargs,
+                {**self._quant_kwargs(), **self._ckey_kw()},
+                (params, *state, bt, active, rem, *cjar.ops, key))
         self._decode_bill_label = "paged_decode"
         n_state = len(state)
 
         def call():
-            o = fn(params, *state, bt, active, rem, key)
+            if cjar is None:
+                o = fn(params, *state, bt, active, rem, key)
+            else:
+                o = fn(params, *state, bt, active, rem, *cjar.ops,
+                       key)
+                cjar.out, o = o[-1], o[:-1]
             return tuple(o[:n_state]), self._out_sync(o[n_state])
 
-        return self._guarded(call, [r for _, r in entries],
-                             self._m_step_seconds)
+        out = self._guarded(call, [r for _, r in entries],
+                            self._m_step_seconds)
+        if cjar is not None:
+            self._cmask_commit(cjar)
+        return out
 
     def _cache_prefilled(self, entries) -> None:
         """After a successful paged prefill: insert each admitted
@@ -4284,25 +4800,42 @@ class InferenceEngine:
         poison = self._spec_poison(entries)
         key = self._root_key()
         dparams = self._draft_params
-        fn = self._resolve_program(
-            "spec_decode", _compiled_spec_decode,
-            (astuple(self.cfg), self.mesh, self._spec_cur_k,
-             self._num_slots, float(self.config.temperature),
-             int(self.config.top_k), float(self.config.top_p)),
-            dict(self._quant_kwargs(),
-                 draft_quantized=self._draft_qmode,
-                 draft_layers=self._draft_layers),
-            (params, dparams, *state, active, rem, poison, key))
+        fargs = (astuple(self.cfg), self.mesh, self._spec_cur_k,
+                 self._num_slots, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        fkw = dict(self._quant_kwargs(),
+                   draft_quantized=self._draft_qmode,
+                   draft_layers=self._draft_layers)
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "spec_decode", _compiled_spec_decode, fargs, fkw,
+                (params, dparams, *state, active, rem, poison, key))
+        else:
+            fn = self._resolve_program(
+                "spec_decode_c", _compiled_spec_decode_c, fargs,
+                {**fkw, **self._ckey_kw()},
+                (params, dparams, *state, active, rem, poison,
+                 *cjar.ops, key))
         self._decode_bill_label = f"spec_decode_k{self._spec_cur_k}"
         n_state = len(state)
 
         def call():
-            o = fn(params, dparams, *state, active, rem, poison, key)
+            if cjar is None:
+                o = fn(params, dparams, *state, active, rem, poison,
+                       key)
+            else:
+                o = fn(params, dparams, *state, active, rem, poison,
+                       *cjar.ops, key)
+                cjar.out, o = o[-1], o[:-1]
             return (tuple(o[:n_state]),
                     *self._out_sync_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
+        if cjar is not None:
+            self._cmask_commit(cjar)
         return state, toks, nc, drafted, accepted, poison
 
     def _call_spec_paged(self, params, state, entries):
@@ -4325,28 +4858,46 @@ class InferenceEngine:
         poison = self._spec_poison(entries)
         key = self._root_key()
         dparams = self._draft_params
-        fn = self._resolve_program(
-            "paged_spec_decode", _compiled_paged_spec_decode,
-            (astuple(self.cfg), self.mesh, self._spec_cur_k,
-             self._num_slots, self._page_size, self._max_pages,
-             self._num_pages, float(self.config.temperature),
-             int(self.config.top_k), float(self.config.top_p)),
-            dict(self._quant_kwargs(),
-                 draft_quantized=self._draft_qmode,
-                 draft_layers=self._draft_layers),
-            (params, dparams, *state, bt, active, rem, poison, key))
+        fargs = (astuple(self.cfg), self.mesh, self._spec_cur_k,
+                 self._num_slots, self._page_size, self._max_pages,
+                 self._num_pages, float(self.config.temperature),
+                 int(self.config.top_k), float(self.config.top_p))
+        fkw = dict(self._quant_kwargs(),
+                   draft_quantized=self._draft_qmode,
+                   draft_layers=self._draft_layers)
+        cjar = (self._cmask_begin() if self._constrain_active
+                else None)
+        if cjar is None:
+            fn = self._resolve_program(
+                "paged_spec_decode", _compiled_paged_spec_decode,
+                fargs, fkw,
+                (params, dparams, *state, bt, active, rem, poison,
+                 key))
+        else:
+            fn = self._resolve_program(
+                "paged_spec_decode_c", _compiled_paged_spec_decode_c,
+                fargs, {**fkw, **self._ckey_kw()},
+                (params, dparams, *state, bt, active, rem, poison,
+                 *cjar.ops, key))
         self._decode_bill_label = \
             f"paged_spec_decode_k{self._spec_cur_k}"
         n_state = len(state)
 
         def call():
-            o = fn(params, dparams, *state, bt, active, rem, poison,
-                   key)
+            if cjar is None:
+                o = fn(params, dparams, *state, bt, active, rem,
+                       poison, key)
+            else:
+                o = fn(params, dparams, *state, bt, active, rem,
+                       poison, *cjar.ops, key)
+                cjar.out, o = o[-1], o[:-1]
             return (tuple(o[:n_state]),
                     *self._out_sync_many(o[n_state:n_state + 4]))
 
         state, toks, nc, drafted, accepted = self._guarded(
             call, [r for _, r in entries], self._m_step_seconds)
+        if cjar is not None:
+            self._cmask_commit(cjar)
         return state, toks, nc, drafted, accepted, poison
 
     def _spec_update(self, occupied, drafted, accepted,
@@ -4466,6 +5017,20 @@ class InferenceEngine:
         decode chunks to completion. The position-keyed sampling
         schedule makes the continuation identical to what the pooled
         run would have produced."""
+        if not self._constrain_active:
+            return self._run_isolated_inner(r)
+        # scratch DFA vector to match the scratch KV pool: slot 0
+        # carries the request's committed-prefix replay, the live
+        # pool's states are untouched for when pooled traffic resumes
+        saved = (self._cstate, self._cseed_pending)
+        self._cstate = np.zeros((self._num_slots,), np.int32)
+        self._cseed_pending = {0: self._c_state_for(r)}
+        try:
+            return self._run_isolated_inner(r)
+        finally:
+            self._cstate, self._cseed_pending = saved
+
+    def _run_isolated_inner(self, r: RequestHandle) -> None:
         params = self._params
         state = init_slot_state(self.cfg, self.mesh, self._num_slots,
                                 kv_mode=self._kv_mode)

@@ -288,6 +288,13 @@ class FleetHandle:
         # the migrated cache-chain handoff the next dispatch ships
         self._chain_hashes: Dict[int, List[int]] = {}
         self._migrate_kv = None
+        # grammar constraint (ISSUE-20): the normalized consumed-free
+        # spec + the submit-time consumed count. Every dispatch hop
+        # recomputes `consumed` from how much committed prefix was
+        # folded into the hop's prompt, so a failover target replays
+        # the DFA to exactly the state the lost replica held
+        self._constrain: Optional[dict] = None
+        self._consumed0 = 0
         self._on_terminal: Optional[Callable] = None
         self._done = threading.Event()
 
@@ -872,6 +879,10 @@ class SubprocessReplica:
         priority = kw.pop("priority", 0)
         hold_kv = bool(kw.pop("hold_kv", False))
         kv = kw.pop("kv", None)
+        # grammar constraint (ISSUE-20): the spec dict is JSON-able
+        # by construction (normalize_constraint), so it crosses the
+        # pipe verbatim and the worker's engine compiles/validates it
+        constrain = kw.pop("constrain", None)
         if kw:
             log.warning("subprocess replica %d ignores submit "
                         "kwargs %s", self.id, sorted(kw))
@@ -890,6 +901,8 @@ class SubprocessReplica:
                # QoS class crosses the pipe too (ISSUE-16): the
                # worker's engine seats/preempts by it
                "priority": int(priority)}
+        if constrain is not None:
+            msg["constrain"] = constrain
         if hold_kv:
             msg["hold_kv"] = True
         if kv is not None:
@@ -1467,7 +1480,8 @@ class Router:
                deadline_s: Optional[float] = None,
                on_deadline: str = "shed",
                tenant: Optional[str] = None,
-               priority: int = 0) -> FleetHandle:
+               priority: int = 0,
+               constrain=None) -> FleetHandle:
         """Admit one prompt to the fleet. The submit-time deadline is
         stamped ABSOLUTE here and every later hop — dispatch, failover,
         hedge — carries only the remaining budget, so no retry can
@@ -1495,6 +1509,21 @@ class Router:
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token "
                              "array")
+        cspec = None
+        cconsumed = 0
+        if constrain is not None:
+            # ISSUE-20: typed validation at the ROUTER — an
+            # unsupported/invalid grammar raises ConstraintError here
+            # instead of bouncing off every replica as a shed. The
+            # compile is cache-shared with the replicas (same grammar
+            # hash), so it costs once per distinct grammar
+            from deeplearning4j_tpu.serving.constrain import (
+                compile_grammar, normalize_constraint)
+            cspec, cconsumed = normalize_constraint(constrain)
+            compile_grammar(
+                cspec,
+                int(self.cfg.vocab_size) if self.cfg is not None
+                else 256)
         now = self._clock()
         with self._lock:
             if not self._accepting:
@@ -1527,6 +1556,8 @@ class Router:
                 on_deadline)
             fr.tenant = tenant
             fr.priority = priority
+            fr._constrain = cspec
+            fr._consumed0 = int(cconsumed)
             tkey = tenant or "default"
             self._tenant_live[tkey] = (
                 self._tenant_live.get(tkey, 0) + 1)
@@ -2845,6 +2876,7 @@ class Router:
             kw["tenant"] = fr.tenant
         if fr.priority:
             kw["priority"] = fr.priority
+        kw.update(self._constrain_kw(fr, prompt))
         rep = ctl.replica
         if kv is not None:
             rep.last_wire = None
@@ -2857,6 +2889,21 @@ class Router:
             fr.trace.add("kvwire", direction="seed", outcome="ok",
                          bytes=lw["bytes"], seconds=lw["seconds"])
         return inner
+
+    @staticmethod
+    def _constrain_kw(fr: FleetHandle, prompt: np.ndarray) -> dict:
+        """The constraint spec a dispatch hop forwards (ISSUE-20):
+        the grammar plus a `consumed` count covering the submit-time
+        consumed tail AND every committed token folded into this
+        hop's prompt — the receiving engine replays that tail through
+        the DFA, so failover/requeue resume in exactly the state the
+        lost replica held."""
+        if fr._constrain is None:
+            return {}
+        return {"constrain": dict(
+            fr._constrain,
+            consumed=(fr._consumed0
+                      + int(prompt.shape[0] - fr.prompt.shape[0])))}
 
     def _prepare_failover(self, fr: FleetHandle,
                           ctl: _ReplicaCtl) -> None:

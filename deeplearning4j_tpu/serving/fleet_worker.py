@@ -232,7 +232,8 @@ def main() -> int:
                     hold_kv=hold, kv=kv,
                     trace_ctx=cmd.get("trace_ctx"),
                     tenant=cmd.get("tenant"),
-                    priority=int(cmd.get("priority") or 0))
+                    priority=int(cmd.get("priority") or 0),
+                    constrain=cmd.get("constrain"))
             except Exception as e:
                 emit({"ev": "rejected", "rid": rid,
                       "etype": type(e).__name__, "msg": str(e)})

@@ -1,0 +1,116 @@
+"""Grammar-constrained decoding demo: schema-valid JSON from a fleet.
+
+Builds a 2-replica in-process fleet behind the health-aware `Router`
+(deeplearning4j_tpu/serving/fleet.py) and submits requests whose
+outputs MUST satisfy a JSON schema — `submit(constrain=...)` compiles
+the schema into a token-level DFA (`serving/constrain.py`) whose
+allow-masks gate every sampling step as pure runtime data, so the
+engine's compiled-program set stays closed. The demo shows:
+
+- every constrained request decodes to bytes that `json.loads`
+  accepts and that match the declared property set — 100% of them,
+  by construction, not by luck;
+- a regex-constrained request alongside, truncated at its grammar's
+  terminal state (early completion before max_new_tokens);
+- unconstrained requests sharing the same slots, token-identical to
+  a constrain-free engine;
+- the `serving_constrained_*` scrape rows (requests, grammar
+  compiles, terminal completions, live DFA-table rows) and a typed
+  `ConstraintError` rejection for an unsupported pattern.
+
+Run: JAX_PLATFORMS=cpu python examples/constrained_serving.py
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from deeplearning4j_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig, init_params)
+from deeplearning4j_tpu.observability.export import (  # noqa: E402
+    prometheus_text)
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: E402
+    MeshSpec, make_mesh)
+from deeplearning4j_tpu.serving import (  # noqa: E402
+    ConstraintError, EngineConfig, FleetConfig, Router)
+
+#: The constrained token map is byte-level: token id i <-> bytes([i])
+#: for ids below 256, so decoded outputs ARE the UTF-8 text.
+VOCAB = 256
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "status": {"enum": ["ok", "retry", "dead"]},
+        "attempts": {"type": "integer"},
+        "fatal": {"type": "boolean"},
+    },
+}
+
+
+def main() -> None:
+    cfg = TransformerConfig(vocab_size=VOCAB, d_model=64, n_heads=4,
+                            n_layers=2, max_len=128)
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    router = Router(cfg=cfg, mesh=mesh, params=params, num_replicas=2,
+                    engine_config=EngineConfig(
+                        max_batch_size=4, max_new_tokens=48,
+                        decode_chunk=4, backoff_base_s=0.0),
+                    config=FleetConfig(restart_backoff_base_s=0.05))
+    try:
+        prompts = [rng.integers(0, VOCAB, 8).astype(np.int32)
+                   for _ in range(6)]
+        print("submitting 4 schema-constrained + 1 regex-constrained "
+              "+ 1 unconstrained request...\n")
+        schema_hs = [router.submit(
+            p, max_new_tokens=48,
+            constrain={"type": "json_schema", "schema": SCHEMA})
+            for p in prompts[:4]]
+        regex_h = router.submit(prompts[4], max_new_tokens=48,
+                                constrain="(GET|PUT) /[a-z]{1,8}")
+        free_h = router.submit(prompts[5], max_new_tokens=12)
+        router.run_pending()
+
+        valid = 0
+        for i, h in enumerate(schema_hs):
+            gen = h.result(0)[prompts[i].shape[0]:]
+            text = bytes(int(t) for t in gen).decode()
+            doc = json.loads(text)          # raises if not valid JSON
+            assert set(doc) <= set(SCHEMA["properties"])
+            valid += 1
+            print(f"  schema[{i}]: {text}")
+        print(f"\nschema-valid outputs: {valid}/{len(schema_hs)} "
+              "(json.loads + property check)")
+
+        gen = regex_h.result(0)[prompts[4].shape[0]:]
+        print(f"  regex : {bytes(int(t) for t in gen).decode()!r} "
+              f"({gen.shape[0]} tokens — terminal-truncated)")
+        gen = free_h.result(0)[prompts[5].shape[0]:]
+        print(f"  free  : {gen.tolist()} (unconstrained, "
+              "token-identical to a constrain-free engine)")
+
+        try:
+            router.submit(prompts[0], constrain="(?<=x)y")
+        except ConstraintError as e:
+            print(f"\nrejected at submit (reason={e.reason}): {e}")
+
+        print("\nconstrained scrape rows (replica 0):")
+        eng = router._ctls[0].replica.engine
+        for line in prometheus_text(eng.registry).splitlines():
+            if line.startswith("serving_constrained"):
+                print(f"  {line}")
+    finally:
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

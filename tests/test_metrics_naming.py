@@ -904,6 +904,75 @@ def test_elastic_series_pass_the_lint():
     check_cardinality(snap, budget=64)
 
 
+def test_constrained_series_pass_the_lint():
+    """The constrained-decoding series (ISSUE-20:
+    serving_constrained_{requests,grammar_compiles,
+    terminal_completions}_total counters, the reason-labeled
+    serving_constrained_rejections_total, and the
+    serving_constrained_states gauge) register LAZILY on the first
+    ``constrain=`` submission — a constrain-off engine's scrape must
+    not carry a single one of them — and once real constrained
+    traffic (a completion AND a typed rejection) materializes them
+    they pass the same naming rules as everything else."""
+    from deeplearning4j_tpu.observability.export import prometheus_text
+    from deeplearning4j_tpu.serving import ConstraintError
+
+    cfg = TransformerConfig(vocab_size=256, d_model=32, n_heads=4,
+                            n_layers=2, max_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(data=1, model=1))
+    ec = EngineConfig(decode_chunk=2, max_new_tokens=8,
+                      backoff_base_s=0.0)
+
+    # constrain-off: the lazy families never register — the scrape
+    # carries zero constrained series
+    off = InferenceEngine(cfg, mesh, params, ec)
+    off.submit(np.arange(8, dtype=np.int32))
+    off.run_pending()
+    assert "serving_constrained" not in prometheus_text(off.registry)
+
+    eng = InferenceEngine(cfg, mesh, params, ec)
+    h = eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=8,
+                   constrain="[ab]{1,5}")
+    with pytest.raises(ConstraintError):
+        eng.submit(np.arange(8, dtype=np.int32), constrain="a+?")
+    eng.run_pending()
+    assert h.done()
+    text = prometheus_text(eng.registry)
+    types = _types(text)
+    assert types["serving_constrained_requests_total"] == "counter"
+    assert types["serving_constrained_rejections_total"] == "counter"
+    assert types["serving_constrained_grammar_compiles_total"] \
+        == "counter"
+    assert types["serving_constrained_terminal_completions_total"] \
+        == "counter"
+    assert types["serving_constrained_states"] == "gauge"
+    # the traffic really exercised the families
+    assert "serving_constrained_requests_total 0" not in text
+    assert 'reason="unsupported"' in text
+    for name, kind in types.items():
+        assert SNAKE.match(name), f"{name}: not snake_case"
+        assert (kind == "counter") == name.endswith("_total"), name
+        if kind == "histogram":
+            assert (name.endswith(HIST_UNITS)
+                    or name in UNITLESS_HISTOGRAMS), name
+        if kind == "gauge":
+            assert not name.endswith(("_bucket", "_sum", "_count")), \
+                f"{name}: gauge name collides with histogram samples"
+    hist_samples = {f"{n}{s}" for n, k in types.items()
+                    if k == "histogram"
+                    for s in ("_bucket", "_sum", "_count")}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        assert m.group(1) in types or m.group(1) in hist_samples, \
+            f"{m.group(1)}: sample without a TYPE header"
+        for lab in LABEL.findall(m.group(3) or ""):
+            assert SNAKE.match(lab), f"label {lab!r} not snake_case"
+
+
 def test_lint_rejects_known_bad_names():
     """The rules themselves catch the drift they exist for."""
     for bad in ("servingTTFT", "serving-ttft", "2fast"):

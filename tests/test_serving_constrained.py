@@ -1,0 +1,417 @@
+"""Grammar-constrained decoding (ISSUE-20): token-DFA masks as
+runtime data, composed with every serving path.
+
+The tentpole guarantees, each proven deterministically on the CPU
+backend:
+
+- legality: across a 3-seed sweep, EVERY emitted token of a
+  constrained request is grammar-legal, and a request that hit its
+  grammar's terminal state ends in an ACCEPTING state (truncated at
+  terminal -> early completion, a typed ``constraint`` trace event);
+- off-path purity: an engine that never sees ``constrain=`` compiles
+  ZERO masked programs — its compile-cache keys and its emitted
+  tokens are byte-identical to the pre-constraint engine, even after
+  OTHER engines in the process have compiled masked programs;
+- composition: constrained decode is token-identical across the
+  whole config matrix — pipelined (the default), speculative,
+  paged, int8 KV, chunked prefill — vs the constrained synchronous
+  engine, 3 seeds;
+- recovery: a replica crash mid-constrained-decode fails over
+  token-exactly (the failover hop ships the spec + a ``consumed``
+  count, the target replays the committed prefix to the exact DFA
+  state), and an engine-local preempt/requeue (hot reload) resumes
+  the same way;
+- closure: mixed traffic over TWO grammars sharing slots with
+  unconstrained requests adds ZERO compiled programs once warm —
+  masks, transitions, and per-slot states are runtime operands
+  (helpers.assert_no_recompiles);
+- rejection: every unsupported construct, oversized table, invalid
+  spec, empty grammar, and batch-mode engine is refused at
+  ``submit()`` with a typed ``ConstraintError`` — never mid-decode —
+  and counted in ``serving_constrained_rejections{reason}``.
+"""
+import json
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+from deeplearning4j_tpu.parallel.failure import FleetFaultInjector
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.serving import (ConstraintError, EngineConfig,
+                                        FleetConfig, InferenceEngine,
+                                        RequestStatus, Router,
+                                        compile_grammar)
+from deeplearning4j_tpu.serving.engine import (
+    _compiled_chunked_prefill_c, _compiled_decode_chunk_c,
+    _compiled_paged_decode_c, _compiled_paged_prefill_c,
+    _compiled_paged_spec_decode_c, _compiled_prefill_c,
+    _compiled_spec_decode_c)
+from helpers import assert_no_recompiles
+
+#: Byte-level token map needs ids 0..255 <-> bytes([i]).
+CFG = TransformerConfig(vocab_size=256, d_model=32, n_heads=4,
+                        n_layers=2, max_len=64)
+
+#: Terminal after at most 5 tokens (every emitted byte is a/b).
+RX = "[ab]{1,5}"
+
+SEEDS = (0, 1, 2)
+
+_MASKED_CACHES = (
+    _compiled_prefill_c, _compiled_decode_chunk_c,
+    _compiled_chunked_prefill_c, _compiled_paged_prefill_c,
+    _compiled_paged_decode_c, _compiled_spec_decode_c,
+    _compiled_paged_spec_decode_c)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(MeshSpec(data=1, model=1))
+
+
+def _prompt(t0=6, seed=0):
+    return (np.arange(t0, dtype=np.int32) * (seed + 3)) % 50
+
+
+def _config(**kw):
+    base = dict(decode_chunk=2, max_new_tokens=8, backoff_base_s=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _gen(h):
+    """Generated suffix only (``result`` returns prompt+generated)."""
+    full = h.result(0)
+    return full[h.prompt.shape[0]:]
+
+
+def _counter(eng, name, **labels):
+    fam = eng.registry.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam._unlabeled()
+    return child.value
+
+
+# ---------------------------------------------------------------------------
+# legality + terminal semantics (satellite 3a)
+# ---------------------------------------------------------------------------
+
+def test_every_emitted_token_is_grammar_legal_3_seeds(params, mesh1):
+    """3-seed sweep: each emitted token is allowed by the DFA state
+    the host replays, and the terminal request ends ACCEPTING —
+    stopping early (5 < max_new_tokens) with a ``constraint`` trace
+    event and a terminal-completions count."""
+    g = compile_grammar(RX, CFG.vocab_size)
+    for seed in SEEDS:
+        eng = InferenceEngine(CFG, mesh1, params, _config(seed=seed))
+        h = eng.submit(_prompt(seed=seed), max_new_tokens=8,
+                       constrain=RX)
+        eng.run_pending()
+        assert h.status == RequestStatus.COMPLETED
+        toks = _gen(h)
+        st = 0
+        for t in toks:
+            assert g.allow[st, int(t)], (seed, st, int(t))
+            st = g.advance(st, int(t))
+        assert g.accepts(st), (seed, toks)
+        # {1,5} forces terminal at 5 -> early completion
+        assert toks.shape[0] == 5
+        assert "constraint" in h.trace.kinds()
+        assert _counter(
+            eng, "serving_constrained_terminal_completions") == 1
+        assert _counter(eng, "serving_constrained_requests") == 1
+
+
+def test_constrained_json_schema_output_parses(params, mesh1):
+    """A json_schema constraint yields bytes that json.loads accepts
+    and that validate against the declared property set."""
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"}}}
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_new_tokens=16))
+    h = eng.submit(_prompt(), max_new_tokens=16,
+                   constrain={"type": "json_schema", "schema": schema})
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    text = bytes(int(t) for t in _gen(h)).decode()
+    doc = json.loads(text)
+    assert set(doc) == {"ok"} and isinstance(doc["ok"], bool)
+
+
+# ---------------------------------------------------------------------------
+# off-path purity (satellite: constrain=None bit-identical, no new
+# compile keys)
+# ---------------------------------------------------------------------------
+
+def test_constrain_off_compiles_no_masked_programs(params, mesh1):
+    """An engine that never sees constrain= must not compile ANY
+    masked program (its compile-cache keys are the pre-constraint
+    set) and its ``serving_compiles`` labels carry no ``*_c``
+    program names."""
+    import re
+    before = [c.cache_info().currsize for c in _MASKED_CACHES]
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    hs = [eng.submit(_prompt(6 + 2 * (i % 2), i), max_new_tokens=6)
+          for i in range(3)]
+    eng.run_pending()
+    assert all(h.status == RequestStatus.COMPLETED for h in hs)
+    after = [c.cache_info().currsize for c in _MASKED_CACHES]
+    assert after == before
+    fam = eng.registry.get("serving_compiles")
+    labels = [values[0] for values, _ in fam.collect()]
+    assert labels and not any(
+        re.search(r"_c(_|$)", lb) for lb in labels), labels
+    # the constrained series never appear on a constrain-off engine
+    assert eng.registry.get("serving_constrained_requests") is None
+
+
+def test_constrain_off_tokens_unchanged_by_coresident(params, mesh1):
+    """Bit-identity two ways: (1) a constrain-off engine built AFTER
+    other engines compiled masked programs still matches a pristine
+    run; (2) an unconstrained request sharing slots with constrained
+    ones on an ACTIVE engine emits the very same tokens."""
+    plain = InferenceEngine(CFG, mesh1, params, _config())
+    hp = plain.submit(_prompt(), max_new_tokens=8)
+    plain.run_pending()
+    want = hp.result(0)
+
+    mixed = InferenceEngine(CFG, mesh1, params, _config())
+    hc = mixed.submit(_prompt(8, 1), max_new_tokens=8, constrain=RX)
+    hu = mixed.submit(_prompt(), max_new_tokens=8)
+    mixed.run_pending()
+    assert hc.status == RequestStatus.COMPLETED
+    np.testing.assert_array_equal(hu.result(0), want)
+
+
+# ---------------------------------------------------------------------------
+# composition matrix (satellite 3c): every config arm == sync engine
+# ---------------------------------------------------------------------------
+
+def _constrained_run(params, mesh, ec, n=2):
+    eng = InferenceEngine(CFG, mesh, params, ec)
+    hs = [eng.submit(_prompt(seed=i), max_new_tokens=8, constrain=RX)
+          for i in range(n)]
+    eng.run_pending()
+    assert all(h.status == RequestStatus.COMPLETED for h in hs)
+    return [_gen(h) for h in hs]
+
+
+@pytest.mark.parametrize("arm", [
+    dict(),                                      # pipelined default
+    dict(prefill_chunk=4),                       # chunked prefill
+    dict(spec_decode=True, spec_k=2, draft="self",
+         spec_adaptive=False),                   # speculative
+    dict(paged=True, page_size=8, spec_decode=True, spec_k=2,
+         draft="self", spec_adaptive=False),     # spec x paged
+])
+def test_constrained_matrix_token_identical_3_seeds(params, mesh1,
+                                                    arm):
+    """Constrained decode through each config arm reproduces the
+    constrained SYNCHRONOUS engine byte-for-byte, 3 seeds."""
+    for seed in SEEDS:
+        want = _constrained_run(params, mesh1,
+                                _config(seed=seed, pipeline=False))
+        got = _constrained_run(params, mesh1,
+                               _config(seed=seed, **arm))
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
+
+
+def test_constrained_int8_kv_matches_int8_sync(params, mesh1):
+    """int8 KV arm: constrained paged int8-KV decode == the
+    constrained synchronous int8-KV engine, token for token."""
+    for seed in SEEDS:
+        want = _constrained_run(
+            params, mesh1,
+            _config(seed=seed, pipeline=False, kv_quantize="int8"))
+        got = _constrained_run(
+            params, mesh1,
+            _config(seed=seed, kv_quantize="int8", paged=True,
+                    page_size=8))
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
+
+
+# ---------------------------------------------------------------------------
+# recovery (satellite 3d): failover + requeue replay the DFA
+# ---------------------------------------------------------------------------
+
+def test_fleet_failover_resumes_constrained_exactly(params, mesh1):
+    """Kill a replica mid-constrained-decode: the failover hop folds
+    the committed prefix into the prompt with ``consumed=``, the
+    target replays it to the exact DFA state, and every result is
+    byte-identical to an uninterrupted single-engine run."""
+    ref = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=2))
+    want = []
+    for i in range(3):
+        h = ref.submit(_prompt(seed=i), max_new_tokens=8,
+                       constrain=RX)
+        ref.run_pending()
+        want.append(h.result(0))
+    inj = FleetFaultInjector(kill_at={2: 0})
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=2,
+               engine_config=_config(max_batch_size=2),
+               fault_injector=inj,
+               config=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        hs = [r.submit(_prompt(seed=i), max_new_tokens=8,
+                       constrain=RX) for i in range(3)]
+        r.run_pending()
+        assert inj.kills_injected == 1
+        assert r.stats["failovers"] >= 1
+        for h, w in zip(hs, want):
+            np.testing.assert_array_equal(h.result(0), w)
+            assert h.status == RequestStatus.COMPLETED
+    finally:
+        r.close()
+
+
+def test_requeue_recomputes_dfa_and_resumes(tmp_path, params, mesh1):
+    """Engine-local preempt/requeue (hot reload under the SAME
+    weights): the committed prefix survives, the re-seated slot's DFA
+    state is recomputed from it, and the final stream equals an
+    uninterrupted constrained run."""
+    from deeplearning4j_tpu.util.checkpointing import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "w"), use_orbax=False)
+    mgr.save_tree(params, 1)
+
+    ref = InferenceEngine(CFG, mesh1, params, _config())
+    hr = ref.submit(_prompt(), max_new_tokens=8, constrain=RX)
+    ref.run_pending()
+    want = hr.result(0)
+
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    h = eng.submit(_prompt(), max_new_tokens=8, constrain=RX)
+    for _ in range(4):
+        eng.tick()
+        if h.generated.shape[0] > 0:
+            break
+    committed = h.generated.copy()
+    assert 0 < committed.shape[0] < 5
+    assert eng.reload_weights(mgr, step=1) == 1
+    assert eng.stats["preempted"] == 1
+    assert h.status == RequestStatus.QUEUED
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+    np.testing.assert_array_equal(
+        h.generated[:committed.shape[0]], committed)
+    np.testing.assert_array_equal(h.result(0), want)
+
+
+# ---------------------------------------------------------------------------
+# closure (satellite 4): mixed grammars, zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+def test_mixed_grammars_share_slots_no_recompiles(params, mesh1):
+    """Two grammars + unconstrained traffic sharing slots: after ONE
+    warm round the masked program set is closed — masks, transition
+    rows, and per-slot DFA states are runtime operands only."""
+    rx2 = "[cd]{2,6}"
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(max_batch_size=2))
+    warm = eng.submit(_prompt(), max_new_tokens=8, constrain=RX)
+    eng.run_pending()
+    assert warm.status == RequestStatus.COMPLETED
+    with assert_no_recompiles(_compiled_prefill_c,
+                              _compiled_decode_chunk_c):
+        hs = [eng.submit(_prompt(seed=i), max_new_tokens=8,
+                         constrain=(RX if i % 2 else rx2))
+              for i in range(3)]
+        hs.append(eng.submit(_prompt(), max_new_tokens=8))
+        eng.run_pending()
+    assert all(h.status == RequestStatus.COMPLETED for h in hs)
+    g2 = compile_grammar(rx2, CFG.vocab_size)
+    toks = _gen(hs[0])
+    assert g2.accepts(g2.replay(toks)), toks
+    # both grammars hold live rows in the fixed-shape table
+    assert _counter(eng, "serving_constrained_grammar_compiles") == 2
+    assert eng._ctab.rows_used > 0
+
+
+# ---------------------------------------------------------------------------
+# rejection (satellite 1): typed ConstraintError, always at submit()
+# ---------------------------------------------------------------------------
+
+def test_unsupported_constructs_rejected(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    for bad in (r"(?=a)b", r"a+?", r"^ab$"):
+        with pytest.raises(ConstraintError) as ei:
+            eng.submit(_prompt(), constrain=bad)
+        assert ei.value.reason == "unsupported"
+    with pytest.raises(ConstraintError) as ei:
+        eng.submit(_prompt(), constrain={
+            "type": "json_schema",
+            "schema": {"anyOf": [{"type": "null"}]}})
+    assert ei.value.reason == "unsupported"
+    assert _counter(eng, "serving_constrained_rejections",
+                    reason="unsupported") == 4
+    # rejection never admitted anything
+    assert _counter(eng, "serving_constrained_requests") == 0
+
+
+def test_oversize_table_rejected_with_documented_bound(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params,
+                          _config(constrain_state_cap=4))
+    with pytest.raises(ConstraintError, match="constrain_state_cap")\
+            as ei:
+        eng.submit(_prompt(), constrain="[ab]{1,64}")
+    assert ei.value.reason == "oversize"
+    assert _counter(eng, "serving_constrained_rejections",
+                    reason="oversize") == 1
+    # a small grammar still fits under the tiny cap
+    h = eng.submit(_prompt(), max_new_tokens=4, constrain="[ab]{1,2}")
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_batch_mode_engine_rejects_constrain(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config(mode="batch"))
+    with pytest.raises(ConstraintError, match="batch") as ei:
+        eng.submit(_prompt(), constrain=RX)
+    assert ei.value.reason == "mode"
+    # the engine still serves unconstrained work
+    h = eng.submit(_prompt(), max_new_tokens=4)
+    eng.run_pending()
+    assert h.status == RequestStatus.COMPLETED
+
+
+def test_invalid_and_empty_specs_rejected(params, mesh1):
+    eng = InferenceEngine(CFG, mesh1, params, _config())
+    with pytest.raises(ConstraintError) as ei:
+        eng.submit(_prompt(t0=2), constrain={
+            "type": "regex", "pattern": "ab", "consumed": 3})
+    assert ei.value.reason == "invalid"
+    with pytest.raises(ConstraintError) as ei:
+        eng.submit(_prompt(), constrain=42)
+    assert ei.value.reason == "invalid"
+    # prompt tail already completes the grammar -> zero tokens to emit
+    p = np.array([97], np.int32)
+    with pytest.raises(ConstraintError, match="zero tokens") as ei:
+        eng.submit(p, constrain={
+            "type": "regex", "pattern": "a", "consumed": 1})
+    assert ei.value.reason == "empty"
+
+
+def test_fleet_rejects_at_router_before_dispatch(params, mesh1):
+    """The Router validates the spec pre-dispatch: a bad constraint
+    never consumes a replica slot or a failover budget."""
+    r = Router(cfg=CFG, mesh=mesh1, params=params, num_replicas=1,
+               engine_config=_config(),
+               config=FleetConfig(restart_backoff_base_s=0.01))
+    try:
+        with pytest.raises(ConstraintError) as ei:
+            r.submit(_prompt(), constrain=r"a+?")
+        assert ei.value.reason == "unsupported"
+        assert r.stats["completed"] == 0
+    finally:
+        r.close()
